@@ -1,0 +1,57 @@
+"""Shared RS codec shell: encode/reconstruct orchestration over a
+matrix-apply backend (XLA bit-sliced or fused Pallas).
+
+Survivor selection and decode-matrix caching live here once so the two
+device backends cannot diverge. The TPU analogue of the reference's
+enc.Encode / enc.Reconstruct pair (weed/storage/erasure_coding/
+ec_encoder.go:214,267-277; weed/storage/store_ec.go:374-393).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class RSCodecBase:
+    """Encode / reconstruct for one RS(k, m) code.
+
+    `matrix_apply_factory(C) -> callable([k, n] bytes) -> [m, n] bytes`
+    supplies the device kernel for a fixed GF(2^8) matrix C.
+    """
+
+    def __init__(self, code, matrix_apply_factory):
+        self.code = code
+        self.k, self.m, self.n = code.k, code.m, code.n
+        self._factory = matrix_apply_factory
+        self._parity = matrix_apply_factory(code.parity_matrix)
+        self._decode_cache: dict = {}
+
+    def encode_parity(self, data: jax.Array) -> jax.Array:
+        """[k, n] data -> [m, n] parity (systematic: data shards unchanged)."""
+        return self._parity(data)
+
+    def encode(self, data: jax.Array) -> jax.Array:
+        """[k, n] data -> [k+m, n] shards."""
+        return jnp.concatenate([data, self.encode_parity(data)], axis=0)
+
+    def reconstruct(self, shards: dict[int, jax.Array],
+                    wanted: list[int] | None = None) -> dict[int, jax.Array]:
+        """Rebuild missing shards from any >= k survivors.
+
+        The first k survivor indices (sorted) feed the inverse matrix; the
+        matrix is cached per (survivors, wanted) pattern since failure
+        patterns are few in practice."""
+        present = tuple(sorted(shards))
+        if wanted is None:
+            wanted = [i for i in range(self.n) if i not in shards]
+        if not wanted:
+            return {}
+        key = (present[: self.k], tuple(wanted))
+        mat = self._decode_cache.get(key)
+        if mat is None:
+            mat = self._factory(self.code.decode_matrix(list(present), list(wanted)))
+            self._decode_cache[key] = mat
+        stack = jnp.stack([shards[i] for i in present[: self.k]], axis=0)
+        out = mat(stack)
+        return {w: out[i] for i, w in enumerate(wanted)}

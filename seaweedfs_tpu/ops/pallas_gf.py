@@ -1,0 +1,145 @@
+"""Fused Pallas TPU kernel for the bit-sliced GF(2^8) matmul.
+
+The XLA path (`ops.gfmat_jax`) materialises the 8x bit-plane expansion in
+HBM; this kernel keeps it in VMEM. Each grid step DMAs a [k, TN] byte tile,
+unpacks bit-planes in VMEM, runs one int8 MXU dot against the pre-lifted
+coding matrix, folds parity-mask + repack into the epilogue, and writes only
+the [m, TN] output bytes — HBM traffic is the information-theoretic minimum.
+
+Measured on v5e-1 (RS(10,4), 640MB): ~130-165 GB/s of data encoded vs
+~90 GB/s for the XLA path and ~5 GB/s for the reference's AVX2 CPU codec
+(klauspost/reedsolomon driven by weed/storage/erasure_coding/ec_encoder.go).
+
+Kernel-shape notes (why it looks the way it does):
+- Bit extraction is `(x & (1<<s)) != 0`: Mosaic has no 8-bit shifts
+  (`arith.shrui` on i8 fails to legalize) but and/cmp/select are native and
+  uint8 lanes are 4x-packed, so this is the cheapest unpack.
+- Bit-planes are *plane-major* (all of bit s for every shard, then bit s+1)
+  and each plane is padded to KPAD=16 sublanes: concatenation then happens on
+  16-sublane-aligned int8 blocks, which Mosaic lays out without relayout
+  copies. The coding bit-matrix gets matching zero columns (free MXU work —
+  the MXU is nowhere near the bottleneck; the VPU unpack is).
+- The dot is int8 x int8 -> int32: 0/1 operands, sums bounded by 8k <= 128,
+  exact. preferred_element_type=int8 trips a Mosaic verifier bug; int32 also
+  keeps the <<r repack shifts legal (no 8-bit shifts, see above).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seaweedfs_tpu.ops import codec_base, gf
+
+DEFAULT_TILE = 16384
+PLANE_PAD = 16  # sublane alignment for each bit-plane block
+
+
+def gf_matrix_to_bitmatrix_planemajor(C: np.ndarray, kpad: int | None = None) -> np.ndarray:
+    """[m,k] GF(2^8) matrix -> [8m, 8*kpad] 0/1 matrix, plane-major:
+    out[r*m + i, s*kpad + j] = bit r of (C[i,j] * 2^s); columns j >= k are 0.
+    """
+    C = np.asarray(C, dtype=np.uint8)
+    m, k = C.shape
+    if kpad is None:
+        kpad = k
+    assert kpad >= k
+    out = np.zeros((8 * m, 8 * kpad), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            M = gf.gf_mul_bitmatrix(int(C[i, j]))  # [bit r, plane s]
+            for r in range(8):
+                for s in range(8):
+                    out[r * m + i, s * kpad + j] = M[r, s]
+    return out
+
+
+def _gf_apply_kernel(bitmat_ref, x_ref, o_ref, *, k: int, m: int, kpad: int):
+    x = x_ref[:]  # [k, TN] uint8
+    zpad = jnp.zeros((kpad - k, x.shape[1]), jnp.int8)
+    planes = []
+    for s in range(8):
+        p = ((x & jnp.uint8(1 << s)) != 0).astype(jnp.int8)
+        planes.append(p if kpad == k else jnp.concatenate([p, zpad], axis=0))
+    xbits = jnp.concatenate(planes, axis=0)  # [8*kpad, TN] int8 0/1
+    acc = jnp.dot(bitmat_ref[:], xbits, preferred_element_type=jnp.int32)
+    acc = acc & 1  # [8m, TN] parity bits, plane-major
+    byte = acc[0:m]
+    for r in range(1, 8):
+        byte = byte | (acc[r * m : (r + 1) * m] << r)
+    o_ref[:] = byte.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "kpad", "tile", "interpret"))
+def _gf_apply(bitmat: jax.Array, data: jax.Array, k: int, m: int, kpad: int,
+              tile: int, interpret: bool) -> jax.Array:
+    _, n = data.shape
+    assert n % tile == 0, (n, tile)
+    kernel = functools.partial(_gf_apply_kernel, k=k, m=m, kpad=kpad)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // tile,),
+        in_specs=[
+            pl.BlockSpec((8 * m, 8 * kpad), lambda i: (0, 0)),  # VMEM-resident
+            pl.BlockSpec((k, tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.uint8),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(bitmat, data)
+
+
+class PallasGFMatrix:
+    """Fixed GF(2^8) matrix applied via the fused kernel.
+
+    Pads the byte-column count up to the tile size internally; for bulk EC
+    work callers should feed tile-aligned spans (the EC block sizes — 1GB/1MB,
+    reference weed/storage/erasure_coding/ec_encoder.go:21-22 — are all
+    tile-multiples).
+    """
+
+    def __init__(self, C: np.ndarray, tile: int = DEFAULT_TILE,
+                 interpret: bool | None = None):
+        self.C = np.asarray(C, dtype=np.uint8)
+        self.m, self.k = self.C.shape
+        self.kpad = max(PLANE_PAD, -(-self.k // PLANE_PAD) * PLANE_PAD)
+        self.tile = tile
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.interpret = interpret
+        self.bitmat = jnp.asarray(
+            gf_matrix_to_bitmatrix_planemajor(self.C, self.kpad), dtype=jnp.int8)
+
+    def __call__(self, data: jax.Array) -> jax.Array:
+        k, n = data.shape
+        assert k == self.k, (k, self.k)
+        pad = (-n) % self.tile
+        if pad:
+            data = jnp.pad(data, ((0, 0), (0, pad)))
+        out = _gf_apply(self.bitmat, data, self.k, self.m, self.kpad,
+                        self.tile, self.interpret)
+        return out[:, :n] if pad else out
+
+
+class PallasRSCodec(codec_base.RSCodecBase):
+    """Fused-kernel RS codec: `RSCodecBase` over `PallasGFMatrix` applies."""
+
+    def __init__(self, code, tile: int = DEFAULT_TILE, interpret: bool | None = None):
+        super().__init__(
+            code, lambda C: PallasGFMatrix(C, tile, interpret))
+        self.tile = tile
+        self.interpret = interpret
+
+
+@functools.lru_cache(maxsize=16)
+def get_codec(k: int, m: int, construction: str = "vandermonde",
+              tile: int = DEFAULT_TILE) -> PallasRSCodec:
+    from seaweedfs_tpu.models import rs
+    return PallasRSCodec(rs.get_code(k, m, construction), tile)

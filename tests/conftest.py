@@ -1,0 +1,30 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Must set env before the first `import jax` anywhere in the test process so
+multi-chip sharding tests (parallel/) exercise real collectives without TPU
+hardware. Benchmarks (`bench.py`) do NOT import this and run on the real chip.
+"""
+
+import os
+import sys
+import pathlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+REFERENCE_ROOT = pathlib.Path("/root/reference")
+
+
+def reference_fixture(relpath: str) -> pathlib.Path | None:
+    """Path to a binary test fixture inside the read-only reference checkout,
+    or None when the reference isn't mounted (tests then skip the golden
+    cross-checks and rely on self-generated fixtures)."""
+    p = REFERENCE_ROOT / relpath
+    return p if p.exists() else None

@@ -1,0 +1,86 @@
+"""Field + matrix algebra tests for ops.gf."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf.GF_EXP[gf.GF_LOG[a]] == a
+
+
+def test_mul_table_matches_carryless_polynomial_mul():
+    # independent slow definition: carry-less multiply then reduce mod 0x11D
+    def slow_mul(a, b):
+        prod = 0
+        for i in range(8):
+            if (b >> i) & 1:
+                prod ^= a << i
+        for bit in range(15, 7, -1):
+            if (prod >> bit) & 1:
+                prod ^= gf.POLY << (bit - 8)
+        return prod
+
+    rng = np.random.default_rng(0)
+    for _ in range(2000):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert gf.gf_mul(a, b) == slow_mul(a, b), (a, b)
+
+
+def test_field_axioms_samples():
+    rng = np.random.default_rng(1)
+    for _ in range(500):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf.gf_mul(a, b) == gf.gf_mul(b, a)
+        assert gf.gf_mul(a, gf.gf_mul(b, c)) == gf.gf_mul(gf.gf_mul(a, b), c)
+        # distributivity over xor (field addition)
+        assert gf.gf_mul(a, b ^ c) == gf.gf_mul(a, b) ^ gf.gf_mul(a, c)
+    for a in range(1, 256):
+        assert gf.gf_mul(a, gf.gf_inv(a)) == 1
+
+
+def test_matrix_inverse():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 5, 10):
+        for _ in range(5):
+            while True:
+                A = rng.integers(0, 256, (n, n)).astype(np.uint8)
+                try:
+                    Ainv = gf.gf_mat_inv(A)
+                    break
+                except ValueError:
+                    continue
+            assert np.array_equal(gf.gf_matmul(A, Ainv), np.eye(n, dtype=np.uint8))
+            assert np.array_equal(gf.gf_matmul(Ainv, A), np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    A = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf.gf_mat_inv(A)
+
+
+def test_bitmatrix_is_multiplication():
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        c, x = int(rng.integers(256)), int(rng.integers(256))
+        M = gf.gf_mul_bitmatrix(c)
+        xbits = np.array([(x >> s) & 1 for s in range(8)], dtype=np.uint8)
+        ybits = (M @ xbits) % 2
+        y = int(sum(int(b) << r for r, b in enumerate(ybits)))
+        assert y == gf.gf_mul(c, x), (c, x)
+
+
+def test_big_bitmatrix_matches_gf_matmul():
+    rng = np.random.default_rng(4)
+    C = rng.integers(0, 256, (4, 10)).astype(np.uint8)
+    X = rng.integers(0, 256, (10, 33)).astype(np.uint8)
+    want = gf.gf_matmul(C, X)
+
+    B = gf.gf_matrix_to_bitmatrix(C)  # [32, 80]
+    xbits = ((X[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(80, 33)
+    ybits = (B.astype(np.int64) @ xbits.astype(np.int64)) % 2
+    got = (ybits.reshape(4, 8, 33) << np.arange(8)[None, :, None]).sum(1).astype(np.uint8)
+    assert np.array_equal(got, want)

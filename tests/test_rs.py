@@ -1,0 +1,94 @@
+"""RS code construction + numpy codec property tests.
+
+Mirrors the reference's EC round-trip strategy
+(weed/storage/erasure_coding/ec_test.go: encode, then verify reconstruction
+from random k-of-n subsets)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models import rs
+from seaweedfs_tpu.ops import gf
+
+
+def test_default_matches_reference_constants():
+    assert rs.DATA_SHARDS == 10
+    assert rs.PARITY_SHARDS == 4
+    assert rs.TOTAL_SHARDS == 14
+
+
+@pytest.mark.parametrize("construction", ["vandermonde", "cauchy"])
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4), (16, 4), (2, 1), (4, 2)])
+def test_systematic_and_mds(k, m, construction):
+    code = rs.RSCode(k, m, construction)
+    assert code.matrix.shape == (k + m, k)
+    assert np.array_equal(code.matrix[:k], np.eye(k, dtype=np.uint8))
+    # MDS property (spot check): every sampled k-subset of rows is invertible
+    rng = np.random.default_rng(k * 31 + m)
+    subsets = itertools.combinations(range(k + m), k)
+    sampled = []
+    for i, s in enumerate(subsets):
+        if i < 50 or rng.random() < 0.05:
+            sampled.append(s)
+        if len(sampled) > 120:
+            break
+    for s in sampled:
+        gf.gf_mat_inv(code.matrix[list(s)])  # raises if singular
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (3, 2)])
+def test_encode_reconstruct_roundtrip(k, m):
+    code = rs.RSCode(k, m)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (k, 257)).astype(np.uint8)
+    shards = code.encode_numpy(data)
+    assert shards.shape == (k + m, 257)
+    assert np.array_equal(shards[:k], data)
+
+    for trial in range(8):
+        keep = sorted(rng.choice(k + m, size=k, replace=False).tolist())
+        present = {i: shards[i] for i in keep}
+        rebuilt = code.reconstruct_numpy(present)
+        for i in range(k + m):
+            got = present.get(i)
+            if got is None:
+                got = rebuilt[i]
+            assert np.array_equal(got, shards[i]), (trial, i)
+
+
+def test_reconstruct_needs_k_shards():
+    code = rs.RSCode(4, 2)
+    data = np.zeros((4, 8), dtype=np.uint8)
+    shards = code.encode_numpy(data)
+    with pytest.raises(ValueError):
+        code.reconstruct_numpy({0: shards[0], 1: shards[1], 2: shards[2]})
+
+
+def test_vandermonde_known_values():
+    # Golden bytes of the normalised Vandermonde construction (poly 0x11D,
+    # generator 2): accidental table/polynomial changes fail loudly here,
+    # protecting shard-format compatibility.
+    code = rs.RSCode(10, 4)
+    assert code.parity_matrix[0].tolist() == [
+        129, 150, 175, 184, 210, 196, 254, 232, 3, 2]
+    assert code.parity_matrix[1].tolist() == [
+        150, 129, 184, 175, 196, 210, 232, 254, 2, 3]
+    assert code.parity_matrix[:, 0].tolist() == [129, 150, 191, 214]
+    code63 = rs.RSCode(6, 3)
+    assert code63.parity_matrix.tolist() == [
+        [7, 6, 5, 4, 3, 2], [6, 7, 4, 5, 2, 3], [160, 223, 223, 183, 254, 232]]
+    # parity rows are dense (no zero coefficients) for RS(10,4)
+    assert (code.parity_matrix != 0).all()
+
+
+def test_parity_linear_in_data():
+    code = rs.RSCode(6, 3)
+    rng = np.random.default_rng(11)
+    a = rng.integers(0, 256, (6, 64)).astype(np.uint8)
+    b = rng.integers(0, 256, (6, 64)).astype(np.uint8)
+    pa = code.encode_numpy(a)[6:]
+    pb = code.encode_numpy(b)[6:]
+    pxor = code.encode_numpy(a ^ b)[6:]
+    assert np.array_equal(pa ^ pb, pxor)
