@@ -53,10 +53,9 @@ def cauchy_matrix(k: int, m: int) -> np.ndarray:
     """[k+m, k] systematic generator with a Cauchy parity block.
 
     Parity row i, col j = 1 / (x_i + y_j) with x_i = k + i, y_j = j; all
-    x_i, y_j distinct so every square submatrix is invertible.
+    x_i, y_j distinct so every square submatrix is invertible. (k+m <= 256
+    is validated by RSCode.__init__.)
     """
-    if k + m > 256:
-        raise ValueError("k+m must be <= 256 for GF(2^8)")
     mat = np.zeros((k + m, k), dtype=np.uint8)
     mat[:k] = np.eye(k, dtype=np.uint8)
     for i in range(m):
@@ -78,6 +77,8 @@ class RSCode:
                  construction: str = "vandermonde"):
         if k < 1 or m < 0:
             raise ValueError(f"bad RS({k},{m})")
+        if k + m > 256:
+            raise ValueError(f"RS({k},{m}): k+m must be <= 256 in GF(2^8)")
         self.k = k
         self.m = m
         self.n = k + m

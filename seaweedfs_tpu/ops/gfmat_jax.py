@@ -50,20 +50,23 @@ def pack_bits(y: jax.Array) -> jax.Array:
     return jnp.sum(y * weights, axis=1, dtype=jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("out_dtype",))
-def _bitsliced_apply(bitmat: jax.Array, data: jax.Array,
-                     out_dtype: jnp.dtype = jnp.uint8) -> jax.Array:
-    """y[m, n] = (C @ data) over GF(2^8), with bitmat the [8m, 8k] lift of C."""
+def bitsliced_apply_body(bitmat: jax.Array, data: jax.Array) -> jax.Array:
+    """y[m, n] = (C @ data) over GF(2^8), with bitmat the [8m, 8k] int8 lift
+    of C. Un-jitted body, shared by the single-device codec and the
+    shard_map per-device functions in parallel/mesh.py."""
     xbits = unpack_bits(data)
     # int8 x int8 -> int32 rides the MXU's integer path on v5e; values are
     # 0/1 so the popcount-parity sum is exact.
     acc = jax.lax.dot_general(
-        bitmat.astype(jnp.int8), xbits,
+        bitmat, xbits,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )
     ybits = jax.lax.bitwise_and(acc, 1)
-    return pack_bits(ybits).astype(out_dtype)
+    return pack_bits(ybits)
+
+
+_bitsliced_apply = jax.jit(bitsliced_apply_body)
 
 
 class JaxGFMatrix:
@@ -72,7 +75,7 @@ class JaxGFMatrix:
     def __init__(self, C: np.ndarray):
         self.C = np.asarray(C, dtype=np.uint8)
         self.m, self.k = self.C.shape
-        self.bitmat = jnp.asarray(gf.gf_matrix_to_bitmatrix(self.C))
+        self.bitmat = jnp.asarray(gf.gf_matrix_to_bitmatrix(self.C), dtype=jnp.int8)
 
     def __call__(self, data: jax.Array) -> jax.Array:
         """data [k, n] uint8 -> [m, n] uint8 product over GF(2^8)."""
