@@ -42,6 +42,8 @@ def vandermonde(rows: int, cols: int) -> np.ndarray:
 
 def systematic_vandermonde_matrix(k: int, m: int) -> np.ndarray:
     """[k+m, k] systematic generator: vm @ inv(vm[:k]). Top k rows == I."""
+    if k + m > 256:
+        raise ValueError(f"RS({k},{m}): k+m must be <= 256 in GF(2^8)")
     vm = vandermonde(k + m, k)
     top_inv = gf.gf_mat_inv(vm[:k])
     mat = gf.gf_matmul(vm, top_inv)
@@ -53,9 +55,10 @@ def cauchy_matrix(k: int, m: int) -> np.ndarray:
     """[k+m, k] systematic generator with a Cauchy parity block.
 
     Parity row i, col j = 1 / (x_i + y_j) with x_i = k + i, y_j = j; all
-    x_i, y_j distinct so every square submatrix is invertible. (k+m <= 256
-    is validated by RSCode.__init__.)
+    x_i, y_j distinct so every square submatrix is invertible.
     """
+    if k + m > 256:
+        raise ValueError(f"RS({k},{m}): k+m must be <= 256 in GF(2^8)")
     mat = np.zeros((k + m, k), dtype=np.uint8)
     mat[:k] = np.eye(k, dtype=np.uint8)
     for i in range(m):

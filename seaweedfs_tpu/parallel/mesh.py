@@ -19,8 +19,6 @@ on a real multi-chip slice and on the virtual CPU mesh used in tests.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -33,8 +31,11 @@ from seaweedfs_tpu.ops import gf, gfmat_jax
 def make_mesh(n_devices: int | None = None,
               axis_names: tuple[str, ...] = ("data",),
               shape: tuple[int, ...] | None = None) -> Mesh:
-    """Build a Mesh over the first n_devices (default: all)."""
+    """Build a Mesh over the first n_devices (default: all devices, or
+    prod(shape) when an explicit shape is given)."""
     devs = jax.devices()
+    if n_devices is None and shape is not None:
+        n_devices = int(np.prod(shape))
     if n_devices is not None:
         devs = devs[:n_devices]
     if shape is None:
@@ -76,9 +77,11 @@ class ShardedRSEncoder:
             mesh=mesh, in_specs=(P(), P(None, col_axis)),
             out_specs=P(None, col_axis)))
 
+        self._placement_groups: int | None = None
         if vol_axis is not None:
             D = mesh.shape[vol_axis]
             S = -(-self.n_shards // D) * D
+            self._placement_groups = S
             pad_rows = S - self.n_shards
 
             def _enc_place(bm, vols):  # vols: [Vl, k, nl]
@@ -121,9 +124,8 @@ class ShardedRSEncoder:
 
     def placement_groups(self) -> int:
         """Shard rows are padded so every device gets an equal group."""
-        assert self.vol_axis is not None
-        D = self.mesh.shape[self.vol_axis]
-        return -(-self.n_shards // D) * D
+        assert self._placement_groups is not None, "construct with vol_axis="
+        return self._placement_groups
 
     def encode_batch_place(self, volumes: jax.Array) -> jax.Array:
         """[V, k, n] -> [V, S_pad, n] where the shard dimension is sharded

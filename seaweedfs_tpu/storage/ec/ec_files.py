@@ -1,0 +1,220 @@
+"""EC shard file generation / rebuild / decode — the TPU data plane.
+
+The reference streams 10x256KB buffers through a CPU SIMD encoder
+(weed/storage/erasure_coding/ec_encoder.go:120-235). Here each batch is a
+[10, B] uint8 matrix shipped to the device once and erasure-coded by the
+bit-sliced MXU codec; B defaults to 16MB per shard (160MB per batch) so the
+kernel runs deep in its throughput regime and host<->device transfers
+amortise. Data shards are written straight from the host buffer — only
+parity ([4, B]) comes back from the device.
+
+Functions mirror the reference's capability surface:
+  write_ec_files      <- WriteEcFiles (ec_encoder.go:56)
+  rebuild_ec_files    <- RebuildEcFiles (ec_encoder.go:91)
+  write_sorted_ecx    <- WriteSortedFileFromIdx (ec_encoder.go:27)
+  write_dat_file      <- WriteDatFile (ec_decoder.go:153)
+  write_idx_from_ecx  <- WriteIdxFileFromEcIndex (ec_decoder.go:18)
+  find_dat_file_size  <- FindDatFileSize (ec_decoder.go:48)
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from seaweedfs_tpu.storage import idx as idxf
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.ec import layout
+
+DEFAULT_BATCH = 16 * 1024 * 1024  # bytes per shard per device round-trip
+
+
+def _get_codec():
+    import jax
+
+    from seaweedfs_tpu.ops import gfmat_jax, pallas_gf
+    if jax.default_backend() == "tpu":
+        return pallas_gf.get_codec(layout.DATA_SHARDS, layout.PARITY_SHARDS)
+    return gfmat_jax.get_codec(layout.DATA_SHARDS, layout.PARITY_SHARDS)
+
+
+def _encode_parity_batch(codec, batch: np.ndarray) -> np.ndarray:
+    """[10, B] host bytes -> [4, B] parity bytes via the device codec."""
+    import jax.numpy as jnp
+    return np.asarray(codec.encode_parity(jnp.asarray(batch)))
+
+
+def write_ec_files(base: str, dat_path: str | None = None,
+                   large_block: int = layout.LARGE_BLOCK_SIZE,
+                   small_block: int = layout.SMALL_BLOCK_SIZE,
+                   batch_size: int = DEFAULT_BATCH) -> None:
+    """Encode `<base>.dat` (or dat_path) into `<base>.ec00` .. `.ec13`."""
+    dat_path = dat_path or base + ".dat"
+    dat_size = os.path.getsize(dat_path)
+    codec = _get_codec()
+
+    outputs = [open(base + layout.to_ext(i), "wb")
+               for i in range(layout.TOTAL_SHARDS)]
+    try:
+        with open(dat_path, "rb") as dat:
+            processed = 0
+            remaining = dat_size
+            while remaining > large_block * layout.DATA_SHARDS:
+                _encode_row(codec, dat, dat_size, processed, large_block,
+                            batch_size, outputs)
+                processed += large_block * layout.DATA_SHARDS
+                remaining -= large_block * layout.DATA_SHARDS
+            while remaining > 0:
+                _encode_row(codec, dat, dat_size, processed, small_block,
+                            batch_size, outputs)
+                processed += small_block * layout.DATA_SHARDS
+                remaining -= small_block * layout.DATA_SHARDS
+    finally:
+        for f in outputs:
+            f.close()
+
+
+def _encode_row(codec, dat, dat_size: int, row_start: int, block: int,
+                batch_size: int, outputs) -> None:
+    """Encode one 10-wide row of `block`-sized blocks in column batches."""
+    step = min(batch_size, block)
+    assert block % step == 0, (block, step)
+    for col in range(0, block, step):
+        batch = np.zeros((layout.DATA_SHARDS, step), dtype=np.uint8)
+        for j in range(layout.DATA_SHARDS):
+            off = row_start + j * block + col
+            n = max(0, min(step, dat_size - off))
+            if n > 0:
+                dat.seek(off)
+                raw = dat.read(n)
+                batch[j, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        parity = _encode_parity_batch(codec, batch)
+        for i in range(layout.TOTAL_SHARDS):
+            buf = batch[i] if i < layout.DATA_SHARDS else parity[i - layout.DATA_SHARDS]
+            outputs[i].write(buf.tobytes())
+
+
+def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH) -> list[int]:
+    """Regenerate whichever `.ecXX` files are missing from the >=10 present
+    ones. Returns the rebuilt shard ids."""
+    present = [i for i in range(layout.TOTAL_SHARDS)
+               if os.path.exists(base + layout.to_ext(i))]
+    missing = [i for i in range(layout.TOTAL_SHARDS) if i not in present]
+    if not missing:
+        return []
+    if len(present) < layout.DATA_SHARDS:
+        raise ValueError(
+            f"need >= {layout.DATA_SHARDS} shards to rebuild, have {len(present)}")
+    import jax.numpy as jnp
+    codec = _get_codec()
+    use = present[: layout.DATA_SHARDS]
+    shard_size = os.path.getsize(base + layout.to_ext(use[0]))
+
+    ins = {i: open(base + layout.to_ext(i), "rb") for i in use}
+    outs = {i: open(base + layout.to_ext(i), "wb") for i in missing}
+    try:
+        for off in range(0, shard_size, batch_size):
+            n = min(batch_size, shard_size - off)
+            stack = np.zeros((layout.DATA_SHARDS, n), dtype=np.uint8)
+            for row, i in enumerate(use):
+                ins[i].seek(off)
+                stack[row] = np.frombuffer(ins[i].read(n), dtype=np.uint8)
+            shards = {i: jnp.asarray(stack[row]) for row, i in enumerate(use)}
+            rebuilt = codec.reconstruct(shards, wanted=missing)
+            for i in missing:
+                outs[i].write(np.asarray(rebuilt[i]).tobytes())
+    finally:
+        for f in ins.values():
+            f.close()
+        for f in outs.values():
+            f.close()
+    return missing
+
+
+def write_dat_file(base: str, dat_size: int,
+                   large_block: int = layout.LARGE_BLOCK_SIZE,
+                   small_block: int = layout.SMALL_BLOCK_SIZE) -> None:
+    """`.ec00`-`.ec09` -> `<base>.dat` (row-major interleave copy)."""
+    rows = layout.n_large_rows(dat_size, large_block, small_block)
+    ins = [open(base + layout.to_ext(i), "rb")
+           for i in range(layout.DATA_SHARDS)]
+    written = 0
+    try:
+        with open(base + ".dat", "wb") as dat:
+            for r in range(rows):
+                for j in range(layout.DATA_SHARDS):
+                    ins[j].seek(r * large_block)
+                    n = min(large_block, dat_size - written)
+                    if n <= 0:
+                        return
+                    dat.write(ins[j].read(n))
+                    written += n
+            small_base = rows * large_block
+            r = 0
+            while written < dat_size:
+                for j in range(layout.DATA_SHARDS):
+                    ins[j].seek(small_base + r * small_block)
+                    n = min(small_block, dat_size - written)
+                    if n <= 0:
+                        return
+                    dat.write(ins[j].read(n))
+                    written += n
+                r += 1
+    finally:
+        for f in ins:
+            f.close()
+
+
+def write_sorted_ecx(idx_path: str, ecx_path: str | None = None) -> None:
+    """.idx -> .ecx: same 16-byte entries, sorted by needle id ascending.
+    Later entries for a duplicate id win (the .idx is a log)."""
+    ecx_path = ecx_path or idx_path[: -len(".idx")] + ".ecx"
+    with open(idx_path, "rb") as f:
+        data = f.read()
+    ids, offs, sizes = idxf.read_columns(data)
+    # last occurrence of each id wins: stable-sort by (id, position)
+    order = np.argsort(ids, kind="stable")
+    with open(ecx_path, "wb") as out:
+        for i in order.tolist():
+            out.write(idxf.pack_entry(int(ids[i]), int(offs[i]), int(sizes[i])))
+
+
+def write_idx_from_ecx(ecx_path: str, idx_path: str | None = None) -> None:
+    """.ecx (+ replayed .ecj tombstones) -> .idx for decode-to-volume."""
+    idx_path = idx_path or ecx_path[: -len(".ecx")] + ".idx"
+    ecj_path = ecx_path[: -len(".ecx")] + ".ecj"
+    deleted = read_ecj(ecj_path)
+    with open(ecx_path, "rb") as f:
+        data = f.read()
+    ids, offs, sizes = idxf.read_columns(data)
+    with open(idx_path, "wb") as out:
+        for nid, off, size in zip(ids.tolist(), offs.tolist(), sizes.tolist()):
+            out.write(idxf.pack_entry(nid, off, size))
+        for nid in deleted:
+            out.write(idxf.pack_entry(nid, 0, t.TOMBSTONE_FILE_SIZE))
+
+
+def find_dat_file_size(base: str) -> int:
+    """Recover the original .dat size: max end offset of live .ecx entries
+    (reference: ec_decoder.go:48-70)."""
+    version = t.CURRENT_VERSION
+    with open(base + ".ecx", "rb") as f:
+        data = f.read()
+    ids, offs, sizes = idxf.read_columns(data)
+    max_end = 0
+    for off, size in zip(offs.tolist(), sizes.tolist()):
+        if t.size_is_valid(size):
+            end = t.from_offset_units(off) + t.actual_size(size, version)
+            max_end = max(max_end, end)
+    return max_end
+
+
+def read_ecj(ecj_path: str) -> list[int]:
+    """Deletion journal: 8-byte big-endian needle ids, appended per delete."""
+    if not os.path.exists(ecj_path):
+        return []
+    with open(ecj_path, "rb") as f:
+        data = f.read()
+    n = len(data) // 8
+    return [int.from_bytes(data[i * 8:(i + 1) * 8], "big") for i in range(n)]

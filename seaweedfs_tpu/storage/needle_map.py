@@ -1,0 +1,101 @@
+"""In-RAM needle map: needleId -> (offset, size) per volume, plus the
+bookkeeping metrics the master heartbeat needs.
+
+The reference offers compact-sectioned arrays, leveldb, and sorted-file
+variants (weed/storage/needle_map/compact_map.go, needle_map_leveldb.go);
+here one dict-backed map covers the in-memory kind — CPython dicts are
+open-addressing tables, i.e. already the compact-map idea — and the
+metrics/persistence contract matches so other kinds can slot in later.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import BinaryIO, Iterator
+
+from seaweedfs_tpu.storage import idx, types as t
+
+
+class NeedleMap:
+    """needleId -> (offset_units, size) with live/deleted accounting
+    (metric semantics follow weed/storage/needle_map_metric.go)."""
+
+    def __init__(self) -> None:
+        self._m: dict[int, tuple[int, int]] = {}
+        self.file_count = 0
+        self.deleted_count = 0
+        self.deleted_bytes = 0
+        self.maximum_key = 0
+        self._idx_file: BinaryIO | None = None
+
+    # -- core ----------------------------------------------------------
+
+    def put(self, needle_id: int, offset_units: int, size: int) -> None:
+        old = self._m.get(needle_id)
+        if old is not None and t.size_is_valid(old[1]):
+            self.deleted_count += 1
+            self.deleted_bytes += old[1]
+        self._m[needle_id] = (offset_units, size)
+        self.file_count += 1
+        self.maximum_key = max(self.maximum_key, needle_id)
+        if self._idx_file is not None:
+            self._idx_file.write(idx.pack_entry(needle_id, offset_units, size))
+
+    def get(self, needle_id: int) -> tuple[int, int] | None:
+        v = self._m.get(needle_id)
+        if v is None or not t.size_is_valid(v[1]):
+            return None
+        return v
+
+    def delete(self, needle_id: int) -> int:
+        """Tombstone the entry; returns the freed byte count (0 if absent)."""
+        old = self._m.get(needle_id)
+        if old is None or not t.size_is_valid(old[1]):
+            return 0
+        self._m[needle_id] = (old[0], t.TOMBSTONE_FILE_SIZE)
+        self.deleted_count += 1
+        self.deleted_bytes += old[1]
+        if self._idx_file is not None:
+            self._idx_file.write(
+                idx.pack_entry(needle_id, old[0], t.TOMBSTONE_FILE_SIZE))
+        return old[1]
+
+    def __len__(self) -> int:
+        return sum(1 for v in self._m.values() if t.size_is_valid(v[1]))
+
+    def items(self) -> Iterator[tuple[int, tuple[int, int]]]:
+        return iter(self._m.items())
+
+    @property
+    def content_size(self) -> int:
+        return sum(v[1] for v in self._m.values() if t.size_is_valid(v[1]))
+
+    # -- persistence -----------------------------------------------------
+
+    def attach_idx(self, f: BinaryIO) -> None:
+        """Subsequent put/delete calls append entries to this .idx file."""
+        self._idx_file = f
+
+    def flush(self) -> None:
+        if self._idx_file is not None:
+            self._idx_file.flush()
+            os.fsync(self._idx_file.fileno())
+
+    @classmethod
+    def load_from_idx(cls, path: str) -> "NeedleMap":
+        nm = cls()
+        if not os.path.exists(path):
+            return nm
+        with open(path, "rb") as f:
+            data = f.read()
+        ids, offs, sizes = idx.read_columns(data)
+        for nid, off, size in zip(ids.tolist(), offs.tolist(), sizes.tolist()):
+            if t.size_is_valid(size):
+                nm.put(nid, off, size)
+            else:  # tombstone entry replayed from the log
+                old = nm._m.get(nid)
+                if old is not None and t.size_is_valid(old[1]):
+                    nm.deleted_count += 1
+                    nm.deleted_bytes += old[1]
+                nm._m[nid] = (old[0] if old is not None else off, size)
+        return nm

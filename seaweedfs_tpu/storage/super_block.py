@@ -1,0 +1,56 @@
+"""Volume super block: the first 8 bytes of every .dat file.
+
+Byte 0 version, byte 1 replica-placement code, bytes 2-3 TTL, bytes 4-5
+compaction revision (big-endian), bytes 6-7 length of an optional protobuf
+extra section (reference: weed/storage/super_block/super_block.go:16-65).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.storage import types as t
+
+SUPER_BLOCK_SIZE = 8
+
+
+@dataclass
+class SuperBlock:
+    version: int = t.CURRENT_VERSION
+    replica_placement: t.ReplicaPlacement = field(
+        default_factory=t.ReplicaPlacement)
+    ttl: t.TTL = field(default_factory=t.TTL)
+    compaction_revision: int = 0
+    extra: bytes = b""
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(SUPER_BLOCK_SIZE)
+        out[0] = self.version
+        out[1] = self.replica_placement.to_byte()
+        out[2:4] = self.ttl.to_bytes()
+        struct.pack_into(">H", out, 4, self.compaction_revision)
+        if self.extra:
+            struct.pack_into(">H", out, 6, len(self.extra))
+            return bytes(out) + self.extra
+        return bytes(out)
+
+    @property
+    def block_size(self) -> int:
+        return SUPER_BLOCK_SIZE + len(self.extra)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "SuperBlock":
+        if len(b) < SUPER_BLOCK_SIZE:
+            raise ValueError("super block truncated")
+        version = b[0]
+        if version not in (t.VERSION1, t.VERSION2, t.VERSION3):
+            raise ValueError(f"unsupported volume version {version}")
+        (rev,) = struct.unpack_from(">H", b, 4)
+        (extra_size,) = struct.unpack_from(">H", b, 6)
+        extra = bytes(b[SUPER_BLOCK_SIZE: SUPER_BLOCK_SIZE + extra_size]) if extra_size else b""
+        return cls(version=version,
+                   replica_placement=t.ReplicaPlacement.from_byte(b[1]),
+                   ttl=t.TTL.from_bytes(b[2:4]),
+                   compaction_revision=rev,
+                   extra=extra)

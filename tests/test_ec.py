@@ -1,0 +1,242 @@
+"""EC pipeline tests, modelled on the reference's ec_test.go: encode a
+volume with tiny block sizes (large=10000, small=100), verify every needle
+byte-equal when read back from shards, including via reconstruction from
+k-of-n subsets; plus layout-math unit tests and the full
+encode->rebuild->decode cycle on the reference's checked-in fixture volume."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import needle as ndl
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.ec import ec_files, ec_volume, layout
+from seaweedfs_tpu.storage.volume import Volume
+
+from conftest import reference_fixture
+
+LARGE, SMALL = 10000, 100  # test block sizes (reference ec_test.go:16-19)
+
+
+# ---- layout math ------------------------------------------------------
+
+def test_locate_small_only():
+    dat_size = 9971  # < one large row
+    ivs = layout.locate_data(LARGE, SMALL, dat_size, 0, dat_size)
+    # 9971 bytes = 99 full small blocks + 71
+    assert sum(iv.size for iv in ivs) == dat_size
+    assert all(not iv.is_large_block for iv in ivs)
+    assert len(ivs) == 100
+
+
+def test_locate_straddles_large_to_small():
+    dat_size = LARGE * layout.DATA_SHARDS + 500  # 1 large row + change
+    # the byte range crossing the large/small boundary
+    ivs = layout.locate_data(LARGE, SMALL, dat_size, LARGE * 10 - 50, 100)
+    assert sum(iv.size for iv in ivs) == 100
+    assert ivs[0].is_large_block and not ivs[1].is_large_block
+    assert ivs[0].size == 50
+    sid0, off0 = ivs[0].to_shard_id_and_offset(LARGE, SMALL)
+    sid1, off1 = ivs[1].to_shard_id_and_offset(LARGE, SMALL)
+    assert sid0 == 9 and off0 == LARGE - 50
+    assert sid1 == 0 and off1 == LARGE  # first small block sits after larges
+
+
+def test_locate_consistent_with_encode_loop_everywhere():
+    """Property: for any dat size — including the window where the
+    reference's own nLargeBlockRows formula disagrees with its encode loop —
+    locate_data maps every sampled byte to the exact (shard, offset) the
+    encode loop would have written it to."""
+    rng = np.random.default_rng(99)
+    sizes = [1, SMALL * 10, LARGE * 10, LARGE * 10 + 1,
+             LARGE * 10 + (LARGE - SMALL) * 10,       # reference-bug boundary
+             LARGE * 10 + (LARGE - SMALL) * 10 + 7,   # inside the bug window
+             LARGE * 20 - SMALL * 3,                   # inside the bug window
+             LARGE * 25 + 12345]
+    for dat_size in sizes:
+        # simulate the encode loop: byte offset -> (shard, shard_offset)
+        def encoded_location(off):
+            remaining, row_start, shard_off = dat_size, 0, 0
+            while remaining > LARGE * 10:
+                if off < row_start + LARGE * 10:
+                    j = (off - row_start) // LARGE
+                    return j, shard_off + (off - row_start) % LARGE
+                remaining -= LARGE * 10
+                row_start += LARGE * 10
+                shard_off += LARGE
+            while True:
+                if off < row_start + SMALL * 10:
+                    j = (off - row_start) // SMALL
+                    return j, shard_off + (off - row_start) % SMALL
+                row_start += SMALL * 10
+                shard_off += SMALL
+
+        for off in sorted(set(
+                [0, dat_size - 1] +
+                list(rng.integers(0, dat_size, 20).tolist()))):
+            ivs = layout.locate_data(LARGE, SMALL, dat_size, off, 1)
+            got = ivs[0].to_shard_id_and_offset(LARGE, SMALL)
+            assert got == encoded_location(off), (dat_size, off)
+
+
+def test_shard_file_size_matches_encode_loop():
+    for dat_size in (0, 1, 999, SMALL * 10, LARGE * 10, LARGE * 10 + 1,
+                     LARGE * 20 - SMALL * 3, LARGE * 25 + 12345):
+        # emulate the reference loop
+        remaining, large_rows = dat_size, 0
+        while remaining > LARGE * 10:
+            large_rows += 1
+            remaining -= LARGE * 10
+        small_rows = 0
+        while remaining > 0:
+            small_rows += 1
+            remaining -= SMALL * 10
+        want = large_rows * LARGE + small_rows * SMALL
+        assert layout.shard_file_size(dat_size, LARGE, SMALL) == want, dat_size
+
+
+# ---- full pipeline ----------------------------------------------------
+
+@pytest.fixture()
+def small_volume(tmp_path):
+    """A volume with a few hundred mixed-size needles."""
+    vol = Volume(str(tmp_path), "", 7)
+    rng = np.random.default_rng(7)
+    blobs = {}
+    for i in range(1, 200):
+        size = int(rng.integers(1, 2000)) if i % 7 else int(rng.integers(2000, 9000))
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        vol.append_needle(ndl.Needle(cookie=0x1234, id=i, data=data))
+        blobs[i] = data
+    vol.close()
+    return tmp_path, blobs
+
+
+def encode_small(base):
+    ec_files.write_ec_files(base, large_block=LARGE, small_block=SMALL,
+                            batch_size=SMALL * 10)
+    ec_files.write_sorted_ecx(base + ".idx")
+
+
+def test_ec_encode_roundtrip_all_needles(small_volume):
+    tmp_path, blobs = small_volume
+    base = str(tmp_path / "7")
+    encode_small(base)
+    for i in range(layout.TOTAL_SHARDS):
+        assert os.path.getsize(base + layout.to_ext(i)) == \
+            layout.shard_file_size(os.path.getsize(base + ".dat"), LARGE, SMALL)
+
+    ev = ec_volume.EcVolume(base, LARGE, SMALL)
+    for nid, data in blobs.items():
+        n = ev.read_needle(nid)
+        assert n.data == data, nid
+    ev.close()
+
+
+def test_ec_degraded_read_with_missing_shards(small_volume):
+    tmp_path, blobs = small_volume
+    base = str(tmp_path / "7")
+    encode_small(base)
+    # lose 4 shards (2 data + 2 parity)
+    for sid in (1, 7, 10, 13):
+        os.remove(base + layout.to_ext(sid))
+    ev = ec_volume.EcVolume(base, LARGE, SMALL)
+    assert ev.shard_ids() == [0, 2, 3, 4, 5, 6, 8, 9, 11, 12]
+    for nid, data in blobs.items():
+        assert ev.read_needle(nid).data == data, nid
+    ev.close()
+
+
+def test_ec_read_fails_below_k_shards(small_volume):
+    tmp_path, blobs = small_volume
+    base = str(tmp_path / "7")
+    encode_small(base)
+    for sid in (0, 1, 2, 3, 10):
+        os.remove(base + layout.to_ext(sid))
+    ev = ec_volume.EcVolume(base, LARGE, SMALL)
+    with pytest.raises(IOError, match="shards readable"):
+        # any needle hitting shard 0..3 must fail with 9 shards left
+        for nid in blobs:
+            ev.read_needle(nid)
+    ev.close()
+
+
+def test_ec_rebuild_missing_shards(small_volume):
+    tmp_path, blobs = small_volume
+    base = str(tmp_path / "7")
+    encode_small(base)
+    golden = {sid: open(base + layout.to_ext(sid), "rb").read()
+              for sid in (2, 11)}
+    for sid in (2, 11):
+        os.remove(base + layout.to_ext(sid))
+    rebuilt = ec_files.rebuild_ec_files(base, batch_size=SMALL * 10)
+    assert sorted(rebuilt) == [2, 11]
+    for sid, want in golden.items():
+        assert open(base + layout.to_ext(sid), "rb").read() == want, sid
+
+
+def test_ec_delete_and_journal_replay(small_volume):
+    tmp_path, blobs = small_volume
+    base = str(tmp_path / "7")
+    encode_small(base)
+    ev = ec_volume.EcVolume(base, LARGE, SMALL)
+    ev.delete_needle(5)
+    ev.delete_needle(6)
+    with pytest.raises(KeyError):
+        ev.read_needle(5)
+    ev.close()
+    assert ec_files.read_ecj(base + ".ecj") == [5, 6]
+    # remount replays the journal and removes it
+    ev2 = ec_volume.EcVolume(base, LARGE, SMALL)
+    assert not os.path.exists(base + ".ecj")
+    with pytest.raises(KeyError):
+        ev2.read_needle(6)
+    assert ev2.read_needle(7).data == blobs[7]
+    ev2.close()
+
+
+def test_ec_decode_back_to_volume(small_volume):
+    tmp_path, blobs = small_volume
+    base = str(tmp_path / "7")
+    golden_dat = open(base + ".dat", "rb").read()
+    encode_small(base)
+    dat_size = ec_files.find_dat_file_size(base)
+    assert dat_size == len(golden_dat)
+    os.remove(base + ".dat")
+    os.remove(base + ".idx")
+    ec_files.write_dat_file(base, dat_size, LARGE, SMALL)
+    ec_files.write_idx_from_ecx(base + ".ecx")
+    assert open(base + ".dat", "rb").read() == golden_dat
+    # reload as a normal volume and read everything
+    vol = Volume(str(tmp_path), "", 7)
+    for nid, data in blobs.items():
+        assert vol.read_needle(nid).data == data
+    vol.close()
+
+
+# ---- golden fixture ---------------------------------------------------
+
+@pytest.mark.skipif(reference_fixture("weed/storage/erasure_coding/1.dat") is None,
+                    reason="reference mount absent")
+def test_ec_reference_fixture_end_to_end(tmp_path):
+    """Encode the reference's fixture volume with OUR pipeline at the same
+    test block sizes ec_test.go uses, then read every live needle back from
+    shards with two shards missing."""
+    shutil.copy(reference_fixture("weed/storage/erasure_coding/1.dat"), tmp_path / "1.dat")
+    shutil.copy(reference_fixture("weed/storage/erasure_coding/1.idx"), tmp_path / "1.idx")
+    os.chmod(tmp_path / "1.dat", 0o644)
+    os.chmod(tmp_path / "1.idx", 0o644)
+    base = str(tmp_path / "1")
+    encode_small(base)
+    for sid in (3, 12):
+        os.remove(base + layout.to_ext(sid))
+    vol = Volume(str(tmp_path), "", 1)
+    live = {nid: vol.read_needle(nid).data
+            for nid, (off, sz) in vol.nm.items() if t.size_is_valid(sz)}
+    vol.close()
+    ev = ec_volume.EcVolume(base, LARGE, SMALL)
+    for nid, data in live.items():
+        assert ev.read_needle(nid).data == data, nid
+    ev.close()
