@@ -1,0 +1,176 @@
+"""CLI entry point — the single-binary `weed`-style launcher
+(reference: weed/weed.go:46-85, weed/command/server.go all-in-one).
+
+  python -m seaweedfs_tpu master  -port 9333
+  python -m seaweedfs_tpu volume  -dir /data -mserver host:9333 -port 8080
+  python -m seaweedfs_tpu server  -dir /data    # master + volume in one proc
+  python -m seaweedfs_tpu shell   -master host:9333 [-c "cmd; cmd"]
+  python -m seaweedfs_tpu benchmark -master host:9333
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+def _add_master_flags(p):
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    p.add_argument("-defaultReplication", default="000")
+
+
+def _add_volume_flags(p, with_master=True):
+    p.add_argument("-dir", action="append", required=True)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-publicUrl", default="")
+    p.add_argument("-max", type=int, default=8)
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-rack", default="")
+    if with_master:
+        p.add_argument("-mserver", default="127.0.0.1:9333")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="seaweedfs_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    pm = sub.add_parser("master")
+    _add_master_flags(pm)
+
+    pv = sub.add_parser("volume")
+    _add_volume_flags(pv)
+
+    ps = sub.add_parser("server")
+    _add_master_flags(ps)
+    _add_volume_flags(ps, with_master=False)
+    ps.add_argument("-volumePort", type=int, default=8080)
+
+    psh = sub.add_parser("shell")
+    psh.add_argument("-master", default="127.0.0.1:9333")
+    psh.add_argument("-c", dest="script", default=None,
+                     help="semicolon-separated commands; omit for a REPL")
+
+    pb = sub.add_parser("benchmark")
+    pb.add_argument("-master", default="127.0.0.1:9333")
+    pb.add_argument("-n", type=int, default=10000)
+    pb.add_argument("-size", type=int, default=1024)
+    pb.add_argument("-c", type=int, dest="concurrency", default=16)
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "master":
+        return asyncio.run(_run_master(args))
+    if args.cmd == "volume":
+        return asyncio.run(_run_volume(args))
+    if args.cmd == "server":
+        return asyncio.run(_run_server(args))
+    if args.cmd == "shell":
+        from seaweedfs_tpu.shell.shell import repl
+        return repl(args.master, args.script)
+    if args.cmd == "benchmark":
+        return _run_benchmark(args)
+    return 2
+
+
+async def _serve_forever():
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        return
+
+
+async def _run_master(args) -> int:
+    from seaweedfs_tpu.server.master import MasterServer
+    m = MasterServer(args.ip, args.port,
+                     volume_size_limit=args.volumeSizeLimitMB << 20,
+                     default_replication=args.defaultReplication)
+    await m.start()
+    await _serve_forever()
+    await m.stop()
+    return 0
+
+
+async def _run_volume(args) -> int:
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    v = VolumeServer(args.dir, args.mserver, args.ip, args.port,
+                     public_url=args.publicUrl, max_volumes=args.max,
+                     data_center=args.dataCenter, rack=args.rack)
+    await v.start()
+    await _serve_forever()
+    await v.stop()
+    return 0
+
+
+async def _run_server(args) -> int:
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    m = MasterServer(args.ip, args.port,
+                     volume_size_limit=args.volumeSizeLimitMB << 20,
+                     default_replication=args.defaultReplication)
+    await m.start()
+    v = VolumeServer(args.dir, m.url, args.ip, args.volumePort,
+                     public_url=args.publicUrl, max_volumes=args.max,
+                     data_center=args.dataCenter, rack=args.rack)
+    await v.start()
+    await _serve_forever()
+    await v.stop()
+    await m.stop()
+    return 0
+
+
+def _run_benchmark(args) -> int:
+    """Concurrent small-file write/read benchmark
+    (reference: weed/command/benchmark.go:52-460)."""
+    import concurrent.futures
+    import time
+
+    import numpy as np
+
+    from seaweedfs_tpu.client import WeedClient
+
+    client = WeedClient(args.master)
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
+
+    def write_one(i):
+        t0 = time.perf_counter()
+        fid = client.upload(payload, name=f"bench{i}")
+        return fid, time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    fids, lat = [], []
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+        for fid, dt in ex.map(write_one, range(args.n)):
+            fids.append(fid)
+            lat.append(dt)
+    wall = time.perf_counter() - t0
+    lat_ms = sorted(x * 1e3 for x in lat)
+    print(f"write: {args.n / wall:.1f} req/s, "
+          f"{args.n * args.size / wall / 1e6:.2f} MB/s, "
+          f"p50 {lat_ms[len(lat_ms)//2]:.2f}ms "
+          f"p99 {lat_ms[int(len(lat_ms)*0.99)]:.2f}ms")
+
+    def read_one(fid):
+        t0 = time.perf_counter()
+        data = client.download(fid)
+        assert len(data) == args.size
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+        lat = list(ex.map(read_one, fids))
+    wall = time.perf_counter() - t0
+    lat_ms = sorted(x * 1e3 for x in lat)
+    print(f"read:  {args.n / wall:.1f} req/s, "
+          f"{args.n * args.size / wall / 1e6:.2f} MB/s, "
+          f"p50 {lat_ms[len(lat_ms)//2]:.2f}ms "
+          f"p99 {lat_ms[int(len(lat_ms)*0.99)]:.2f}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Client operation primitives: assign, upload, download, delete.
+
+The equivalent of the reference's weed/operation package
+(assign_file_id.go:141 Assign, upload_content.go:85 UploadWithRetry,
+lookup.go, delete_content.go) plus a vid->locations cache like
+wdclient/vid_map.go.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+
+class WeedClient:
+    def __init__(self, master: str, timeout: float = 30.0):
+        self.master = master
+        self.timeout = timeout
+        self._vid_cache: dict[int, tuple[list[str], float]] = {}
+        self.vid_cache_ttl = 10.0
+
+    # -- raw http ------------------------------------------------------
+
+    def _get_json(self, url: str) -> dict:
+        with urllib.request.urlopen(f"http://{url}", timeout=self.timeout) as r:
+            return json.load(r)
+
+    # -- master ops ----------------------------------------------------
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str = "", ttl: str = "") -> dict:
+        params = {"count": count}
+        if collection:
+            params["collection"] = collection
+        if replication:
+            params["replication"] = replication
+        if ttl:
+            params["ttl"] = ttl
+        qs = urllib.parse.urlencode(params)
+        r = self._get_json(f"{self.master}/dir/assign?{qs}")
+        if "error" in r:
+            raise RuntimeError(f"assign failed: {r['error']}")
+        return r
+
+    def lookup(self, vid: int) -> list[str]:
+        cached = self._vid_cache.get(vid)
+        if cached and time.time() - cached[1] < self.vid_cache_ttl:
+            return cached[0]
+        r = self._get_json(f"{self.master}/dir/lookup?volumeId={vid}")
+        urls = [l["url"] for l in r.get("locations", [])]
+        if urls:
+            self._vid_cache[vid] = (urls, time.time())
+        return urls
+
+    # -- blob ops ------------------------------------------------------
+
+    def upload(self, data: bytes, name: str = "", mime: str = "",
+               collection: str = "", replication: str = "",
+               ttl: str = "") -> str:
+        """Assign + upload; returns the fid."""
+        a = self.assign(collection=collection, replication=replication, ttl=ttl)
+        fid, url = a["fid"], a["url"]
+        self.upload_to(url, fid, data, name, mime)
+        return fid
+
+    def upload_to(self, url: str, fid: str, data: bytes,
+                  name: str = "", mime: str = "") -> None:
+        headers = {"Content-Type": mime or "application/octet-stream"}
+        if name:
+            headers["X-File-Name"] = name
+        req = urllib.request.Request(
+            f"http://{url}/{fid}", data=data, method="PUT", headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            if r.status >= 300:
+                raise RuntimeError(f"upload {fid} to {url}: HTTP {r.status}")
+
+    def download(self, fid: str) -> bytes:
+        vid = int(fid.partition(",")[0])
+        last_err: Exception | None = None
+        for url in self.lookup(vid):
+            try:
+                with urllib.request.urlopen(
+                        f"http://{url}/{fid}", timeout=self.timeout) as r:
+                    return r.read()
+            except OSError as e:
+                last_err = e
+        raise RuntimeError(f"download {fid} failed: {last_err or 'no locations'}")
+
+    def delete(self, fid: str) -> None:
+        vid = int(fid.partition(",")[0])
+        for url in self.lookup(vid):
+            req = urllib.request.Request(f"http://{url}/{fid}", method="DELETE")
+            try:
+                urllib.request.urlopen(req, timeout=self.timeout).close()
+                return
+            except OSError:
+                continue
+        raise RuntimeError(f"delete {fid} failed")

@@ -80,8 +80,7 @@ class RSCode:
                  construction: str = "vandermonde"):
         if k < 1 or m < 0:
             raise ValueError(f"bad RS({k},{m})")
-        if k + m > 256:
-            raise ValueError(f"RS({k},{m}): k+m must be <= 256 in GF(2^8)")
+        # k+m <= 256 is validated by the matrix constructors below
         self.k = k
         self.m = m
         self.n = k + m

@@ -1,0 +1,230 @@
+"""Master server: topology bookkeeping, fid assignment, volume lookup.
+
+Speaks the reference master's public HTTP API (weed/server/
+master_server_handlers.go): /dir/assign, /dir/lookup, /vol/grow,
+/cluster/status — plus JSON endpoints for what the reference does over
+gRPC: /heartbeat (volume servers report state,
+master_grpc_server.go:61), /dir/ec/lookup (LookupEcVolume,
+master_grpc_server_volume.go:156), and the shell's exclusive admin lock
+(master_grpc_server_admin.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import time
+
+import aiohttp
+from aiohttp import web
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.topology.topology import Topology
+
+log = logging.getLogger("master")
+
+
+class MasterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9333,
+                 volume_size_limit: int = 30 * 1024 * 1024 * 1024,
+                 default_replication: str = "000",
+                 grow_count: int = 1):
+        self.host, self.port = host, port
+        self.topo = Topology(volume_size_limit=volume_size_limit,
+                             replication=default_replication)
+        self.grow_count = grow_count
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.add_routes([
+            web.route("*", "/dir/assign", self.handle_assign),
+            web.get("/dir/lookup", self.handle_lookup),
+            web.get("/dir/ec/lookup", self.handle_ec_lookup),
+            web.post("/heartbeat", self.handle_heartbeat),
+            web.get("/cluster/status", self.handle_cluster_status),
+            web.post("/vol/grow", self.handle_grow),
+            web.post("/admin/lock", self.handle_lock),
+            web.post("/admin/unlock", self.handle_unlock),
+            web.post("/admin/renew_lock", self.handle_renew_lock),
+        ])
+        self._runner: web.AppRunner | None = None
+        self._session: aiohttp.ClientSession | None = None
+        self._grow_lock = asyncio.Lock()
+        self._admin_lock: tuple[str, str, float] | None = None  # (token, owner, ts)
+        self._expire_task: asyncio.Task | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self._expire_task = asyncio.create_task(self._expire_loop())
+        log.info("master listening on %s", self.url)
+
+    async def stop(self) -> None:
+        if self._expire_task:
+            self._expire_task.cancel()
+        if self._session:
+            await self._session.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _expire_loop(self) -> None:
+        while True:
+            await asyncio.sleep(5)
+            dead = self.topo.expire_dead_nodes()
+            for nid in dead:
+                log.warning("volume server %s expired from topology", nid)
+
+    # -- handlers ------------------------------------------------------
+
+    async def handle_heartbeat(self, req: web.Request) -> web.Response:
+        beat = await req.json()
+        self.topo.register_heartbeat(
+            node_id=beat["id"], url=beat["url"],
+            public_url=beat.get("public_url", ""),
+            dc=beat.get("data_center", ""), rack=beat.get("rack", ""),
+            beat=beat)
+        return web.json_response({
+            "volume_size_limit": self.topo.volume_size_limit,
+        })
+
+    async def handle_assign(self, req: web.Request) -> web.Response:
+        q = req.query
+        count = int(q.get("count", "1"))
+        collection = q.get("collection", "")
+        replication = q.get("replication") or self.topo.default_replication
+        ttl = q.get("ttl", "")
+
+        picked = self.topo.pick_for_write(collection, replication, ttl)
+        if picked is None:
+            async with self._grow_lock:
+                picked = self.topo.pick_for_write(collection, replication, ttl)
+                if picked is None:
+                    grown = await self._grow(collection, replication, ttl,
+                                             self.grow_count)
+                    if not grown:
+                        return web.json_response(
+                            {"error": "no free volumes and cannot grow"},
+                            status=500)
+                picked = self.topo.pick_for_write(collection, replication, ttl)
+        if picked is None:
+            return web.json_response({"error": "no writable volume"}, status=500)
+        vid, nodes = picked
+        key = self.topo.sequencer.next_ids(count)
+        cookie = secrets.randbits(32)
+        fid = t.FileId(vid, key, cookie)
+        node = nodes[0]
+        return web.json_response({
+            "fid": str(fid), "count": count,
+            "url": node.url, "publicUrl": node.public_url,
+        })
+
+    async def handle_lookup(self, req: web.Request) -> web.Response:
+        raw = req.query.get("volumeId", "")
+        vid = int(raw.partition(",")[0])
+        nodes = self.topo.lookup(vid, req.query.get("collection", ""))
+        if not nodes:
+            return web.json_response(
+                {"volumeId": raw, "error": "volume id not found"}, status=404)
+        return web.json_response({
+            "volumeId": raw,
+            "locations": [{"url": n.url, "publicUrl": n.public_url}
+                          for n in nodes],
+        })
+
+    async def handle_ec_lookup(self, req: web.Request) -> web.Response:
+        vid = int(req.query.get("volumeId", "0"))
+        shards = self.topo.lookup_ec_shards(vid)
+        if shards is None:
+            return web.json_response({"error": "not an ec volume"}, status=404)
+        return web.json_response({
+            "volumeId": vid,
+            "shards": {str(sid): [{"url": n.url, "publicUrl": n.public_url}
+                                  for n in nodes]
+                       for sid, nodes in shards.items()},
+        })
+
+    async def handle_cluster_status(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "IsLeader": True,
+            "Leader": self.url,
+            "Topology": self.topo.to_dict(),
+        })
+
+    async def handle_grow(self, req: web.Request) -> web.Response:
+        q = req.query
+        n = await self._grow(q.get("collection", ""),
+                             q.get("replication") or self.topo.default_replication,
+                             q.get("ttl", ""), int(q.get("count", "1")))
+        if n == 0:
+            return web.json_response({"error": "growth failed"}, status=500)
+        return web.json_response({"count": n})
+
+    # -- admin lock (shell exclusivity) --------------------------------
+
+    async def handle_lock(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        now = time.time()
+        if self._admin_lock and now - self._admin_lock[2] < 30:
+            return web.json_response(
+                {"error": f"locked by {self._admin_lock[1]}"}, status=409)
+        token = secrets.token_hex(8)
+        self._admin_lock = (token, body.get("owner", "?"), now)
+        return web.json_response({"token": token})
+
+    async def handle_renew_lock(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        if not self._admin_lock or self._admin_lock[0] != body.get("token"):
+            return web.json_response({"error": "not lock owner"}, status=409)
+        self._admin_lock = (self._admin_lock[0], self._admin_lock[1], time.time())
+        return web.json_response({})
+
+    async def handle_unlock(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        if self._admin_lock and self._admin_lock[0] == body.get("token"):
+            self._admin_lock = None
+        return web.json_response({})
+
+    # -- growth --------------------------------------------------------
+
+    async def _grow(self, collection: str, replication: str, ttl: str,
+                    count: int) -> int:
+        """Allocate `count` new volumes on free nodes (reference:
+        volume_growth.go GrowByCountAndType -> AllocateVolume RPCs)."""
+        rp = t.ReplicaPlacement.parse(replication)
+        slots = self.topo.find_empty_slots(rp, count)
+        if not slots:
+            return 0
+        grown = 0
+        for replica_set in slots:
+            vid = self.topo.next_volume_id()
+            ok = True
+            for node in replica_set:
+                try:
+                    async with self._session.post(
+                            f"http://{node.url}/admin/assign_volume",
+                            json={"volume": vid, "collection": collection,
+                                  "replication": replication, "ttl": ttl}) as r:
+                        ok &= r.status == 200
+                except aiohttp.ClientError as e:
+                    log.warning("assign_volume to %s failed: %s", node.url, e)
+                    ok = False
+            if ok:
+                # register optimistically so the next pick_for_write can use
+                # the volume before the next heartbeat lands
+                from seaweedfs_tpu.topology.topology import VolumeState
+                for node in replica_set:
+                    v = VolumeState(id=vid, collection=collection,
+                                    replica_placement=replication, ttl=ttl)
+                    node.volumes[v.id] = v
+                    self.topo.layout(collection, replication, ttl).register(v, node)
+                grown += 1
+        return grown

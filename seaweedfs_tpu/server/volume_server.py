@@ -1,0 +1,489 @@
+"""Volume server: HTTP blob data path + admin/EC control plane.
+
+Blob API matches the reference volume server HTTP surface
+(weed/server/volume_server_handlers_write.go, _read.go):
+  POST/PUT /{fid}   upload (raw body or multipart), ?type=replicate marks a
+                    forwarded replica write (no re-fan-out)
+  GET /{fid}        read (EC volumes served transparently, degraded reads
+                    reconstruct online — volume_server_handlers_read.go:67)
+  DELETE /{fid}     delete (+replica fan-out)
+
+Admin endpoints carry what the reference does over ~45 gRPC RPCs
+(volume_grpc_erasure_coding.go and friends): allocate/delete volumes,
+vacuum, EC generate/mount/unmount/copy/rebuild/read/to-volume, file pull.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+
+import aiohttp
+from aiohttp import web
+
+from seaweedfs_tpu.storage import needle as ndl
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.ec import ec_files, ec_volume as ecv, layout
+from seaweedfs_tpu.storage.store import Store
+
+log = logging.getLogger("volume")
+
+EC_FILE_EXTS = [layout.to_ext(i) for i in range(layout.TOTAL_SHARDS)] + \
+    [".ecx", ".ecj", ".vif"]
+
+
+class VolumeServer:
+    def __init__(self, directories: list[str], master_url: str,
+                 host: str = "127.0.0.1", port: int = 8080,
+                 public_url: str = "", max_volumes: int = 8,
+                 data_center: str = "", rack: str = "",
+                 heartbeat_interval: float = 3.0):
+        self.host, self.port = host, port
+        self.url = f"{host}:{port}"
+        self.public_url = public_url or self.url
+        self.master_url = master_url
+        self.data_center, self.rack = data_center, rack
+        self.heartbeat_interval = heartbeat_interval
+        self.store = Store(directories, max_volumes, self.public_url)
+        self.volume_size_limit = 30 * 1024 * 1024 * 1024
+
+        self.app = web.Application(client_max_size=256 * 1024 * 1024)
+        self.app.add_routes([
+            web.get("/status", self.handle_status),
+            web.post("/admin/assign_volume", self.handle_assign_volume),
+            web.post("/admin/volume/delete", self.handle_volume_delete),
+            web.post("/admin/volume/readonly", self.handle_volume_readonly),
+            web.post("/admin/volume/vacuum", self.handle_vacuum),
+            web.post("/admin/ec/generate", self.handle_ec_generate),
+            web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
+            web.post("/admin/ec/mount", self.handle_ec_mount),
+            web.post("/admin/ec/unmount", self.handle_ec_unmount),
+            web.post("/admin/ec/delete_shards", self.handle_ec_delete_shards),
+            web.post("/admin/ec/copy", self.handle_ec_copy),
+            web.post("/admin/ec/to_volume", self.handle_ec_to_volume),
+            web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
+            web.get("/admin/file", self.handle_file_pull),
+            web.route("*", "/{fid:[^/]*,[^/]+}", self.handle_blob),
+        ])
+        self._runner: web.AppRunner | None = None
+        self._session: aiohttp.ClientSession | None = None
+        self._hb_task: asyncio.Task | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=300))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        await self._heartbeat_once()
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        log.info("volume server on %s (dirs=%s)", self.url,
+                 [l.directory for l in self.store.locations])
+
+    async def stop(self) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+        if self._session:
+            await self._session.close()
+        if self._runner:
+            await self._runner.cleanup()
+        self.store.close()
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_interval)
+            try:
+                await self._heartbeat_once()
+            except aiohttp.ClientError as e:
+                log.warning("heartbeat to master failed: %s", e)
+
+    async def _heartbeat_once(self) -> None:
+        beat = self.store.collect_heartbeat()
+        beat.update({"id": self.url, "url": self.url,
+                     "public_url": self.public_url,
+                     "data_center": self.data_center, "rack": self.rack})
+        async with self._session.post(
+                f"http://{self.master_url}/heartbeat", json=beat) as r:
+            if r.status == 200:
+                data = await r.json()
+                self.volume_size_limit = data.get(
+                    "volume_size_limit", self.volume_size_limit)
+
+    # -- blob data path -------------------------------------------------
+
+    async def handle_blob(self, req: web.Request) -> web.StreamResponse:
+        try:
+            fid = t.FileId.parse(req.match_info["fid"])
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if req.method in ("POST", "PUT"):
+            return await self._write_blob(req, fid)
+        if req.method == "GET" or req.method == "HEAD":
+            return await self._read_blob(req, fid)
+        if req.method == "DELETE":
+            return await self._delete_blob(req, fid)
+        return web.json_response({"error": "method not allowed"}, status=405)
+
+    async def _write_blob(self, req: web.Request, fid: t.FileId) -> web.Response:
+        name, mime, data = b"", b"", b""
+        ctype = req.headers.get("Content-Type", "")
+        if ctype.startswith("multipart/"):
+            reader = await req.multipart()
+            part = await reader.next()
+            while part is not None:
+                if part.name in (None, "file"):
+                    name = (part.filename or "").encode()
+                    pm = part.headers.get("Content-Type", "")
+                    mime = b"" if pm == "application/octet-stream" else pm.encode()
+                    data = await part.read(decode=False)
+                    break
+                part = await reader.next()
+        else:
+            data = await req.read()
+            if ctype and ctype != "application/octet-stream":
+                mime = ctype.encode()
+            hname = req.headers.get("X-File-Name")
+            if hname:
+                name = hname.encode()
+        n = ndl.Needle(cookie=fid.cookie, id=fid.key, data=data,
+                       name=name, mime=mime,
+                       last_modified=int(time.time()))
+        try:
+            size = await asyncio.to_thread(
+                self.store.write_needle, fid.volume_id, n)
+        except KeyError:
+            return web.json_response({"error": "volume not found"}, status=404)
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        del size
+
+        if req.query.get("type") != "replicate":
+            err = await self._replicate(fid, "PUT", data, name, mime)
+            if err:
+                return web.json_response({"error": err}, status=500)
+        return web.json_response({"name": name.decode(errors="replace"),
+                                  "size": len(data), "eTag": f"{n.checksum:x}"},
+                                 status=201)
+
+    async def _replicate(self, fid: t.FileId, method: str,
+                         data: bytes | None, name: bytes = b"",
+                         mime: bytes = b"") -> str | None:
+        """Synchronous fan-out to the other replica locations
+        (reference: weed/topology/store_replicate.go:24-135)."""
+        vol = self.store.get_volume(fid.volume_id)
+        if vol is None or vol.super_block.replica_placement.copy_count <= 1:
+            return None
+        try:
+            async with self._session.get(
+                    f"http://{self.master_url}/dir/lookup",
+                    params={"volumeId": str(fid.volume_id)}) as r:
+                locations = (await r.json()).get("locations", [])
+        except aiohttp.ClientError as e:
+            return f"replica lookup failed: {e}"
+        peers = [l["url"] for l in locations if l["url"] != self.url]
+        headers = {}
+        if mime:
+            headers["Content-Type"] = mime.decode(errors="replace")
+        if name:
+            headers["X-File-Name"] = name.decode(errors="replace")
+        for peer in peers:
+            url = f"http://{peer}/{fid}?type=replicate"
+            try:
+                if method == "PUT":
+                    async with self._session.put(url, data=data,
+                                                 headers=headers) as r:
+                        if r.status >= 300:
+                            return f"replica write to {peer}: {r.status}"
+                else:
+                    async with self._session.delete(url) as r:
+                        if r.status >= 300:
+                            return f"replica delete to {peer}: {r.status}"
+            except aiohttp.ClientError as e:
+                return f"replica {method} to {peer} failed: {e}"
+        return None
+
+    async def _read_blob(self, req: web.Request, fid: t.FileId) -> web.StreamResponse:
+        try:
+            n = await asyncio.to_thread(
+                self.store.read_needle, fid.volume_id, fid.key,
+                fid.cookie, self._shard_reader(fid.volume_id))
+        except KeyError:
+            return web.json_response({"error": "not found"}, status=404)
+        except PermissionError:
+            return web.json_response({"error": "cookie mismatch"}, status=404)
+        except IOError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        headers = {"Etag": f'"{n.checksum:x}"'}
+        if n.name:
+            headers["Content-Disposition"] = \
+                f'inline; filename="{n.name.decode(errors="replace")}"'
+        body = b"" if req.method == "HEAD" else n.data
+        return web.Response(
+            body=body,
+            content_type=(n.mime.decode() if n.mime else "application/octet-stream"),
+            headers=headers)
+
+    async def _delete_blob(self, req: web.Request, fid: t.FileId) -> web.Response:
+        try:
+            size = await asyncio.to_thread(
+                self.store.delete_needle, fid.volume_id, fid.key, fid.cookie)
+        except KeyError:
+            return web.json_response({"error": "not found"}, status=404)
+        except PermissionError:
+            return web.json_response({"error": "cookie mismatch"}, status=404)
+        if req.query.get("type") != "replicate":
+            err = await self._replicate(fid, "DELETE", None)
+            if err:
+                return web.json_response({"error": err}, status=500)
+        return web.json_response({"size": size})
+
+    def _shard_reader(self, vid: int):
+        """Remote-shard fetch for EC degraded reads: ask the master where
+        each shard lives, pull the byte range from a peer
+        (reference: store_ec.go readRemoteEcShardInterval)."""
+        def read(shard_id: int, offset: int, size: int) -> bytes | None:
+            # runs inside a worker thread: use a blocking http client
+            import urllib.request
+            import json as _json
+            try:
+                with urllib.request.urlopen(
+                        f"http://{self.master_url}/dir/ec/lookup?volumeId={vid}",
+                        timeout=10) as r:
+                    shards = _json.load(r).get("shards", {})
+                for loc in shards.get(str(shard_id), []):
+                    if loc["url"] == self.url:
+                        continue
+                    try:
+                        req = (f"http://{loc['url']}/admin/ec/shard_read?"
+                               f"volume={vid}&shard={shard_id}"
+                               f"&offset={offset}&size={size}")
+                        with urllib.request.urlopen(req, timeout=30) as rr:
+                            data = rr.read()
+                        if len(data) == size:
+                            return data
+                    except OSError:
+                        continue
+            except OSError:
+                return None
+            return None
+        return read
+
+    # -- admin: volumes --------------------------------------------------
+
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return web.json_response(self.store.collect_heartbeat())
+
+    async def handle_assign_volume(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            self.store.allocate_volume(
+                body["volume"], body.get("collection", ""),
+                body.get("replication", "000"), body.get("ttl", ""))
+        except FileExistsError:
+            pass  # idempotent
+        except OSError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({})
+
+    async def handle_volume_delete(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.store.delete_volume(body["volume"])
+        await self._heartbeat_once()
+        return web.json_response({})
+
+    async def handle_volume_readonly(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        v = self.store.get_volume(body["volume"])
+        if v is None:
+            return web.json_response({"error": "volume not found"}, status=404)
+        v.read_only = bool(body.get("readonly", True))
+        await self._heartbeat_once()
+        return web.json_response({})
+
+    async def handle_vacuum(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        v = self.store.get_volume(body["volume"])
+        if v is None:
+            return web.json_response({"error": "volume not found"}, status=404)
+        garbage = v.garbage_ratio()
+        await asyncio.to_thread(v.compact)
+        return web.json_response({"garbage_ratio": garbage})
+
+    # -- admin: EC -------------------------------------------------------
+
+    def _ec_base(self, vid: int) -> str | None:
+        for loc in self.store.locations:
+            for cand in (loc.base_path(vid, loc.collections.get(vid, "")),
+                         loc.base_path(vid)):
+                if any(os.path.exists(cand + ext) for ext in
+                       (".dat", ".ecx", layout.to_ext(0))):
+                    return cand
+        return None
+
+    async def handle_ec_generate(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:38): .dat ->
+        .ec00-13 + .ecx, parity computed by the TPU codec."""
+        body = await req.json()
+        vid = body["volume"]
+        v = self.store.get_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"}, status=404)
+        base = v._base
+        def gen():
+            v.nm.flush()
+            ec_files.write_ec_files(base)
+            ec_files.write_sorted_ecx(base + ".idx")
+        await asyncio.to_thread(gen)
+        return web.json_response({"shards": list(range(layout.TOTAL_SHARDS))})
+
+    async def handle_ec_rebuild(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsRebuild (volume_grpc_erasure_coding.go:84)."""
+        body = await req.json()
+        base = self._ec_base(body["volume"])
+        if base is None:
+            return web.json_response({"error": "no shards here"}, status=404)
+        rebuilt = await asyncio.to_thread(ec_files.rebuild_ec_files, base)
+        return web.json_response({"rebuilt": rebuilt})
+
+    async def handle_ec_mount(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        vid = body["volume"]
+        base = self._ec_base(vid)
+        if base is None:
+            return web.json_response({"error": "no shard files"}, status=404)
+        loc = next(l for l in self.store.locations
+                   if base.startswith(l.directory))
+        old = loc.ec_volumes.pop(vid, None)
+        if old is not None:
+            old.close()
+        loc.ec_volumes[vid] = ecv.EcVolume(base)
+        await self._heartbeat_once()
+        return web.json_response({"shards": loc.ec_volumes[vid].shard_ids()})
+
+    async def handle_ec_unmount(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        vid = body["volume"]
+        for loc in self.store.locations:
+            ev = loc.ec_volumes.pop(vid, None)
+            if ev is not None:
+                ev.close()
+        await self._heartbeat_once()
+        return web.json_response({})
+
+    async def handle_ec_delete_shards(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        vid, shards = body["volume"], body.get("shards", [])
+        base = self._ec_base(vid)
+        if base is None:
+            return web.json_response({})
+        mounted = self.store.get_ec_volume(vid)
+        for sid in shards:
+            p = base + layout.to_ext(sid)
+            if os.path.exists(p):
+                os.remove(p)
+            if mounted is not None:
+                f = mounted.shards.pop(sid, None)
+                if f is not None:
+                    f.close()
+        # if no shards remain anywhere, drop index files too
+        if not any(os.path.exists(base + layout.to_ext(i))
+                   for i in range(layout.TOTAL_SHARDS)):
+            for ext in (".ecx", ".ecj"):
+                if os.path.exists(base + ext):
+                    os.remove(base + ext)
+        await self._heartbeat_once()
+        return web.json_response({})
+
+    async def handle_ec_copy(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsCopy (volume_grpc_erasure_coding.go:126): PULL shard
+        files from a peer (the reference's CopyFile stream, as HTTP)."""
+        body = await req.json()
+        vid, source = body["volume"], body["source"]
+        shards = body.get("shards", [])
+        collection = body.get("collection", "")
+        exts = [layout.to_ext(s) for s in shards]
+        if body.get("copy_ecx", True):
+            exts += [".ecx", ".vif"]
+        if body.get("copy_ecj", False):
+            exts.append(".ecj")
+        loc = min(self.store.locations, key=lambda l: len(l.volumes))
+        base = loc.base_path(vid, collection)
+        for ext in exts:
+            name = os.path.basename(base + ext)
+            try:
+                async with self._session.get(
+                        f"http://{source}/admin/file",
+                        params={"name": name}) as r:
+                    if r.status != 200:
+                        if ext in (".ecj", ".vif"):
+                            continue  # optional files
+                        return web.json_response(
+                            {"error": f"pull {name} from {source}: {r.status}"},
+                            status=500)
+                    with open(base + ext, "wb") as f:
+                        async for chunk in r.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+            except aiohttp.ClientError as e:
+                return web.json_response({"error": str(e)}, status=500)
+        loc.collections.setdefault(vid, collection)
+        return web.json_response({})
+
+    async def handle_file_pull(self, req: web.Request) -> web.StreamResponse:
+        """Serve a volume/ec file by basename for peer pulls (source side of
+        VolumeEcShardsCopy / VolumeCopy)."""
+        name = req.query.get("name", "")
+        if "/" in name or ".." in name:
+            return web.json_response({"error": "bad name"}, status=400)
+        ok_ext = name.endswith((".dat", ".idx")) or \
+            any(name.endswith(e) for e in EC_FILE_EXTS)
+        if not ok_ext:
+            return web.json_response({"error": "bad extension"}, status=400)
+        for loc in self.store.locations:
+            p = os.path.join(loc.directory, name)
+            if os.path.exists(p):
+                return web.FileResponse(p)
+        return web.json_response({"error": "file not found"}, status=404)
+
+    async def handle_ec_shard_read(self, req: web.Request) -> web.Response:
+        q = req.query
+        vid, sid = int(q["volume"]), int(q["shard"])
+        offset, size = int(q["offset"]), int(q["size"])
+        ev = self.store.get_ec_volume(vid)
+        if ev is None:
+            return web.json_response({"error": "not mounted"}, status=404)
+        data = ev._read_local(sid, offset, size)
+        if data is None:
+            return web.json_response({"error": "shard not local"}, status=404)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def handle_ec_to_volume(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsToVolume (volume_grpc_erasure_coding.go:407):
+        decode local data shards back into a normal volume."""
+        body = await req.json()
+        vid = body["volume"]
+        collection = body.get("collection", "")
+        base = self._ec_base(vid)
+        if base is None:
+            return web.json_response({"error": "no shards here"}, status=404)
+        missing = [i for i in range(layout.DATA_SHARDS)
+                   if not os.path.exists(base + layout.to_ext(i))]
+        def decode():
+            if missing:
+                ec_files.rebuild_ec_files(base)
+            dat_size = ec_files.find_dat_file_size(base)
+            ec_files.write_dat_file(base, dat_size)
+            ec_files.write_idx_from_ecx(base + ".ecx")
+        await asyncio.to_thread(decode)
+        # mount as a normal volume
+        loc = next(l for l in self.store.locations if base.startswith(l.directory))
+        from seaweedfs_tpu.storage.volume import Volume
+        loc.volumes[vid] = Volume(loc.directory, collection, vid)
+        loc.collections[vid] = collection
+        await self._heartbeat_once()
+        return web.json_response({})

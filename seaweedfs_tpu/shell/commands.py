@@ -1,0 +1,355 @@
+"""Admin shell command environment + commands.
+
+The shell drives the cluster purely over the master/volume-server HTTP
+APIs, holding the master's exclusive admin lock while mutating — same
+operating model as the reference shell (weed/shell/commands.go:23-60,
+command_ec_encode.go, command_ec_rebuild.go, command_ec_decode.go,
+command_ec_balance.go), synchronous code for operator predictability.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import urllib.parse
+import urllib.request
+
+from seaweedfs_tpu.storage.ec import layout
+
+
+class CommandEnv:
+    def __init__(self, master: str):
+        self.master = master
+        self.lock_token: str | None = None
+
+    # -- http helpers --------------------------------------------------
+
+    def _call(self, url: str, body: dict | None = None,
+              method: str | None = None, timeout: float = 600.0) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://{url}", data=data,
+            method=method or ("POST" if body is not None else "GET"),
+            headers={"Content-Type": "application/json"} if body is not None else {})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                raw = r.read()
+                return json.loads(raw) if raw else {}
+        except urllib.error.HTTPError as e:
+            try:
+                err = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                err = str(e)
+            raise RuntimeError(f"{url}: {err}") from None
+
+    def master_get(self, path: str, **params) -> dict:
+        qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+        return self._call(f"{self.master}{path}{qs}")
+
+    def master_post(self, path: str, body: dict | None = None, **params) -> dict:
+        qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+        return self._call(f"{self.master}{path}{qs}", body or {})
+
+    def vs_post(self, url: str, path: str, body: dict) -> dict:
+        return self._call(f"{url}{path}", body)
+
+    # -- lock -----------------------------------------------------------
+
+    def acquire_lock(self, owner: str = "shell") -> None:
+        if self.lock_token:
+            return
+        self.lock_token = self.master_post("/admin/lock", {"owner": owner})["token"]
+
+    def release_lock(self) -> None:
+        if self.lock_token:
+            self.master_post("/admin/unlock", {"token": self.lock_token})
+            self.lock_token = None
+
+    def require_lock(self) -> None:
+        if not self.lock_token:
+            raise RuntimeError("this command requires `lock` first")
+
+    # -- topology helpers -----------------------------------------------
+
+    def topology(self) -> dict:
+        return self.master_get("/cluster/status")["Topology"]
+
+    def volume_locations(self, vid: int) -> list[str]:
+        try:
+            r = self.master_get("/dir/lookup", volumeId=str(vid))
+        except RuntimeError:
+            return []
+        return [l["url"] for l in r.get("locations", [])]
+
+    def ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
+        try:
+            r = self.master_get("/dir/ec/lookup", volumeId=str(vid))
+        except RuntimeError:
+            return {}
+        return {int(s): [l["url"] for l in locs]
+                for s, locs in r.get("shards", {}).items()}
+
+
+# ---- commands ---------------------------------------------------------
+
+COMMANDS: dict[str, callable] = {}
+
+
+def command(name):
+    def deco(fn):
+        COMMANDS[name] = fn
+        return fn
+    return deco
+
+
+def parse_flags(args: list[str]) -> dict[str, str]:
+    out = {}
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("-"):
+            key = a.lstrip("-")
+            if "=" in key:
+                k, _, v = key.partition("=")
+                out[k] = v
+            elif i + 1 < len(args) and not args[i + 1].startswith("-"):
+                out[key] = args[i + 1]
+                i += 1
+            else:
+                out[key] = "true"
+        i += 1
+    return out
+
+
+@command("lock")
+def cmd_lock(env: CommandEnv, args, out):
+    env.acquire_lock()
+    print("locked", file=out)
+
+
+@command("unlock")
+def cmd_unlock(env: CommandEnv, args, out):
+    env.release_lock()
+    print("unlocked", file=out)
+
+
+@command("cluster.status")
+def cmd_cluster_status(env: CommandEnv, args, out):
+    print(json.dumps(env.master_get("/cluster/status"), indent=2), file=out)
+
+
+@command("volume.list")
+def cmd_volume_list(env: CommandEnv, args, out):
+    topo = env.topology()
+    for nid, node in sorted(topo["nodes"].items()):
+        print(f"node {nid} dc={node['dc']} rack={node['rack']} "
+              f"free={node['free_slots']}", file=out)
+        for vid in node["volumes"]:
+            print(f"  volume {vid}", file=out)
+        for vid, shards in sorted(node["ec_shards"].items()):
+            print(f"  ec volume {vid} shards {shards}", file=out)
+
+
+@command("volume.vacuum")
+def cmd_volume_vacuum(env: CommandEnv, args, out):
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    for url in env.volume_locations(vid):
+        r = env.vs_post(url, "/admin/volume/vacuum", {"volume": vid})
+        print(f"vacuumed {vid} on {url} (garbage was "
+              f"{r.get('garbage_ratio', 0):.2%})", file=out)
+
+
+@command("volume.delete")
+def cmd_volume_delete(env: CommandEnv, args, out):
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    for url in env.volume_locations(vid):
+        env.vs_post(url, "/admin/volume/delete", {"volume": vid})
+        print(f"deleted {vid} on {url}", file=out)
+
+
+@command("volume.mark")
+def cmd_volume_mark(env: CommandEnv, args, out):
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    readonly = flags.get("writable", "false") != "true"
+    for url in env.volume_locations(vid):
+        env.vs_post(url, "/admin/volume/readonly",
+                    {"volume": vid, "readonly": readonly})
+        print(f"marked {vid} readonly={readonly} on {url}", file=out)
+
+
+def balanced_ec_distribution(nodes: list[str]) -> dict[str, list[int]]:
+    """Round-robin the 14 shards over nodes (reference:
+    command_ec_encode.go:272 balancedEcDistribution)."""
+    alloc: dict[str, list[int]] = {n: [] for n in nodes}
+    order = sorted(nodes)
+    for sid in range(layout.TOTAL_SHARDS):
+        target = order[sid % len(order)]
+        alloc[target].append(sid)
+    return alloc
+
+
+@command("ec.encode")
+def cmd_ec_encode(env: CommandEnv, args, out):
+    """Convert a volume to EC shards and spread them
+    (reference: command_ec_encode.go:58-321)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    collection = flags.get("collection", "")
+
+    locations = env.volume_locations(vid)
+    if not locations:
+        raise RuntimeError(f"volume {vid} not found")
+    source = locations[0]
+
+    # 1. freeze writes on every replica
+    for url in locations:
+        env.vs_post(url, "/admin/volume/readonly", {"volume": vid, "readonly": True})
+    # 2. generate shards on the source (TPU codec)
+    env.vs_post(source, "/admin/ec/generate",
+                {"volume": vid, "collection": collection})
+    print(f"generated 14 shards of volume {vid} on {source}", file=out)
+
+    # 3. spread shards over the cluster
+    topo = env.topology()
+    nodes = sorted(topo["nodes"])
+    alloc = balanced_ec_distribution(nodes)
+    for target, shards in alloc.items():
+        if not shards:
+            continue
+        if target != source:
+            env.vs_post(target, "/admin/ec/copy",
+                        {"volume": vid, "collection": collection,
+                         "source": source, "shards": shards, "copy_ecx": True})
+        env.vs_post(target, "/admin/ec/mount",
+                    {"volume": vid, "collection": collection})
+        print(f"  shards {shards} -> {target}", file=out)
+    # 4. delete moved shard files from source, and the original volume
+    moved = [s for tgt, ss in alloc.items() if tgt != source for s in ss]
+    if moved:
+        env.vs_post(source, "/admin/ec/delete_shards",
+                    {"volume": vid, "shards": moved})
+        env.vs_post(source, "/admin/ec/mount",
+                    {"volume": vid, "collection": collection})
+    for url in locations:
+        env.vs_post(url, "/admin/volume/delete", {"volume": vid})
+    print(f"ec.encode {vid} done", file=out)
+
+
+@command("ec.rebuild")
+def cmd_ec_rebuild(env: CommandEnv, args, out):
+    """Rebuild missing shards (reference: command_ec_rebuild.go:58-281)."""
+    env.require_lock()
+    topo = env.topology()
+    ec_vids = {int(v) for node in topo["nodes"].values()
+               for v in node["ec_shards"]}
+    for vid in sorted(ec_vids):
+        shard_locs = env.ec_shard_locations(vid)
+        present = set(shard_locs)
+        missing = [s for s in range(layout.TOTAL_SHARDS) if s not in present]
+        if not missing:
+            continue
+        if len(present) < layout.DATA_SHARDS:
+            print(f"volume {vid}: only {len(present)} shards left, "
+                  f"cannot rebuild", file=out)
+            continue
+        # rebuilder = node holding the most shards
+        counts: dict[str, int] = {}
+        for locs in shard_locs.values():
+            for url in locs:
+                counts[url] = counts.get(url, 0) + 1
+        rebuilder = max(counts, key=counts.get)
+        local = {s for s, locs in shard_locs.items() if rebuilder in locs}
+        # pull missing survivors to the rebuilder
+        borrowed = []
+        for s, locs in shard_locs.items():
+            if s in local:
+                continue
+            env.vs_post(rebuilder, "/admin/ec/copy",
+                        {"volume": vid, "source": locs[0], "shards": [s],
+                         "copy_ecx": False})
+            borrowed.append(s)
+        r = env.vs_post(rebuilder, "/admin/ec/rebuild", {"volume": vid})
+        env.vs_post(rebuilder, "/admin/ec/delete_shards",
+                    {"volume": vid, "shards": borrowed})
+        env.vs_post(rebuilder, "/admin/ec/mount", {"volume": vid})
+        print(f"volume {vid}: rebuilt {r.get('rebuilt')} on {rebuilder}",
+              file=out)
+
+
+@command("ec.decode")
+def cmd_ec_decode(env: CommandEnv, args, out):
+    """EC shards -> normal volume (reference: command_ec_decode.go:40-292)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    collection = flags.get("collection", "")
+    shard_locs = env.ec_shard_locations(vid)
+    if not shard_locs:
+        raise RuntimeError(f"no ec shards for volume {vid}")
+    counts: dict[str, int] = {}
+    for locs in shard_locs.values():
+        for url in locs:
+            counts[url] = counts.get(url, 0) + 1
+    collector = max(counts, key=counts.get)
+    local = {s for s, locs in shard_locs.items() if collector in locs}
+    for s, locs in shard_locs.items():
+        if s not in local and locs:
+            env.vs_post(collector, "/admin/ec/copy",
+                        {"volume": vid, "collection": collection,
+                         "source": locs[0], "shards": [s], "copy_ecx": False})
+    env.vs_post(collector, "/admin/ec/to_volume",
+                {"volume": vid, "collection": collection})
+    # drop shards everywhere
+    all_nodes = {url for locs in shard_locs.values() for url in locs} | {collector}
+    for url in all_nodes:
+        env.vs_post(url, "/admin/ec/unmount", {"volume": vid})
+        env.vs_post(url, "/admin/ec/delete_shards",
+                    {"volume": vid, "shards": list(range(layout.TOTAL_SHARDS))})
+    print(f"ec.decode {vid} -> normal volume on {collector}", file=out)
+
+
+@command("ec.balance")
+def cmd_ec_balance(env: CommandEnv, args, out):
+    """Even shard spread (reference: command_ec_balance.go, simplified to
+    per-volume round-robin re-placement)."""
+    env.require_lock()
+    topo = env.topology()
+    nodes = sorted(topo["nodes"])
+    ec_vids = {int(v) for node in topo["nodes"].values()
+               for v in node["ec_shards"]}
+    for vid in sorted(ec_vids):
+        shard_locs = env.ec_shard_locations(vid)
+        want = balanced_ec_distribution(nodes)
+        want_by_shard = {s: tgt for tgt, ss in want.items() for s in ss}
+        for s, locs in shard_locs.items():
+            tgt = want_by_shard.get(s)
+            if tgt is None or tgt in locs:
+                continue
+            src = locs[0]
+            env.vs_post(tgt, "/admin/ec/copy",
+                        {"volume": vid, "source": src, "shards": [s],
+                         "copy_ecx": True})
+            env.vs_post(tgt, "/admin/ec/mount", {"volume": vid})
+            env.vs_post(src, "/admin/ec/delete_shards",
+                        {"volume": vid, "shards": [s]})
+            env.vs_post(src, "/admin/ec/mount", {"volume": vid})
+            print(f"volume {vid} shard {s}: {src} -> {tgt}", file=out)
+    print("ec.balance done", file=out)
+
+
+def run_command(env: CommandEnv, line: str, out) -> None:
+    parts = shlex.split(line)
+    if not parts:
+        return
+    fn = COMMANDS.get(parts[0])
+    if fn is None:
+        raise RuntimeError(f"unknown command {parts[0]!r} "
+                           f"(have: {', '.join(sorted(COMMANDS))})")
+    fn(env, parts[1:], out)

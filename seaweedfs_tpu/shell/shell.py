@@ -1,0 +1,38 @@
+"""Interactive admin shell (reference: weed/shell/shell.go REPL)."""
+
+from __future__ import annotations
+
+import sys
+
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+
+def repl(master: str, script: str | None = None) -> int:
+    env = CommandEnv(master)
+    rc = 0
+    try:
+        if script is not None:
+            for line in script.split(";"):
+                line = line.strip()
+                if line:
+                    run_command(env, line, sys.stdout)
+            return 0
+        while True:
+            try:
+                line = input("> ").strip()
+            except EOFError:
+                break
+            if line in ("exit", "quit"):
+                break
+            if not line:
+                continue
+            try:
+                run_command(env, line, sys.stdout)
+            except RuntimeError as e:
+                print(f"error: {e}", file=sys.stderr)
+    finally:
+        try:
+            env.release_lock()
+        except RuntimeError:
+            pass
+    return rc

@@ -49,9 +49,14 @@ def write_ec_files(base: str, dat_path: str | None = None,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
                    batch_size: int = DEFAULT_BATCH) -> None:
-    """Encode `<base>.dat` (or dat_path) into `<base>.ec00` .. `.ec13`."""
+    """Encode `<base>.dat` (or dat_path) into `<base>.ec00` .. `.ec13`,
+    plus a `<base>.vif` volume-info sidecar recording the encode-time dat
+    size and version (the reference's .vif, volume_info.go:16-40, as JSON):
+    the layout was cut from the FILE size, which later lookups cannot
+    reliably re-derive from the index once tail needles get deleted."""
     dat_path = dat_path or base + ".dat"
     dat_size = os.path.getsize(dat_path)
+    write_vif(base, dat_size)
     codec = _get_codec()
 
     outputs = [open(base + layout.to_ext(i), "wb")
@@ -167,17 +172,21 @@ def write_dat_file(base: str, dat_size: int,
 
 
 def write_sorted_ecx(idx_path: str, ecx_path: str | None = None) -> None:
-    """.idx -> .ecx: same 16-byte entries, sorted by needle id ascending.
-    Later entries for a duplicate id win (the .idx is a log)."""
+    """.idx -> .ecx: 16-byte entries sorted by needle id ascending, ONE entry
+    per id. The .idx is a log, so the last occurrence of an id (re-write or
+    tombstone) is its truth — keeping duplicates would make the binary
+    search land on the oldest entry and resurrect stale data."""
     ecx_path = ecx_path or idx_path[: -len(".idx")] + ".ecx"
     with open(idx_path, "rb") as f:
         data = f.read()
     ids, offs, sizes = idxf.read_columns(data)
-    # last occurrence of each id wins: stable-sort by (id, position)
-    order = np.argsort(ids, kind="stable")
+    latest: dict[int, tuple[int, int]] = {}
+    for nid, off, size in zip(ids.tolist(), offs.tolist(), sizes.tolist()):
+        latest[nid] = (off, size)
     with open(ecx_path, "wb") as out:
-        for i in order.tolist():
-            out.write(idxf.pack_entry(int(ids[i]), int(offs[i]), int(sizes[i])))
+        for nid in sorted(latest):
+            off, size = latest[nid]
+            out.write(idxf.pack_entry(nid, off, size))
 
 
 def write_idx_from_ecx(ecx_path: str, idx_path: str | None = None) -> None:
@@ -195,10 +204,30 @@ def write_idx_from_ecx(ecx_path: str, idx_path: str | None = None) -> None:
             out.write(idxf.pack_entry(nid, 0, t.TOMBSTONE_FILE_SIZE))
 
 
-def find_dat_file_size(base: str) -> int:
-    """Recover the original .dat size: max end offset of live .ecx entries
-    (reference: ec_decoder.go:48-70)."""
-    version = t.CURRENT_VERSION
+def write_vif(base: str, dat_size: int,
+              version: int = t.CURRENT_VERSION) -> None:
+    import json
+    with open(base + ".vif", "w") as f:
+        json.dump({"version": version, "dat_file_size": dat_size}, f)
+
+
+def read_vif(base: str) -> dict | None:
+    import json
+    try:
+        with open(base + ".vif") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def find_dat_file_size(base: str, version: int = t.CURRENT_VERSION) -> int:
+    """Recover the original .dat size: the encode-time size from the .vif
+    sidecar when present, else the max end offset of live .ecx entries
+    (reference: ec_decoder.go:48-70 — index-derived only, which misroutes
+    when the volume's tail needles were all deleted)."""
+    vif = read_vif(base)
+    if vif and "dat_file_size" in vif:
+        return int(vif["dat_file_size"])
     with open(base + ".ecx", "rb") as f:
         data = f.read()
     ids, offs, sizes = idxf.read_columns(data)

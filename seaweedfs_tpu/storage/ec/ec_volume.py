@@ -36,7 +36,8 @@ class EcVolume:
         self.base = base
         self.large_block = large_block
         self.small_block = small_block
-        self.version = version
+        vif = ec_files.read_vif(base)
+        self.version = vif.get("version", version) if vif else version
 
         # replay any crash-left journal into the .ecx, as the reference
         # does at mount (RebuildEcxFile, ec_volume_delete.go:51-98)
@@ -56,7 +57,7 @@ class EcVolume:
             self.shard_size = os.path.getsize(base + layout.to_ext(any_id))
         else:
             self.shard_size = 0
-        self.dat_size = ec_files.find_dat_file_size(base)
+        self.dat_size = ec_files.find_dat_file_size(base, self.version)
 
     # -- index ---------------------------------------------------------
 

@@ -1,0 +1,192 @@
+"""Store: every volume (normal + EC) on one volume server.
+
+Mirrors the reference store layer (weed/storage/store.go:57-77,
+disk_location.go, store_ec.go): disk locations own volumes found on disk at
+boot; the store routes volume ids and assembles heartbeat payloads for the
+master.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+
+from seaweedfs_tpu.storage import needle as ndl
+from seaweedfs_tpu.storage.ec import ec_volume as ecv
+from seaweedfs_tpu.storage.ec import layout
+from seaweedfs_tpu.storage.volume import Volume
+
+_VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
+_ECX_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ecx$")
+
+
+class DiskLocation:
+    """One data directory; loads .dat volumes and .ecx EC volumes at boot
+    (reference: weed/storage/disk_location.go, disk_location_ec.go)."""
+
+    def __init__(self, directory: str, max_volumes: int = 8):
+        self.directory = directory
+        self.max_volumes = max_volumes
+        os.makedirs(directory, exist_ok=True)
+        self.volumes: dict[int, Volume] = {}
+        self.ec_volumes: dict[int, ecv.EcVolume] = {}
+        self.collections: dict[int, str] = {}
+        self.load_existing()
+
+    def load_existing(self) -> None:
+        for path in glob.glob(os.path.join(self.directory, "*.dat")):
+            m = _VOL_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            col = m.group("col") or ""
+            if vid not in self.volumes:
+                self.volumes[vid] = Volume(self.directory, col, vid)
+                self.collections[vid] = col
+        for path in glob.glob(os.path.join(self.directory, "*.ecx")):
+            m = _ECX_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            base = path[: -len(".ecx")]
+            has_shards = any(os.path.exists(base + layout.to_ext(i))
+                             for i in range(layout.TOTAL_SHARDS))
+            if vid not in self.ec_volumes and has_shards:
+                self.ec_volumes[vid] = ecv.EcVolume(base)
+                self.collections.setdefault(vid, m.group("col") or "")
+
+    def base_path(self, vid: int, collection: str = "") -> str:
+        name = f"{collection}_{vid}" if collection else str(vid)
+        return os.path.join(self.directory, name)
+
+
+class Store:
+    def __init__(self, directories: list[str], max_volumes: int = 8,
+                 public_url: str = ""):
+        self.locations = [DiskLocation(d, max_volumes) for d in directories]
+        self.public_url = public_url
+        self._lock = threading.RLock()
+
+    # -- lookup --------------------------------------------------------
+
+    def get_volume(self, vid: int) -> Volume | None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def get_ec_volume(self, vid: int) -> ecv.EcVolume | None:
+        for loc in self.locations:
+            v = loc.ec_volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def location_of(self, vid: int) -> DiskLocation | None:
+        for loc in self.locations:
+            if vid in loc.volumes or vid in loc.ec_volumes:
+                return loc
+        return None
+
+    def has_free_slot(self) -> bool:
+        return any(len(loc.volumes) < loc.max_volumes for loc in self.locations)
+
+    # -- volume lifecycle ---------------------------------------------
+
+    def allocate_volume(self, vid: int, collection: str = "",
+                        replica_placement: str = "000", ttl: str = "") -> Volume:
+        with self._lock:
+            if self.get_volume(vid) is not None:
+                raise FileExistsError(f"volume {vid} already exists")
+            loc = min(self.locations, key=lambda l: len(l.volumes))
+            if len(loc.volumes) >= loc.max_volumes:
+                raise OSError("no free volume slots")
+            v = Volume(loc.directory, collection, vid,
+                       replica_placement=replica_placement, ttl=ttl)
+            loc.volumes[vid] = v
+            loc.collections[vid] = collection
+            return v
+
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.close()
+                    for ext in (".dat", ".idx"):
+                        p = v._base + ext
+                        if os.path.exists(p):
+                            os.remove(p)
+
+    # -- blob ops ------------------------------------------------------
+
+    def write_needle(self, vid: int, n: ndl.Needle) -> int:
+        v = self.get_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        _, size = v.append_needle(n)
+        return size
+
+    def read_needle(self, vid: int, needle_id: int,
+                    cookie: int | None = None,
+                    shard_reader=None) -> ndl.Needle:
+        v = self.get_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie)
+        ev = self.get_ec_volume(vid)
+        if ev is not None:
+            n = ev.read_needle(needle_id, shard_reader)
+            if cookie is not None and n.cookie != cookie:
+                raise PermissionError("cookie mismatch")
+            return n
+        raise KeyError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, needle_id: int,
+                      cookie: int | None = None) -> int:
+        v = self.get_volume(vid)
+        if v is not None:
+            return v.delete_needle(needle_id, cookie)
+        ev = self.get_ec_volume(vid)
+        if ev is not None:
+            ev.delete_needle(needle_id)
+            return 0
+        raise KeyError(f"volume {vid} not found")
+
+    # -- heartbeat payload --------------------------------------------
+
+    def collect_heartbeat(self) -> dict:
+        """Volume + EC shard report for the master
+        (reference: store.go CollectHeartbeat, store_ec.go:25-49)."""
+        vols, ec_shards = [], []
+        max_slots = 0
+        for loc in self.locations:
+            max_slots += loc.max_volumes
+            for vid, v in loc.volumes.items():
+                info = v.info()
+                vols.append({
+                    "id": vid, "collection": info.collection,
+                    "size": info.size, "file_count": info.file_count,
+                    "delete_count": info.delete_count,
+                    "deleted_bytes": info.deleted_bytes,
+                    "read_only": info.read_only,
+                    "replica_placement": info.replica_placement,
+                    "ttl": info.ttl, "version": info.version,
+                })
+            for vid, ev in loc.ec_volumes.items():
+                ec_shards.append({
+                    "id": vid,
+                    "collection": loc.collections.get(vid, ""),
+                    "shard_ids": ev.shard_ids(),
+                })
+        return {"volumes": vols, "ec_shards": ec_shards,
+                "max_volume_count": max_slots, "public_url": self.public_url}
+
+    def close(self) -> None:
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                v.close()
+            for ev in loc.ec_volumes.values():
+                ev.close()

@@ -11,6 +11,7 @@ tails at load).
 from __future__ import annotations
 
 import os
+import struct
 import threading
 import time
 from dataclasses import dataclass
@@ -99,8 +100,14 @@ class Volume:
                 torn.append(nid)
             else:
                 end = max(end, entry_end)
-        for nid in torn:
-            self.nm._m.pop(nid, None)
+        if torn:
+            # tombstone torn ids ON DISK too — dropping them only from the
+            # in-memory map lets them resurrect on the next load, pointing
+            # into whatever bytes were appended after the truncate
+            with open(self.idx_path, "ab") as f:
+                for nid in torn:
+                    self.nm._m.pop(nid, None)
+                    f.write(idxf.pack_entry(nid, 0, t.TOMBSTONE_FILE_SIZE))
 
         # walk complete records after the last indexed one
         offset = end + (-end) % t.NEEDLE_PADDING_SIZE
@@ -175,7 +182,11 @@ class Volume:
             record = self._dat.read(length)
         if len(record) < length:
             raise EOFError(f"truncated needle at {offset}")
-        return ndl.Needle.from_record(record, self.version, verify_checksum)
+        try:
+            return ndl.Needle.from_record(record, self.version, verify_checksum)
+        except (IndexError, struct.error) as e:
+            raise ValueError(
+                f"corrupt needle record at offset {offset}: {e}") from e
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> ndl.Needle:
         loc = self.nm.get(needle_id)
@@ -257,11 +268,12 @@ class Volume:
         offset += (-offset) % t.NEEDLE_PADDING_SIZE
         while offset + t.NEEDLE_HEADER_SIZE <= end:
             with self._lock:
+                # header + body under ONE lock hold: the fd position is
+                # shared with concurrent read/append seeks
                 self._dat.seek(offset)
                 header = self._dat.read(t.NEEDLE_HEADER_SIZE)
-            n = ndl.Needle.parse_header(header)
-            body_len = t.needle_body_length(max(n.size, 0), self.version)
-            with self._lock:
+                n = ndl.Needle.parse_header(header)
+                body_len = t.needle_body_length(max(n.size, 0), self.version)
                 body = self._dat.read(body_len)
             if len(body) < body_len:
                 return

@@ -1,0 +1,217 @@
+"""Cluster end-to-end: master + volume servers + shell EC ops, in-process.
+
+The asyncio servers run in a background thread with real sockets; the test
+body drives them synchronously like an external client would — the same
+"no mocks, real files in temp dirs" strategy as the reference
+(test/s3/basic, weed/shell/command_ec_test.go)."""
+
+import asyncio
+import io
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import WeedClient
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.storage import types as t
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Cluster:
+    """Master + N volume servers on one asyncio loop in a daemon thread."""
+
+    def __init__(self, tmp_path, n_volume_servers=2, max_volumes=20,
+                 volume_size_limit=64 * 1024 * 1024, replication="000"):
+        self.tmp = tmp_path
+        self.n = n_volume_servers
+        self.max_volumes = max_volumes
+        self.volume_size_limit = volume_size_limit
+        self.replication = replication
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self.master = None
+        self.volume_servers = []
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(60)
+
+    def start(self):
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        self.thread.start()
+        self.master = MasterServer("127.0.0.1", free_port(),
+                                   volume_size_limit=self.volume_size_limit,
+                                   default_replication=self.replication)
+        self.submit(self.master.start())
+        for i in range(self.n):
+            d = self.tmp / f"vs{i}"
+            d.mkdir(exist_ok=True)
+            vs = VolumeServer([str(d)], self.master.url, "127.0.0.1",
+                              free_port(), max_volumes=self.max_volumes,
+                              heartbeat_interval=0.3)
+            self.submit(vs.start())
+            self.volume_servers.append(vs)
+        return self
+
+    def stop(self):
+        for vs in self.volume_servers:
+            self.submit(vs.stop())
+        self.submit(self.master.stop())
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(5)
+
+    def wait_heartbeats(self, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.master.topo.nodes) == self.n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError("volume servers did not register")
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path).start()
+    c.wait_heartbeats()
+    yield c
+    c.stop()
+
+
+def test_blob_lifecycle(cluster):
+    client = WeedClient(cluster.master.url)
+    rng = np.random.default_rng(0)
+    payloads = {}
+    for i in range(50):
+        data = rng.integers(0, 256, int(rng.integers(10, 50_000)),
+                            dtype=np.uint8).tobytes()
+        fid = client.upload(data, name=f"f{i}.bin", mime="application/x-test")
+        payloads[fid] = data
+    for fid, data in payloads.items():
+        assert client.download(fid) == data
+    victim = next(iter(payloads))
+    client.delete(victim)
+    with pytest.raises(RuntimeError):
+        client.download(victim)
+    # wrong cookie is rejected
+    vid, _, keycookie = victim.partition(",")
+    bad = f"{vid},{keycookie[:-8]}{'00000000'}"
+    with pytest.raises(RuntimeError):
+        client.download(bad)
+
+
+def test_replicated_write_spans_servers(tmp_path):
+    c = Cluster(tmp_path, n_volume_servers=2, replication="001").start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.master.url)
+        fid = client.upload(b"replicated payload", replication="001")
+        vid = int(fid.partition(",")[0])
+        time.sleep(0.7)  # let heartbeats refresh
+        locs = client.lookup(vid)
+        assert len(locs) == 2, locs
+        # read from each server directly
+        import urllib.request
+        for url in locs:
+            with urllib.request.urlopen(f"http://{url}/{fid}") as r:
+                assert r.read() == b"replicated payload"
+        # delete propagates to both replicas
+        client.delete(fid)
+        for url in locs:
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"http://{url}/{fid}")
+    finally:
+        c.stop()
+
+
+def _fill_volume(client, n_blobs=60, seed=1):
+    rng = np.random.default_rng(seed)
+    payloads = {}
+    for i in range(n_blobs):
+        data = rng.integers(0, 256, int(rng.integers(1000, 80_000)),
+                            dtype=np.uint8).tobytes()
+        fid = client.upload(data, name=f"ec{i}.bin")
+        payloads[fid] = data
+    return payloads
+
+
+def test_ec_encode_degraded_read_rebuild_decode(cluster):
+    client = WeedClient(cluster.master.url)
+    payloads = _fill_volume(client)
+    vids = {int(fid.partition(",")[0]) for fid in payloads}
+    time.sleep(0.7)
+
+    env = CommandEnv(cluster.master.url)
+    out = io.StringIO()
+    run_command(env, "lock", out)
+    for vid in sorted(vids):
+        run_command(env, f"ec.encode -volumeId {vid}", out)
+    time.sleep(0.7)  # shard heartbeats
+
+    # all blobs must read back through the EC path (normal volume is gone)
+    client._vid_cache.clear()
+    for fid, data in payloads.items():
+        assert client.download(fid) == data, fid
+
+    # delete shards on one server -> degraded reads reconstruct on the fly
+    vs0 = cluster.volume_servers[0]
+    import urllib.request, json as _json
+    for vid in sorted(vids):
+        shards0 = [vid_s for loc in vs0.store.locations
+                   for vid_s in ([] if vid not in loc.ec_volumes else
+                                 loc.ec_volumes[vid].shard_ids())]
+        if not shards0:
+            continue
+        drop = shards0[:2]
+        body = _json.dumps({"volume": vid, "shards": drop}).encode()
+        req = urllib.request.Request(
+            f"http://{vs0.url}/admin/ec/delete_shards", data=body,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req).close()
+    time.sleep(0.7)
+    client._vid_cache.clear()
+    for fid, data in payloads.items():
+        assert client.download(fid) == data, f"degraded read {fid}"
+
+    # rebuild the dropped shards, then decode back to a normal volume
+    run_command(env, "ec.rebuild", out)
+    time.sleep(0.7)
+    for vid in sorted(vids):
+        locs = env.ec_shard_locations(vid)
+        assert sorted(locs) == list(range(14)), (vid, sorted(locs))
+        run_command(env, f"ec.decode -volumeId {vid}", out)
+    time.sleep(0.7)
+    client._vid_cache.clear()
+    for fid, data in payloads.items():
+        assert client.download(fid) == data, f"post-decode read {fid}"
+    run_command(env, "unlock", out)
+
+
+def test_shell_requires_lock(cluster):
+    env = CommandEnv(cluster.master.url)
+    with pytest.raises(RuntimeError, match="lock"):
+        run_command(env, "volume.vacuum -volumeId 1", io.StringIO())
+
+
+def test_vacuum_via_shell(cluster):
+    client = WeedClient(cluster.master.url)
+    fids = [client.upload(bytes(2000)) for _ in range(20)]
+    for fid in fids[:15]:
+        client.delete(fid)
+    vid = int(fids[0].partition(",")[0])
+    time.sleep(0.5)
+    env = CommandEnv(cluster.master.url)
+    out = io.StringIO()
+    run_command(env, "lock", out)
+    run_command(env, f"volume.vacuum -volumeId {vid}", out)
+    run_command(env, "unlock", out)
+    assert "garbage" in out.getvalue()
+    for fid in fids[15:]:
+        assert client.download(fid) == bytes(2000)
