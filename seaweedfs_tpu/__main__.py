@@ -24,13 +24,14 @@ def _add_master_flags(p):
 
 def _add_volume_flags(p, with_master=True):
     p.add_argument("-dir", action="append", required=True)
-    p.add_argument("-ip", default="127.0.0.1")
-    p.add_argument("-port", type=int, default=8080)
     p.add_argument("-publicUrl", default="")
     p.add_argument("-max", type=int, default=8)
     p.add_argument("-dataCenter", default="")
     p.add_argument("-rack", default="")
     if with_master:
+        # standalone volume server: its own ip/port + master address
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-port", type=int, default=8080)
         p.add_argument("-mserver", default="127.0.0.1:9333")
 
 
@@ -48,6 +49,19 @@ def main(argv=None) -> int:
     _add_master_flags(ps)
     _add_volume_flags(ps, with_master=False)
     ps.add_argument("-volumePort", type=int, default=8080)
+    ps.add_argument("-filer", action="store_true",
+                    help="also run a filer (in-proc, sqlite store in -dir)")
+    ps.add_argument("-filerPort", type=int, default=8888)
+
+    pf = sub.add_parser("filer")
+    pf.add_argument("-ip", default="127.0.0.1")
+    pf.add_argument("-port", type=int, default=8888)
+    pf.add_argument("-master", default="127.0.0.1:9333")
+    pf.add_argument("-dir", default=None,
+                    help="metadata dir (sqlite store); omit for in-memory")
+    pf.add_argument("-collection", default="")
+    pf.add_argument("-defaultReplication", default="")
+    pf.add_argument("-maxMB", type=int, default=4)
 
     psh = sub.add_parser("shell")
     psh.add_argument("-master", default="127.0.0.1:9333")
@@ -66,6 +80,8 @@ def main(argv=None) -> int:
         return asyncio.run(_run_master(args))
     if args.cmd == "volume":
         return asyncio.run(_run_volume(args))
+    if args.cmd == "filer":
+        return asyncio.run(_run_filer(args))
     if args.cmd == "server":
         return asyncio.run(_run_server(args))
     if args.cmd == "shell":
@@ -105,6 +121,18 @@ async def _run_volume(args) -> int:
     return 0
 
 
+async def _run_filer(args) -> int:
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    f = FilerServer(args.master, args.ip, args.port, data_dir=args.dir,
+                    collection=args.collection,
+                    replication=args.defaultReplication,
+                    chunk_size=args.maxMB << 20)
+    await f.start()
+    await _serve_forever()
+    await f.stop()
+    return 0
+
+
 async def _run_server(args) -> int:
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
@@ -116,7 +144,14 @@ async def _run_server(args) -> int:
                      public_url=args.publicUrl, max_volumes=args.max,
                      data_center=args.dataCenter, rack=args.rack)
     await v.start()
+    f = None
+    if getattr(args, "filer", False):
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        f = FilerServer(m.url, args.ip, args.filerPort, data_dir=args.dir[0])
+        await f.start()
     await _serve_forever()
+    if f:
+        await f.stop()
     await v.stop()
     await m.stop()
     return 0

@@ -1,0 +1,9 @@
+"""Filer metadata layer (L4): entries, chunking, stores, event log.
+
+The file-semantics brain of the framework — capability parity with
+weed/filer/ in the reference (see SURVEY.md §2.4)."""
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk  # noqa: F401
+from seaweedfs_tpu.filer.filer import Filer, MetaEvent  # noqa: F401
+from seaweedfs_tpu.filer.filerstore import (  # noqa: F401
+    FilerStore, MemoryStore, NotFound, SqliteStore, make_store)

@@ -1,0 +1,78 @@
+"""Chunk manifests: batch many chunk refs into a stored blob.
+
+Huge files would otherwise carry 100k+ chunk refs in their metadata row;
+the reference batches every 10,000 refs into a "chunk of chunks" blob
+stored in the blob store itself and resolved recursively at read
+(weed/filer/filechunk_manifest.go: ManifestBatch=10000,
+ResolveChunkManifest:52, maybeManifestize:215). Same contract here with a
+JSON manifest payload.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+from seaweedfs_tpu.filer.entry import FileChunk
+
+MANIFEST_BATCH = 10000
+
+SaveFunc = Callable[[bytes], FileChunk]   # bytes -> stored chunk ref
+ReadFunc = Callable[[str], bytes]         # fid -> chunk bytes
+
+
+def has_chunk_manifest(chunks: list[FileChunk]) -> bool:
+    return any(c.is_chunk_manifest for c in chunks)
+
+
+def maybe_manifestize(save: SaveFunc, chunks: list[FileChunk],
+                      batch: int = MANIFEST_BATCH) -> list[FileChunk]:
+    """If the ref list is long, replace runs of `batch` non-manifest chunks
+    with manifest chunks. Idempotent; already-manifest refs pass through."""
+    if len(chunks) <= batch:
+        return chunks
+    plain = [c for c in chunks if not c.is_chunk_manifest]
+    out = [c for c in chunks if c.is_chunk_manifest]
+    for i in range(0, len(plain), batch):
+        group = plain[i:i + batch]
+        if len(group) < batch:
+            out.extend(group)
+            break
+        out.append(_manifestize(save, group))
+    out.sort(key=lambda c: c.offset)
+    return out
+
+
+def manifest_payload(group: list[FileChunk]) -> bytes:
+    """The stored manifest blob for a group of chunk refs."""
+    return json.dumps({"chunks": [c.to_dict() for c in group]},
+                      separators=(",", ":")).encode()
+
+
+def manifest_ref(stored: FileChunk, group: list[FileChunk]) -> FileChunk:
+    """The chunk ref that replaces `group`, pointing at the stored
+    manifest blob."""
+    start = min(c.offset for c in group)
+    stop = max(c.offset + c.size for c in group)
+    return FileChunk(fid=stored.fid, offset=start, size=stop - start,
+                     mtime=max(c.mtime for c in group), etag=stored.etag,
+                     is_chunk_manifest=True)
+
+
+def _manifestize(save: SaveFunc, group: list[FileChunk]) -> FileChunk:
+    return manifest_ref(save(manifest_payload(group)), group)
+
+
+def resolve_chunk_manifest(read: ReadFunc,
+                           chunks: list[FileChunk]) -> list[FileChunk]:
+    """Recursively expand manifest refs into the full flat chunk list
+    (reference: ResolveChunkManifest)."""
+    out: list[FileChunk] = []
+    for c in chunks:
+        if not c.is_chunk_manifest:
+            out.append(c)
+            continue
+        payload = json.loads(read(c.fid))
+        nested = [FileChunk.from_dict(d) for d in payload["chunks"]]
+        out.extend(resolve_chunk_manifest(read, nested))
+    return out

@@ -1,0 +1,361 @@
+"""FilerStore SPI: the pluggable metadata backend interface.
+
+Mirrors the reference's 8-method plugin contract
+(weed/filer/filerstore.go:21-45: Insert/Update/Find/Delete entry,
+DeleteFolderChildren, ListDirectoryEntries, KV get/put/delete, Begin/
+Commit/Rollback) plus the wrapper that layers counters over any store
+(filerstore_wrapper.go). Store drivers register in STORES by name and are
+selected by config — the analogue of the reference's blank-import
+registration (weed/command/imports.go:17-36).
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from collections import defaultdict
+
+from seaweedfs_tpu.filer.entry import Entry, split_path
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class FilerStore(abc.ABC):
+    """Metadata backend contract. Paths are absolute ('/a/b/c'); the root
+    directory '/' always implicitly exists. Listing returns entries sorted
+    by name."""
+
+    name = "abstract"
+
+    @abc.abstractmethod
+    def insert_entry(self, entry: Entry) -> None: ...
+
+    @abc.abstractmethod
+    def update_entry(self, entry: Entry) -> None: ...
+
+    @abc.abstractmethod
+    def find_entry(self, full_path: str) -> Entry: ...  # raises NotFound
+
+    @abc.abstractmethod
+    def delete_entry(self, full_path: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete_folder_children(self, full_path: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]: ...
+
+    # -- generic KV (used for filer.conf, iam, sync offsets) -----------
+
+    @abc.abstractmethod
+    def kv_put(self, key: bytes, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def kv_get(self, key: bytes) -> bytes: ...  # raises NotFound
+
+    @abc.abstractmethod
+    def kv_delete(self, key: bytes) -> None: ...
+
+    # -- lifecycle / tx (default no-op, like most reference stores) ----
+
+    def initialize(self, **options) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def begin_transaction(self):
+        return None
+
+    def commit_transaction(self, tx) -> None:
+        pass
+
+    def rollback_transaction(self, tx) -> None:
+        pass
+
+
+class FilerStoreWrapper(FilerStore):
+    """Pass-through wrapper adding op counters (the reference wrapper also
+    adds per-store metrics + path translation, filerstore_wrapper.go)."""
+
+    name = "wrapper"
+
+    def __init__(self, actual: FilerStore):
+        self.actual = actual
+        self.counters: dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def _count(self, op: str) -> None:
+        with self._lock:
+            self.counters[op] += 1
+
+    def insert_entry(self, entry: Entry) -> None:
+        self._count("insert")
+        self.actual.insert_entry(entry)
+
+    def update_entry(self, entry: Entry) -> None:
+        self._count("update")
+        self.actual.update_entry(entry)
+
+    def find_entry(self, full_path: str) -> Entry:
+        self._count("find")
+        return self.actual.find_entry(full_path)
+
+    def delete_entry(self, full_path: str) -> None:
+        self._count("delete")
+        self.actual.delete_entry(full_path)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        self._count("delete_folder_children")
+        self.actual.delete_folder_children(full_path)
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        self._count("list")
+        return self.actual.list_directory_entries(
+            dir_path, start_from, include_start, limit, prefix)
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.actual.kv_put(key, value)
+
+    def kv_get(self, key: bytes) -> bytes:
+        return self.actual.kv_get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self.actual.kv_delete(key)
+
+    def shutdown(self) -> None:
+        self.actual.shutdown()
+
+
+class MemoryStore(FilerStore):
+    """In-RAM store: dict of directory -> {name: Entry blob}. The test /
+    ephemeral-filer store (plays the role of the reference's leveldb default
+    for unit tests)."""
+
+    name = "memory"
+
+    def __init__(self):
+        self._dirs: dict[str, dict[str, bytes]] = defaultdict(dict)
+        self._kv: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = split_path(entry.full_path)
+        with self._lock:
+            self._dirs[d][n] = entry.encode()
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = split_path(full_path)
+        with self._lock:
+            blob = self._dirs.get(d, {}).get(n)
+        if blob is None:
+            raise NotFound(full_path)
+        return Entry.decode(blob)
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = split_path(full_path)
+        with self._lock:
+            self._dirs.get(d, {}).pop(n, None)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        full_path = full_path.rstrip("/") or "/"
+        with self._lock:
+            pref = full_path if full_path.endswith("/") else full_path + "/"
+            for d in [k for k in self._dirs if k == full_path or
+                      k.startswith(pref)]:
+                del self._dirs[d]
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = dir_path.rstrip("/") or "/"
+        with self._lock:
+            names = sorted(self._dirs.get(dir_path, {}))
+            out = []
+            for n in names:
+                if prefix and not n.startswith(prefix):
+                    continue
+                if start_from:
+                    if n < start_from or (n == start_from and not include_start):
+                        continue
+                out.append(Entry.decode(self._dirs[dir_path][n]))
+                if len(out) >= limit:
+                    break
+            return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: bytes) -> bytes:
+        with self._lock:
+            if key not in self._kv:
+                raise NotFound(key)
+            return self._kv[key]
+
+    def kv_delete(self, key: bytes) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+
+
+class SqliteStore(FilerStore):
+    """Embedded persistent store on stdlib sqlite3 — the same schema shape
+    as the reference's abstract_sql layer (weed/filer/abstract_sql/
+    abstract_sql_store.go: (dirhash, name, directory, meta) rows with a
+    (dirhash, name) primary key; the reference's sqlite driver rides that
+    layer too)."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str):
+        import sqlite3
+        self.path = path
+        self._local = threading.local()
+        self._sqlite3 = sqlite3
+        conn = self._conn()
+        conn.executescript("""
+            CREATE TABLE IF NOT EXISTS filemeta (
+                dirhash INTEGER NOT NULL,
+                name TEXT NOT NULL,
+                directory TEXT NOT NULL,
+                meta BLOB,
+                PRIMARY KEY (dirhash, name)
+            );
+            CREATE INDEX IF NOT EXISTS idx_dir ON filemeta (directory);
+            CREATE TABLE IF NOT EXISTS kv (
+                key BLOB PRIMARY KEY,
+                value BLOB
+            );
+        """)
+        conn.commit()
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._sqlite3.connect(self.path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    @staticmethod
+    def _dirhash(directory: str) -> int:
+        """Stable 64-bit dir hash (reference: util.HashStringToLong), kept
+        signed for sqlite INTEGER."""
+        import hashlib
+        h = hashlib.md5(directory.encode()).digest()
+        v = int.from_bytes(h[:8], "big", signed=True)
+        return v
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = split_path(entry.full_path)
+        conn = self._conn()
+        conn.execute(
+            "INSERT OR REPLACE INTO filemeta (dirhash,name,directory,meta) "
+            "VALUES (?,?,?,?)", (self._dirhash(d), n, d, entry.encode()))
+        conn.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = split_path(full_path)
+        cur = self._conn().execute(
+            "SELECT meta FROM filemeta WHERE dirhash=? AND name=?",
+            (self._dirhash(d), n))
+        row = cur.fetchone()
+        if row is None:
+            raise NotFound(full_path)
+        return Entry.decode(row[0])
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = split_path(full_path)
+        conn = self._conn()
+        conn.execute("DELETE FROM filemeta WHERE dirhash=? AND name=?",
+                     (self._dirhash(d), n))
+        conn.commit()
+
+    def delete_folder_children(self, full_path: str) -> None:
+        full_path = full_path.rstrip("/") or "/"
+        conn = self._conn()
+        pref = full_path if full_path.endswith("/") else full_path + "/"
+        esc = (pref.replace("\\", r"\\").replace("%", r"\%")
+               .replace("_", r"\_"))
+        conn.execute(
+            r"DELETE FROM filemeta WHERE directory=? "
+            r"OR directory LIKE ? ESCAPE '\'",
+            (full_path, esc + "%"))
+        conn.commit()
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = dir_path.rstrip("/") or "/"
+        cmp = ">=" if include_start else ">"
+        sql = "SELECT meta FROM filemeta WHERE dirhash=? AND directory=?"
+        params: list = [self._dirhash(dir_path), dir_path]
+        if start_from:
+            sql += f" AND name {cmp} ?"
+            params.append(start_from)
+        if prefix:
+            sql += r" AND name LIKE ? ESCAPE '\'"
+            params.append(prefix.replace("\\", r"\\").replace("%", r"\%")
+                          .replace("_", r"\_") + "%")
+        sql += " ORDER BY name LIMIT ?"
+        params.append(limit)
+        cur = self._conn().execute(sql, params)
+        return [Entry.decode(row[0]) for row in cur.fetchall()]
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        conn = self._conn()
+        conn.execute("INSERT OR REPLACE INTO kv (key,value) VALUES (?,?)",
+                     (key, value))
+        conn.commit()
+
+    def kv_get(self, key: bytes) -> bytes:
+        cur = self._conn().execute("SELECT value FROM kv WHERE key=?", (key,))
+        row = cur.fetchone()
+        if row is None:
+            raise NotFound(key)
+        return row[0]
+
+    def kv_delete(self, key: bytes) -> None:
+        conn = self._conn()
+        conn.execute("DELETE FROM kv WHERE key=?", (key,))
+        conn.commit()
+
+    def shutdown(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+STORES: dict[str, type] = {
+    "memory": MemoryStore,
+    "sqlite": SqliteStore,
+}
+
+
+def make_store(kind: str, **options) -> FilerStore:
+    try:
+        cls = STORES[kind]
+    except KeyError:
+        raise StoreError(
+            f"unknown filer store {kind!r}; have {sorted(STORES)}") from None
+    return cls(**options)

@@ -1,0 +1,536 @@
+"""Filer server: HTTP file API over the Filer metadata core + blob store.
+
+Capability parity with the reference filer server (weed/server/
+filer_server.go, filer_server_handlers_write_autochunk.go:26-151,
+filer_server_handlers_read.go + weed/filer/stream.go):
+
+  POST/PUT /path/file   upload; body auto-chunked into blob-store chunks
+                        assigned by the master (?collection ?replication
+                        ?ttl ?maxMB override path rules); `Seaweed-`
+                        headers become extended attrs; trailing slash or
+                        empty body with dir mime creates a directory
+  GET /path/file        stream file (Range supported); ?metadata=true
+                        returns the entry JSON
+  GET /path/dir/        JSON listing (?limit ?lastFileName ?prefix)
+  HEAD                  attrs only
+  DELETE                ?recursive=true for dirs; chunks enqueued for
+                        background blob deletion
+  POST /new?mv.from=/x  rename/move (subtree-safe)
+
+Plus the meta-event feed the reference serves over gRPC
+(SubscribeMetadata): GET /__meta__/subscribe?since=<ts_ns> streams JSONL
+events, replay-then-live, for filer.sync and gateway cache invalidation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import time
+
+import aiohttp
+from aiohttp import web
+
+from seaweedfs_tpu.client import WeedClient
+from seaweedfs_tpu.filer import filechunk_manifest as fcm
+from seaweedfs_tpu.filer import filechunks as fc
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk, new_directory_entry
+from seaweedfs_tpu.filer.filer import Filer, dir_has_prefix
+from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
+                                            load_filer_conf, save_filer_conf)
+from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
+from seaweedfs_tpu.filer.filerstore import (MemoryStore, NotFound,
+                                            SqliteStore)
+from seaweedfs_tpu.utils.http import parse_range
+
+log = logging.getLogger("filer")
+
+DEFAULT_CHUNK_SIZE = 4 * 1024 * 1024  # reference filer -maxMB default (4MB)
+
+
+class FilerServer:
+    def __init__(self, master_url: str, host: str = "127.0.0.1",
+                 port: int = 8888, data_dir: str | None = None,
+                 collection: str = "", replication: str = "",
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 jwt_signer=None):
+        self.master_url = master_url
+        self.host, self.port = host, port
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        self.jwt_signer = jwt_signer
+
+        if data_dir:
+            import os
+            os.makedirs(data_dir, exist_ok=True)
+            store = SqliteStore(os.path.join(data_dir, "filer.db"))
+            meta_log_path = os.path.join(data_dir, "meta_events.jsonl")
+        else:
+            store = MemoryStore()
+            meta_log_path = None
+        self.deletion = DeletionQueue(WeedClient(master_url),
+                                      resolve_manifest=self._resolve_for_delete)
+        self.filer = Filer(store, meta_log_path=meta_log_path,
+                           on_delete_chunks=self.deletion.enqueue_chunks)
+        self.conf: FilerConf = load_filer_conf(self.filer.store)
+
+        self.app = web.Application(client_max_size=1024 * 1024 * 1024)
+        self.app.add_routes([
+            web.get("/__meta__/subscribe", self.handle_meta_subscribe),
+            web.get("/__admin__/filer_conf", self.handle_get_conf),
+            web.post("/__admin__/filer_conf", self.handle_put_conf),
+            web.get("/__admin__/status", self.handle_status),
+            web.route("*", "/{path:.*}", self.handle_path),
+        ])
+        self._runner: web.AppRunner | None = None
+        self._session: aiohttp.ClientSession | None = None
+        self._subscribers: set[asyncio.Queue] = set()
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=60))
+        self.deletion.start()
+        self.filer.meta_log.subscribe(self._fanout_event)
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        log.info("filer listening on %s", self.url)
+
+    async def stop(self) -> None:
+        self.deletion.stop(drain=False)
+        if self._session:
+            await self._session.close()
+        if self._runner:
+            await self._runner.cleanup()
+        self.filer.meta_log.close()
+        self.filer.store.shutdown()
+
+    def _fanout_event(self, ev) -> None:
+        if self._loop is None:
+            return
+        payload = json.dumps(ev.to_dict(), separators=(",", ":"))
+
+        def put():
+            for q in list(self._subscribers):
+                if q.qsize() < 4096:
+                    q.put_nowait(payload)
+        self._loop.call_soon_threadsafe(put)
+
+    # -- helpers -------------------------------------------------------
+
+    def _resolve_for_delete(self, chunks):
+        return fcm.resolve_chunk_manifest(
+            lambda fid: self._read_chunk_blob_sync(fid), chunks)
+
+    def _read_chunk_blob_sync(self, fid: str) -> bytes:
+        # runs only on the deletion worker thread, never the event loop
+        return self.deletion.client.download(fid)
+
+    async def _assign(self, collection: str, replication: str,
+                      ttl: str) -> dict:
+        params = {"count": "1"}
+        if collection:
+            params["collection"] = collection
+        if replication:
+            params["replication"] = replication
+        if ttl:
+            params["ttl"] = ttl
+        async with self._session.get(
+                f"http://{self.master_url}/dir/assign", params=params) as r:
+            a = await r.json()
+        if "error" in a:
+            raise RuntimeError(f"assign: {a['error']}")
+        return a
+
+    async def _upload_chunk(self, data: bytes, collection: str,
+                            replication: str, ttl: str) -> FileChunk:
+        a = await self._assign(collection, replication, ttl)
+        headers = {"Content-Type": "application/octet-stream"}
+        if self.jwt_signer:
+            headers["Authorization"] = "BEARER " + self.jwt_signer(a["fid"])
+        async with self._session.put(
+                f"http://{a['url']}/{a['fid']}", data=data,
+                headers=headers) as r:
+            if r.status >= 300:
+                raise RuntimeError(f"chunk upload: HTTP {r.status}")
+        return FileChunk(fid=a["fid"], offset=0, size=len(data),
+                         mtime=time.time_ns(),
+                         etag=hashlib.md5(data).hexdigest())
+
+    async def _fetch_chunk(self, fid: str) -> bytes:
+        vid = fid.partition(",")[0]
+        async with self._session.get(
+                f"http://{self.master_url}/dir/lookup",
+                params={"volumeId": vid}) as r:
+            locs = (await r.json()).get("locations", [])
+        last = None
+        for loc in locs:
+            try:
+                async with self._session.get(f"http://{loc['url']}/{fid}") as r:
+                    if r.status == 200:
+                        return await r.read()
+                    last = f"HTTP {r.status}"
+            except aiohttp.ClientError as e:
+                last = str(e)
+        raise IOError(f"chunk {fid}: {last or 'no locations'}")
+
+    async def _resolve_chunks(self, entry: Entry) -> list[FileChunk]:
+        """Expand manifest refs, fetching manifest blobs level by level
+        (they may nest)."""
+        out = entry.chunks
+        while fcm.has_chunk_manifest(out):
+            blobs = {c.fid: await self._fetch_chunk(c.fid)
+                     for c in out if c.is_chunk_manifest}
+            expanded: list[FileChunk] = []
+            for c in out:
+                if not c.is_chunk_manifest:
+                    expanded.append(c)
+                    continue
+                payload = json.loads(blobs[c.fid])
+                expanded.extend(FileChunk.from_dict(d)
+                                for d in payload["chunks"])
+            out = expanded
+        return out
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        path = "/" + path.strip("/")
+        return path
+
+    # -- main dispatch -------------------------------------------------
+
+    async def handle_path(self, req: web.Request) -> web.StreamResponse:
+        raw = req.match_info["path"]
+        is_dir_request = raw.endswith("/") or raw == ""
+        path = self._norm(raw)
+        try:
+            if req.method in ("POST", "PUT"):
+                if "mv.from" in req.query:
+                    return await self._handle_move(req, path)
+                return await self._handle_upload(req, path, is_dir_request)
+            if req.method in ("GET", "HEAD"):
+                return await self._handle_read(req, path, is_dir_request)
+            if req.method == "DELETE":
+                return await self._handle_delete(req, path)
+        except NotFound:
+            return web.json_response({"error": "not found"}, status=404)
+        except (IsADirectoryError, NotADirectoryError, FileExistsError) as e:
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=409)
+        return web.json_response({"error": "method not allowed"}, status=405)
+
+    # -- write ---------------------------------------------------------
+
+    async def _handle_move(self, req: web.Request, path: str) -> web.Response:
+        src = self._norm(req.query["mv.from"])
+        try:
+            moved = self.filer.rename_entry(src, path)
+        except (FileExistsError, NotADirectoryError, OSError) as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"path": moved.full_path})
+
+    async def _handle_upload(self, req: web.Request, path: str,
+                             is_dir_request: bool) -> web.Response:
+        rule = self.conf.match(path)
+        if rule.read_only:
+            return web.json_response({"error": "read only path"}, status=403)
+        collection = req.query.get("collection") or rule.collection or \
+            self.collection
+        replication = req.query.get("replication") or rule.replication or \
+            self.replication
+        ttl = req.query.get("ttl") or rule.ttl
+        chunk_size = int(req.query.get("maxMB", "0")) * 1024 * 1024 or \
+            self.chunk_size
+
+        if is_dir_request and path != "/":
+            d = new_directory_entry(path)
+            self._apply_headers(d, req)
+            self.filer.create_entry(d)
+            return web.json_response({"name": d.name}, status=201)
+
+        # autochunk the body (reference: doPostAutoChunk)
+        chunks: list[FileChunk] = []
+        md5 = hashlib.md5()
+        total = 0
+        pending = bytearray()
+        content = req.content
+        try:
+            while True:
+                piece = await content.read(min(chunk_size, 1 << 20))
+                if not piece:
+                    break
+                md5.update(piece)
+                pending.extend(piece)
+                while len(pending) >= chunk_size:
+                    blob = bytes(pending[:chunk_size])
+                    del pending[:chunk_size]
+                    ck = await self._upload_chunk(blob, collection,
+                                                  replication, ttl)
+                    ck.offset = total
+                    total += len(blob)
+                    chunks.append(ck)
+            if pending:  # empty files carry no chunks, like the reference
+                blob = bytes(pending)
+                ck = await self._upload_chunk(blob, collection,
+                                              replication, ttl)
+                ck.offset = total
+                total += len(blob)
+                chunks.append(ck)
+        except (RuntimeError, OSError, aiohttp.ClientError) as e:
+            # clean up already-written chunks on failure
+            self.deletion.enqueue_chunks(chunks)
+            return web.json_response({"error": str(e)}, status=500)
+
+        # many-chunk files get manifestized through the blob store
+        if len(chunks) > fcm.MANIFEST_BATCH:
+            try:
+                chunks = await self._maybe_manifestize_async(
+                    chunks, collection, replication, ttl)
+            except (RuntimeError, OSError, aiohttp.ClientError) as e:
+                self.deletion.enqueue_chunks(chunks)
+                return web.json_response({"error": str(e)}, status=500)
+
+        now = time.time()
+        mime = req.headers.get("Content-Type", "")
+        if mime in ("application/octet-stream", ""):
+            import mimetypes
+            mime = mimetypes.guess_type(path)[0] or mime
+        entry = Entry(
+            full_path=path,
+            attr=Attr(mtime=now, crtime=now, mode=0o660, mime=mime,
+                      ttl_sec=_ttl_seconds(ttl), md5=md5.hexdigest(),
+                      file_size=total),
+            chunks=chunks)
+        self._apply_headers(entry, req)
+        self.filer.create_entry(entry)
+        return web.json_response(
+            {"name": entry.name, "size": total, "eTag": md5.hexdigest()},
+            status=201)
+
+    async def _maybe_manifestize_async(self, chunks, collection,
+                                       replication, ttl):
+        """Async twin of fcm.maybe_manifestize (same grouping, shared
+        payload/ref builders; the save callback here is an await)."""
+        plain = [c for c in chunks if not c.is_chunk_manifest]
+        out = [c for c in chunks if c.is_chunk_manifest]
+        for i in range(0, len(plain), fcm.MANIFEST_BATCH):
+            group = plain[i:i + fcm.MANIFEST_BATCH]
+            if len(group) < fcm.MANIFEST_BATCH:
+                out.extend(group)
+                break
+            stored = await self._upload_chunk(
+                fcm.manifest_payload(group), collection, replication, ttl)
+            out.append(fcm.manifest_ref(stored, group))
+        out.sort(key=lambda c: c.offset)
+        return out
+
+    @staticmethod
+    def _apply_headers(entry: Entry, req: web.Request) -> None:
+        for k, v in req.headers.items():
+            if k.lower().startswith("seaweed-"):
+                entry.extended[k[len("Seaweed-"):]] = v
+
+    # -- read ----------------------------------------------------------
+
+    async def _handle_read(self, req: web.Request, path: str,
+                           is_dir_request: bool) -> web.StreamResponse:
+        entry = self.filer.find_entry(path)
+        if req.query.get("metadata") == "true":
+            return web.json_response(entry.to_dict())
+        if entry.is_directory:
+            return await self._list_directory(req, path)
+
+        chunks = await self._resolve_chunks(entry)
+        size = max(entry.size(), fc.total_size(chunks))
+        headers = {
+            "Accept-Ranges": "bytes",
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT",
+                time.gmtime(entry.attr.mtime)),
+        }
+        if entry.attr.md5:
+            headers["ETag"] = f'"{entry.attr.md5}"'
+        for k, v in entry.extended.items():
+            headers[f"Seaweed-{k}"] = v
+        mime = entry.attr.mime or "application/octet-stream"
+
+        rng = req.headers.get("Range", "")
+        offset, length, status = 0, size, 200
+        if rng.startswith("bytes="):
+            try:
+                offset, length = parse_range(rng, size)
+                status = 206
+                headers["Content-Range"] = \
+                    f"bytes {offset}-{offset + length - 1}/{size}"
+            except ValueError:
+                return web.Response(
+                    status=416, headers={"Content-Range": f"bytes */{size}"})
+
+        if req.method == "HEAD":
+            headers["Content-Length"] = str(length)
+            return web.Response(status=status, headers=headers,
+                                content_type=mime)
+
+        resp = web.StreamResponse(status=status, headers=headers)
+        resp.content_type = mime
+        resp.content_length = length
+        await resp.prepare(req)
+        await self._stream_range(resp, chunks, offset, length)
+        await resp.write_eof()
+        return resp
+
+    async def _stream_range(self, resp, chunks: list[FileChunk],
+                            offset: int, length: int) -> None:
+        """Stream [offset, offset+length) to the client, zero-filling
+        sparse gaps (reference: filer/stream.go StreamContent)."""
+        views = fc.view_from_chunks(chunks, offset, length)
+        pos = offset
+        for v in views:
+            if v.logic_offset > pos:
+                await _write_zeros(resp, v.logic_offset - pos)
+                pos = v.logic_offset
+            blob = await self._fetch_chunk(v.fid)
+            await resp.write(blob[v.offset_in_chunk:v.offset_in_chunk + v.size])
+            pos += v.size
+        if pos < offset + length:
+            await _write_zeros(resp, offset + length - pos)
+
+    async def _list_directory(self, req: web.Request,
+                              path: str) -> web.Response:
+        limit = int(req.query.get("limit", "100"))
+        last = req.query.get("lastFileName", "")
+        prefix = req.query.get("prefix", "")
+        entries = self.filer.list_entries(path, start_from=last,
+                                          include_start=False,
+                                          limit=limit + 1, prefix=prefix)
+        more = len(entries) > limit
+        entries = entries[:limit]
+        return web.json_response({
+            "Path": path,
+            "Entries": [_entry_json(e) for e in entries],
+            "Limit": limit,
+            "LastFileName": entries[-1].name if entries else "",
+            "ShouldDisplayLoadMore": more,
+        })
+
+    # -- delete --------------------------------------------------------
+
+    async def _handle_delete(self, req: web.Request,
+                             path: str) -> web.Response:
+        recursive = req.query.get("recursive") == "true"
+        ignore = req.query.get("ignoreRecursiveError") == "true"
+        try:
+            self.filer.delete_entry(path, recursive=recursive,
+                                    ignore_recursive_error=ignore)
+        except OSError as e:
+            if isinstance(e, (FileNotFoundError,)) or "not found" in str(e):
+                return web.json_response({"error": str(e)}, status=404)
+            return web.json_response({"error": str(e)}, status=409)
+        return web.Response(status=204)
+
+    # -- meta subscribe ------------------------------------------------
+
+    async def handle_meta_subscribe(self, req: web.Request) -> web.StreamResponse:
+        since = int(req.query.get("since", "0"))
+        prefix = req.query.get("prefix", "/")
+        live = req.query.get("live", "true") == "true"
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(req)
+        q: asyncio.Queue = asyncio.Queue()
+        if live:
+            self._subscribers.add(q)
+        try:
+            last_ts = since
+            for ev in self.filer.meta_log.replay(since_ts_ns=since,
+                                                 prefix=prefix):
+                await resp.write(json.dumps(
+                    ev.to_dict(), separators=(",", ":")).encode() + b"\n")
+                last_ts = ev.ts_ns
+            if not live:
+                await resp.write_eof()
+                return resp
+            while True:
+                payload = await q.get()
+                d = json.loads(payload)
+                if d["ts_ns"] <= last_ts:
+                    continue
+                if not dir_has_prefix(d["directory"], prefix):
+                    continue
+                await resp.write(payload.encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._subscribers.discard(q)
+        return resp
+
+    # -- admin ---------------------------------------------------------
+
+    async def handle_get_conf(self, req: web.Request) -> web.Response:
+        return web.Response(text=self.conf.to_json(),
+                            content_type="application/json")
+
+    async def handle_put_conf(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        if "locations" in body:
+            self.conf = FilerConf.from_json(json.dumps(body))
+        else:
+            self.conf.upsert(PathConf(**{
+                k: v for k, v in body.items()
+                if k in PathConf.__dataclass_fields__}))
+        save_filer_conf(self.filer.store, self.conf)
+        return web.json_response({"ok": True})
+
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "master": self.master_url,
+            "store": self.filer.store.actual.name,
+            "counters": dict(self.filer.store.counters),
+            "deletion_pending": self.deletion.pending_count(),
+            "deletion_done": self.deletion.deleted_count,
+        })
+
+
+def _entry_json(e: Entry) -> dict:
+    return {
+        "FullPath": e.full_path,
+        "Mtime": e.attr.mtime,
+        "Crtime": e.attr.crtime,
+        "Mode": e.attr.mode,
+        "Mime": e.attr.mime,
+        "FileSize": e.size(),
+        "IsDirectory": e.is_directory,
+        "Md5": e.attr.md5,
+        "Extended": e.extended,
+        "chunks": len(e.chunks),
+    }
+
+
+async def _write_zeros(resp, n: int, block: int = 1 << 20) -> None:
+    zero = bytes(min(n, block))
+    while n > 0:
+        step = min(n, len(zero))
+        await resp.write(zero[:step])
+        n -= step
+
+
+def _ttl_seconds(ttl: str) -> int:
+    if not ttl:
+        return 0
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 7 * 86400,
+             "M": 30 * 86400, "y": 365 * 86400}
+    if ttl[-1] in units:
+        return int(ttl[:-1]) * units[ttl[-1]]
+    return int(ttl)

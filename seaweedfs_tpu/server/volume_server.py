@@ -218,13 +218,26 @@ class VolumeServer:
             return web.json_response({"error": "cookie mismatch"}, status=404)
         except IOError as e:
             return web.json_response({"error": str(e)}, status=500)
-        headers = {"Etag": f'"{n.checksum:x}"'}
+        headers = {"Etag": f'"{n.checksum:x}"', "Accept-Ranges": "bytes"}
         if n.name:
             headers["Content-Disposition"] = \
                 f'inline; filename="{n.name.decode(errors="replace")}"'
-        body = b"" if req.method == "HEAD" else n.data
+        data, status = n.data, 200
+        rng = req.headers.get("Range", "")
+        if rng.startswith("bytes=") and data:
+            from seaweedfs_tpu.utils.http import parse_range
+            try:
+                lo, length = parse_range(rng, len(data))
+            except ValueError:
+                return web.Response(
+                    status=416,
+                    headers={"Content-Range": f"bytes */{len(data)}"})
+            headers["Content-Range"] = \
+                f"bytes {lo}-{lo + length - 1}/{len(data)}"
+            data, status = data[lo:lo + length], 206
+        body = b"" if req.method == "HEAD" else data
         return web.Response(
-            body=body,
+            body=body, status=status,
             content_type=(n.mime.decode() if n.mime else "application/octet-stream"),
             headers=headers)
 

@@ -1,0 +1,24 @@
+"""Shared HTTP helpers for the servers."""
+
+from __future__ import annotations
+
+
+def parse_range(rng: str, size: int) -> tuple[int, int]:
+    """Parse a single-range `bytes=` header against a body of `size` bytes.
+    Returns (offset, length); raises ValueError for unsatisfiable ranges
+    (callers answer 416)."""
+    spec = rng[len("bytes="):].split(",")[0].strip()
+    start_s, _, end_s = spec.partition("-")
+    if start_s == "":
+        n = int(end_s)
+        if n <= 0:
+            raise ValueError(rng)
+        start = max(0, size - n)
+        end = size - 1
+    else:
+        start = int(start_s)
+        end = int(end_s) if end_s else size - 1
+        end = min(end, size - 1)
+    if start > end or start >= size:
+        raise ValueError(rng)
+    return start, end - start + 1

@@ -1,0 +1,313 @@
+"""Filer core unit tests: chunk interval resolution, stores, filer CRUD,
+rename, meta log, manifests — modelled on the reference's
+weed/filer/filechunks_test.go and store test patterns."""
+
+import stat
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import (Entry, FileChunk, Filer, MemoryStore,
+                                 NotFound, SqliteStore)
+from seaweedfs_tpu.filer import filechunks as fc
+from seaweedfs_tpu.filer import filechunk_manifest as fcm
+from seaweedfs_tpu.filer.entry import Attr, new_directory_entry, split_path
+
+
+# ---------------------------------------------------------------- chunks
+
+def _c(fid, offset, size, mtime):
+    return FileChunk(fid=fid, offset=offset, size=size, mtime=mtime)
+
+
+def test_visible_intervals_sequential():
+    chunks = [_c("1,a", 0, 100, 1), _c("1,b", 100, 100, 2)]
+    v = fc.non_overlapping_visible_intervals(chunks)
+    assert [(x.start, x.stop, x.fid) for x in v] == \
+        [(0, 100, "1,a"), (100, 200, "1,b")]
+
+
+def test_visible_intervals_full_overwrite():
+    chunks = [_c("1,a", 0, 100, 1), _c("1,b", 0, 100, 2)]
+    v = fc.non_overlapping_visible_intervals(chunks)
+    assert [(x.start, x.stop, x.fid) for x in v] == [(0, 100, "1,b")]
+
+
+def test_visible_intervals_partial_overwrite_middle():
+    # old covers [0,300); new covers [100,200) later -> old splits
+    chunks = [_c("1,a", 0, 300, 1), _c("1,b", 100, 100, 2)]
+    v = fc.non_overlapping_visible_intervals(chunks)
+    assert [(x.start, x.stop, x.fid, x.chunk_offset) for x in v] == [
+        (0, 100, "1,a", 0), (100, 200, "1,b", 0), (200, 300, "1,a", 200)]
+
+
+def test_visible_intervals_newer_loses_to_newest():
+    chunks = [_c("1,a", 0, 100, 1), _c("1,b", 50, 100, 2),
+              _c("1,c", 25, 50, 3)]
+    v = fc.non_overlapping_visible_intervals(chunks)
+    assert [(x.start, x.stop, x.fid) for x in v] == [
+        (0, 25, "1,a"), (25, 75, "1,c"), (75, 150, "1,b")]
+
+
+def test_view_from_chunks_range_and_gap():
+    chunks = [_c("1,a", 0, 100, 1), _c("1,b", 200, 100, 2)]  # hole [100,200)
+    views = fc.view_from_chunks(chunks, 50, 200)
+    assert [(w.fid, w.offset_in_chunk, w.size, w.logic_offset)
+            for w in views] == [("1,a", 50, 50, 50), ("1,b", 0, 50, 200)]
+
+
+def test_compact_and_minus_chunks():
+    chunks = [_c("1,a", 0, 100, 1), _c("1,b", 0, 100, 2)]
+    live, garbage = fc.compact_chunks(chunks)
+    assert [c.fid for c in live] == ["1,b"]
+    assert [c.fid for c in garbage] == ["1,a"]
+    delta = fc.minus_chunks(chunks, [chunks[1]])
+    assert [c.fid for c in delta] == ["1,a"]
+
+
+def test_equal_mtime_later_append_wins():
+    chunks = [_c("1,a", 0, 100, 5), _c("1,b", 0, 100, 5)]
+    v = fc.non_overlapping_visible_intervals(chunks)
+    assert [x.fid for x in v] == ["1,b"]
+
+
+# ---------------------------------------------------------------- stores
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryStore()
+    else:
+        s = SqliteStore(str(tmp_path / "filer.db"))
+        yield s
+        s.shutdown()
+
+
+def _entry(path, size=0, mode=0o660):
+    e = Entry(full_path=path, attr=Attr(mtime=time.time(), crtime=time.time(),
+                                        mode=mode, file_size=size))
+    return e
+
+
+def test_store_crud(store):
+    e = _entry("/dir/hello.txt", size=5)
+    e.chunks = [_c("3,aabb", 0, 5, 1)]
+    e.extended["x-meta"] = "v"
+    store.insert_entry(e)
+    got = store.find_entry("/dir/hello.txt")
+    assert got.full_path == "/dir/hello.txt"
+    assert got.chunks[0].fid == "3,aabb"
+    assert got.extended == {"x-meta": "v"}
+    with pytest.raises(NotFound):
+        store.find_entry("/dir/none")
+    store.delete_entry("/dir/hello.txt")
+    with pytest.raises(NotFound):
+        store.find_entry("/dir/hello.txt")
+
+
+def test_store_listing_pagination_prefix(store):
+    for name in ["a", "ab", "b", "ba", "c"]:
+        store.insert_entry(_entry(f"/d/{name}"))
+    alles = store.list_directory_entries("/d")
+    assert [e.name for e in alles] == ["a", "ab", "b", "ba", "c"]
+    page = store.list_directory_entries("/d", start_from="ab",
+                                        include_start=False, limit=2)
+    assert [e.name for e in page] == ["b", "ba"]
+    pref = store.list_directory_entries("/d", prefix="b")
+    assert [e.name for e in pref] == ["b", "ba"]
+    # prefix with SQL wildcard chars must be literal
+    store.insert_entry(_entry("/d/x%y"))
+    store.insert_entry(_entry("/d/x_y"))
+    assert [e.name for e in store.list_directory_entries("/d", prefix="x%")] \
+        == ["x%y"]
+
+
+def test_store_delete_folder_children(store):
+    for p in ["/top/f1", "/top/sub/f2", "/top/sub/deep/f3", "/other/f4"]:
+        store.insert_entry(_entry(p))
+    store.delete_folder_children("/top")
+    assert store.list_directory_entries("/top") == []
+    assert store.list_directory_entries("/top/sub") == []
+    assert [e.name for e in store.list_directory_entries("/other")] == ["f4"]
+
+
+def test_store_kv(store):
+    store.kv_put(b"k", b"v1")
+    assert store.kv_get(b"k") == b"v1"
+    store.kv_put(b"k", b"v2")
+    assert store.kv_get(b"k") == b"v2"
+    store.kv_delete(b"k")
+    with pytest.raises(NotFound):
+        store.kv_get(b"k")
+
+
+def test_sqlite_store_persistence(tmp_path):
+    path = str(tmp_path / "p.db")
+    s = SqliteStore(path)
+    s.insert_entry(_entry("/a/b.txt", size=7))
+    s.shutdown()
+    s2 = SqliteStore(path)
+    assert s2.find_entry("/a/b.txt").attr.file_size == 7
+    s2.shutdown()
+
+
+# ---------------------------------------------------------------- filer
+
+@pytest.fixture()
+def filer():
+    deleted: list[FileChunk] = []
+    f = Filer(MemoryStore(), on_delete_chunks=deleted.extend)
+    f._test_deleted = deleted
+    return f
+
+
+def test_filer_create_makes_parents(filer):
+    filer.create_entry(_entry("/a/b/c/file.txt"))
+    for d in ["/a", "/a/b", "/a/b/c"]:
+        assert filer.find_entry(d).is_directory
+    kids = filer.list_entries("/a/b/c")
+    assert [e.name for e in kids] == ["file.txt"]
+
+
+def test_filer_delete_recursive_collects_chunks(filer):
+    e1 = _entry("/x/f1")
+    e1.chunks = [_c("1,a", 0, 10, 1)]
+    e2 = _entry("/x/sub/f2")
+    e2.chunks = [_c("2,b", 0, 10, 1)]
+    filer.create_entry(e1)
+    filer.create_entry(e2)
+    with pytest.raises(OSError):
+        filer.delete_entry("/x")
+    filer.delete_entry("/x", recursive=True)
+    assert not filer.exists("/x")
+    assert sorted(c.fid for c in filer._test_deleted) == ["1,a", "2,b"]
+
+
+def test_filer_overwrite_gc_old_chunks(filer):
+    e = _entry("/f.txt")
+    e.chunks = [_c("1,a", 0, 10, 1)]
+    filer.create_entry(e)
+    e2 = _entry("/f.txt")
+    e2.chunks = [_c("1,b", 0, 20, 2)]
+    filer.create_entry(e2)
+    assert [c.fid for c in filer._test_deleted] == ["1,a"]
+
+
+def test_filer_o_excl(filer):
+    filer.create_entry(_entry("/only.txt"))
+    with pytest.raises(FileExistsError):
+        filer.create_entry(_entry("/only.txt"), o_excl=True)
+
+
+def test_filer_rename_file_and_subtree(filer):
+    fe = _entry("/src/d/f.txt")
+    fe.chunks = [_c("9,z", 0, 4, 1)]
+    filer.create_entry(fe)
+    filer.create_entry(_entry("/src/d/g.txt"))
+    filer.rename_entry("/src/d", "/dst")
+    assert not filer.exists("/src/d")
+    assert filer.find_entry("/dst").is_directory
+    got = filer.find_entry("/dst/f.txt")
+    assert got.chunks[0].fid == "9,z"
+    assert filer.exists("/dst/g.txt")
+    # rename file INTO an existing directory
+    filer.rename_entry("/dst/f.txt", "/src")
+    assert filer.exists("/src/f.txt")
+
+
+def test_filer_meta_log_replay(filer):
+    t0 = time.time_ns()
+    filer.create_entry(_entry("/ev/one"))
+    filer.delete_entry("/ev/one")
+    events = list(filer.meta_log.replay(since_ts_ns=t0))
+    # create /ev dir, create file, delete file
+    kinds = [("create" if ev.old_entry is None else
+              "delete" if ev.new_entry is None else "update")
+             for ev in events]
+    assert kinds == ["create", "create", "delete"]
+    # offsets resume correctly
+    mid = events[1].ts_ns
+    tail = list(filer.meta_log.replay(since_ts_ns=mid))
+    assert len(tail) == 1 and tail[0].new_entry is None
+
+
+def test_meta_log_file_persistence(tmp_path):
+    log_path = str(tmp_path / "meta.jsonl")
+    f = Filer(MemoryStore(), meta_log_path=log_path)
+    f.create_entry(_entry("/p/file"))
+    f.meta_log.ring.clear()  # simulate ring rollover
+    events = list(f.meta_log.replay(since_ts_ns=0))
+    assert [e.new_entry.full_path for e in events] == ["/p", "/p/file"]
+
+
+def test_ttl_expiry(filer):
+    e = _entry("/ttl.txt")
+    e.attr.ttl_sec = 1
+    e.attr.crtime = time.time() - 10
+    filer.create_entry(e)
+    with pytest.raises(NotFound):
+        filer.find_entry("/ttl.txt")
+
+
+# ------------------------------------------------------------- manifest
+
+def test_manifestize_roundtrip():
+    blobs = {}
+
+    def save(payload: bytes) -> FileChunk:
+        fid = f"7,m{len(blobs)}"
+        blobs[fid] = payload
+        return FileChunk(fid=fid, offset=0, size=len(payload), etag="e")
+
+    chunks = [_c(f"1,{i:x}", i * 10, 10, i) for i in range(25)]
+    out = fcm.maybe_manifestize(save, chunks, batch=10)
+    manifests = [c for c in out if c.is_chunk_manifest]
+    assert len(manifests) == 2 and len(out) == 7
+    assert manifests[0].offset == 0 and manifests[0].size == 100
+    resolved = fcm.resolve_chunk_manifest(lambda fid: blobs[fid], out)
+    assert sorted(c.fid for c in resolved) == \
+        sorted(c.fid for c in chunks)
+    # resolved views reproduce the file byte-for-byte ranges
+    v = fc.non_overlapping_visible_intervals(resolved)
+    assert v[0].start == 0 and v[-1].stop == 250
+
+
+def test_split_path_edges():
+    assert split_path("/") == ("/", "")
+    assert split_path("/a") == ("/", "a")
+    assert split_path("/a/b/") == ("/a", "b")
+
+
+def test_directory_entry_mode():
+    d = new_directory_entry("/d")
+    assert d.is_directory and stat.S_ISDIR(d.attr.mode)
+
+
+def test_rename_into_own_subtree_rejected(filer):
+    filer.create_entry(_entry("/tree/sub/f.txt"))
+    with pytest.raises(OSError):
+        filer.rename_entry("/tree", "/tree/sub/moved")
+    with pytest.raises(OSError):
+        filer.rename_entry("/tree", "/tree")
+    # store intact
+    assert filer.exists("/tree/sub/f.txt")
+
+
+def test_meta_log_prefix_component_boundary(filer):
+    from seaweedfs_tpu.filer.filer import dir_has_prefix
+    assert dir_has_prefix("/topics/a", "/topics")
+    assert dir_has_prefix("/topics", "/topics")
+    assert not dir_has_prefix("/topics2", "/topics")
+    assert dir_has_prefix("/anything", "/")
+    t0 = time.time_ns()
+    filer.create_entry(_entry("/topics/in"))
+    filer.create_entry(_entry("/topics2/out"))
+    evs = list(filer.meta_log.replay(since_ts_ns=t0, prefix="/topics"))
+    dirs = {e.directory for e in evs}
+    assert "/topics2" not in dirs and "/" not in dirs
+
+
+def test_delete_ignore_recursive_error(filer):
+    filer.create_entry(_entry("/ig/a.txt"))
+    filer.delete_entry("/ig", recursive=False, ignore_recursive_error=True)
+    assert not filer.exists("/ig")
