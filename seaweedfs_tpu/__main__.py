@@ -15,6 +15,18 @@ import asyncio
 import sys
 
 
+def _add_common_flags(p):
+    p.add_argument("-v", type=int, default=0, help="log verbosity")
+    p.add_argument("-logFile", default=None)
+    p.add_argument("-securityConfig", default=None,
+                   help="security.toml path (default: standard search paths)")
+
+
+def _security(args):
+    from seaweedfs_tpu.security.guard import SecurityConfig
+    return SecurityConfig.load(getattr(args, "securityConfig", None))
+
+
 def _add_master_flags(p):
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=9333)
@@ -74,7 +86,13 @@ def main(argv=None) -> int:
     pb.add_argument("-size", type=int, default=1024)
     pb.add_argument("-c", type=int, dest="concurrency", default=16)
 
+    for p in (pm, pv, ps, pf, psh, pb):
+        _add_common_flags(p)
+
     args = ap.parse_args(argv)
+
+    from seaweedfs_tpu.utils import weedlog
+    weedlog.setup(args.v, args.logFile)
 
     if args.cmd == "master":
         return asyncio.run(_run_master(args))
@@ -103,7 +121,8 @@ async def _run_master(args) -> int:
     from seaweedfs_tpu.server.master import MasterServer
     m = MasterServer(args.ip, args.port,
                      volume_size_limit=args.volumeSizeLimitMB << 20,
-                     default_replication=args.defaultReplication)
+                     default_replication=args.defaultReplication,
+                     security=_security(args))
     await m.start()
     await _serve_forever()
     await m.stop()
@@ -114,7 +133,8 @@ async def _run_volume(args) -> int:
     from seaweedfs_tpu.server.volume_server import VolumeServer
     v = VolumeServer(args.dir, args.mserver, args.ip, args.port,
                      public_url=args.publicUrl, max_volumes=args.max,
-                     data_center=args.dataCenter, rack=args.rack)
+                     data_center=args.dataCenter, rack=args.rack,
+                     security=_security(args))
     await v.start()
     await _serve_forever()
     await v.stop()
@@ -126,7 +146,7 @@ async def _run_filer(args) -> int:
     f = FilerServer(args.master, args.ip, args.port, data_dir=args.dir,
                     collection=args.collection,
                     replication=args.defaultReplication,
-                    chunk_size=args.maxMB << 20)
+                    chunk_size=args.maxMB << 20, security=_security(args))
     await f.start()
     await _serve_forever()
     await f.stop()
@@ -136,18 +156,22 @@ async def _run_filer(args) -> int:
 async def _run_server(args) -> int:
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
+    sec = _security(args)
     m = MasterServer(args.ip, args.port,
                      volume_size_limit=args.volumeSizeLimitMB << 20,
-                     default_replication=args.defaultReplication)
+                     default_replication=args.defaultReplication,
+                     security=sec)
     await m.start()
     v = VolumeServer(args.dir, m.url, args.ip, args.volumePort,
                      public_url=args.publicUrl, max_volumes=args.max,
-                     data_center=args.dataCenter, rack=args.rack)
+                     data_center=args.dataCenter, rack=args.rack,
+                     security=sec)
     await v.start()
     f = None
     if getattr(args, "filer", False):
         from seaweedfs_tpu.server.filer_server import FilerServer
-        f = FilerServer(m.url, args.ip, args.filerPort, data_dir=args.dir[0])
+        f = FilerServer(m.url, args.ip, args.filerPort, data_dir=args.dir[0],
+                        security=sec)
         await f.start()
     await _serve_forever()
     if f:

@@ -15,9 +15,13 @@ import urllib.request
 
 
 class WeedClient:
-    def __init__(self, master: str, timeout: float = 30.0):
+    def __init__(self, master: str, timeout: float = 30.0, jwt_signer=None):
+        """`jwt_signer(fid) -> token` signs volume writes/deletes when the
+        cluster enforces JWTs (reference: operation callers hold the
+        security.toml signing key, security/jwt.go GenJwtForVolumeServer)."""
         self.master = master
         self.timeout = timeout
+        self.jwt_signer = jwt_signer
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self.vid_cache_ttl = 10.0
 
@@ -62,12 +66,17 @@ class WeedClient:
         """Assign + upload; returns the fid."""
         a = self.assign(collection=collection, replication=replication, ttl=ttl)
         fid, url = a["fid"], a["url"]
-        self.upload_to(url, fid, data, name, mime)
+        self.upload_to(url, fid, data, name, mime, jwt=a.get("auth", ""))
         return fid
 
+    def _auth_headers(self, fid: str, jwt: str = "") -> dict:
+        token = jwt or (self.jwt_signer(fid) if self.jwt_signer else "")
+        return {"Authorization": "Bearer " + token} if token else {}
+
     def upload_to(self, url: str, fid: str, data: bytes,
-                  name: str = "", mime: str = "") -> None:
+                  name: str = "", mime: str = "", jwt: str = "") -> None:
         headers = {"Content-Type": mime or "application/octet-stream"}
+        headers.update(self._auth_headers(fid, jwt))
         if name:
             headers["X-File-Name"] = name
         req = urllib.request.Request(
@@ -91,7 +100,8 @@ class WeedClient:
     def delete(self, fid: str) -> None:
         vid = int(fid.partition(",")[0])
         for url in self.lookup(vid):
-            req = urllib.request.Request(f"http://{url}/{fid}", method="DELETE")
+            req = urllib.request.Request(f"http://{url}/{fid}", method="DELETE",
+                                         headers=self._auth_headers(fid))
             try:
                 urllib.request.urlopen(req, timeout=self.timeout).close()
                 return

@@ -63,16 +63,20 @@ def _manifestize(save: SaveFunc, group: list[FileChunk]) -> FileChunk:
     return manifest_ref(save(manifest_payload(group)), group)
 
 
-def resolve_chunk_manifest(read: ReadFunc,
-                           chunks: list[FileChunk]) -> list[FileChunk]:
+def resolve_chunk_manifest(read: ReadFunc, chunks: list[FileChunk],
+                           include_manifests: bool = False) -> list[FileChunk]:
     """Recursively expand manifest refs into the full flat chunk list
-    (reference: ResolveChunkManifest)."""
+    (reference: ResolveChunkManifest). With `include_manifests` the manifest
+    refs themselves are kept in the output too — deletion needs every fid at
+    every nesting level, not just the leaves."""
     out: list[FileChunk] = []
     for c in chunks:
         if not c.is_chunk_manifest:
             out.append(c)
             continue
+        if include_manifests:
+            out.append(c)
         payload = json.loads(read(c.fid))
         nested = [FileChunk.from_dict(d) for d in payload["chunks"]]
-        out.extend(resolve_chunk_manifest(read, nested))
+        out.extend(resolve_chunk_manifest(read, nested, include_manifests))
     return out

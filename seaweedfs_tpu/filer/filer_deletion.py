@@ -62,13 +62,17 @@ class DeletionQueue:
             return
         fids: list[str] = []
         for c in batch:
+            resolved = False
             if c.is_chunk_manifest and self.resolve_manifest:
                 try:
-                    fids.extend(sub.fid for sub in self.resolve_manifest([c])
-                                if not sub.is_chunk_manifest)
+                    # resolver returns every nesting level including `c`
+                    # itself, so intermediate manifest blobs get deleted too
+                    fids.extend(sub.fid for sub in self.resolve_manifest([c]))
+                    resolved = True
                 except Exception as e:
                     log.warning("manifest resolve for delete: %s", e)
-            fids.append(c.fid)
+            if not resolved:
+                fids.append(c.fid)
         by_volume: dict[int, list[str]] = defaultdict(list)
         for fid in fids:
             try:

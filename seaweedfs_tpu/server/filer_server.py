@@ -43,6 +43,7 @@ from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
 from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
 from seaweedfs_tpu.filer.filerstore import (MemoryStore, NotFound,
                                             SqliteStore)
+from seaweedfs_tpu.stats import metrics
 from seaweedfs_tpu.utils.http import parse_range
 
 log = logging.getLogger("filer")
@@ -55,12 +56,15 @@ class FilerServer:
                  port: int = 8888, data_dir: str | None = None,
                  collection: str = "", replication: str = "",
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
-                 jwt_signer=None):
+                 jwt_signer=None, security=None):
         self.master_url = master_url
         self.host, self.port = host, port
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        if jwt_signer is None and security is not None and security.volume_write:
+            from seaweedfs_tpu.security.jwt import gen_jwt
+            jwt_signer = lambda fid: gen_jwt(security.volume_write, fid)  # noqa: E731
         self.jwt_signer = jwt_signer
 
         if data_dir:
@@ -71,8 +75,9 @@ class FilerServer:
         else:
             store = MemoryStore()
             meta_log_path = None
-        self.deletion = DeletionQueue(WeedClient(master_url),
-                                      resolve_manifest=self._resolve_for_delete)
+        self.deletion = DeletionQueue(
+            WeedClient(master_url, jwt_signer=self.jwt_signer),
+            resolve_manifest=self._resolve_for_delete)
         self.filer = Filer(store, meta_log_path=meta_log_path,
                            on_delete_chunks=self.deletion.enqueue_chunks)
         self.conf: FilerConf = load_filer_conf(self.filer.store)
@@ -83,6 +88,7 @@ class FilerServer:
             web.get("/__admin__/filer_conf", self.handle_get_conf),
             web.post("/__admin__/filer_conf", self.handle_put_conf),
             web.get("/__admin__/status", self.handle_status),
+            web.get("/metrics", self.handle_metrics),
             web.route("*", "/{path:.*}", self.handle_path),
         ])
         self._runner: web.AppRunner | None = None
@@ -132,7 +138,8 @@ class FilerServer:
 
     def _resolve_for_delete(self, chunks):
         return fcm.resolve_chunk_manifest(
-            lambda fid: self._read_chunk_blob_sync(fid), chunks)
+            lambda fid: self._read_chunk_blob_sync(fid), chunks,
+            include_manifests=True)
 
     def _read_chunk_blob_sync(self, fid: str) -> bytes:
         # runs only on the deletion worker thread, never the event loop
@@ -158,8 +165,11 @@ class FilerServer:
                             replication: str, ttl: str) -> FileChunk:
         a = await self._assign(collection, replication, ttl)
         headers = {"Content-Type": "application/octet-stream"}
-        if self.jwt_signer:
-            headers["Authorization"] = "BEARER " + self.jwt_signer(a["fid"])
+        if a.get("auth"):
+            # per-fid write JWT from the master's Assign response
+            headers["Authorization"] = "Bearer " + a["auth"]
+        elif self.jwt_signer:
+            headers["Authorization"] = "Bearer " + self.jwt_signer(a["fid"])
         async with self._session.put(
                 f"http://{a['url']}/{a['fid']}", data=data,
                 headers=headers) as r:
@@ -211,10 +221,20 @@ class FilerServer:
 
     # -- main dispatch -------------------------------------------------
 
+    async def handle_metrics(self, req: web.Request) -> web.Response:
+        return web.Response(text=metrics.REGISTRY.render(),
+                            content_type="text/plain")
+
     async def handle_path(self, req: web.Request) -> web.StreamResponse:
+        metrics.FILER_REQUEST_COUNTER.labels(req.method.lower()).inc()
         raw = req.match_info["path"]
         is_dir_request = raw.endswith("/") or raw == ""
         path = self._norm(raw)
+        with metrics.FILER_REQUEST_HISTOGRAM.labels(req.method.lower()).time():
+            return await self._dispatch(req, path, is_dir_request)
+
+    async def _dispatch(self, req: web.Request, path: str,
+                        is_dir_request: bool) -> web.StreamResponse:
         try:
             if req.method in ("POST", "PUT"):
                 if "mv.from" in req.query:

@@ -19,6 +19,8 @@ import time
 import aiohttp
 from aiohttp import web
 
+from seaweedfs_tpu.security.jwt import gen_jwt
+from seaweedfs_tpu.stats import metrics
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.topology.topology import Topology
 
@@ -29,12 +31,15 @@ class MasterServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 9333,
                  volume_size_limit: int = 30 * 1024 * 1024 * 1024,
                  default_replication: str = "000",
-                 grow_count: int = 1):
+                 grow_count: int = 1, security=None):
         self.host, self.port = host, port
+        self.security = security
+        self.guard = security.guard if security is not None else None
         self.topo = Topology(volume_size_limit=volume_size_limit,
                              replication=default_replication)
         self.grow_count = grow_count
-        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app = web.Application(client_max_size=64 * 1024 * 1024,
+                                   middlewares=[self._guard_middleware])
         self.app.add_routes([
             web.route("*", "/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),
@@ -45,6 +50,7 @@ class MasterServer:
             web.post("/admin/lock", self.handle_lock),
             web.post("/admin/unlock", self.handle_unlock),
             web.post("/admin/renew_lock", self.handle_renew_lock),
+            web.get("/metrics", self.handle_metrics),
         ])
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
@@ -85,7 +91,19 @@ class MasterServer:
 
     # -- handlers ------------------------------------------------------
 
+    @web.middleware
+    async def _guard_middleware(self, req: web.Request, handler):
+        """IP-whitelist guard on master endpoints (security/guard.go)."""
+        if self.guard and req.remote and not self.guard.is_allowed(req.remote):
+            return web.json_response({"error": "forbidden"}, status=403)
+        return await handler(req)
+
+    async def handle_metrics(self, req: web.Request) -> web.Response:
+        return web.Response(text=metrics.REGISTRY.render(),
+                            content_type="text/plain")
+
     async def handle_heartbeat(self, req: web.Request) -> web.Response:
+        metrics.MASTER_RECEIVED_HEARTBEATS.labels().inc()
         beat = await req.json()
         self.topo.register_heartbeat(
             node_id=beat["id"], url=beat["url"],
@@ -122,10 +140,16 @@ class MasterServer:
         cookie = secrets.randbits(32)
         fid = t.FileId(vid, key, cookie)
         node = nodes[0]
-        return web.json_response({
+        metrics.MASTER_ASSIGN_COUNTER.labels(collection).inc()
+        resp = {
             "fid": str(fid), "count": count,
             "url": node.url, "publicUrl": node.public_url,
-        })
+        }
+        # per-fid write JWT, like the reference Assign response
+        # (master_grpc_server_assign.go:119)
+        if self.security is not None and self.security.volume_write:
+            resp["auth"] = gen_jwt(self.security.volume_write, str(fid))
+        return web.json_response(resp)
 
     async def handle_lookup(self, req: web.Request) -> web.Response:
         raw = req.query.get("volumeId", "")

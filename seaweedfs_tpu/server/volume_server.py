@@ -23,6 +23,8 @@ import time
 import aiohttp
 from aiohttp import web
 
+from seaweedfs_tpu.security import jwt as sjwt
+from seaweedfs_tpu.stats import metrics
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import ec_files, ec_volume as ecv, layout
@@ -39,7 +41,8 @@ class VolumeServer:
                  host: str = "127.0.0.1", port: int = 8080,
                  public_url: str = "", max_volumes: int = 8,
                  data_center: str = "", rack: str = "",
-                 heartbeat_interval: float = 3.0):
+                 heartbeat_interval: float = 3.0, security=None):
+        self.security = security
         self.host, self.port = host, port
         self.url = f"{host}:{port}"
         self.public_url = public_url or self.url
@@ -52,6 +55,7 @@ class VolumeServer:
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
         self.app.add_routes([
             web.get("/status", self.handle_status),
+            web.get("/metrics", self.handle_metrics),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/volume/delete", self.handle_volume_delete),
             web.post("/admin/volume/readonly", self.handle_volume_readonly),
@@ -104,6 +108,10 @@ class VolumeServer:
 
     async def _heartbeat_once(self) -> None:
         beat = self.store.collect_heartbeat()
+        metrics.VOLUME_COUNT_GAUGE.labels("", "normal").set(
+            len(beat.get("volumes", [])))
+        metrics.VOLUME_COUNT_GAUGE.labels("", "ec").set(
+            len(beat.get("ec_shards", [])))
         beat.update({"id": self.url, "url": self.url,
                      "public_url": self.public_url,
                      "data_center": self.data_center, "rack": self.rack})
@@ -121,13 +129,36 @@ class VolumeServer:
             fid = t.FileId.parse(req.match_info["fid"])
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
+        if req.method in ("POST", "PUT", "DELETE"):
+            # write JWT check (reference: volume_server_handlers_write.go:33)
+            err = self._check_jwt(req)
+            if err is not None:
+                return err
         if req.method in ("POST", "PUT"):
-            return await self._write_blob(req, fid)
+            metrics.VOLUME_REQUEST_COUNTER.labels("write").inc()
+            with metrics.VOLUME_REQUEST_HISTOGRAM.labels("write").time():
+                return await self._write_blob(req, fid)
         if req.method == "GET" or req.method == "HEAD":
-            return await self._read_blob(req, fid)
+            metrics.VOLUME_REQUEST_COUNTER.labels("read").inc()
+            with metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").time():
+                return await self._read_blob(req, fid)
         if req.method == "DELETE":
+            metrics.VOLUME_REQUEST_COUNTER.labels("delete").inc()
             return await self._delete_blob(req, fid)
         return web.json_response({"error": "method not allowed"}, status=405)
+
+    def _check_jwt(self, req: web.Request) -> web.Response | None:
+        if self.security is None or not self.security.volume_write:
+            return None
+        token = sjwt.token_from_request(req.headers, req.query)
+        if not token:
+            return web.json_response({"error": "missing jwt"}, status=401)
+        try:
+            sjwt.decode_jwt(self.security.volume_write, token,
+                            expected_fid=req.match_info["fid"])
+        except sjwt.JwtError as e:
+            return web.json_response({"error": str(e)}, status=401)
+        return None
 
     async def _write_blob(self, req: web.Request, fid: t.FileId) -> web.Response:
         name, mime, data = b"", b"", b""
@@ -187,6 +218,9 @@ class VolumeServer:
             return f"replica lookup failed: {e}"
         peers = [l["url"] for l in locations if l["url"] != self.url]
         headers = {}
+        if self.security is not None and self.security.volume_write:
+            headers["Authorization"] = "Bearer " + sjwt.gen_jwt(
+                self.security.volume_write, str(fid))
         if mime:
             headers["Content-Type"] = mime.decode(errors="replace")
         if name:
@@ -200,7 +234,7 @@ class VolumeServer:
                         if r.status >= 300:
                             return f"replica write to {peer}: {r.status}"
                 else:
-                    async with self._session.delete(url) as r:
+                    async with self._session.delete(url, headers=headers) as r:
                         if r.status >= 300:
                             return f"replica delete to {peer}: {r.status}"
             except aiohttp.ClientError as e:
@@ -291,6 +325,10 @@ class VolumeServer:
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response(self.store.collect_heartbeat())
 
+    async def handle_metrics(self, req: web.Request) -> web.Response:
+        return web.Response(text=metrics.REGISTRY.render(),
+                            content_type="text/plain")
+
     async def handle_assign_volume(self, req: web.Request) -> web.Response:
         body = await req.json()
         try:
@@ -351,6 +389,8 @@ class VolumeServer:
             v.nm.flush()
             ec_files.write_ec_files(base)
             ec_files.write_sorted_ecx(base + ".idx")
+            metrics.EC_ENCODE_BYTES.labels("tpu").inc(
+                os.path.getsize(base + ".dat"))
         await asyncio.to_thread(gen)
         return web.json_response({"shards": list(range(layout.TOTAL_SHARDS))})
 

@@ -1,0 +1,216 @@
+"""Prometheus-style metrics: counters/gauges/histograms + text exposition.
+
+Reference: weed/stats/metrics.go — per-role registries (master/volume/filer)
+with request counters, latency histograms, volume gauges, and optional push
+to a gateway. Implemented on the stdlib; the /metrics endpoint on every
+server serves `render()` in Prometheus text exposition format 0.0.4.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+_DEFAULT_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str, label_names: tuple[str, ...]):
+        self.name, self.help, self.label_names = name, help_text, label_names
+        self._children: dict[tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str):
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: want {len(self.label_names)} labels")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._new_child()
+                self._children[values] = child
+            return child
+
+    def _pairs(self):
+        with self._lock:
+            items = list(self._children.items())
+        for values, child in items:
+            yield tuple(zip(self.label_names, values)), child
+
+
+class _CounterValue:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Counter(_Metric):
+    kind = "counter"
+    _new_child = staticmethod(_CounterValue)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for labels, child in self._pairs():
+            out.append(f"{self.name}{_fmt_labels(labels)} {child.value}")
+        return out
+
+
+class _GaugeValue(_CounterValue):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = value
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+    _new_child = staticmethod(_GaugeValue)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for labels, child in self._pairs():
+            out.append(f"{self.name}{_fmt_labels(labels)} {child.value}")
+        return out
+
+
+class _HistogramValue:
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+
+    def __init__(self, buckets=_DEFAULT_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.total += value
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += 1
+                    break
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, hist: _HistogramValue):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, label_names, buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self._buckets = buckets
+
+    def _new_child(self):
+        return _HistogramValue(self._buckets)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for labels, child in self._pairs():
+            cum = 0
+            for b, c in zip(child.buckets, child.counts):
+                cum += c
+                out.append(f"{self.name}_bucket"
+                           f"{_fmt_labels(labels, f'le=\"{b}\"')} {cum}")
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels(labels, 'le=\"+Inf\"')} {child.count}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {child.total}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {child.count}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            if metric.name in self._metrics:
+                return self._metrics[metric.name]
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_text="", labels=()) -> Counter:
+        return self._register(Counter(name, help_text, tuple(labels)))
+
+    def gauge(self, name, help_text="", labels=()) -> Gauge:
+        return self._register(Gauge(name, help_text, tuple(labels)))
+
+    def histogram(self, name, help_text="", labels=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_text, tuple(labels), buckets))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def push(self, gateway_url: str, job: str) -> None:
+        """Push-gateway support (stats/metrics.go:14 StartPushingMetric)."""
+        body = self.render().encode()
+        req = urllib.request.Request(
+            f"{gateway_url.rstrip('/')}/metrics/job/{job}",
+            data=body, method="PUT",
+            headers={"Content-Type": "text/plain"})
+        urllib.request.urlopen(req, timeout=5).close()
+
+
+# Global registry + the standard gauges/counters each role uses
+# (stats/metrics.go: MasterReceivedHeartbeatCounter, VolumeServerRequestCounter,
+# VolumeServerVolumeCounter, FilerRequestCounter, FilerRequestHistogram, ...).
+REGISTRY = Registry()
+
+MASTER_RECEIVED_HEARTBEATS = REGISTRY.counter(
+    "weedtpu_master_received_heartbeats", "Heartbeats received by master")
+MASTER_ASSIGN_COUNTER = REGISTRY.counter(
+    "weedtpu_master_assign_total", "fid assignments", ("collection",))
+VOLUME_REQUEST_COUNTER = REGISTRY.counter(
+    "weedtpu_volume_request_total", "volume server requests", ("type",))
+VOLUME_REQUEST_HISTOGRAM = REGISTRY.histogram(
+    "weedtpu_volume_request_seconds", "volume request latency", ("type",))
+VOLUME_COUNT_GAUGE = REGISTRY.gauge(
+    "weedtpu_volumes", "volumes served", ("collection", "type"))
+FILER_REQUEST_COUNTER = REGISTRY.counter(
+    "weedtpu_filer_request_total", "filer requests", ("type",))
+FILER_REQUEST_HISTOGRAM = REGISTRY.histogram(
+    "weedtpu_filer_request_seconds", "filer request latency", ("type",))
+EC_ENCODE_BYTES = REGISTRY.counter(
+    "weedtpu_ec_encode_bytes_total", "bytes EC-encoded", ("codec",))

@@ -1,0 +1,65 @@
+"""Leveled verbosity logging in the style of the reference's vendored glog.
+
+Reference: weed/glog/glog.go — `glog.V(n).Infof(...)` gates chatty logs by a
+`-v` flag; errors/warnings always print. Here `V(n)` returns a logger bound
+to DEBUG when n <= the process verbosity, else a no-op, layered on stdlib
+logging so handlers/formatting stay standard.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_verbosity = 0
+_configured = False
+
+
+def setup(verbosity: int = 0, logfile: str | None = None) -> None:
+    """Install the root handler (stderr or rotating file, glog_file.go)."""
+    global _verbosity, _configured
+    _verbosity = verbosity
+    if _configured:
+        return
+    handler: logging.Handler
+    if logfile:
+        from logging.handlers import RotatingFileHandler
+        handler = RotatingFileHandler(logfile, maxBytes=64 << 20, backupCount=5)
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        "%(levelname).1s%(asctime)s %(name)s %(filename)s:%(lineno)d] %(message)s",
+        datefmt="%m%d %H:%M:%S"))
+    root = logging.getLogger()
+    root.addHandler(handler)
+    root.setLevel(logging.DEBUG if verbosity > 0 else logging.INFO)
+    _configured = True
+
+
+def verbosity() -> int:
+    return _verbosity
+
+
+class _Noop:
+    def infof(self, *a, **k): pass
+    info = infof
+
+
+class _V:
+    def __init__(self, logger: logging.Logger):
+        self._logger = logger
+
+    def infof(self, fmt: str, *args) -> None:
+        self._logger.debug(fmt, *args, stacklevel=2)
+
+    info = infof
+
+
+_NOOP = _Noop()
+
+
+def V(n: int, name: str = "weed"):
+    """glog.V(n): chatty logging enabled only when -v >= n."""
+    if n <= _verbosity:
+        return _V(logging.getLogger(name))
+    return _NOOP
