@@ -64,6 +64,10 @@ def main(argv=None) -> int:
     ps.add_argument("-filer", action="store_true",
                     help="also run a filer (in-proc, sqlite store in -dir)")
     ps.add_argument("-filerPort", type=int, default=8888)
+    ps.add_argument("-s3", action="store_true",
+                    help="also run the S3 gateway (implies -filer)")
+    ps.add_argument("-s3Port", type=int, default=8333)
+    ps.add_argument("-s3Config", default=None)
 
     pf = sub.add_parser("filer")
     pf.add_argument("-ip", default="127.0.0.1")
@@ -74,6 +78,18 @@ def main(argv=None) -> int:
     pf.add_argument("-collection", default="")
     pf.add_argument("-defaultReplication", default="")
     pf.add_argument("-maxMB", type=int, default=4)
+
+    p3 = sub.add_parser("s3")
+    p3.add_argument("-ip", default="127.0.0.1")
+    p3.add_argument("-port", type=int, default=8333)
+    p3.add_argument("-filer", default="127.0.0.1:8888")
+    p3.add_argument("-config", default=None,
+                    help="s3.json identities file; omit = allow all")
+
+    pi = sub.add_parser("iam")
+    pi.add_argument("-ip", default="127.0.0.1")
+    pi.add_argument("-port", type=int, default=8111)
+    pi.add_argument("-filer", default="127.0.0.1:8888")
 
     psh = sub.add_parser("shell")
     psh.add_argument("-master", default="127.0.0.1:9333")
@@ -86,7 +102,7 @@ def main(argv=None) -> int:
     pb.add_argument("-size", type=int, default=1024)
     pb.add_argument("-c", type=int, dest="concurrency", default=16)
 
-    for p in (pm, pv, ps, pf, psh, pb):
+    for p in (pm, pv, ps, pf, p3, pi, psh, pb):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -102,6 +118,10 @@ def main(argv=None) -> int:
         return asyncio.run(_run_filer(args))
     if args.cmd == "server":
         return asyncio.run(_run_server(args))
+    if args.cmd == "s3":
+        return asyncio.run(_run_s3(args))
+    if args.cmd == "iam":
+        return asyncio.run(_run_iam(args))
     if args.cmd == "shell":
         from seaweedfs_tpu.shell.shell import repl
         return repl(args.master, args.script)
@@ -153,6 +173,29 @@ async def _run_filer(args) -> int:
     return 0
 
 
+async def _run_s3(args) -> int:
+    from seaweedfs_tpu.s3.auth import IdentityAccessManagement
+    from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+    iam = IdentityAccessManagement.from_file(args.config) \
+        if args.config else IdentityAccessManagement()
+    s = S3ApiServer(args.filer, args.ip, args.port, iam=iam,
+                    security=_security(args))
+    await s.start()
+    await _serve_forever()
+    await s.stop()
+    return 0
+
+
+async def _run_iam(args) -> int:
+    from seaweedfs_tpu.s3.iamapi_server import IamApiServer
+    s = IamApiServer(args.filer, args.ip, args.port,
+                     security=_security(args))
+    await s.start()
+    await _serve_forever()
+    await s.stop()
+    return 0
+
+
 async def _run_server(args) -> int:
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
@@ -167,13 +210,22 @@ async def _run_server(args) -> int:
                      data_center=args.dataCenter, rack=args.rack,
                      security=sec)
     await v.start()
-    f = None
-    if getattr(args, "filer", False):
+    f = s3 = None
+    if getattr(args, "filer", False) or getattr(args, "s3", False):
         from seaweedfs_tpu.server.filer_server import FilerServer
         f = FilerServer(m.url, args.ip, args.filerPort, data_dir=args.dir[0],
                         security=sec)
         await f.start()
+    if getattr(args, "s3", False):
+        from seaweedfs_tpu.s3.auth import IdentityAccessManagement
+        from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+        iam = IdentityAccessManagement.from_file(args.s3Config) \
+            if args.s3Config else IdentityAccessManagement()
+        s3 = S3ApiServer(f.url, args.ip, args.s3Port, iam=iam, security=sec)
+        await s3.start()
     await _serve_forever()
+    if s3:
+        await s3.stop()
     if f:
         await f.stop()
     await v.stop()

@@ -15,13 +15,16 @@ import urllib.request
 
 
 class WeedClient:
-    def __init__(self, master: str, timeout: float = 30.0, jwt_signer=None):
-        """`jwt_signer(fid) -> token` signs volume writes/deletes when the
-        cluster enforces JWTs (reference: operation callers hold the
-        security.toml signing key, security/jwt.go GenJwtForVolumeServer)."""
+    def __init__(self, master: str, timeout: float = 30.0, jwt_signer=None,
+                 jwt_read_signer=None):
+        """`jwt_signer(fid) -> token` signs volume writes/deletes, and
+        `jwt_read_signer(fid)` signs reads, when the cluster enforces JWTs
+        (reference: operation callers hold the security.toml signing keys,
+        security/jwt.go GenJwtForVolumeServer)."""
         self.master = master
         self.timeout = timeout
         self.jwt_signer = jwt_signer
+        self.jwt_read_signer = jwt_read_signer
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self.vid_cache_ttl = 10.0
 
@@ -87,11 +90,15 @@ class WeedClient:
 
     def download(self, fid: str) -> bytes:
         vid = int(fid.partition(",")[0])
+        headers = {}
+        if self.jwt_read_signer:
+            headers["Authorization"] = "Bearer " + self.jwt_read_signer(fid)
         last_err: Exception | None = None
         for url in self.lookup(vid):
             try:
-                with urllib.request.urlopen(
-                        f"http://{url}/{fid}", timeout=self.timeout) as r:
+                req = urllib.request.Request(f"http://{url}/{fid}",
+                                             headers=headers)
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     return r.read()
             except OSError as e:
                 last_err = e

@@ -1,0 +1,779 @@
+"""S3-compatible REST gateway over the filer.
+
+Reference: weed/s3api/ — s3api_server.go (router), s3api_bucket_handlers.go,
+s3api_object_handlers.go (+_put/_copy/_multipart/_tagging),
+filer_multipart.go (multipart assembly by chunk-list splice, no data copy),
+s3api_object_handlers_list.go (ListObjects V1/V2 with prefix/delimiter/
+marker), s3err/ (XML error bodies). Buckets are directories under
+`/buckets/{bucket}` on the filer; each bucket doubles as a collection name
+for its blob chunks so bucket deletion can reclaim volumes.
+
+The gateway holds no object state of its own: object data flows through the
+filer's auto-chunking upload path, multipart parts are normal filer files
+under `/buckets/{bucket}/.uploads/{uploadId}/`, and CompleteMultipartUpload
+splices the parts' chunk lists into one entry via the filer raw-entry API,
+then deletes part entries with skipChunkDeletion.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+import aiohttp
+from aiohttp import web
+
+from seaweedfs_tpu.s3.auth import (ACTION_LIST, ACTION_READ, ACTION_TAGGING,
+                                   ACTION_WRITE, AuthError, Identity,
+                                   IdentityAccessManagement)
+
+log = logging.getLogger("s3")
+
+BUCKETS_DIR = "/buckets"
+UPLOADS_SUBDIR = ".uploads"
+TAG_PREFIX = "x-amz-tag-"
+S3_XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + \
+        ET.tostring(root, encoding="unicode").encode()
+
+
+def _el(parent: ET.Element, tag: str, text: str | None = None) -> ET.Element:
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = text
+    return e
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+def _error_response(code: str, message: str, status: int,
+                    resource: str = "") -> web.Response:
+    root = ET.Element("Error")
+    _el(root, "Code", code)
+    _el(root, "Message", message)
+    _el(root, "Resource", resource)
+    _el(root, "RequestId", uuid.uuid4().hex[:16])
+    return web.Response(body=_xml(root), status=status,
+                        content_type="application/xml")
+
+
+class S3ApiServer:
+    def __init__(self, filer_url: str, host: str = "127.0.0.1",
+                 port: int = 8333, iam: IdentityAccessManagement | None = None,
+                 buckets_dir: str = BUCKETS_DIR, security=None):
+        self.filer_url = filer_url
+        self.host, self.port = host, port
+        self.iam = iam or IdentityAccessManagement()
+        self.buckets_dir = buckets_dir.rstrip("/")
+        self.security = security
+        self.app = web.Application(client_max_size=5 * 1024 * 1024 * 1024)
+        self.app.add_routes([web.route("*", "/{tail:.*}", self.dispatch)])
+        self._runner: web.AppRunner | None = None
+        self._session: aiohttp.ClientSession | None = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=3600))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        log.info("s3 gateway on %s -> filer %s", self.url, self.filer_url)
+
+    async def stop(self) -> None:
+        if self._session:
+            await self._session.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- filer client --------------------------------------------------
+
+    def _fp(self, bucket: str, key: str = "") -> str:
+        p = f"{self.buckets_dir}/{bucket}"
+        if key:
+            p += "/" + key.lstrip("/")
+        return p
+
+    def _filer_auth(self, write: bool) -> dict:
+        """Sign gateway->filer calls when the filer enforces its JWT keys."""
+        if self.security is None:
+            return {}
+        key = self.security.filer_write if write else self.security.filer_read
+        if not key:
+            return {}
+        from seaweedfs_tpu.security.jwt import gen_jwt
+        return {"Authorization": "Bearer " + gen_jwt(key, "")}
+
+    async def _filer(self, method: str, path: str, *, params=None, data=None,
+                     headers=None, ok=(200, 201, 204)) -> tuple[int, bytes]:
+        url = f"http://{self.filer_url}{urllib.parse.quote(path)}"
+        headers = dict(headers or {})
+        headers.update(self._filer_auth(write=method not in ("GET", "HEAD")))
+        async with self._session.request(method, url, params=params,
+                                         data=data, headers=headers) as r:
+            body = await r.read()
+            return r.status, body
+
+    async def _filer_meta(self, path: str) -> dict | None:
+        st, body = await self._filer("GET", path, params={"metadata": "true"})
+        if st != 200:
+            return None
+        return json.loads(body)
+
+    async def _filer_list(self, dir_path: str, last: str = "",
+                          limit: int = 1000, prefix: str = "") -> dict:
+        params = {"limit": str(limit)}
+        if last:
+            params["lastFileName"] = last
+        if prefix:
+            params["prefix"] = prefix
+        st, body = await self._filer("GET", dir_path.rstrip("/") + "/",
+                                     params=params)
+        if st != 200:
+            return {"Entries": []}
+        return json.loads(body)
+
+    # -- dispatch ------------------------------------------------------
+
+    async def dispatch(self, req: web.Request) -> web.StreamResponse:
+        raw_path = req.raw_path.split("?", 1)[0]
+        path = urllib.parse.unquote(raw_path)
+        bucket, _, key = path.lstrip("/").partition("/")
+        q = {k: req.query.get(k, "") for k in req.query}
+
+        body: bytes | None = None
+        if req.method in ("PUT", "POST"):
+            body = await self._read_body(req)
+
+        try:
+            ident = self.iam.authenticate(req.method, raw_path, q,
+                                          req.headers)
+        except AuthError as e:
+            return _error_response(e.code, str(e), e.status, path)
+
+        try:
+            if not bucket:
+                return await self.list_buckets(ident)
+            if not key:
+                return await self.bucket_op(req, ident, bucket, q, body)
+            return await self.object_op(req, ident, bucket, key, q, body)
+        except AuthError as e:
+            return _error_response(e.code, str(e), e.status, path)
+
+    async def _read_body(self, req: web.Request) -> bytes:
+        body = await req.read()
+        sha_hdr = req.headers.get("x-amz-content-sha256", "")
+        if sha_hdr.startswith("STREAMING-") or \
+                "aws-chunked" in req.headers.get("Content-Encoding", ""):
+            body = _decode_aws_chunked(body)
+        return body
+
+    def _require(self, ident: Identity, action: str, bucket: str) -> None:
+        if not ident.can_do(action, bucket):
+            raise AuthError("AccessDenied", "Access Denied")
+
+    # -- service level -------------------------------------------------
+
+    async def list_buckets(self, ident: Identity) -> web.Response:
+        listing = await self._filer_list(self.buckets_dir, limit=10000)
+        root = ET.Element("ListAllMyBucketsResult", xmlns=S3_XMLNS)
+        owner = _el(root, "Owner")
+        _el(owner, "ID", ident.name)
+        _el(owner, "DisplayName", ident.name)
+        buckets = _el(root, "Buckets")
+        for e in listing.get("Entries", []):
+            if not e.get("IsDirectory"):
+                continue
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            if not ident.can_do(ACTION_LIST, name):
+                continue
+            b = _el(buckets, "Bucket")
+            _el(b, "Name", name)
+            _el(b, "CreationDate", _iso(e.get("Crtime", 0)))
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    # -- bucket level --------------------------------------------------
+
+    async def bucket_op(self, req, ident, bucket, q, body) -> web.Response:
+        m = req.method
+        if m == "PUT":
+            self._require(ident, ACTION_WRITE, bucket)
+            return await self.put_bucket(bucket)
+        if m == "DELETE":
+            self._require(ident, ACTION_WRITE, bucket)
+            return await self.delete_bucket(bucket)
+        if m == "HEAD":
+            self._require(ident, ACTION_LIST, bucket)
+            meta = await self._filer_meta(self._fp(bucket))
+            if meta is None:
+                return _error_response("NoSuchBucket",
+                                       "The specified bucket does not exist",
+                                       404, bucket)
+            return web.Response()
+        if m == "POST" and "delete" in q:
+            self._require(ident, ACTION_WRITE, bucket)
+            return await self.batch_delete(bucket, body)
+        if m == "GET":
+            if "location" in q:
+                root = ET.Element("LocationConstraint", xmlns=S3_XMLNS)
+                return web.Response(body=_xml(root),
+                                    content_type="application/xml")
+            if "uploads" in q:
+                self._require(ident, ACTION_LIST, bucket)
+                return await self.list_multipart_uploads(bucket)
+            if "acl" in q:
+                return self._canned_acl(ident)
+            for sub in ("lifecycle", "policy", "cors", "website"):
+                if sub in q:
+                    return _error_response(
+                        f"NoSuch{sub.capitalize()}Configuration",
+                        f"The {sub} configuration does not exist", 404, bucket)
+            if "versioning" in q:
+                root = ET.Element("VersioningConfiguration", xmlns=S3_XMLNS)
+                return web.Response(body=_xml(root),
+                                    content_type="application/xml")
+            if "tagging" in q:
+                return await self.get_tagging(bucket, "")
+            self._require(ident, ACTION_LIST, bucket)
+            meta = await self._filer_meta(self._fp(bucket))
+            if meta is None:
+                return _error_response("NoSuchBucket",
+                                       "The specified bucket does not exist",
+                                       404, bucket)
+            return await self.list_objects(bucket, q)
+        return _error_response("MethodNotAllowed", "method not allowed", 405)
+
+    def _canned_acl(self, ident: Identity) -> web.Response:
+        root = ET.Element("AccessControlPolicy", xmlns=S3_XMLNS)
+        owner = _el(root, "Owner")
+        _el(owner, "ID", ident.name)
+        acl = _el(root, "AccessControlList")
+        grant = _el(acl, "Grant")
+        grantee = _el(grant, "Grantee")
+        grantee.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+        grantee.set("xsi:type", "CanonicalUser")
+        _el(grantee, "ID", ident.name)
+        _el(grant, "Permission", "FULL_CONTROL")
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    async def put_bucket(self, bucket: str) -> web.Response:
+        if not _valid_bucket_name(bucket):
+            return _error_response("InvalidBucketName",
+                                   "The specified bucket is not valid", 400,
+                                   bucket)
+        meta = await self._filer_meta(self._fp(bucket))
+        if meta is not None:
+            return _error_response("BucketAlreadyExists",
+                                   "The requested bucket name already exists",
+                                   409, bucket)
+        st, _ = await self._filer("POST", self._fp(bucket) + "/")
+        if st >= 300:
+            return _error_response("InternalError", f"filer: {st}", 500)
+        return web.Response(headers={"Location": "/" + bucket})
+
+    async def delete_bucket(self, bucket: str) -> web.Response:
+        meta = await self._filer_meta(self._fp(bucket))
+        if meta is None:
+            return _error_response("NoSuchBucket",
+                                   "The specified bucket does not exist",
+                                   404, bucket)
+        st, _ = await self._filer("DELETE", self._fp(bucket),
+                                  params={"recursive": "true"})
+        if st >= 300 and st != 404:
+            return _error_response("InternalError", f"filer: {st}", 500)
+        return web.Response(status=204)
+
+    async def batch_delete(self, bucket: str, body: bytes) -> web.Response:
+        try:
+            root_in = ET.fromstring(body.decode())
+        except ET.ParseError:
+            return _error_response("MalformedXML", "cannot parse body", 400)
+        quiet = (root_in.findtext("Quiet") or "").lower() == "true"
+        keys = [o.findtext("Key") or ""
+                for o in root_in.iter() if o.tag.endswith("Object")]
+        root = ET.Element("DeleteResult", xmlns=S3_XMLNS)
+        for k in keys:
+            if not k:
+                continue
+            st, _ = await self._filer("DELETE", self._fp(bucket, k),
+                                      params={"recursive": "true"})
+            if st in (204, 404, 200):  # S3 delete is idempotent
+                if not quiet:
+                    d = _el(root, "Deleted")
+                    _el(d, "Key", k)
+            else:
+                e = _el(root, "Error")
+                _el(e, "Key", k)
+                _el(e, "Code", "InternalError")
+                _el(e, "Message", f"filer status {st}")
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    # -- listing -------------------------------------------------------
+
+    async def list_objects(self, bucket: str, q: dict) -> web.Response:
+        v2 = q.get("list-type") == "2"
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        if v2:
+            marker = q.get("start-after", "")
+            token = q.get("continuation-token", "")
+            if token:
+                marker = urllib.parse.unquote(token)
+        else:
+            marker = q.get("marker", "")
+
+        contents, prefixes, truncated, next_marker = \
+            await self._collect_keys(bucket, prefix, delimiter, marker,
+                                     max_keys)
+
+        root = ET.Element("ListBucketResult", xmlns=S3_XMLNS)
+        _el(root, "Name", bucket)
+        _el(root, "Prefix", prefix)
+        _el(root, "MaxKeys", str(max_keys))
+        if delimiter:
+            _el(root, "Delimiter", delimiter)
+        _el(root, "IsTruncated", "true" if truncated else "false")
+        if v2:
+            _el(root, "KeyCount", str(len(contents) + len(prefixes)))
+            if q.get("continuation-token"):
+                _el(root, "ContinuationToken", q["continuation-token"])
+            if truncated:
+                _el(root, "NextContinuationToken",
+                    urllib.parse.quote(next_marker))
+        else:
+            _el(root, "Marker", marker)
+            if truncated:
+                _el(root, "NextMarker", next_marker)
+        for key, e in contents:
+            c = _el(root, "Contents")
+            _el(c, "Key", key)
+            _el(c, "LastModified", _iso(e.get("Mtime", 0)))
+            _el(c, "ETag", f'"{e.get("Md5") or ""}"')
+            _el(c, "Size", str(e.get("FileSize", 0)))
+            _el(c, "StorageClass", "STANDARD")
+        for p in prefixes:
+            cp = _el(root, "CommonPrefixes")
+            _el(cp, "Prefix", p)
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    async def _collect_keys(self, bucket: str, prefix: str, delimiter: str,
+                            marker: str, max_keys: int):
+        """Walk the bucket subtree in key order, applying prefix/delimiter/
+        marker the way s3api_object_handlers_list.go does over filer
+        listings."""
+        contents: list[tuple[str, dict]] = []
+        prefixes: list[str] = []
+        seen_prefixes: set[str] = set()
+        state = {"count": 0, "truncated": False, "next_marker": ""}
+
+        async def emit(key: str, entry: dict) -> bool:
+            """Returns False when the listing is full."""
+            if state["count"] >= max_keys:
+                state["truncated"] = True
+                return False
+            if delimiter:
+                rest = key[len(prefix):]
+                di = rest.find(delimiter)
+                if di >= 0:
+                    common = prefix + rest[: di + len(delimiter)]
+                    if common not in seen_prefixes:
+                        seen_prefixes.add(common)
+                        prefixes.append(common)
+                        state["count"] += 1
+                        state["next_marker"] = common
+                    return True
+            contents.append((key, entry))
+            state["count"] += 1
+            state["next_marker"] = key
+            return True
+
+        async def walk(dir_path: str, key_base: str) -> bool:
+            last = ""
+            while True:
+                listing = await self._filer_list(dir_path, last=last,
+                                                 limit=1000)
+                entries = listing.get("Entries", [])
+                if not entries:
+                    return True
+                for e in entries:
+                    name = e["FullPath"].rsplit("/", 1)[-1]
+                    last = name
+                    if name.startswith("."):
+                        continue  # .uploads and friends stay hidden
+                    key = key_base + name
+                    if e.get("IsDirectory"):
+                        sub_key = key + "/"
+                        # prune subtrees that cannot match the prefix
+                        if prefix and not (sub_key.startswith(prefix)
+                                           or prefix.startswith(sub_key)):
+                            continue
+                        if marker and marker >= sub_key and \
+                                not marker.startswith(sub_key):
+                            continue
+                        if not await walk(dir_path + "/" + name, sub_key):
+                            return False
+                    else:
+                        if prefix and not key.startswith(prefix):
+                            continue
+                        if marker and key <= marker:
+                            continue
+                        if not await emit(key, e):
+                            return False
+                if not listing.get("ShouldDisplayLoadMore"):
+                    return True
+
+        await walk(self._fp(bucket), "")
+        return contents, prefixes, state["truncated"], state["next_marker"]
+
+    # -- object level --------------------------------------------------
+
+    async def object_op(self, req, ident, bucket, key, q, body):
+        m = req.method
+        if m == "GET" and "uploadId" in q:
+            self._require(ident, ACTION_READ, bucket)
+            return await self.list_parts(bucket, key, q["uploadId"])
+        if "tagging" in q:
+            if m in ("PUT", "DELETE"):
+                self._require(ident, ACTION_TAGGING, bucket)
+                return await self.put_tagging(
+                    bucket, key, body if m == "PUT" else None)
+            self._require(ident, ACTION_READ, bucket)
+            return await self.get_tagging(bucket, key)
+        if m == "PUT":
+            self._require(ident, ACTION_WRITE, bucket)
+            if "partNumber" in q:
+                return await self.put_part(req, bucket, key, q, body)
+            if "x-amz-copy-source" in req.headers:
+                return await self.copy_object(req, ident, bucket, key)
+            return await self.put_object(req, bucket, key, body)
+        if m == "POST":
+            if "uploads" in q:
+                self._require(ident, ACTION_WRITE, bucket)
+                return await self.initiate_multipart(req, bucket, key)
+            if "uploadId" in q:
+                self._require(ident, ACTION_WRITE, bucket)
+                return await self.complete_multipart(bucket, key,
+                                                     q["uploadId"], body)
+        if m == "DELETE":
+            if "uploadId" in q:
+                self._require(ident, ACTION_WRITE, bucket)
+                return await self.abort_multipart(bucket, key, q["uploadId"])
+            self._require(ident, ACTION_WRITE, bucket)
+            st, _ = await self._filer("DELETE", self._fp(bucket, key),
+                                      params={"recursive": "true"})
+            return web.Response(status=204)
+        if m in ("GET", "HEAD"):
+            self._require(ident, ACTION_READ, bucket)
+            return await self.get_object(req, bucket, key)
+        return _error_response("MethodNotAllowed", "method not allowed", 405)
+
+    async def put_object(self, req, bucket, key, body) -> web.Response:
+        headers = {"Content-Type": req.headers.get(
+            "Content-Type", "application/octet-stream")}
+        md5 = hashlib.md5(body).hexdigest()
+        params = {"collection": bucket}
+        # x-amz-meta-* -> extended attrs via Seaweed- headers
+        for h, v in req.headers.items():
+            if h.lower().startswith("x-amz-meta-"):
+                headers[f"Seaweed-{h}"] = v
+        st, rbody = await self._filer("PUT", self._fp(bucket, key),
+                                      params=params, data=body,
+                                      headers=headers)
+        if st >= 300:
+            return _error_response("InternalError",
+                                   f"filer: {st} {rbody[:200]!r}", 500)
+        return web.Response(headers={"ETag": f'"{md5}"'})
+
+    async def get_object(self, req, bucket, key) -> web.StreamResponse:
+        headers = self._filer_auth(write=False)
+        if "Range" in req.headers:
+            headers["Range"] = req.headers["Range"]
+        url = f"http://{self.filer_url}{urllib.parse.quote(self._fp(bucket, key))}"
+        async with self._session.request(req.method, url,
+                                         headers=headers) as r:
+            if r.status == 404:
+                return _error_response("NoSuchKey",
+                                       "The specified key does not exist",
+                                       404, key)
+            if r.status >= 300 and r.status not in (206, 304):
+                return _error_response("InternalError", f"filer {r.status}",
+                                       500, key)
+            out_headers = {}
+            for h in ("Content-Range", "Accept-Ranges", "Last-Modified",
+                      "ETag", "Content-Type"):
+                if h in r.headers:
+                    out_headers[h] = r.headers[h]
+            for h, v in r.headers.items():
+                if h.lower().startswith("seaweed-x-amz-"):
+                    out_headers[h[len("Seaweed-"):]] = v
+            resp = web.StreamResponse(status=r.status, headers=out_headers)
+            if r.headers.get("Content-Length"):
+                resp.content_length = int(r.headers["Content-Length"])
+            await resp.prepare(req)
+            if req.method != "HEAD":
+                async for chunk in r.content.iter_chunked(1 << 20):
+                    await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+
+    async def copy_object(self, req, ident, bucket, key) -> web.Response:
+        src = urllib.parse.unquote(req.headers["x-amz-copy-source"])
+        src_bucket, _, src_key = src.lstrip("/").partition("/")
+        self._require(ident, ACTION_READ, src_bucket)
+        st, data = await self._filer("GET", self._fp(src_bucket, src_key))
+        if st != 200:
+            return _error_response("NoSuchKey", "copy source missing", 404,
+                                   src)
+        put = await self.put_object(req, bucket, key, data)
+        if put.status >= 300:
+            return put
+        root = ET.Element("CopyObjectResult", xmlns=S3_XMLNS)
+        _el(root, "LastModified", _iso(time.time()))
+        _el(root, "ETag", put.headers.get("ETag", ""))
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    # -- tagging (stored as extended attrs, reference:
+    # s3api_object_tagging_handlers.go + filer extended attrs) ----------
+
+    async def get_tagging(self, bucket, key) -> web.Response:
+        meta = await self._filer_meta(self._fp(bucket, key))
+        if meta is None:
+            return _error_response("NoSuchKey", "not found", 404, key)
+        root = ET.Element("Tagging", xmlns=S3_XMLNS)
+        ts = _el(root, "TagSet")
+        for k, v in (meta.get("extended") or meta.get("Extended") or {}).items():
+            if k.startswith(TAG_PREFIX):
+                t = _el(ts, "Tag")
+                _el(t, "Key", k[len(TAG_PREFIX):])
+                _el(t, "Value", v)
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    async def put_tagging(self, bucket, key, body) -> web.Response:
+        meta = await self._filer_meta(self._fp(bucket, key))
+        if meta is None:
+            return _error_response("NoSuchKey", "not found", 404, key)
+        tags: dict[str, str] = {}
+        if body is not None:
+            try:
+                root_in = ET.fromstring(body.decode())
+            except ET.ParseError:
+                return _error_response("MalformedXML", "bad tagging", 400)
+            for t in root_in.iter():
+                if t.tag.endswith("Tag"):
+                    tk = t.findtext("Key") or t.findtext(
+                        f"{{{S3_XMLNS}}}Key") or ""
+                    tv = t.findtext("Value") or t.findtext(
+                        f"{{{S3_XMLNS}}}Value") or ""
+                    if tk:
+                        tags[tk] = tv
+        ext = {k: v for k, v in (meta.get("extended") or {}).items()
+               if not k.startswith(TAG_PREFIX)}
+        ext.update({TAG_PREFIX + k: v for k, v in tags.items()})
+        meta["extended"] = ext
+        st, _ = await self._filer("POST", "/__admin__/entry",
+                                  data=json.dumps({"entry": meta}),
+                                  headers={"Content-Type": "application/json"})
+        if st >= 300:
+            return _error_response("InternalError", f"filer {st}", 500)
+        return web.Response(status=200 if body is not None else 204)
+
+    # -- multipart -----------------------------------------------------
+
+    def _upload_dir(self, bucket: str, upload_id: str) -> str:
+        return f"{self.buckets_dir}/{bucket}/{UPLOADS_SUBDIR}/{upload_id}"
+
+    async def initiate_multipart(self, req, bucket, key) -> web.Response:
+        upload_id = uuid.uuid4().hex
+        # remember the object key + content-type in the upload dir entry
+        st, _ = await self._filer(
+            "POST", self._upload_dir(bucket, upload_id) + "/",
+            headers={"Seaweed-s3-key": urllib.parse.quote(key),
+                     "Seaweed-s3-mime": req.headers.get("Content-Type", "")})
+        if st >= 300:
+            return _error_response("InternalError", f"filer {st}", 500)
+        root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_XMLNS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "UploadId", upload_id)
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    async def put_part(self, req, bucket, key, q, body) -> web.Response:
+        part_num = int(q["partNumber"])
+        upload_id = q.get("uploadId", "")
+        meta = await self._filer_meta(self._upload_dir(bucket, upload_id))
+        if meta is None:
+            return _error_response("NoSuchUpload", "upload not found", 404)
+        md5 = hashlib.md5(body).hexdigest()
+        path = f"{self._upload_dir(bucket, upload_id)}/{part_num:04d}.part"
+        st, _ = await self._filer("PUT", path, data=body,
+                                  params={"collection": bucket})
+        if st >= 300:
+            return _error_response("InternalError", f"filer {st}", 500)
+        return web.Response(headers={"ETag": f'"{md5}"'})
+
+    async def list_parts(self, bucket, key, upload_id) -> web.Response:
+        listing = await self._filer_list(self._upload_dir(bucket, upload_id),
+                                         limit=10000)
+        root = ET.Element("ListPartsResult", xmlns=S3_XMLNS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "UploadId", upload_id)
+        _el(root, "IsTruncated", "false")
+        for e in listing.get("Entries", []):
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            if not name.endswith(".part"):
+                continue
+            p = _el(root, "Part")
+            _el(p, "PartNumber", str(int(name[:-5])))
+            _el(p, "LastModified", _iso(e.get("Mtime", 0)))
+            _el(p, "ETag", f'"{e.get("Md5") or ""}"')
+            _el(p, "Size", str(e.get("FileSize", 0)))
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    async def list_multipart_uploads(self, bucket) -> web.Response:
+        listing = await self._filer_list(
+            f"{self.buckets_dir}/{bucket}/{UPLOADS_SUBDIR}", limit=10000)
+        root = ET.Element("ListMultipartUploadsResult", xmlns=S3_XMLNS)
+        _el(root, "Bucket", bucket)
+        _el(root, "IsTruncated", "false")
+        for e in listing.get("Entries", []):
+            if not e.get("IsDirectory"):
+                continue
+            upload_id = e["FullPath"].rsplit("/", 1)[-1]
+            u = _el(root, "Upload")
+            ext = e.get("Extended") or {}
+            _el(u, "Key", urllib.parse.unquote(ext.get("s3-key", "")))
+            _el(u, "UploadId", upload_id)
+            _el(u, "Initiated", _iso(e.get("Crtime", 0)))
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    async def complete_multipart(self, bucket, key, upload_id,
+                                 body) -> web.Response:
+        """Splice part chunk lists into the final entry — no data copy
+        (reference: filer_multipart.go completeMultipartUpload)."""
+        updir = self._upload_dir(bucket, upload_id)
+        upload_meta = await self._filer_meta(updir)
+        if upload_meta is None:
+            return _error_response("NoSuchUpload", "upload not found", 404)
+
+        wanted: list[int] | None = None
+        if body:
+            try:
+                root_in = ET.fromstring(body.decode())
+                wanted = sorted(
+                    int(p.findtext("PartNumber")
+                        or p.findtext(f"{{{S3_XMLNS}}}PartNumber"))
+                    for p in root_in.iter()
+                    if p.tag.endswith("Part") and p.tag != "CompleteMultipartUpload")
+            except (ET.ParseError, TypeError, ValueError):
+                return _error_response("MalformedXML", "bad complete body", 400)
+
+        listing = await self._filer_list(updir, limit=10000)
+        parts: dict[int, dict] = {}
+        for e in listing.get("Entries", []):
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            if name.endswith(".part"):
+                meta = await self._filer_meta(e["FullPath"])
+                if meta is not None:
+                    parts[int(name[:-5])] = meta
+        order = wanted if wanted is not None else sorted(parts)
+        if not order or any(p not in parts for p in order):
+            return _error_response("InvalidPart", "missing part", 400)
+
+        chunks: list[dict] = []
+        offset = 0
+        etags = []
+        for pn in order:
+            pmeta = parts[pn]
+            psize = 0
+            for c in pmeta.get("chunks", []):
+                c = dict(c)
+                c["offset"] = offset + c["offset"]
+                chunks.append(c)
+                psize = max(psize, c["offset"] - offset + c["size"])
+            psize = max(psize, pmeta.get("attr", {}).get("file_size", 0))
+            offset += psize
+            etags.append(pmeta.get("attr", {}).get("md5", ""))
+
+        final_etag = hashlib.md5(
+            b"".join(bytes.fromhex(e) for e in etags if e)).hexdigest() + \
+            f"-{len(order)}"
+        ext = upload_meta.get("extended") or {}
+        mime = ext.get("s3-mime", "") or "application/octet-stream"
+        entry = {
+            "full_path": self._fp(bucket, key),
+            "attr": {"mtime": time.time(), "crtime": time.time(),
+                     "mode": 0o660, "mime": mime, "file_size": offset,
+                     "md5": final_etag.partition("-")[0]},
+            "chunks": chunks,
+            "extended": {"s3-etag": final_etag},
+        }
+        st, rbody = await self._filer(
+            "POST", "/__admin__/entry", data=json.dumps({"entry": entry}),
+            headers={"Content-Type": "application/json"})
+        if st >= 300:
+            return _error_response("InternalError",
+                                   f"filer {st} {rbody[:200]!r}", 500)
+        # drop part entries but keep their (now shared) chunks
+        await self._filer("DELETE", updir,
+                          params={"recursive": "true",
+                                  "skipChunkDeletion": "true"})
+        root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_XMLNS)
+        _el(root, "Location", f"http://{self.url}/{bucket}/{key}")
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "ETag", f'"{final_etag}"')
+        return web.Response(body=_xml(root), content_type="application/xml")
+
+    async def abort_multipart(self, bucket, key, upload_id) -> web.Response:
+        await self._filer("DELETE", self._upload_dir(bucket, upload_id),
+                          params={"recursive": "true"})
+        return web.Response(status=204)
+
+
+def _valid_bucket_name(name: str) -> bool:
+    if not 3 <= len(name) <= 63:
+        return False
+    if not all(c.islower() or c.isdigit() or c in ".-" for c in name):
+        return False
+    return name[0] not in ".-" and name[-1] not in ".-"
+
+
+def _decode_aws_chunked(body: bytes) -> bytes:
+    """Decode aws-chunked streaming payload: hex-size;chunk-signature=...\r\n
+    <data>\r\n ... 0;...\r\n\r\n (sig per chunk not re-verified here; the
+    reference validates them in chunked_reader_v4.go)."""
+    out = bytearray()
+    i = 0
+    while i < len(body):
+        nl = body.find(b"\r\n", i)
+        if nl < 0:
+            break
+        header = body[i:nl]
+        size_hex = header.split(b";", 1)[0]
+        try:
+            size = int(size_hex, 16)
+        except ValueError:
+            break
+        if size == 0:
+            break
+        start = nl + 2
+        out += body[start:start + size]
+        i = start + size + 2  # skip trailing \r\n
+    return bytes(out)

@@ -85,8 +85,10 @@ class SecurityConfig:
         cfg = cls(data)
         env_key = os.environ.get("WEED_JWT_SIGNING_KEY")
         if env_key:
-            cfg.volume_write = SigningKey(env_key, cfg.volume_write.expires_after_seconds or 10)
+            cfg.volume_write = SigningKey(
+                env_key, cfg.volume_write.expires_after_seconds)
         env_rkey = os.environ.get("WEED_JWT_SIGNING_READ_KEY")
         if env_rkey:
-            cfg.volume_read = SigningKey(env_rkey, cfg.volume_read.expires_after_seconds or 10)
+            cfg.volume_read = SigningKey(
+                env_rkey, cfg.volume_read.expires_after_seconds)
         return cfg

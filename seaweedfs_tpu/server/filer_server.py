@@ -62,6 +62,7 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        self.security = security
         if jwt_signer is None and security is not None and security.volume_write:
             from seaweedfs_tpu.security.jwt import gen_jwt
             jwt_signer = lambda fid: gen_jwt(security.volume_write, fid)  # noqa: E731
@@ -85,6 +86,7 @@ class FilerServer:
         self.app = web.Application(client_max_size=1024 * 1024 * 1024)
         self.app.add_routes([
             web.get("/__meta__/subscribe", self.handle_meta_subscribe),
+            web.post("/__admin__/entry", self.handle_raw_entry),
             web.get("/__admin__/filer_conf", self.handle_get_conf),
             web.post("/__admin__/filer_conf", self.handle_put_conf),
             web.get("/__admin__/status", self.handle_status),
@@ -185,10 +187,16 @@ class FilerServer:
                 f"http://{self.master_url}/dir/lookup",
                 params={"volumeId": vid}) as r:
             locs = (await r.json()).get("locations", [])
+        headers = {}
+        if self.security is not None and self.security.volume_read:
+            from seaweedfs_tpu.security.jwt import gen_jwt
+            headers["Authorization"] = "Bearer " + gen_jwt(
+                self.security.volume_read, fid)
         last = None
         for loc in locs:
             try:
-                async with self._session.get(f"http://{loc['url']}/{fid}") as r:
+                async with self._session.get(f"http://{loc['url']}/{fid}",
+                                             headers=headers) as r:
                     if r.status == 200:
                         return await r.read()
                     last = f"HTTP {r.status}"
@@ -225,8 +233,54 @@ class FilerServer:
         return web.Response(text=metrics.REGISTRY.render(),
                             content_type="text/plain")
 
+    async def handle_raw_entry(self, req: web.Request) -> web.Response:
+        """Create/replace an entry from a raw entry dict, chunk refs
+        included — the HTTP face of filer_pb CreateEntry, needed by the S3
+        gateway to assemble multipart uploads without copying data
+        (reference: weed/s3api/filer_multipart.go)."""
+        err = self._check_filer_jwt(req, write=True)
+        if err is not None:
+            return err
+        try:
+            body = await req.json()
+            entry = Entry.from_dict(body["entry"])
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad entry: {e}"}, status=400)
+        def put():
+            self.filer.create_entry(entry, o_excl=bool(body.get("o_excl")))
+        try:
+            await asyncio.to_thread(put)
+        except FileExistsError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"path": entry.full_path}, status=201)
+
+    def _check_filer_jwt(self, req: web.Request,
+                         write: bool) -> web.Response | None:
+        """Filer JWT enforcement (reference: filer tokens checked at
+        volume_server_handlers_write.go:53 / filer auth): mutations need a
+        [jwt.filer.signing] token, reads a [jwt.filer.signing.read] one —
+        each only when the corresponding key is configured."""
+        if self.security is None:
+            return None
+        key = self.security.filer_write if write else self.security.filer_read
+        if not key:
+            return None
+        from seaweedfs_tpu.security import jwt as sjwt
+        token = sjwt.token_from_request(req.headers, req.query)
+        if not token:
+            return web.json_response({"error": "missing jwt"}, status=401)
+        try:
+            sjwt.decode_jwt(key, token)
+        except sjwt.JwtError as e:
+            return web.json_response({"error": str(e)}, status=401)
+        return None
+
     async def handle_path(self, req: web.Request) -> web.StreamResponse:
         metrics.FILER_REQUEST_COUNTER.labels(req.method.lower()).inc()
+        err = self._check_filer_jwt(req, req.method in ("POST", "PUT",
+                                                        "DELETE"))
+        if err is not None:
+            return err
         raw = req.match_info["path"]
         is_dir_request = raw.endswith("/") or raw == ""
         path = self._norm(raw)
@@ -451,9 +505,14 @@ class FilerServer:
                              path: str) -> web.Response:
         recursive = req.query.get("recursive") == "true"
         ignore = req.query.get("ignoreRecursiveError") == "true"
+        # skipChunkDeletion: metadata-only delete — used by the S3 gateway
+        # when chunk refs were spliced into another entry (multipart
+        # complete), mirroring filer_pb DeleteEntry.delete_data=false
+        delete_chunks = req.query.get("skipChunkDeletion") != "true"
         try:
             self.filer.delete_entry(path, recursive=recursive,
-                                    ignore_recursive_error=ignore)
+                                    ignore_recursive_error=ignore,
+                                    delete_chunks=delete_chunks)
         except OSError as e:
             if isinstance(e, (FileNotFoundError,)) or "not found" in str(e):
                 return web.json_response({"error": str(e)}, status=404)
@@ -503,6 +562,9 @@ class FilerServer:
                             content_type="application/json")
 
     async def handle_put_conf(self, req: web.Request) -> web.Response:
+        err = self._check_filer_jwt(req, write=True)
+        if err is not None:
+            return err
         body = await req.json()
         if "locations" in body:
             self.conf = FilerConf.from_json(json.dumps(body))

@@ -91,10 +91,16 @@ class MasterServer:
 
     # -- handlers ------------------------------------------------------
 
+    # the whitelist guards client-facing endpoints only: volume servers must
+    # always heartbeat and Prometheus must always scrape (the reference
+    # guards HTTP handlers while heartbeats ride unguarded gRPC)
+    _UNGUARDED = ("/heartbeat", "/metrics")
+
     @web.middleware
     async def _guard_middleware(self, req: web.Request, handler):
         """IP-whitelist guard on master endpoints (security/guard.go)."""
-        if self.guard and req.remote and not self.guard.is_allowed(req.remote):
+        if self.guard and req.remote and req.path not in self._UNGUARDED \
+                and not self.guard.is_allowed(req.remote):
             return web.json_response({"error": "forbidden"}, status=403)
         return await handler(req)
 

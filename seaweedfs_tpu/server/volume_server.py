@@ -139,6 +139,14 @@ class VolumeServer:
             with metrics.VOLUME_REQUEST_HISTOGRAM.labels("write").time():
                 return await self._write_blob(req, fid)
         if req.method == "GET" or req.method == "HEAD":
+            # read JWT, only when a [jwt.signing.read] key is configured
+            if self.security is not None and self.security.volume_read:
+                token = sjwt.token_from_request(req.headers, req.query)
+                try:
+                    sjwt.decode_jwt(self.security.volume_read, token,
+                                    expected_fid=req.match_info["fid"])
+                except sjwt.JwtError as e:
+                    return web.json_response({"error": str(e)}, status=401)
             metrics.VOLUME_REQUEST_COUNTER.labels("read").inc()
             with metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").time():
                 return await self._read_blob(req, fid)

@@ -16,8 +16,12 @@ _DEFAULT_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_esc(v)}"' for k, v in labels]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -25,6 +29,10 @@ def _fmt_labels(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
 
 class _Metric:
     kind = "untyped"
+    # cardinality bound: label values can come from client input (collection
+    # names); past this, samples collapse into an "__other__" series instead
+    # of growing server memory without bound
+    MAX_CHILDREN = 1000
 
     def __init__(self, name: str, help_text: str, label_names: tuple[str, ...]):
         self.name, self.help, self.label_names = name, help_text, label_names
@@ -38,6 +46,11 @@ class _Metric:
         with self._lock:
             child = self._children.get(values)
             if child is None:
+                if len(self._children) >= self.MAX_CHILDREN:
+                    values = ("__other__",) * len(self.label_names)
+                    child = self._children.get(values)
+                    if child is not None:
+                        return child
                 child = self._new_child()
                 self._children[values] = child
             return child
