@@ -1,0 +1,155 @@
+"""ctypes bindings for the native C++ runtime library (native/weedtpu_native.cc).
+
+The reference gets its CPU performance from native code in dependencies —
+klauspost/reedsolomon's AVX2 GF(2^8) assembly (go.mod:61) for erasure coding,
+Go's AES-NI stdlib for chunk encryption (weed/util/cipher.go), and hardware
+CRC for checksums.  This module is the equivalent seam in this framework: a
+small C++ library exposing a C ABI, compiled on first use with the in-repo
+Makefile and loaded via ctypes (pybind11 is not in the image).
+
+Falls back gracefully: `available()` is False when no compiler is present,
+and callers (ops.codec registry, utils.cipher) keep a pure-Python/numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_HERE, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libweedtpu_native.so")
+
+_lib = None
+_lib_err: str | None = None
+_lock = threading.Lock()
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _build() -> None:
+    src = os.path.join(_NATIVE_DIR, "weedtpu_native.cc")
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        return
+    subprocess.run(["make", "-C", _NATIVE_DIR, "libweedtpu_native.so"],
+                   check=True, capture_output=True)
+
+
+def _load():
+    global _lib, _lib_err
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            _build()
+            lib = ctypes.CDLL(_SO_PATH)
+        except (OSError, subprocess.CalledProcessError) as e:
+            _lib_err = str(e)
+            return None
+        lib.wn_gf_init()
+        lib.wn_gf_mul.restype = ctypes.c_uint8
+        lib.wn_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+        lib.wn_gf_mul_slice.argtypes = [
+            ctypes.c_uint8, _u8p, _u8p, ctypes.c_size_t, ctypes.c_int]
+        lib.wn_gf_matmul.argtypes = [
+            _u8p, ctypes.c_int, ctypes.c_int, _u8p, _u8p, ctypes.c_size_t]
+        lib.wn_crc32c.restype = ctypes.c_uint32
+        lib.wn_crc32c.argtypes = [_u8p, ctypes.c_size_t, ctypes.c_uint32]
+        lib.wn_aes256_ctr.argtypes = [_u8p, _u8p, _u8p, _u8p, ctypes.c_size_t]
+        lib.wn_aes256_gcm_seal.argtypes = [
+            _u8p, _u8p, _u8p, ctypes.c_size_t, _u8p, _u8p, ctypes.c_size_t, _u8p]
+        lib.wn_aes256_gcm_open.restype = ctypes.c_int
+        lib.wn_aes256_gcm_open.argtypes = [
+            _u8p, _u8p, _u8p, ctypes.c_size_t, _u8p, _u8p, ctypes.c_size_t, _u8p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_error() -> str | None:
+    _load()
+    return _lib_err
+
+
+def _as_u8p(a) -> _u8p:
+    return a.ctypes.data_as(_u8p)
+
+
+def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[rows, n] = mat[rows, k] @ data[k, n] over GF(2^8) (native AVX2)."""
+    lib = _load()
+    assert lib is not None, _lib_err
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    rows, k = mat.shape
+    k2, n = data.shape
+    assert k == k2, (mat.shape, data.shape)
+    out = np.empty((rows, n), dtype=np.uint8)
+    lib.wn_gf_matmul(_as_u8p(mat), rows, k, _as_u8p(data), _as_u8p(out),
+                     ctypes.c_size_t(n))
+    return out
+
+
+def gf_mul_slice(c: int, src: np.ndarray, dst: np.ndarray,
+                 accumulate: bool = False) -> None:
+    lib = _load()
+    assert lib is not None, _lib_err
+    assert src.dtype == np.uint8 and dst.dtype == np.uint8
+    assert src.size == dst.size
+    lib.wn_gf_mul_slice(c, _as_u8p(src), _as_u8p(dst),
+                        ctypes.c_size_t(src.size), 1 if accumulate else 0)
+
+
+def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+    lib = _load()
+    assert lib is not None, _lib_err
+    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else data
+    return int(lib.wn_crc32c(_as_u8p(np.ascontiguousarray(arr)),
+                             ctypes.c_size_t(arr.size), crc))
+
+
+def aes256_gcm_seal(key: bytes, nonce: bytes, plaintext: bytes,
+                    aad: bytes = b"") -> bytes:
+    """Returns ciphertext||tag, mirroring Go's gcm.Seal output layout that
+    the reference stores for encrypted chunks (weed/util/cipher.go)."""
+    lib = _load()
+    assert lib is not None, _lib_err
+    assert len(key) == 32 and len(nonce) == 12
+    pt = np.frombuffer(plaintext, dtype=np.uint8)
+    ct = np.empty(len(plaintext), dtype=np.uint8)
+    tag = np.empty(16, dtype=np.uint8)
+    k = np.frombuffer(key, dtype=np.uint8)
+    nc = np.frombuffer(nonce, dtype=np.uint8)
+    ad = np.frombuffer(aad, dtype=np.uint8) if aad else np.empty(0, np.uint8)
+    lib.wn_aes256_gcm_seal(_as_u8p(k), _as_u8p(nc), _as_u8p(ad),
+                           ctypes.c_size_t(len(aad)), _as_u8p(pt), _as_u8p(ct),
+                           ctypes.c_size_t(len(plaintext)), _as_u8p(tag))
+    return ct.tobytes() + tag.tobytes()
+
+
+def aes256_gcm_open(key: bytes, nonce: bytes, sealed: bytes,
+                    aad: bytes = b"") -> bytes:
+    lib = _load()
+    assert lib is not None, _lib_err
+    assert len(key) == 32 and len(nonce) == 12 and len(sealed) >= 16
+    ct = np.frombuffer(sealed[:-16], dtype=np.uint8)
+    tag = np.frombuffer(sealed[-16:], dtype=np.uint8)
+    pt = np.empty(len(ct), dtype=np.uint8)
+    k = np.frombuffer(key, dtype=np.uint8)
+    nc = np.frombuffer(nonce, dtype=np.uint8)
+    ad = np.frombuffer(aad, dtype=np.uint8) if aad else np.empty(0, np.uint8)
+    rc = lib.wn_aes256_gcm_open(_as_u8p(k), _as_u8p(nc), _as_u8p(ad),
+                                ctypes.c_size_t(len(aad)), _as_u8p(ct),
+                                _as_u8p(pt), ctypes.c_size_t(ct.size),
+                                _as_u8p(tag))
+    if rc != 0:
+        raise ValueError("cipher: message authentication failed")
+    return pt.tobytes()

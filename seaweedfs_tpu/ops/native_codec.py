@@ -1,0 +1,59 @@
+"""CPU RS codec over the native C++ AVX2 GF(2^8) kernels (native.py).
+
+The host-side twin of ops.gfmat_jax / ops.pallas_gf with the same
+encode/reconstruct surface but numpy arrays in and out.  Fills the role
+klauspost/reedsolomon's SIMD assembly plays in the reference (invoked from
+weed/storage/erasure_coding/ec_encoder.go:214 enc.Encode and
+weed/storage/store_ec.go:374 enc.ReconstructData): the fast path when no
+TPU is attached, and the honest CPU baseline for bench.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from seaweedfs_tpu import native
+from seaweedfs_tpu.models import rs
+
+
+class NativeRSCodec:
+    def __init__(self, code: rs.RSCode):
+        self.code = code
+        self.k, self.m, self.n = code.k, code.m, code.n
+        self._decode_cache: dict = {}
+
+    def encode_parity(self, data: np.ndarray) -> np.ndarray:
+        """[k, n] data -> [m, n] parity."""
+        return native.gf_matmul(self.code.parity_matrix, np.asarray(data))
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data)
+        return np.concatenate([data, self.encode_parity(data)], axis=0)
+
+    def reconstruct(self, shards: dict[int, np.ndarray],
+                    wanted: list[int] | None = None) -> dict[int, np.ndarray]:
+        present = tuple(sorted(shards))
+        if wanted is None:
+            wanted = [i for i in range(self.n) if i not in shards]
+        if not wanted:
+            return {}
+        key = (present[: self.k], tuple(wanted))
+        mat = self._decode_cache.get(key)
+        if mat is None:
+            mat = self.code.decode_matrix(list(present), list(wanted))
+            self._decode_cache[key] = mat
+        stack = np.stack([np.asarray(shards[i]) for i in present[: self.k]])
+        out = native.gf_matmul(mat, stack)
+        return {w: out[i] for i, w in enumerate(wanted)}
+
+
+_CODECS: dict = {}
+
+
+def get_codec(k: int, m: int, construction: str = "vandermonde") -> NativeRSCodec:
+    key = (k, m, construction)
+    c = _CODECS.get(key)
+    if c is None:
+        c = NativeRSCodec(rs.get_code(k, m, construction))
+        _CODECS[key] = c
+    return c

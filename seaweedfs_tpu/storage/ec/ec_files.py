@@ -30,19 +30,63 @@ from seaweedfs_tpu.storage.ec import layout
 DEFAULT_BATCH = 16 * 1024 * 1024  # bytes per shard per device round-trip
 
 
-def _get_codec():
-    import jax
+def _get_codec(kind: str | None = None):
+    """Select the EC codec backend: the `ec.codec` knob of this framework.
 
-    from seaweedfs_tpu.ops import gfmat_jax, pallas_gf
-    if jax.default_backend() == "tpu":
-        return pallas_gf.get_codec(layout.DATA_SHARDS, layout.PARITY_SHARDS)
-    return gfmat_jax.get_codec(layout.DATA_SHARDS, layout.PARITY_SHARDS)
+    auto (default): Pallas on TPU, native C++ AVX2 on CPU hosts, XLA
+    bit-sliced otherwise.  Override with WEEDTPU_EC_CODEC=tpu|jax|cpp|numpy.
+    """
+    kind = kind or os.environ.get("WEEDTPU_EC_CODEC", "auto")
+    k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
+    if kind in ("cpp", "native"):
+        from seaweedfs_tpu.ops import native_codec
+        return native_codec.get_codec(k, m)
+    if kind == "numpy":
+        from seaweedfs_tpu.models import rs
+        return rs.get_code(k, m)
+    if kind == "auto":
+        import jax
+        if jax.default_backend() == "tpu":
+            from seaweedfs_tpu.ops import pallas_gf
+            return pallas_gf.get_codec(k, m)
+        from seaweedfs_tpu import native
+        if native.available():
+            from seaweedfs_tpu.ops import native_codec
+            return native_codec.get_codec(k, m)
+        from seaweedfs_tpu.ops import gfmat_jax
+        return gfmat_jax.get_codec(k, m)
+    if kind == "tpu":
+        from seaweedfs_tpu.ops import pallas_gf
+        return pallas_gf.get_codec(k, m)
+    from seaweedfs_tpu.ops import gfmat_jax
+    return gfmat_jax.get_codec(k, m)
 
 
 def _encode_parity_batch(codec, batch: np.ndarray) -> np.ndarray:
-    """[10, B] host bytes -> [4, B] parity bytes via the device codec."""
+    """[10, B] host bytes -> [4, B] parity bytes via the selected codec."""
+    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
+    from seaweedfs_tpu.models.rs import RSCode
+    if isinstance(codec, NativeRSCodec):
+        return codec.encode_parity(batch)
+    if isinstance(codec, RSCode):
+        return codec.encode_numpy(batch)[layout.DATA_SHARDS:]
     import jax.numpy as jnp
     return np.asarray(codec.encode_parity(jnp.asarray(batch)))
+
+
+def _reconstruct_batch(codec, shards: dict[int, np.ndarray],
+                       wanted: list[int]) -> dict[int, np.ndarray]:
+    """Rebuild `wanted` shard rows from >=k survivor rows (host bytes in/out)."""
+    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
+    from seaweedfs_tpu.models.rs import RSCode
+    if isinstance(codec, NativeRSCodec):
+        return codec.reconstruct(shards, wanted=wanted)
+    if isinstance(codec, RSCode):
+        return codec.reconstruct_numpy(shards, wanted=wanted)
+    import jax.numpy as jnp
+    out = codec.reconstruct({i: jnp.asarray(v) for i, v in shards.items()},
+                            wanted=wanted)
+    return {i: np.asarray(v) for i, v in out.items()}
 
 
 def write_ec_files(base: str, dat_path: str | None = None,
@@ -111,7 +155,6 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH) -> list[int]:
     if len(present) < layout.DATA_SHARDS:
         raise ValueError(
             f"need >= {layout.DATA_SHARDS} shards to rebuild, have {len(present)}")
-    import jax.numpy as jnp
     codec = _get_codec()
     use = present[: layout.DATA_SHARDS]
     shard_size = os.path.getsize(base + layout.to_ext(use[0]))
@@ -125,8 +168,8 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH) -> list[int]:
             for row, i in enumerate(use):
                 ins[i].seek(off)
                 stack[row] = np.frombuffer(ins[i].read(n), dtype=np.uint8)
-            shards = {i: jnp.asarray(stack[row]) for row, i in enumerate(use)}
-            rebuilt = codec.reconstruct(shards, wanted=missing)
+            rebuilt = _reconstruct_batch(
+                codec, {i: stack[row] for row, i in enumerate(use)}, missing)
             for i in missing:
                 outs[i].write(np.asarray(rebuilt[i]).tobytes())
     finally:

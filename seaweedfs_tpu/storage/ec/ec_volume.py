@@ -121,7 +121,6 @@ class EcVolume:
     def _reconstruct_interval(self, shard_id: int, offset: int, size: int,
                               shard_reader: ShardReader | None) -> bytes:
         """Online repair: rebuild this shard's byte range from any k others."""
-        import jax.numpy as jnp
         codec = ec_files._get_codec()
         got: dict[int, np.ndarray] = {}
         for i in range(layout.TOTAL_SHARDS):
@@ -136,8 +135,7 @@ class EcVolume:
             raise IOError(
                 f"ec volume {self.base}: only {len(got)} shards readable, "
                 f"need {layout.DATA_SHARDS} to reconstruct shard {shard_id}")
-        shards = {i: jnp.asarray(v) for i, v in got.items()}
-        out = codec.reconstruct(shards, wanted=[shard_id])
+        out = ec_files._reconstruct_batch(codec, got, [shard_id])
         return np.asarray(out[shard_id]).tobytes()
 
     def read_needle(self, needle_id: int,

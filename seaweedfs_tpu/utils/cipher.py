@@ -1,0 +1,54 @@
+"""Chunk encryption: AES-256-GCM with a random per-chunk key.
+
+Mirrors the reference's cipher scheme (weed/util/cipher.go): `encrypt`
+draws a fresh 256-bit key per chunk, seals with AES-GCM, and prepends the
+random 12-byte nonce to the ciphertext (Go's `gcm.Seal(nonce, nonce, ...)`
+layout), so `sealed = nonce || ciphertext || tag`.  The per-chunk key rides
+in the chunk metadata (filer entry), never on the volume server.
+
+The AES/GHASH cores live in the native C++ library (native/weedtpu_native.cc,
+AES-NI when the host has it)."""
+
+from __future__ import annotations
+
+import secrets
+
+from seaweedfs_tpu import native
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+TAG_SIZE = 16
+
+
+class CipherError(Exception):
+    pass
+
+
+def available() -> bool:
+    return native.available()
+
+
+def gen_cipher_key() -> bytes:
+    return secrets.token_bytes(KEY_SIZE)
+
+
+def encrypt(plaintext: bytes, key: bytes | None = None) -> tuple[bytes, bytes]:
+    """Returns (cipher_key, nonce||ciphertext||tag)."""
+    if not native.available():
+        raise CipherError(f"native cipher unavailable: {native.load_error()}")
+    key = key or gen_cipher_key()
+    nonce = secrets.token_bytes(NONCE_SIZE)
+    sealed = native.aes256_gcm_seal(key, nonce, plaintext)
+    return key, nonce + sealed
+
+
+def decrypt(key: bytes, sealed: bytes) -> bytes:
+    if not native.available():
+        raise CipherError(f"native cipher unavailable: {native.load_error()}")
+    if len(sealed) < NONCE_SIZE + TAG_SIZE:
+        raise CipherError("sealed data too short")
+    nonce, body = sealed[:NONCE_SIZE], sealed[NONCE_SIZE:]
+    try:
+        return native.aes256_gcm_open(key, nonce, body)
+    except ValueError as e:
+        raise CipherError(str(e)) from e
