@@ -11,8 +11,9 @@
 //  - CRC32C (Castagnoli) with SSE4.2 hardware instructions (reference:
 //    needle checksums, weed/storage/needle/crc.go).
 //  - AES-256-GCM and AES-256-CTR (reference: weed/util/cipher.go encrypts
-//    chunks with AES-256-GCM).  AES-NI + PCLMUL paths with portable
-//    fallbacks.
+//    chunks with AES-256-GCM).  4-wide AES-NI CTR with a portable fallback;
+//    GHASH via Shoup-style 16x256 tables derived from the bit-level
+//    reference multiply.
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
@@ -97,7 +98,7 @@ static void gf_mul_slice_avx2(uint8_t c, const uint8_t* in, uint8_t* out,
 }
 #endif
 
-static void gf_mul_slice_scalar(uint8_t c, const uint8_t* in, uint8_t* out,
+__attribute__((unused)) static void gf_mul_slice_scalar(uint8_t c, const uint8_t* in, uint8_t* out,
                                 size_t n, int accumulate) {
   const uint8_t* row = GF_MUL[c];
   if (accumulate) {
@@ -255,7 +256,7 @@ void wn_gf_matmul_ptrs(const uint8_t* mat, int rows, int k,
 static uint32_t CRC32C_TABLE[256];
 static int crc_initialized = 0;
 
-static void crc_init(void) {
+__attribute__((unused)) static void crc_init(void) {
   if (crc_initialized) return;
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t c = i;
@@ -394,23 +395,63 @@ static void aes_block(const aes256_key* ks, const uint8_t in[16],
   aes_block_soft(ks, in, out);
 }
 
+static void ctr_inc(uint8_t ctr[16]) {
+  for (int i = 15; i >= 12; i--)
+    if (++ctr[i]) break;
+}
+
+// CTR over a pre-expanded schedule; AES-NI path runs 4 blocks in flight to
+// cover the aesenc latency chain.
+static void aes256_ctr_ks(const aes256_key* ks, const uint8_t iv[16],
+                          const uint8_t* in, uint8_t* out, size_t n) {
+  uint8_t ctr[16];
+  memcpy(ctr, iv, 16);
+  size_t off = 0;
+#if defined(__AES__)
+  static int use_ni = -1;
+  if (use_ni < 0) use_ni = has_aesni();
+  if (use_ni) {
+    while (n - off >= 64) {
+      __m128i b[4];
+      for (int j = 0; j < 4; j++) {
+        b[j] = _mm_loadu_si128((const __m128i*)ctr);
+        ctr_inc(ctr);
+      }
+      const __m128i rk0 = _mm_loadu_si128((const __m128i*)ks->rk[0]);
+      for (int j = 0; j < 4; j++) b[j] = _mm_xor_si128(b[j], rk0);
+      for (int r = 1; r < 14; r++) {
+        const __m128i rk = _mm_loadu_si128((const __m128i*)ks->rk[r]);
+        for (int j = 0; j < 4; j++) b[j] = _mm_aesenc_si128(b[j], rk);
+      }
+      const __m128i rkl = _mm_loadu_si128((const __m128i*)ks->rk[14]);
+      for (int j = 0; j < 4; j++) {
+        b[j] = _mm_aesenclast_si128(b[j], rkl);
+        __m128i v = _mm_loadu_si128((const __m128i*)(in + off + 16 * j));
+        _mm_storeu_si128((__m128i*)(out + off + 16 * j),
+                         _mm_xor_si128(v, b[j]));
+      }
+      off += 64;
+    }
+  }
+#endif
+  uint8_t ksblk[16];
+  while (off < n) {
+    aes_block(ks, ctr, ksblk);
+    size_t chunk = n - off < 16 ? n - off : 16;
+    for (size_t i = 0; i < chunk; i++)
+      out[off + i] = (uint8_t)(in[off + i] ^ ksblk[i]);
+    off += chunk;
+    ctr_inc(ctr);
+  }
+}
+
 // CTR keystream XOR: out = in ^ AES-CTR(key, iv).  iv is the 16-byte
 // initial counter block; the low 32 bits big-endian increment per block.
 void wn_aes256_ctr(const uint8_t key[32], const uint8_t iv[16],
                    const uint8_t* in, uint8_t* out, size_t n) {
   aes256_key ks;
   aes256_expand(key, &ks);
-  uint8_t ctr[16], ksblk[16];
-  memcpy(ctr, iv, 16);
-  size_t off = 0;
-  while (off < n) {
-    aes_block(&ks, ctr, ksblk);
-    size_t chunk = n - off < 16 ? n - off : 16;
-    for (size_t i = 0; i < chunk; i++) out[off + i] = (uint8_t)(in[off + i] ^ ksblk[i]);
-    off += chunk;
-    for (int i = 15; i >= 12; i--)
-      if (++ctr[i]) break;
-  }
+  aes256_ctr_ks(&ks, iv, in, out, n);
 }
 
 // -- GHASH over GF(2^128) ---------------------------------------------------
@@ -418,24 +459,6 @@ void wn_aes256_ctr(const uint8_t key[32], const uint8_t iv[16],
 typedef struct {
   uint64_t hi, lo;
 } be128;
-
-static be128 ghash_mul(be128 x, be128 h) {
-  // bitwise multiply, right-shift variant per NIST SP 800-38D
-  be128 z = {0, 0};
-  be128 v = h;
-  for (int i = 0; i < 128; i++) {
-    uint64_t bit = (i < 64) ? (x.hi >> (63 - i)) & 1 : (x.lo >> (127 - i)) & 1;
-    if (bit) {
-      z.hi ^= v.hi;
-      z.lo ^= v.lo;
-    }
-    int lsb = (int)(v.lo & 1);
-    v.lo = (v.lo >> 1) | (v.hi << 63);
-    v.hi >>= 1;
-    if (lsb) v.hi ^= 0xE100000000000000ull;
-  }
-  return z;
-}
 
 static be128 load_be128(const uint8_t* p) {
   be128 r;
@@ -456,35 +479,83 @@ static void store_be128(be128 v, uint8_t* p) {
   }
 }
 
+// Shoup-style 16x256 GHASH tables, built from the bit-level reference above
+// by linearity: entry [i][b] = (byte b at position i) * H.  Build cost is
+// 128 mulx steps + ~33k 128-bit xors (~us), then each block is 16 lookups.
+typedef struct {
+  be128 t[16][256];
+} ghash_tables;
+
+static void ghash_precompute(const uint8_t h[16], ghash_tables* tb) {
+  // P[p] = u^p * H, where u^p*H is p applications of the mulx step used by
+  // ghash_mul's scan (bit p counts from byte 0's MSB).
+  be128 P[128];
+  be128 v = load_be128(h);
+  for (int p = 0; p < 128; p++) {
+    P[p] = v;
+    int lsb = (int)(v.lo & 1);
+    v.lo = (v.lo >> 1) | (v.hi << 63);
+    v.hi >>= 1;
+    if (lsb) v.hi ^= 0xE100000000000000ull;
+  }
+  for (int i = 0; i < 16; i++) {
+    for (int b = 0; b < 256; b++) {
+      be128 z = {0, 0};
+      for (int j = 0; j < 8; j++) {
+        if (b & (1 << j)) {
+          const be128* p = &P[8 * i + (7 - j)];
+          z.hi ^= p->hi;
+          z.lo ^= p->lo;
+        }
+      }
+      tb->t[i][b] = z;
+    }
+  }
+}
+
+static be128 ghash_mul_tab(const ghash_tables* tb, be128 x) {
+  uint8_t bytes[16];
+  store_be128(x, bytes);
+  be128 z = {0, 0};
+  for (int i = 0; i < 16; i++) {
+    const be128* e = &tb->t[i][bytes[i]];
+    z.hi ^= e->hi;
+    z.lo ^= e->lo;
+  }
+  return z;
+}
+
+static void ghash_update(const ghash_tables* tb, be128* y, const uint8_t* p,
+                         size_t len) {
+  uint8_t blk[16];
+  for (size_t off = 0; off < len; off += 16) {
+    size_t c = len - off < 16 ? len - off : 16;
+    const uint8_t* src = p + off;
+    if (c < 16) {
+      memset(blk, 0, 16);
+      memcpy(blk, src, c);
+      src = blk;
+    }
+    be128 x = load_be128(src);
+    y->hi ^= x.hi;
+    y->lo ^= x.lo;
+    *y = ghash_mul_tab(tb, *y);
+  }
+}
+
 static void ghash(const uint8_t h[16], const uint8_t* aad, size_t aad_len,
                   const uint8_t* ct, size_t ct_len, uint8_t out[16]) {
-  be128 hk = load_be128(h);
+  ghash_tables tb;  // 64KB, per-call so concurrent callers don't race
+  ghash_precompute(h, &tb);
   be128 y = {0, 0};
-  uint8_t blk[16];
-  for (size_t off = 0; off < aad_len; off += 16) {
-    memset(blk, 0, 16);
-    size_t c = aad_len - off < 16 ? aad_len - off : 16;
-    memcpy(blk, aad + off, c);
-    be128 x = load_be128(blk);
-    y.hi ^= x.hi;
-    y.lo ^= x.lo;
-    y = ghash_mul(y, hk);
-  }
-  for (size_t off = 0; off < ct_len; off += 16) {
-    memset(blk, 0, 16);
-    size_t c = ct_len - off < 16 ? ct_len - off : 16;
-    memcpy(blk, ct + off, c);
-    be128 x = load_be128(blk);
-    y.hi ^= x.hi;
-    y.lo ^= x.lo;
-    y = ghash_mul(y, hk);
-  }
+  ghash_update(&tb, &y, aad, aad_len);
+  ghash_update(&tb, &y, ct, ct_len);
   be128 lens;
   lens.hi = (uint64_t)aad_len * 8;
   lens.lo = (uint64_t)ct_len * 8;
   y.hi ^= lens.hi;
   y.lo ^= lens.lo;
-  y = ghash_mul(y, hk);
+  y = ghash_mul_tab(&tb, y);
   store_be128(y, out);
 }
 
@@ -504,9 +575,8 @@ void wn_aes256_gcm_seal(const uint8_t key[32], const uint8_t nonce[12],
   // CTR starts at J0+1
   uint8_t ctr0[16];
   memcpy(ctr0, j0, 16);
-  for (int i = 15; i >= 12; i--)
-    if (++ctr0[i]) break;
-  wn_aes256_ctr(key, ctr0, in, out, n);
+  ctr_inc(ctr0);
+  aes256_ctr_ks(&ks, ctr0, in, out, n);
   uint8_t s[16];
   ghash(h, aad, aad_len, out, n, s);
   uint8_t ek[16];
@@ -537,9 +607,8 @@ int wn_aes256_gcm_open(const uint8_t key[32], const uint8_t nonce[12],
   if (diff) return -1;
   uint8_t ctr0[16];
   memcpy(ctr0, j0, 16);
-  for (int i = 15; i >= 12; i--)
-    if (++ctr0[i]) break;
-  wn_aes256_ctr(key, ctr0, in, out, n);
+  ctr_inc(ctr0);
+  aes256_ctr_ks(&ks, ctr0, in, out, n);
   return 0;
 }
 

@@ -31,12 +31,32 @@ _lock = threading.Lock()
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
+class NativeUnavailable(RuntimeError):
+    pass
+
+
 def _build() -> None:
     src = os.path.join(_NATIVE_DIR, "weedtpu_native.cc")
-    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+
+    def up_to_date() -> bool:
+        return os.path.exists(_SO_PATH) and \
+            os.path.getmtime(_SO_PATH) >= os.path.getmtime(src)
+
+    if up_to_date():
         return
-    subprocess.run(["make", "-C", _NATIVE_DIR, "libweedtpu_native.so"],
-                   check=True, capture_output=True)
+    # serialize concurrent first-use builds across processes so nobody
+    # dlopens a half-written .so
+    import fcntl
+    lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if up_to_date():  # another process built it while we waited
+                return
+            subprocess.run(["make", "-C", _NATIVE_DIR, "libweedtpu_native.so"],
+                           check=True, capture_output=True)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 def _load():
@@ -78,14 +98,22 @@ def load_error() -> str | None:
     return _lib_err
 
 
+def _require():
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable(
+            f"native library unavailable (need g++/make or a prebuilt "
+            f"{_SO_PATH}): {_lib_err}")
+    return lib
+
+
 def _as_u8p(a) -> _u8p:
     return a.ctypes.data_as(_u8p)
 
 
 def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     """out[rows, n] = mat[rows, k] @ data[k, n] over GF(2^8) (native AVX2)."""
-    lib = _load()
-    assert lib is not None, _lib_err
+    lib = _require()
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
     data = np.ascontiguousarray(data, dtype=np.uint8)
     rows, k = mat.shape
@@ -99,8 +127,7 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
 
 def gf_mul_slice(c: int, src: np.ndarray, dst: np.ndarray,
                  accumulate: bool = False) -> None:
-    lib = _load()
-    assert lib is not None, _lib_err
+    lib = _require()
     assert src.dtype == np.uint8 and dst.dtype == np.uint8
     assert src.size == dst.size
     lib.wn_gf_mul_slice(c, _as_u8p(src), _as_u8p(dst),
@@ -108,8 +135,7 @@ def gf_mul_slice(c: int, src: np.ndarray, dst: np.ndarray,
 
 
 def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
-    lib = _load()
-    assert lib is not None, _lib_err
+    lib = _require()
     arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
         data, (bytes, bytearray, memoryview)) else data
     return int(lib.wn_crc32c(_as_u8p(np.ascontiguousarray(arr)),
@@ -120,8 +146,7 @@ def aes256_gcm_seal(key: bytes, nonce: bytes, plaintext: bytes,
                     aad: bytes = b"") -> bytes:
     """Returns ciphertext||tag, mirroring Go's gcm.Seal output layout that
     the reference stores for encrypted chunks (weed/util/cipher.go)."""
-    lib = _load()
-    assert lib is not None, _lib_err
+    lib = _require()
     assert len(key) == 32 and len(nonce) == 12
     pt = np.frombuffer(plaintext, dtype=np.uint8)
     ct = np.empty(len(plaintext), dtype=np.uint8)
@@ -137,8 +162,7 @@ def aes256_gcm_seal(key: bytes, nonce: bytes, plaintext: bytes,
 
 def aes256_gcm_open(key: bytes, nonce: bytes, sealed: bytes,
                     aad: bytes = b"") -> bytes:
-    lib = _load()
-    assert lib is not None, _lib_err
+    lib = _require()
     assert len(key) == 32 and len(nonce) == 12 and len(sealed) >= 16
     ct = np.frombuffer(sealed[:-16], dtype=np.uint8)
     tag = np.frombuffer(sealed[-16:], dtype=np.uint8)

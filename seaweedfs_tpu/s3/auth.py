@@ -11,12 +11,28 @@ or the IAM API; when no identities are configured every request is allowed
 
 from __future__ import annotations
 
+import base64
+import calendar
 import hashlib
 import hmac
 import json
 import time
 import urllib.parse
 from dataclasses import dataclass, field
+
+# Max clock skew accepted on signed requests, like the reference's 15-minute
+# window (auth_signature_v4.go).
+MAX_SKEW_SECONDS = 15 * 60
+
+# Sub-resources included in the V2 canonicalized resource string
+# (auth_signature_v2.go resourceList).
+_V2_SUBRESOURCES = frozenset((
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "response-cache-control",
+    "response-content-disposition", "response-content-encoding",
+    "response-content-language", "response-content-type", "response-expires",
+    "tagging", "torrent", "uploadId", "uploads", "versionId", "versioning",
+    "versions", "website"))
 
 ACTION_ADMIN = "Admin"
 ACTION_READ = "Read"
@@ -61,10 +77,13 @@ class Identity:
 class IdentityAccessManagement:
     def __init__(self, identities: list[Identity] | None = None):
         self.identities = identities or []
+        # once auth has ever been configured, an empty identity list means
+        # "deny everyone", not "back to open access"
+        self._ever_configured = bool(self.identities)
 
     @property
     def enabled(self) -> bool:
-        return bool(self.identities)
+        return bool(self.identities) or self._ever_configured
 
     @classmethod
     def from_config(cls, data: dict) -> "IdentityAccessManagement":
@@ -84,6 +103,13 @@ class IdentityAccessManagement:
 
     def replace_identities(self, identities: list[Identity]) -> None:
         self.identities = identities
+        if identities:
+            self._ever_configured = True
+
+    def mark_configured(self) -> None:
+        """Force auth on even with zero identities (an identity store exists
+        but is empty -> deny-all, not open access)."""
+        self._ever_configured = True
 
     def lookup(self, access_key: str) -> tuple[Identity, Credential]:
         for ident in self.identities:
@@ -108,8 +134,34 @@ class IdentityAccessManagement:
         if "X-Amz-Signature" in query or "X-Amz-Algorithm" in query:
             return self._auth_v4_presigned(method, raw_path, query, headers)
         if auth.startswith("AWS "):
-            return self._auth_v2_header(auth)
+            return self._auth_v2_header(auth, method, raw_path, query, headers)
         raise AuthError("AccessDenied", "no signature provided")
+
+    @staticmethod
+    def verify_payload_hash(headers, body: bytes) -> None:
+        """Compare the signed x-amz-content-sha256 against the actual body.
+        Called by the gateway after it has read the body (kept separate from
+        authenticate() so auth happens before buffering the payload)."""
+        sha_hdr = headers.get("x-amz-content-sha256", "")
+        if not sha_hdr or sha_hdr == "UNSIGNED-PAYLOAD" or \
+                sha_hdr.startswith("STREAMING-"):
+            return
+        if hashlib.sha256(body).hexdigest() != sha_hdr.lower():
+            raise AuthError("XAmzContentSHA256Mismatch",
+                            "The provided 'x-amz-content-sha256' header does "
+                            "not match what was computed.", 400)
+
+    @staticmethod
+    def _check_skew(amz_date: str) -> None:
+        try:
+            t0 = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            raise AuthError("AuthorizationHeaderMalformed",
+                            "invalid x-amz-date", 400)
+        if abs(time.time() - t0) > MAX_SKEW_SECONDS:
+            raise AuthError("RequestTimeTooSkewed",
+                            "The difference between the request time and the "
+                            "server's time is too large.")
 
     # -- V4 ------------------------------------------------------------
 
@@ -165,6 +217,7 @@ class IdentityAccessManagement:
                             "cannot parse Authorization header", 400)
         ident, cred = self.lookup(access_key)
         amz_date = headers.get("x-amz-date", headers.get("X-Amz-Date", ""))
+        self._check_skew(amz_date)
         if payload_hash is None:
             payload_hash = headers.get("x-amz-content-sha256",
                                        "UNSIGNED-PAYLOAD")
@@ -194,8 +247,12 @@ class IdentityAccessManagement:
         except (KeyError, IndexError):
             raise AuthError("AuthorizationQueryParametersError",
                             "incomplete presigned query", 400)
-        expires = int(query.get("X-Amz-Expires", "604800"))
-        t0 = time.mktime(time.strptime(amz_date, "%Y%m%dT%H%M%SZ")) - time.timezone
+        try:
+            expires = int(query.get("X-Amz-Expires", "604800"))
+            t0 = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            raise AuthError("AuthorizationQueryParametersError",
+                            "malformed X-Amz-Expires or X-Amz-Date", 400)
         if time.time() > t0 + expires:
             raise AuthError("AccessDenied", "Request has expired")
         ident, cred = self.lookup(access_key)
@@ -214,16 +271,58 @@ class IdentityAccessManagement:
                             "presigned signature mismatch")
         return ident
 
-    # -- V2 (subset: identity by access key, HMAC-SHA1 not re-derived
-    # against the full canonicalized resource — V2 is long-deprecated; the
-    # reference keeps it for old clients, we accept key'd clients) --------
+    # -- V2 (HMAC-SHA1 over the canonicalized resource,
+    # auth_signature_v2.go) --------------------------------------------
 
-    def _auth_v2_header(self, auth: str) -> Identity:
+    def _auth_v2_header(self, auth: str, method: str, raw_path: str,
+                        query: dict[str, str], headers) -> Identity:
         try:
-            access_key = auth[4:].split(":")[0]
-        except IndexError:
+            access_key, got_sig = auth[4:].split(":", 1)
+        except ValueError:
             raise AuthError("AuthorizationHeaderMalformed", "bad V2 header", 400)
-        ident, _ = self.lookup(access_key)
+        ident, cred = self.lookup(access_key)
+        # CanonicalizedAmzHeaders: sorted lowercase x-amz-* headers
+        amz = sorted((k.lower(), " ".join(v.split()))
+                     for k, v in headers.items()
+                     if k.lower().startswith("x-amz-"))
+        canon_amz = "".join(f"{k}:{v}\n" for k, v in amz)
+        # CanonicalizedResource: path + signed sub-resources
+        subs = sorted(k for k in query if k in _V2_SUBRESOURCES)
+        resource = urllib.parse.unquote(raw_path)
+        if subs:
+            resource += "?" + "&".join(
+                f"{k}={query[k]}" if query[k] else k for k in subs)
+        # freshness: V2 requests carry an RFC1123 date in x-amz-date or Date;
+        # enforce the same 15-minute window as V4 so captured requests can't
+        # replay forever
+        import email.utils
+        date_hdr = headers.get("x-amz-date") or headers.get("Date", "")
+        try:
+            when = email.utils.parsedate_to_datetime(date_hdr)
+        except (TypeError, ValueError):
+            when = None
+        if when is None:
+            raise AuthError("AccessDenied", "missing or malformed Date", 403)
+        if abs(time.time() - when.timestamp()) > MAX_SKEW_SECONDS:
+            raise AuthError("RequestTimeTooSkewed",
+                            "The difference between the request time and the "
+                            "server's time is too large.")
+        # Date line is empty when x-amz-date is signed among the amz headers
+        date_line = "" if any(k.lower() == "x-amz-date" for k in headers) \
+            else headers.get("Date", "")
+        sts = "\n".join([
+            method,
+            headers.get("Content-MD5", ""),
+            headers.get("Content-Type", ""),
+            date_line,
+        ]) + "\n" + canon_amz + resource
+        want = base64.b64encode(
+            hmac.new(cred.secret_key.encode(), sts.encode(),
+                     hashlib.sha1).digest()).decode()
+        if not hmac.compare_digest(want, got_sig):
+            raise AuthError("SignatureDoesNotMatch",
+                            "The request signature we calculated does not "
+                            "match the signature you provided")
         return ident
 
 

@@ -130,7 +130,33 @@ class IamApiServer:
     # -- dispatch ------------------------------------------------------
 
     async def handle(self, req: web.Request) -> web.Response:
-        form = urllib.parse.parse_qs((await req.read()).decode())
+        # SigV4-authenticated, Admin-only once an identity exists that holds
+        # Admin with credentials; before that the API is open for bootstrap
+        # (the reference's weed/iamapi authenticates management calls with
+        # the s3 gateway's identities the same way).
+        from seaweedfs_tpu.s3.auth import ACTION_ADMIN, AuthError
+        if any(ACTION_ADMIN in i.actions and i.credentials
+               for i in self.iam.identities):
+            raw_path = req.raw_path.split("?", 1)[0]
+            q = {k: req.query.get(k, "") for k in req.query}
+            try:
+                ident = self.iam.authenticate(req.method, raw_path, q,
+                                              req.headers)
+            except AuthError as e:
+                return _err(e.code, str(e), e.status)
+            if not ident.can_do(ACTION_ADMIN):
+                return _err("AccessDenied",
+                            "IAM management requires Admin", 403)
+            raw_body = await req.read()
+            try:
+                # the signature covered x-amz-content-sha256; reject a
+                # replayed header set with a swapped Action body
+                self.iam.verify_payload_hash(req.headers, raw_body)
+            except AuthError as e:
+                return _err(e.code, str(e), e.status)
+        else:
+            raw_body = await req.read()
+        form = urllib.parse.parse_qs(raw_body.decode())
         values = {k: v[0] for k, v in form.items()}
         action = values.get("Action", "")
         handler = getattr(self, f"do_{action}", None)

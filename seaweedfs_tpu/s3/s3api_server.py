@@ -53,6 +53,12 @@ def _el(parent: ET.Element, tag: str, text: str | None = None) -> ET.Element:
     return e
 
 
+def _is_aws_chunked(req) -> bool:
+    """Single source of truth for the chunked-upload body encoding check."""
+    return req.headers.get("x-amz-content-sha256", "").startswith("STREAMING-") \
+        or "aws-chunked" in req.headers.get("Content-Encoding", "")
+
+
 def _iso(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
 
@@ -93,9 +99,47 @@ class S3ApiServer:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
+        self._ident_task = asyncio.create_task(self._identity_sync())
         log.info("s3 gateway on %s -> filer %s", self.url, self.filer_url)
 
+    async def _identity_sync(self) -> None:
+        """Load IAM-API-managed identities from the filer and hot-reload on
+        meta events (reference: s3api/auth_credentials_subscribe.go).  A
+        static -config file still wins if the filer has no identity.json."""
+        from seaweedfs_tpu.s3.iamapi_server import IDENTITY_PATH
+        prefix = IDENTITY_PATH.rsplit("/", 1)[0]
+
+        async def load_once() -> None:
+            st, body = await self._filer("GET", IDENTITY_PATH)
+            if st == 200 and body:
+                loaded = IdentityAccessManagement.from_config(
+                    json.loads(body))
+                # an identity store exists: auth stays on even if the list
+                # is (or becomes) empty — deleting the last IAM user means
+                # deny-all, never open access
+                self.iam.replace_identities(loaded.identities)
+                self.iam.mark_configured()
+                log.info("loaded %d identities from filer",
+                         len(loaded.identities))
+
+        while True:
+            try:
+                await load_once()
+                url = f"http://{self.filer_url}/__meta__/subscribe"
+                async with self._session.get(
+                        url, params={"prefix": prefix, "live": "true"},
+                        headers=self._filer_auth(write=False)) as r:
+                    async for line in r.content:
+                        if line.strip():  # skip keepalive blank lines
+                            await load_once()
+            except (aiohttp.ClientError, json.JSONDecodeError, KeyError,
+                    TypeError, ValueError, ConnectionError, OSError):
+                log.warning("identity sync error", exc_info=True)
+            await asyncio.sleep(5)
+
     async def stop(self) -> None:
+        if getattr(self, "_ident_task", None):
+            self._ident_task.cancel()
         if self._session:
             await self._session.close()
         if self._runner:
@@ -156,13 +200,22 @@ class S3ApiServer:
         bucket, _, key = path.lstrip("/").partition("/")
         q = {k: req.query.get(k, "") for k in req.query}
 
-        body: bytes | None = None
-        if req.method in ("PUT", "POST"):
-            body = await self._read_body(req)
-
+        # Authenticate BEFORE buffering the payload so an unauthenticated
+        # client cannot make the gateway hold a multi-GB body in RAM.
         try:
             ident = self.iam.authenticate(req.method, raw_path, q,
                                           req.headers)
+        except AuthError as e:
+            return _error_response(e.code, str(e), e.status, path)
+
+        body: bytes | None = None
+        try:
+            if req.method in ("PUT", "POST"):
+                body = await self._read_body(req)
+                # the signature covered x-amz-content-sha256; now that the
+                # body is read, check the body actually matches it
+                if self.iam.enabled and not _is_aws_chunked(req):
+                    self.iam.verify_payload_hash(req.headers, body)
         except AuthError as e:
             return _error_response(e.code, str(e), e.status, path)
 
@@ -177,9 +230,7 @@ class S3ApiServer:
 
     async def _read_body(self, req: web.Request) -> bytes:
         body = await req.read()
-        sha_hdr = req.headers.get("x-amz-content-sha256", "")
-        if sha_hdr.startswith("STREAMING-") or \
-                "aws-chunked" in req.headers.get("Content-Encoding", ""):
+        if _is_aws_chunked(req):
             body = _decode_aws_chunked(body)
         return body
 
@@ -483,14 +534,19 @@ class S3ApiServer:
             return await self.get_object(req, bucket, key)
         return _error_response("MethodNotAllowed", "method not allowed", 405)
 
-    async def put_object(self, req, bucket, key, body) -> web.Response:
-        headers = {"Content-Type": req.headers.get(
+    async def put_object(self, req, bucket, key, body,
+                         override_headers: dict | None = None) -> web.Response:
+        """`override_headers` replaces the request's Content-Type and
+        x-amz-meta-* source (used by CopyObject's COPY metadata directive)."""
+        src_headers = override_headers if override_headers is not None \
+            else req.headers
+        headers = {"Content-Type": src_headers.get(
             "Content-Type", "application/octet-stream")}
         md5 = hashlib.md5(body).hexdigest()
         params = {"collection": bucket}
-        # x-amz-meta-* -> extended attrs via Seaweed- headers
-        for h, v in req.headers.items():
-            if h.lower().startswith("x-amz-meta-"):
+        # x-amz-meta-* / tag attrs -> extended attrs via Seaweed- headers
+        for h, v in src_headers.items():
+            if h.lower().startswith("x-amz-meta-") or h.startswith(TAG_PREFIX):
                 headers[f"Seaweed-{h}"] = v
         st, rbody = await self._filer("PUT", self._fp(bucket, key),
                                       params=params, data=body,
@@ -540,7 +596,23 @@ class S3ApiServer:
         if st != 200:
             return _error_response("NoSuchKey", "copy source missing", 404,
                                    src)
-        put = await self.put_object(req, bucket, key, data)
+        # S3 copies source metadata (content-type, x-amz-meta-*, tags) by
+        # default; x-amz-metadata-directive: REPLACE takes the request's
+        if req.headers.get("x-amz-metadata-directive", "COPY").upper() \
+                == "REPLACE":
+            put = await self.put_object(req, bucket, key, data)
+        else:
+            src_meta = await self._filer_meta(self._fp(src_bucket, src_key)) or {}
+            hdrs: dict[str, str] = {}
+            attrs = src_meta.get("attr") or {}
+            if attrs.get("mime"):
+                hdrs["Content-Type"] = attrs["mime"]
+            for k, v in (src_meta.get("extended") or {}).items():
+                if k.lower().startswith("x-amz-meta-") or \
+                        k.startswith(TAG_PREFIX):
+                    hdrs[k] = v
+            put = await self.put_object(req, bucket, key, data,
+                                        override_headers=hdrs)
         if put.status >= 300:
             return put
         root = ET.Element("CopyObjectResult", xmlns=S3_XMLNS)

@@ -542,7 +542,13 @@ class FilerServer:
                 await resp.write_eof()
                 return resp
             while True:
-                payload = await q.get()
+                try:
+                    payload = await asyncio.wait_for(q.get(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    # ndjson keepalive: surfaces dead peers so shutdown
+                    # doesn't hang on handlers parked in q.get()
+                    await resp.write(b"\n")
+                    continue
                 d = json.loads(payload)
                 if d["ts_ns"] <= last_ts:
                     continue

@@ -24,7 +24,10 @@ import struct
 import time
 from dataclasses import dataclass, field
 
-import google_crc32c
+try:
+    import google_crc32c
+except ImportError:  # fall back to the native C++ runtime's SSE4.2 CRC
+    google_crc32c = None
 
 from seaweedfs_tpu.storage import types as t
 
@@ -41,7 +44,10 @@ TTL_BYTES = 2
 
 
 def crc32c(data: bytes) -> int:
-    return int(google_crc32c.value(data))
+    if google_crc32c is not None:
+        return int(google_crc32c.value(data))
+    from seaweedfs_tpu import native
+    return native.crc32c(data)
 
 
 def crc_legacy_value(c: int) -> int:

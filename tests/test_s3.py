@@ -6,6 +6,7 @@ test/s3/basic/basic_test.go with aws-sdk-go).
 from __future__ import annotations
 
 import asyncio
+import time
 import threading
 import urllib.error
 import urllib.parse
@@ -353,6 +354,86 @@ class TestAuth:
         st, body, _ = stack.req(
             "GET", "/", cred=Credential("NOPE", "nope"))
         assert st == 403 and b"InvalidAccessKeyId" in body
+
+    def test_v2_signature_verified(self, stack):
+        # access key alone must NOT authenticate (V2 needs a valid HMAC-SHA1)
+        import email.utils
+        st, body, _ = stack.req(
+            "GET", "/", cred=None,
+            headers={"Authorization": f"AWS {CRED.access_key}:garbage",
+                     "Date": email.utils.formatdate(usegmt=True)})
+        assert st == 403 and b"SignatureDoesNotMatch" in body
+
+    def test_v2_missing_date_rejected(self, stack):
+        st, body, _ = stack.req(
+            "GET", "/", cred=None,
+            headers={"Authorization": f"AWS {CRED.access_key}:garbage"})
+        assert st == 403
+
+    def test_v2_stale_date_rejected(self, stack):
+        import email.utils
+        old = email.utils.formatdate(time.time() - 3600, usegmt=True)
+        st, body, _ = stack.req(
+            "GET", "/", cred=None,
+            headers={"Authorization": f"AWS {CRED.access_key}:garbage",
+                     "Date": old})
+        assert st == 403 and b"RequestTimeTooSkewed" in body
+
+    def test_v2_valid_signature_accepted(self, stack):
+        import base64
+        import email.utils
+        import hashlib
+        import hmac as hmac_mod
+        date = email.utils.formatdate(usegmt=True)
+        sts = f"GET\n\n\n{date}\n/"
+        sig = base64.b64encode(hmac_mod.new(
+            CRED.secret_key.encode(), sts.encode(),
+            hashlib.sha1).digest()).decode()
+        st, _, _ = stack.req(
+            "GET", "/", cred=None,
+            headers={"Authorization": f"AWS {CRED.access_key}:{sig}",
+                     "Date": date})
+        assert st == 200
+
+    def test_tampered_body_rejected(self, stack):
+        # signature carries x-amz-content-sha256 of the original body; a
+        # swapped body must be rejected
+        from seaweedfs_tpu.s3.auth import sign_v4
+        stack.req("PUT", "/tamper-bucket")
+        headers = sign_v4(CRED, "PUT", stack.s3.url,
+                          "/tamper-bucket/t.txt", {}, payload=b"original")
+        qs_url = f"http://{stack.s3.url}/tamper-bucket/t.txt"
+        r = urllib.request.Request(qs_url, data=b"TAMPERED", method="PUT",
+                                   headers=headers)
+        try:
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                st, body = resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            st, body = e.code, e.read()
+        assert st == 400 and b"XAmzContentSHA256Mismatch" in body
+
+    def test_stale_date_rejected(self, stack):
+        from seaweedfs_tpu.s3.auth import sign_v4
+        old = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(time.time() - 3600))
+        headers = sign_v4(CRED, "GET", stack.s3.url, "/", {}, amz_date=old)
+        r = urllib.request.Request(f"http://{stack.s3.url}/", headers=headers)
+        try:
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                st, body = resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            st, body = e.code, e.read()
+        assert st == 403 and b"RequestTimeTooSkewed" in body
+
+    def test_malformed_presigned_params_is_400(self, stack):
+        st, body, _ = stack.req(
+            "GET", "/", cred=None,
+            query={"X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+                   "X-Amz-Credential": f"{CRED.access_key}/x/us-east-1/s3/aws4_request",
+                   "X-Amz-SignedHeaders": "host",
+                   "X-Amz-Signature": "0" * 64,
+                   "X-Amz-Date": "not-a-date",
+                   "X-Amz-Expires": "abc"})
+        assert st == 400 and b"AuthorizationQueryParametersError" in body
 
     def test_readonly_identity_cannot_write(self, stack):
         ro = Credential("READONLY", "rsecret")
