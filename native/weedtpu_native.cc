@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <mutex>
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -543,10 +544,35 @@ static void ghash_update(const ghash_tables* tb, be128* y, const uint8_t* p,
   }
 }
 
+// Small mutex-guarded table cache keyed on H: per-chunk keys re-seal many
+// blocks, and repeated small seals with one key shouldn't pay the 64KB
+// table build every call.
+static std::mutex ghash_cache_mu;
+static struct {
+  uint8_t h[16];
+  ghash_tables tb;
+  int valid;
+} ghash_cache[4];
+static int ghash_cache_next = 0;
+
 static void ghash(const uint8_t h[16], const uint8_t* aad, size_t aad_len,
                   const uint8_t* ct, size_t ct_len, uint8_t out[16]) {
-  ghash_tables tb;  // 64KB, per-call so concurrent callers don't race
-  ghash_precompute(h, &tb);
+  ghash_tables tb;
+  {
+    std::lock_guard<std::mutex> g(ghash_cache_mu);
+    int hit = -1;
+    for (int i = 0; i < 4; i++)
+      if (ghash_cache[i].valid && memcmp(ghash_cache[i].h, h, 16) == 0)
+        hit = i;
+    if (hit < 0) {
+      hit = ghash_cache_next;
+      ghash_cache_next = (ghash_cache_next + 1) & 3;
+      ghash_precompute(h, &ghash_cache[hit].tb);
+      memcpy(ghash_cache[hit].h, h, 16);
+      ghash_cache[hit].valid = 1;
+    }
+    memcpy(&tb, &ghash_cache[hit].tb, sizeof(tb));
+  }
   be128 y = {0, 0};
   ghash_update(&tb, &y, aad, aad_len);
   ghash_update(&tb, &y, ct, ct_len);

@@ -286,9 +286,11 @@ class IdentityAccessManagement:
                      for k, v in headers.items()
                      if k.lower().startswith("x-amz-"))
         canon_amz = "".join(f"{k}:{v}\n" for k, v in amz)
-        # CanonicalizedResource: path + signed sub-resources
+        # CanonicalizedResource: the ENCODED Request-URI path as the client
+        # sent it + signed sub-resources (V2 clients sign the escaped path,
+        # reference: auth_signature_v2.go)
         subs = sorted(k for k in query if k in _V2_SUBRESOURCES)
-        resource = urllib.parse.unquote(raw_path)
+        resource = raw_path
         if subs:
             resource += "?" + "&".join(
                 f"{k}={query[k]}" if query[k] else k for k in subs)

@@ -114,9 +114,26 @@ class FilerServer:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
+        self._register_task = asyncio.create_task(self._register_loop())
         log.info("filer listening on %s", self.url)
 
+    async def _register_loop(self) -> None:
+        """Announce this filer in the master's cluster membership so shells
+        and peers can discover it (reference: weed/cluster/cluster.go
+        filer registration through KeepConnected)."""
+        while True:
+            try:
+                async with self._session.post(
+                        f"http://{self.master_url}/cluster/register",
+                        json={"type": "filer", "address": self.url}):
+                    pass
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(10)
+
     async def stop(self) -> None:
+        if getattr(self, "_register_task", None):
+            self._register_task.cancel()
         self.deletion.stop(drain=False)
         if self._session:
             await self._session.close()
@@ -422,7 +439,12 @@ class FilerServer:
                            is_dir_request: bool) -> web.StreamResponse:
         entry = self.filer.find_entry(path)
         if req.query.get("metadata") == "true":
-            return web.json_response(entry.to_dict())
+            d = entry.to_dict()
+            if req.query.get("resolveManifest") == "true" and \
+                    not entry.is_directory:
+                resolved = await self._resolve_chunks(entry)
+                d["chunks"] = [c.to_dict() for c in resolved]
+            return web.json_response(d)
         if entry.is_directory:
             return await self._list_directory(req, path)
 

@@ -50,8 +50,14 @@ class MasterServer:
             web.post("/admin/lock", self.handle_lock),
             web.post("/admin/unlock", self.handle_unlock),
             web.post("/admin/renew_lock", self.handle_renew_lock),
+            web.post("/cluster/register", self.handle_cluster_register),
+            web.post("/vol/vacuum", self.handle_vacuum),
             web.get("/metrics", self.handle_metrics),
         ])
+        # non-volume-server cluster members (filers, brokers, gateways):
+        # type -> {address: last_seen} (reference: weed/cluster/cluster.go)
+        self.cluster_members: dict[str, dict[str, float]] = {}
+        self.garbage_threshold = 0.3
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._grow_lock = asyncio.Lock()
@@ -83,11 +89,59 @@ class MasterServer:
             await self._runner.cleanup()
 
     async def _expire_loop(self) -> None:
+        tick = 0
         while True:
             await asyncio.sleep(5)
             dead = self.topo.expire_dead_nodes()
             for nid in dead:
                 log.warning("volume server %s expired from topology", nid)
+            now = time.time()
+            for members in self.cluster_members.values():
+                for addr in [a for a, ts in members.items() if now - ts > 30]:
+                    del members[addr]
+            tick += 1
+            if tick % 12 == 0:  # every minute: vacuum scan
+                try:
+                    await self._vacuum_scan(self.garbage_threshold)
+                except Exception:
+                    log.warning("vacuum scan failed", exc_info=True)
+
+    async def _vacuum_scan(self, threshold: float) -> int:
+        """Master-driven compaction: scan volumes whose garbage ratio
+        exceeds the threshold and drive the vacuum cycle on their replicas
+        (reference: weed/topology/topology_vacuum.go)."""
+        vacuumed = 0
+        candidates: list[tuple[int, str]] = []
+        with self.topo._lock:
+            for node in self.topo.nodes.values():
+                for vid, v in node.volumes.items():
+                    if v.size > 0 and not v.read_only and \
+                            v.deleted_bytes / max(v.size, 1) > threshold:
+                        candidates.append((vid, node.url))
+        for vid, url in candidates:
+            try:
+                async with self._session.post(
+                        f"http://{url}/admin/volume/vacuum",
+                        json={"volume": vid}) as r:
+                    if r.status == 200:
+                        vacuumed += 1
+                        log.info("vacuumed volume %d on %s", vid, url)
+            except aiohttp.ClientError as e:
+                log.warning("vacuum of %d on %s failed: %s", vid, url, e)
+        return vacuumed
+
+    async def handle_vacuum(self, req: web.Request) -> web.Response:
+        threshold = float(req.query.get("garbageThreshold",
+                                        str(self.garbage_threshold)))
+        n = await self._vacuum_scan(threshold)
+        return web.json_response({"vacuumed": n})
+
+    async def handle_cluster_register(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        kind, addr = body.get("type", "filer"), body.get("address", "")
+        if addr:
+            self.cluster_members.setdefault(kind, {})[addr] = time.time()
+        return web.json_response({})
 
     # -- handlers ------------------------------------------------------
 
@@ -187,6 +241,8 @@ class MasterServer:
             "IsLeader": True,
             "Leader": self.url,
             "Topology": self.topo.to_dict(),
+            "Members": {k: sorted(v) for k, v in
+                        self.cluster_members.items() if v},
         })
 
     async def handle_grow(self, req: web.Request) -> web.Response:

@@ -60,6 +60,8 @@ class VolumeServer:
             web.post("/admin/volume/delete", self.handle_volume_delete),
             web.post("/admin/volume/readonly", self.handle_volume_readonly),
             web.post("/admin/volume/vacuum", self.handle_vacuum),
+            web.post("/admin/volume/copy", self.handle_volume_copy),
+            web.get("/admin/volume/needles", self.handle_volume_needles),
             web.post("/admin/ec/generate", self.handle_ec_generate),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
             web.post("/admin/ec/mount", self.handle_ec_mount),
@@ -494,6 +496,73 @@ class VolumeServer:
         loc.collections.setdefault(vid, collection)
         return web.json_response({})
 
+    async def handle_volume_copy(self, req: web.Request) -> web.Response:
+        """VolumeCopy (reference: volume_grpc_copy.go:199-223 doCopyFile):
+        pull a whole volume's .dat/.idx from a peer and mount it here.
+        Used by volume.balance / volume.fix.replication."""
+        body = await req.json()
+        vid, source = body["volume"], body["source"]
+        collection = body.get("collection", "")
+        if self.store.get_volume(vid) is not None:
+            return web.json_response({"error": "volume exists here"},
+                                     status=409)
+        loc = min(self.store.locations, key=lambda l: len(l.volumes))
+        base = loc.base_path(vid, collection)
+        # pull into .cpd/.cpx temp names, rename only when both succeed, so
+        # a failed copy can't leave a partial .dat that load_existing would
+        # mount as a live volume (reference: volume_vacuum.go temp names)
+        tmp_ext = {".dat": ".cpd", ".idx": ".cpx"}
+        try:
+            for ext in (".dat", ".idx"):
+                name = os.path.basename(base + ext)
+                async with self._session.get(
+                        f"http://{source}/admin/file",
+                        params={"name": name}) as r:
+                    if r.status != 200:
+                        return web.json_response(
+                            {"error": f"pull {name} from {source}: {r.status}"},
+                            status=500)
+                    with open(base + tmp_ext[ext], "wb") as f:
+                        async for chunk in r.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+            for ext in (".dat", ".idx"):
+                os.replace(base + tmp_ext[ext], base + ext)
+        except (aiohttp.ClientError, OSError) as e:
+            for ext in (".cpd", ".cpx"):
+                try:
+                    os.remove(base + ext)
+                except OSError:
+                    pass
+            return web.json_response({"error": str(e)}, status=500)
+        from seaweedfs_tpu.storage.volume import Volume
+        try:
+            vol = await asyncio.to_thread(Volume, loc.directory, collection,
+                                          vid)
+        except Exception as e:
+            return web.json_response({"error": f"load: {e}"}, status=500)
+        loc.volumes[vid] = vol
+        loc.collections[vid] = collection
+        await self._heartbeat_once()
+        return web.json_response({"file_count": vol.info().file_count})
+
+    async def handle_volume_needles(self, req: web.Request) -> web.Response:
+        """List needle ids + sizes of a volume (fsck / check.disk support;
+        the reference streams .idx via VolumeCopy's CopyFile or
+        VolumeNeedleStatus)."""
+        vid = int(req.query["volume"])
+        v = self.store.get_volume(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        limit = int(req.query.get("limit", "1000000"))
+        needles = []
+        for nid, (_off, size) in v.nm.items():
+            if size >= 0:
+                needles.append(nid)
+                if len(needles) >= limit:
+                    break
+        return web.json_response({"volume": vid, "count": len(needles),
+                                  "needles": needles})
+
     async def handle_file_pull(self, req: web.Request) -> web.StreamResponse:
         """Serve a volume/ec file by basename for peer pulls (source side of
         VolumeEcShardsCopy / VolumeCopy)."""
@@ -504,6 +573,16 @@ class VolumeServer:
             any(name.endswith(e) for e in EC_FILE_EXTS)
         if not ok_ext:
             return web.json_response({"error": "bad extension"}, status=400)
+        if name.endswith((".dat", ".idx")):
+            # flush buffered index/data writes so peers pull a current copy
+            stem = name.rsplit(".", 1)[0]
+            try:
+                vid = int(stem.rsplit("_", 1)[-1] if "_" in stem else stem)
+            except ValueError:
+                vid = -1
+            v = self.store.get_volume(vid)
+            if v is not None:
+                await asyncio.to_thread(v.flush)
         for loc in self.store.locations:
             p = os.path.join(loc.directory, name)
             if os.path.exists(p):

@@ -14,6 +14,7 @@ import shlex
 import urllib.parse
 import urllib.request
 
+from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import layout
 
 
@@ -52,6 +53,36 @@ class CommandEnv:
 
     def vs_post(self, url: str, path: str, body: dict) -> dict:
         return self._call(f"{url}{path}", body)
+
+    def master_get_raw(self, node_url: str, path: str, **params) -> dict:
+        """GET a JSON endpoint on an arbitrary cluster node."""
+        qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+        return self._call(f"{node_url}{path}{qs}")
+
+    # -- filer helpers ---------------------------------------------------
+
+    def find_filer(self) -> str:
+        members = self.master_get("/cluster/status").get("Members", {})
+        filers = members.get("filer", [])
+        if not filers:
+            raise RuntimeError("no filer registered with the master")
+        return filers[0]
+
+    def filer_list(self, filer: str, dir_path: str) -> list[dict]:
+        d = dir_path.rstrip("/") + "/"
+        r = self._call(f"{filer}{urllib.parse.quote(d)}?limit=100000")
+        return r.get("Entries") or []
+
+    def filer_read(self, filer: str, path: str) -> bytes:
+        req = urllib.request.Request(
+            f"http://{filer}{urllib.parse.quote(path)}")
+        with urllib.request.urlopen(req, timeout=600) as r:
+            return r.read()
+
+    def filer_delete(self, filer: str, path: str,
+                     recursive: bool = False) -> None:
+        qs = "?recursive=true" if recursive else ""
+        self._call(f"{filer}{urllib.parse.quote(path)}{qs}", method="DELETE")
 
     # -- lock -----------------------------------------------------------
 
@@ -342,6 +373,335 @@ def cmd_ec_balance(env: CommandEnv, args, out):
             env.vs_post(src, "/admin/ec/mount", {"volume": vid})
             print(f"volume {vid} shard {s}: {src} -> {tgt}", file=out)
     print("ec.balance done", file=out)
+
+
+# ---- volume maintenance (reference: weed/shell/command_volume_*.go) ----
+
+
+@command("volume.balance")
+def cmd_volume_balance(env: CommandEnv, args, out):
+    """Even out volume counts across nodes by moving volumes from the most
+    to the least loaded (reference: command_volume_balance.go)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    apply = flags.get("force", "false") == "true" or \
+        flags.get("apply", "false") == "true"
+    topo = env.topology()
+    counts = {nid: len(n["volumes"]) for nid, n in topo["nodes"].items()}
+    if len(counts) < 2:
+        print("volume.balance: nothing to do (single node)", file=out)
+        return
+    moves: list[tuple[int, str, str]] = []
+    while True:
+        hi = max(counts, key=counts.get)
+        lo = min(counts, key=counts.get)
+        if counts[hi] - counts[lo] <= 1:
+            break
+        movable = [v for v in topo["nodes"][hi]["volumes"]
+                   if v not in set(topo["nodes"][lo]["volumes"])]
+        if not movable:
+            break
+        vid = movable[0]
+        moves.append((vid, hi, lo))
+        topo["nodes"][hi]["volumes"].remove(vid)
+        topo["nodes"][lo]["volumes"].append(vid)
+        counts[hi] -= 1
+        counts[lo] += 1
+    cols = {vid: rec.get("collection", "")
+            for vid, rec in collect_volume_infos(topo).items()}
+    for vid, src, dst in moves:
+        print(f"move volume {vid}: {src} -> {dst}"
+              + ("" if apply else " (dry run, -apply to move)"), file=out)
+        if apply:
+            env.vs_post(dst, "/admin/volume/copy",
+                        {"volume": vid, "source": src,
+                         "collection": cols.get(vid, "")})
+            env.vs_post(src, "/admin/volume/delete", {"volume": vid})
+    print(f"volume.balance: {len(moves)} move(s)"
+          + ("" if apply else " planned"), file=out)
+
+
+def collect_volume_infos(topo: dict) -> dict[int, dict]:
+    """vid -> {collection, replica_placement, nodes: [node ids], ...} from
+    the per-node volume_infos in a topology snapshot."""
+    vols: dict[int, dict] = {}
+    for nid, node in topo["nodes"].items():
+        for vi in node.get("volume_infos", []):
+            rec = vols.setdefault(vi["id"], dict(vi, nodes=[]))
+            rec["nodes"].append(nid)
+    return vols
+
+
+@command("volume.fix.replication")
+def cmd_volume_fix_replication(env: CommandEnv, args, out):
+    """Re-replicate under-replicated volumes / purge over-replicated ones
+    (reference: command_volume_fix_replication.go:36-55)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    apply = flags.get("apply", "false") == "true" or \
+        flags.get("force", "false") == "true"
+    topo = env.topology()
+    fixed = 0
+    for vid, rec in sorted(collect_volume_infos(topo).items()):
+        nodes = rec["nodes"]
+        rp = t.ReplicaPlacement.parse(rec.get("replica_placement", "000"))
+        want = rp.copy_count
+        if len(nodes) == want:
+            continue
+        if len(nodes) > want:
+            for extra in nodes[want:]:
+                print(f"volume {vid}: over-replicated, delete from {extra}"
+                      + ("" if apply else " (dry run)"), file=out)
+                if apply:
+                    env.vs_post(extra, "/admin/volume/delete", {"volume": vid})
+                fixed += 1
+        else:
+            targets = [nid for nid in topo["nodes"]
+                       if nid not in nodes and
+                       topo["nodes"][nid]["free_slots"] > 0]
+            for dst in targets[: want - len(nodes)]:
+                print(f"volume {vid}: under-replicated ({len(nodes)}/{want}), "
+                      f"copy {nodes[0]} -> {dst}"
+                      + ("" if apply else " (dry run)"), file=out)
+                if apply:
+                    env.vs_post(dst, "/admin/volume/copy",
+                                {"volume": vid, "source": nodes[0],
+                                 "collection": rec.get("collection", "")})
+                fixed += 1
+    print(f"volume.fix.replication: {fixed} action(s)"
+          + ("" if apply else " planned"), file=out)
+
+
+@command("volume.check.disk")
+def cmd_volume_check_disk(env: CommandEnv, args, out):
+    """Compare replicas of each volume by needle set and report divergence
+    (reference: command_volume_check_disk.go)."""
+    env.require_lock()
+    topo = env.topology()
+    locs: dict[int, list[str]] = {}
+    for nid, node in topo["nodes"].items():
+        for vid in node["volumes"]:
+            locs.setdefault(vid, []).append(nid)
+    issues = 0
+    for vid, nodes in sorted(locs.items()):
+        if len(nodes) < 2:
+            continue
+        sets = {}
+        for url in nodes:
+            r = env.master_get_raw(url, "/admin/volume/needles", volume=vid)
+            sets[url] = set(r.get("needles", []))
+        base = sets[nodes[0]]
+        for url in nodes[1:]:
+            if sets[url] != base:
+                only_a = len(base - sets[url])
+                only_b = len(sets[url] - base)
+                print(f"volume {vid}: {nodes[0]} vs {url} differ "
+                      f"(+{only_a}/-{only_b})", file=out)
+                issues += 1
+    print(f"volume.check.disk: {issues} divergent replica pair(s)", file=out)
+
+
+@command("volume.fsck")
+def cmd_volume_fsck(env: CommandEnv, args, out):
+    """Cross-check filer chunk references against volume needles
+    (reference: command_volume_fsck.go:60-75).  Reports orphan needles
+    (in volumes but unreferenced) and broken refs (referenced but gone)."""
+    env.require_lock()
+    filer = env.find_filer()
+    # collect all chunk fids from the filer
+    referenced: dict[int, set[int]] = {}
+    stack = ["/"]
+    while stack:
+        d = stack.pop()
+        listing = env.filer_list(filer, d)
+        for e in listing:
+            if e.get("IsDirectory"):
+                stack.append(e["FullPath"])
+                continue
+            if not e.get("chunks"):
+                continue
+            # raw chunks (incl. manifest-blob fids) + manifest-resolved data
+            # chunk fids are all legitimately referenced needles
+            raw = env._call(
+                f"{filer}{urllib.parse.quote(e['FullPath'])}?metadata=true")
+            chunks = list(raw.get("chunks") or [])
+            if any(c.get("is_chunk_manifest") for c in chunks):
+                resolved = env._call(
+                    f"{filer}{urllib.parse.quote(e['FullPath'])}"
+                    "?metadata=true&resolveManifest=true")
+                chunks += resolved.get("chunks") or []
+            for c in chunks:
+                try:
+                    f = t.FileId.parse(c.get("fid", ""))
+                    referenced.setdefault(f.volume_id, set()).add(f.key)
+                except ValueError:
+                    pass
+    topo = env.topology()
+    stored: dict[int, set[int]] = {}
+    vol_nodes: dict[int, str] = {}
+    for nid_, node in topo["nodes"].items():
+        for vid in node["volumes"]:
+            r = env.master_get_raw(nid_, "/admin/volume/needles", volume=vid)
+            stored.setdefault(vid, set()).update(r.get("needles", []))
+            vol_nodes[vid] = nid_
+    orphans = broken = 0
+    for vid, needles in sorted(stored.items()):
+        refs = referenced.get(vid, set())
+        o = needles - refs
+        b = refs - needles
+        orphans += len(o)
+        broken += len(b)
+        if o or b:
+            print(f"volume {vid}: {len(o)} orphan needle(s), "
+                  f"{len(b)} broken ref(s)", file=out)
+    print(f"volume.fsck: {orphans} orphan(s), {broken} broken ref(s) "
+          f"across {len(stored)} volume(s)", file=out)
+
+
+@command("collection.list")
+def cmd_collection_list(env: CommandEnv, args, out):
+    topo = env.topology()
+    cols = {rec.get("collection", "")
+            for rec in collect_volume_infos(topo).values()}
+    for name in sorted(cols):
+        print(f"collection {name or '(default)'}", file=out)
+    if not cols:
+        print("no collections", file=out)
+
+
+@command("collection.delete")
+def cmd_collection_delete(env: CommandEnv, args, out):
+    """Delete every volume of a collection, writable or not (reference:
+    command_collection_delete.go)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    name = flags.get("collection", flags.get("name", ""))
+    topo = env.topology()
+    deleted = 0
+    for vid, rec in sorted(collect_volume_infos(topo).items()):
+        if rec.get("collection", "") != name:
+            continue
+        for url in rec["nodes"]:
+            env.vs_post(url, "/admin/volume/delete", {"volume": vid})
+            deleted += 1
+    print(f"collection.delete {name!r}: {deleted} volume replica(s) removed",
+          file=out)
+
+
+# ---- filesystem commands over the filer (reference: weed/shell/command_fs_*.go)
+
+
+@command("fs.ls")
+def cmd_fs_ls(env: CommandEnv, args, out):
+    flags = parse_flags(args)
+    path = (args and not args[-1].startswith("-") and args[-1]) or "/"
+    long = "l" in flags or "long" in flags
+    filer = env.find_filer()
+    for e in env.filer_list(filer, path):
+        name = e["FullPath"].rsplit("/", 1)[-1]
+        if e.get("IsDirectory"):
+            name += "/"
+        if long:
+            print(f"{e.get('FileSize', 0):>12} {name}", file=out)
+        else:
+            print(name, file=out)
+
+
+@command("fs.cat")
+def cmd_fs_cat(env: CommandEnv, args, out):
+    path = args[-1]
+    filer = env.find_filer()
+    data = env.filer_read(filer, path)
+    out.write(data.decode(errors="replace"))
+
+
+@command("fs.rm")
+def cmd_fs_rm(env: CommandEnv, args, out):
+    flags = parse_flags(args)
+    path = args[-1]
+    filer = env.find_filer()
+    env.filer_delete(filer, path, recursive="r" in flags or "rf" in flags)
+    print(f"removed {path}", file=out)
+
+
+@command("fs.mkdir")
+def cmd_fs_mkdir(env: CommandEnv, args, out):
+    path = args[-1].rstrip("/") + "/"
+    filer = env.find_filer()
+    env._call(f"{filer}{urllib.parse.quote(path)}", {}, method="POST")
+    print(f"created {path}", file=out)
+
+
+@command("fs.mv")
+def cmd_fs_mv(env: CommandEnv, args, out):
+    src, dst = args[-2], args[-1]
+    filer = env.find_filer()
+    env._call(f"{filer}{urllib.parse.quote(dst)}?mv.from="
+              f"{urllib.parse.quote(src)}", {}, method="POST")
+    print(f"moved {src} -> {dst}", file=out)
+
+
+@command("fs.du")
+def cmd_fs_du(env: CommandEnv, args, out):
+    path = (args and not args[-1].startswith("-") and args[-1]) or "/"
+    filer = env.find_filer()
+    total = [0]
+    files = [0]
+
+    def walk(d):
+        for e in env.filer_list(filer, d):
+            if e.get("IsDirectory"):
+                walk(e["FullPath"])
+            else:
+                total[0] += e.get("FileSize", 0)
+                files[0] += 1
+    walk(path.rstrip("/") or "/")
+    print(f"{total[0]} bytes in {files[0]} file(s) under {path}", file=out)
+
+
+@command("fs.meta.cat")
+def cmd_fs_meta_cat(env: CommandEnv, args, out):
+    path = args[-1]
+    filer = env.find_filer()
+    meta = env._call(f"{filer}{urllib.parse.quote(path)}?metadata=true")
+    print(json.dumps(meta, indent=2, default=str), file=out)
+
+
+@command("s3.bucket.list")
+def cmd_s3_bucket_list(env: CommandEnv, args, out):
+    filer = env.find_filer()
+    for e in env.filer_list(filer, "/buckets"):
+        if e.get("IsDirectory"):
+            print(e["FullPath"].rsplit("/", 1)[-1], file=out)
+
+
+@command("s3.bucket.create")
+def cmd_s3_bucket_create(env: CommandEnv, args, out):
+    flags = parse_flags(args)
+    name = flags.get("name", args[-1] if args else "")
+    filer = env.find_filer()
+    env._call(f"{filer}/buckets/{name}/", {}, method="POST")
+    print(f"created bucket {name}", file=out)
+
+
+@command("s3.bucket.delete")
+def cmd_s3_bucket_delete(env: CommandEnv, args, out):
+    env.require_lock()
+    flags = parse_flags(args)
+    name = flags.get("name", args[-1] if args else "")
+    filer = env.find_filer()
+    env.filer_delete(filer, f"/buckets/{name}", recursive=True)
+    print(f"deleted bucket {name}", file=out)
+
+
+@command("volume.vacuum.all")
+def cmd_volume_vacuum_all(env: CommandEnv, args, out):
+    """Master-driven vacuum scan (reference: topology_vacuum.go)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    r = env.master_post("/vol/vacuum",
+                        garbageThreshold=flags.get("garbageThreshold", "0.3"))
+    print(f"vacuumed {r.get('vacuumed', 0)} volume(s)", file=out)
 
 
 def run_command(env: CommandEnv, line: str, out) -> None:

@@ -251,6 +251,13 @@ class Volume:
             ttl=str(self.super_block.ttl), version=self.version,
             compact_revision=self.super_block.compaction_revision)
 
+    def flush(self) -> None:
+        """Flush buffered .dat/.idx writes to the OS (peer pulls read the
+        files directly, reference: volume_grpc_copy.go CopyFile)."""
+        with self._lock:
+            self._dat.flush()
+            self.nm.flush()
+
     def close(self) -> None:
         with self._lock:
             self.nm.flush()

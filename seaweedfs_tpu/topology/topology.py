@@ -245,6 +245,8 @@ class Topology:
 
                 def pick(pred, k):
                     picked = []
+                    if k <= 0:
+                        return picked
                     for n in candidates:
                         if n in chosen or n in picked:
                             continue
@@ -283,6 +285,13 @@ class Topology:
                         "dc": n.dc, "rack": n.rack,
                         "free_slots": n.free_slots,
                         "volumes": sorted(n.volumes),
+                        "volume_infos": [
+                            {"id": v.id, "collection": v.collection,
+                             "size": v.size, "file_count": v.file_count,
+                             "read_only": v.read_only,
+                             "replica_placement": v.replica_placement,
+                             "ttl": v.ttl}
+                            for _, v in sorted(n.volumes.items())],
                         "ec_shards": {str(v): sorted(s)
                                       for v, s in n.ec_shards.items()},
                     } for nid, n in self.nodes.items()

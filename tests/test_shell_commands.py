@@ -1,0 +1,178 @@
+"""Shell maintenance + fs commands against a real in-process cluster
+(reference test model: weed/shell/command_volume_balance_test.go,
+command_volume_fix_replication_test.go — but driven end-to-end here)."""
+
+import io
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import WeedClient
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from tests.test_cluster import Cluster, free_port
+
+
+@pytest.fixture()
+def cluster3(tmp_path):
+    c = Cluster(tmp_path, n_volume_servers=3).start()
+    c.wait_heartbeats()
+    yield c
+    c.stop()
+
+
+def shell(env, line) -> str:
+    buf = io.StringIO()
+    run_command(env, line, buf)
+    return buf.getvalue()
+
+
+def wait_for(pred, timeout=6.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_volume_balance_moves_volumes(cluster3, tmp_path):
+    c = cluster3
+    client = WeedClient(c.master.url)
+    # create several volumes, all land somewhere
+    for i in range(6):
+        client.upload(b"x" * 1000, name=f"f{i}")
+        c.submit(c.master._grow("", "000", "", 1))
+    env = CommandEnv(c.master.url)
+    env.acquire_lock()
+    out = shell(env, "volume.balance")  # dry run
+    assert "planned" in out or "nothing to do" in out
+    out = shell(env, "volume.balance -apply")
+    assert "volume.balance:" in out
+    # after apply + heartbeats, counts should be near-even
+    def balanced():
+        topo = env.topology()
+        counts = [len(n["volumes"]) for n in topo["nodes"].values()]
+        return counts and max(counts) - min(counts) <= 1
+    assert wait_for(balanced)
+
+
+def test_fix_replication_restores_copy(cluster3):
+    c = cluster3
+    client = WeedClient(c.master.url)
+    fid = client.upload(b"replicate me", name="r.txt")
+    vid = int(fid.split(",")[0])
+    env = CommandEnv(c.master.url)
+    env.acquire_lock()
+    # force an extra replica via copy, then fix should remove it
+    locs = env.volume_locations(vid)
+    other = [f"127.0.0.1:{vs.port}" for vs in c.volume_servers
+             if f"127.0.0.1:{vs.port}" not in locs]
+    env.vs_post(other[0], "/admin/volume/copy",
+                {"volume": vid, "source": locs[0]})
+    assert wait_for(lambda: len(env.volume_locations(vid)) == 2)
+    out = shell(env, "volume.fix.replication -apply")
+    assert "over-replicated" in out
+    assert wait_for(lambda: len(env.volume_locations(vid)) == 1)
+    # data still readable
+    assert client.download(fid) == b"replicate me"
+
+
+def test_check_disk_detects_divergence(cluster3):
+    c = cluster3
+    client = WeedClient(c.master.url)
+    fid = client.upload(b"abc", name="a.txt")
+    vid = int(fid.split(",")[0])
+    env = CommandEnv(c.master.url)
+    env.acquire_lock()
+    locs = env.volume_locations(vid)
+    other = [f"127.0.0.1:{vs.port}" for vs in c.volume_servers
+             if f"127.0.0.1:{vs.port}" not in locs]
+    env.vs_post(other[0], "/admin/volume/copy",
+                {"volume": vid, "source": locs[0]})
+    # identical replicas -> no divergence
+    out = shell(env, "volume.check.disk")
+    assert "0 divergent" in out
+    # write only to one replica (?type=replicate suppresses the fan-out)
+    client.upload_to(locs[0], f"{vid},000000ffdeadbeef?type=replicate",
+                     b"extra")
+    out = shell(env, "volume.check.disk")
+    assert "differ" in out
+
+
+def test_vacuum_all(cluster3):
+    c = cluster3
+    client = WeedClient(c.master.url)
+    fids = [client.upload(b"y" * 10000, name=f"v{i}") for i in range(10)]
+    for fid in fids[:9]:
+        client.delete(fid)
+    env = CommandEnv(c.master.url)
+    env.acquire_lock()
+    out = shell(env, "volume.vacuum.all -garbageThreshold 0.1")
+    assert "vacuumed" in out
+    assert client.download(fids[9]) == b"y" * 10000
+
+
+class TestFsCommands:
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        c = Cluster(tmp_path, n_volume_servers=1).start()
+        c.wait_heartbeats()
+        filer = FilerServer(c.master.url, port=free_port(),
+                            data_dir=str(tmp_path / "filer"))
+        c.submit(filer.start())
+        env = CommandEnv(c.master.url)
+        # wait for filer registration with the master
+        assert wait_for(lambda: bool(
+            env.master_get("/cluster/status").get("Members", {}).get("filer")))
+        yield c, filer, env
+        c.submit(filer.stop())
+        c.stop()
+
+    def _put(self, filer, path, data: bytes):
+        import urllib.request
+        req = urllib.request.Request(f"http://{filer.url}{path}", data=data,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status in (200, 201)
+
+    def test_fs_roundtrip(self, stack):
+        c, filer, env = stack
+        self._put(filer, "/docs/hello.txt", b"hello world")
+        out = shell(env, "fs.ls /docs")
+        assert "hello.txt" in out
+        out = shell(env, "fs.ls -l /docs")
+        assert "11" in out
+        out = shell(env, "fs.cat /docs/hello.txt")
+        assert out == "hello world"
+        shell(env, "fs.mkdir /docs/sub")
+        assert "sub/" in shell(env, "fs.ls /docs")
+        shell(env, "fs.mv /docs/hello.txt /docs/sub/hi.txt")
+        assert "hi.txt" in shell(env, "fs.ls /docs/sub")
+        out = shell(env, "fs.du /docs")
+        assert "11 bytes in 1 file(s)" in out
+        out = shell(env, "fs.meta.cat /docs/sub/hi.txt")
+        assert "chunks" in out
+        shell(env, "fs.rm -r /docs")
+        assert "docs" not in shell(env, "fs.ls /")
+
+    def test_bucket_commands(self, stack):
+        c, filer, env = stack
+        env.acquire_lock()
+        shell(env, "s3.bucket.create mybucket")
+        assert "mybucket" in shell(env, "s3.bucket.list")
+        self._put(filer, "/buckets/mybucket/k.txt", b"v")
+        shell(env, "s3.bucket.delete mybucket")
+        assert "mybucket" not in shell(env, "s3.bucket.list")
+
+    def test_fsck_clean_and_broken(self, stack):
+        c, filer, env = stack
+        env.acquire_lock()
+        self._put(filer, "/data/f1.bin", b"z" * 50000)
+        out = shell(env, "volume.fsck")
+        assert "0 orphan(s), 0 broken ref(s)" in out
+        # orphan: upload a blob directly (not referenced by filer)
+        client = WeedClient(c.master.url)
+        client.upload(b"orphaned blob")
+        out = shell(env, "volume.fsck")
+        assert "1 orphan(s)" in out
