@@ -102,7 +102,55 @@ def main(argv=None) -> int:
     pb.add_argument("-size", type=int, default=1024)
     pb.add_argument("-c", type=int, dest="concurrency", default=16)
 
-    for p in (pm, pv, ps, pf, p3, pi, psh, pb):
+    pup = sub.add_parser("upload",
+                         help="upload files via master assign (command/upload.go)")
+    pup.add_argument("-master", default="127.0.0.1:9333")
+    pup.add_argument("-collection", default="")
+    pup.add_argument("-replication", default="")
+    pup.add_argument("files", nargs="+")
+
+    pdl = sub.add_parser("download",
+                         help="download blobs by fid (command/download.go)")
+    pdl.add_argument("-master", default="127.0.0.1:9333")
+    pdl.add_argument("-dir", default=".")
+    pdl.add_argument("fids", nargs="+")
+
+    pfx = sub.add_parser("fix",
+                         help="rebuild .idx from a .dat offline (command/fix.go:64)")
+    pfx.add_argument("-dir", required=True)
+    pfx.add_argument("-volumeId", type=int, required=True)
+    pfx.add_argument("-collection", default="")
+
+    pex = sub.add_parser("export",
+                         help="export volume needles to a tar (command/export.go)")
+    pex.add_argument("-dir", required=True)
+    pex.add_argument("-volumeId", type=int, required=True)
+    pex.add_argument("-collection", default="")
+    pex.add_argument("-o", dest="output", required=True, help="output .tar")
+
+    pbk = sub.add_parser("backup",
+                         help="incremental volume backup from a volume server (command/backup.go)")
+    pbk.add_argument("-server", required=True, help="volume server host:port")
+    pbk.add_argument("-volumeId", type=int, required=True)
+    pbk.add_argument("-collection", default="")
+    pbk.add_argument("-dir", default=".")
+
+    psy = sub.add_parser("filer.sync",
+                         help="continuous filer A<->B sync (command/filer_sync.go)")
+    psy.add_argument("-a", required=True, help="filer A host:port")
+    psy.add_argument("-b", required=True, help="filer B host:port")
+    psy.add_argument("-filerPath", default="/")
+    psy.add_argument("-offsetFile", default=".filer_sync_offsets.json")
+    psy.add_argument("-oneway", action="store_true")
+
+    psc = sub.add_parser("scaffold",
+                         help="print a config template (command/scaffold.go:33)")
+    psc.add_argument("-config", default="filer",
+                     choices=["filer", "security", "master", "replication",
+                              "notification", "shell"])
+
+    for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
+              psy, psc):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -127,6 +175,24 @@ def main(argv=None) -> int:
         return repl(args.master, args.script)
     if args.cmd == "benchmark":
         return _run_benchmark(args)
+    if args.cmd == "upload":
+        return _run_upload(args)
+    if args.cmd == "download":
+        return _run_download(args)
+    if args.cmd == "fix":
+        return _run_fix(args)
+    if args.cmd == "export":
+        return _run_export(args)
+    if args.cmd == "backup":
+        return _run_backup(args)
+    if args.cmd == "filer.sync":
+        from seaweedfs_tpu.replication.filer_sync import FilerSync
+        FilerSync(args.a, args.b, prefix=args.filerPath,
+                  offset_path=args.offsetFile,
+                  one_way=args.oneway).run_forever()
+        return 0
+    if args.cmd == "scaffold":
+        return _run_scaffold(args)
     return 2
 
 
@@ -280,6 +346,212 @@ def _run_benchmark(args) -> int:
           f"{args.n * args.size / wall / 1e6:.2f} MB/s, "
           f"p50 {lat_ms[len(lat_ms)//2]:.2f}ms "
           f"p99 {lat_ms[int(len(lat_ms)*0.99)]:.2f}ms")
+    return 0
+
+
+def _run_upload(args) -> int:
+    import json
+    import os
+
+    from seaweedfs_tpu.client import WeedClient
+    client = WeedClient(args.master)
+    results = []
+    for path in args.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        fid = client.upload(data, name=os.path.basename(path),
+                            collection=args.collection,
+                            replication=args.replication)
+        results.append({"fileName": os.path.basename(path), "fid": fid,
+                        "size": len(data)})
+    print(json.dumps(results, indent=1))
+    return 0
+
+
+def _run_download(args) -> int:
+    import os
+
+    from seaweedfs_tpu.client import WeedClient
+    client = WeedClient(args.master)
+    for fid in args.fids:
+        data = client.download(fid)
+        out = os.path.join(args.dir, fid.replace(",", "_"))
+        with open(out, "wb") as f:
+            f.write(data)
+        print(f"{fid} -> {out} ({len(data)} bytes)")
+    return 0
+
+
+def _run_fix(args) -> int:
+    """Offline .idx reconstruction by scanning the .dat
+    (reference: weed/command/fix.go:64 runFix)."""
+    import os
+
+    from seaweedfs_tpu.storage import idx as idxf
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.volume import Volume
+
+    name = (f"{args.collection}_{args.volumeId}" if args.collection
+            else str(args.volumeId))
+    dat = os.path.join(args.dir, name + ".dat")
+    if not os.path.exists(dat):
+        print(f"{dat} not found", file=sys.stderr)
+        return 1
+    idx_path = os.path.join(args.dir, name + ".idx")
+    v = Volume(args.dir, args.collection, args.volumeId)
+    try:
+        # last write wins per needle id; a zero-size record is the
+        # tombstone the delete path appends
+        entries: dict[int, tuple[int, int]] = {}
+        for offset, n in v.scan():
+            if n.size == 0 and not n.data:
+                entries.pop(n.id, None)
+            else:
+                entries[n.id] = (offset // t.NEEDLE_PADDING_SIZE, n.size)
+        with open(idx_path + ".tmp", "wb") as f:
+            for nid, (off_units, size) in sorted(entries.items()):
+                f.write(idxf.pack_entry(nid, off_units, size))
+        os.replace(idx_path + ".tmp", idx_path)
+        print(f"rebuilt {idx_path}: {len(entries)} live entries")
+        return 0
+    finally:
+        v.close()
+
+
+def _run_export(args) -> int:
+    """Export live needles of a volume into a tar file
+    (reference: weed/command/export.go)."""
+    import io
+    import tarfile
+
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(args.dir, args.collection, args.volumeId)
+    count = 0
+    try:
+        with tarfile.open(args.output, "w") as tar:
+            for offset, n in v.scan():
+                # only the record the needle map points at is live; earlier
+                # versions of an overwritten id are superseded
+                live = v.nm.get(n.id)
+                if not n.data or live is None or \
+                        live[0] != offset // t.NEEDLE_PADDING_SIZE:
+                    continue
+                name = n.name.decode(errors="replace") or f"{n.id:x}"
+                info = tarfile.TarInfo(name=f"{args.volumeId}/{n.id:x}_{name}")
+                info.size = len(n.data)
+                info.mtime = n.last_modified or 0
+                tar.addfile(info, io.BytesIO(n.data))
+                count += 1
+    finally:
+        v.close()
+    print(f"exported {count} files to {args.output}")
+    return 0
+
+
+def _run_backup(args) -> int:
+    """Pull a volume's .dat/.idx from a live volume server to a local dir
+    (reference: weed/command/backup.go, via the CopyFile seam)."""
+    import os
+    import urllib.parse
+    import urllib.request
+
+    name = (f"{args.collection}_{args.volumeId}" if args.collection
+            else str(args.volumeId))
+    os.makedirs(args.dir, exist_ok=True)
+    for ext in (".dat", ".idx"):
+        url = (f"http://{args.server}/admin/file?"
+               f"name={urllib.parse.quote(name + ext)}")
+        out = os.path.join(args.dir, name + ext)
+        with urllib.request.urlopen(url, timeout=600) as r, \
+                open(out + ".tmp", "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(out + ".tmp", out)
+        print(f"backed up {name}{ext} -> {out}")
+    return 0
+
+
+_SCAFFOLDS = {
+    "filer": """\
+# filer store configuration (reference: weed scaffold -config=filer)
+[filer.options]
+# directory to persist metadata; omit for in-memory
+# dir = "/data/filer"
+
+[memory]
+enabled = false
+
+[sqlite]
+enabled = true
+# dbFile = "/data/filer/filer.db"
+""",
+    "security": """\
+# security.toml (reference: weed scaffold -config=security)
+[jwt.signing]
+key = ""
+[jwt.signing.read]
+key = ""
+[jwt.filer.signing]
+key = ""
+[jwt.filer.signing.read]
+key = ""
+[access]
+ui = false
+[guard]
+white_list = []
+""",
+    "master": """\
+# master.toml
+[master.volume_growth]
+copy_1 = 7
+copy_2 = 6
+copy_3 = 3
+copy_other = 1
+[master.maintenance]
+garbage_threshold = 0.3
+""",
+    "replication": """\
+# replication.toml (reference: weed scaffold -config=replication)
+[source.filer]
+enabled = true
+grpcAddress = "localhost:8888"
+directory = "/buckets"
+
+[sink.filer]
+enabled = false
+grpcAddress = "localhost:8889"
+directory = "/backup"
+
+[sink.local]
+enabled = false
+directory = "/backup"
+""",
+    "notification": """\
+# notification.toml (reference: weed scaffold -config=notification)
+[notification.log]
+enabled = false
+path = "/tmp/filer_events.jsonl"
+
+[notification.kafka]
+enabled = false
+hosts = ["kafka1:9092"]
+topic = "seaweedfs_filer"
+""",
+    "shell": """\
+# shell.toml
+[cluster]
+default = "localhost:9333"
+""",
+}
+
+
+def _run_scaffold(args) -> int:
+    print(_SCAFFOLDS[args.config], end="")
     return 0
 
 

@@ -29,18 +29,25 @@ from seaweedfs_tpu.filer.filerstore import (FilerStore, FilerStoreWrapper,
 
 
 class MetaEvent:
-    """One metadata mutation: create / update / delete / rename leg."""
+    """One metadata mutation: create / update / delete / rename leg.
 
-    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry", "new_parent")
+    `signatures` carries the origin markers used by filer.sync loop
+    prevention (reference: filer_pb SubscribeMetadata signatures,
+    filer/meta_aggregator.go) — a sync writer stamps its peer signature on
+    the replicated write, and skips events already stamped with its own."""
+
+    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry",
+                 "new_parent", "signatures")
 
     def __init__(self, ts_ns: int, directory: str,
                  old_entry: Entry | None, new_entry: Entry | None,
-                 new_parent: str = ""):
+                 new_parent: str = "", signatures: list[int] | None = None):
         self.ts_ns = ts_ns
         self.directory = directory
         self.old_entry = old_entry
         self.new_entry = new_entry
         self.new_parent = new_parent
+        self.signatures = signatures or []
 
     def to_dict(self) -> dict:
         return {
@@ -49,6 +56,7 @@ class MetaEvent:
             "old_entry": self.old_entry.to_dict() if self.old_entry else None,
             "new_entry": self.new_entry.to_dict() if self.new_entry else None,
             "new_parent": self.new_parent,
+            "signatures": self.signatures,
         }
 
     @classmethod
@@ -57,7 +65,19 @@ class MetaEvent:
             ts_ns=d["ts_ns"], directory=d["directory"],
             old_entry=Entry.from_dict(d["old_entry"]) if d.get("old_entry") else None,
             new_entry=Entry.from_dict(d["new_entry"]) if d.get("new_entry") else None,
-            new_parent=d.get("new_parent", ""))
+            new_parent=d.get("new_parent", ""),
+            signatures=d.get("signatures") or [])
+
+
+def event_matches_prefix(ev: "MetaEvent", prefix: str) -> bool:
+    """Prefix filter that also matches the OLD side of a rename, so a move
+    out of the synced subtree still delivers the deletion leg."""
+    if dir_has_prefix(ev.directory, prefix):
+        return True
+    if ev.old_entry is not None and \
+            dir_has_prefix(ev.old_entry.directory, prefix):
+        return True
+    return False
 
 
 def dir_has_prefix(directory: str, prefix: str) -> bool:
@@ -130,12 +150,12 @@ class MetaLog:
                         continue
                     if ring_min is not None and ev.ts_ns >= ring_min:
                         break
-                    if dir_has_prefix(ev.directory, prefix):
+                    if event_matches_prefix(ev, prefix):
                         yield ev
         for ev in ring_events:
             if ev.ts_ns <= since_ts_ns:
                 continue
-            if dir_has_prefix(ev.directory, prefix):
+            if event_matches_prefix(ev, prefix):
                 yield ev
 
     def close(self) -> None:
@@ -155,21 +175,24 @@ class Filer:
     # -- events --------------------------------------------------------
 
     def _notify(self, old: Entry | None, new: Entry | None,
-                new_parent: str = "") -> None:
+                new_parent: str = "", signatures: list[int] | None = None
+                ) -> None:
         directory = (new or old).directory if (new or old) else "/"
         self.meta_log.append(MetaEvent(
-            self.meta_log.next_ts(), directory, old, new, new_parent))
+            self.meta_log.next_ts(), directory, old, new, new_parent,
+            signatures))
 
     # -- core CRUD -----------------------------------------------------
 
     def create_entry(self, entry: Entry, o_excl: bool = False,
-                     mkdirs: bool = True) -> Entry:
+                     mkdirs: bool = True,
+                     signatures: list[int] | None = None) -> Entry:
         """Insert or replace an entry; creates missing parent directories
         (reference: filer.go CreateEntry + ensureParentDirectoryEntry)."""
         with self._lock:
             if mkdirs:
                 for d in parent_directories(entry.full_path):
-                    self._ensure_directory(d)
+                    self._ensure_directory(d, signatures=signatures)
             old = None
             try:
                 old = self.store.find_entry(entry.full_path)
@@ -190,10 +213,11 @@ class Filer:
                 garbage = filechunks.minus_chunks(old.chunks, entry.chunks)
                 if garbage:
                     self.on_delete_chunks(garbage)
-            self._notify(old, entry)
+            self._notify(old, entry, signatures=signatures)
             return entry
 
-    def _ensure_directory(self, dir_path: str) -> None:
+    def _ensure_directory(self, dir_path: str,
+                          signatures: list[int] | None = None) -> None:
         if dir_path == "/":
             return
         try:
@@ -205,7 +229,9 @@ class Filer:
             pass
         d = new_directory_entry(dir_path)
         self.store.insert_entry(d)
-        self._notify(None, d)
+        # parent auto-creates inherit the caller's signatures so replicated
+        # writes don't echo their mkdir legs back to the origin
+        self._notify(None, d, signatures=signatures)
 
     def find_entry(self, full_path: str) -> Entry:
         full_path = full_path.rstrip("/") or "/"
@@ -257,7 +283,8 @@ class Filer:
 
     def delete_entry(self, full_path: str, recursive: bool = False,
                      ignore_recursive_error: bool = False,
-                     delete_chunks: bool = True) -> None:
+                     delete_chunks: bool = True,
+                     signatures: list[int] | None = None) -> None:
         """Delete one entry; directories require recursive=True when
         non-empty. Collected chunk fids flow to on_delete_chunks
         (reference: filer_delete_entry.go)."""
@@ -276,7 +303,7 @@ class Filer:
             self.store.delete_entry(full_path)
             if delete_chunks and chunks:
                 self.on_delete_chunks(chunks)
-            self._notify(entry, None)
+            self._notify(entry, None, signatures=signatures)
 
     def _collect_subtree(self, dir_path: str,
                          chunks: list[FileChunk]) -> None:

@@ -1,0 +1,68 @@
+"""Notification bus: publish filer meta events to an external queue.
+
+Reference: weed/notification/configuration.go + the kafka / aws_sqs /
+gcp_pub_sub / gocdk_pub_sub / log backends.  External brokers aren't
+available in this environment, so the concrete backends are a JSONL log
+queue and an in-memory queue (the reference's `log` backend analogue),
+behind the same registry seam so kafka-style backends can slot in.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+
+
+class MessageQueue:
+    name = "abstract"
+
+    def send(self, key: str, message: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LogQueue(MessageQueue):
+    """Append events to a JSONL file (reference: notification `log`
+    backend)."""
+
+    name = "log"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+
+    def send(self, key: str, message: dict) -> None:
+        line = json.dumps({"key": key, **message}, separators=(",", ":"))
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+class MemoryQueue(MessageQueue):
+    name = "memory"
+
+    def __init__(self, maxlen: int = 65536):
+        self.messages: deque = deque(maxlen=maxlen)
+
+    def send(self, key: str, message: dict) -> None:
+        self.messages.append((key, message))
+
+
+QUEUES = {"log": LogQueue, "memory": MemoryQueue}
+
+
+def make_queue(kind: str, **options) -> MessageQueue:
+    try:
+        return QUEUES[kind](**options)
+    except KeyError:
+        raise ValueError(f"unknown notification queue {kind!r} "
+                         f"(have {sorted(QUEUES)}; kafka/sqs/pubsub need "
+                         f"their client libraries)")

@@ -1,0 +1,175 @@
+"""filer.sync — continuous (optionally bidirectional) filer→filer
+replication over the meta-event subscribe stream, with persisted resume
+offsets and signature-based loop prevention.
+
+Reference: weed/command/filer_sync.go (doSubscribeFilerMetaChanges),
+weed/replication/track_sync_offset.go.  Loop prevention follows the
+reference's signature scheme: the direction src→dst stamps every write
+with sig(src) and skips any event already stamped sig(dst) — an event on
+src that was itself written by the dst→src direction carries sig(dst) and
+must not echo back.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.parse
+import urllib.request
+import zlib
+
+from seaweedfs_tpu.replication.sink import FilerSink, Replicator
+
+MAX_APPLY_RETRIES = 5
+
+log = logging.getLogger("filer.sync")
+
+
+def filer_signature(filer_url: str) -> int:
+    return zlib.crc32(filer_url.encode()) & 0x7FFFFFFF or 1
+
+
+class SyncOffsetStore:
+    """Resume offsets persisted to a local JSON file
+    (reference: replication/track_sync_offset.go persists in the filer)."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self._data: dict[str, int] = {}
+        self._lock = threading.Lock()  # both sync directions share one store
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._data = {k: int(v) for k, v in json.load(f).items()}
+            except (OSError, ValueError):
+                self._data = {}
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._data.get(key, 0)
+
+    def put(self, key: str, ts_ns: int) -> None:
+        with self._lock:
+            self._data[key] = ts_ns
+            if self.path:
+                tmp = self.path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self._data, f)
+                os.replace(tmp, self.path)
+
+
+class SyncDirection:
+    """One src→dst pump."""
+
+    def __init__(self, src: str, dst: str, prefix: str = "/",
+                 offsets: SyncOffsetStore | None = None,
+                 timeout: float = 60.0):
+        self.src, self.dst = src, dst
+        self.prefix = prefix
+        self.offsets = offsets or SyncOffsetStore(None)
+        self.key = f"{src}=>{dst}"
+        self.src_sig = filer_signature(src)
+        self.dst_sig = filer_signature(dst)
+        self.timeout = timeout
+        sink = FilerSink(dst, signature=self.src_sig, timeout=timeout)
+        self.replicator = Replicator(sink, self._read_source_file, prefix)
+        self.applied = 0
+        self.skipped = 0
+
+    def _read_source_file(self, path: str) -> bytes:
+        url = f"http://{self.src}{urllib.parse.quote(path)}"
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return r.read()
+
+    def run(self, stop: threading.Event, live: bool = True) -> None:
+        """Pump events until `stop` is set (or the replay drains when
+        live=False)."""
+        while not stop.is_set():
+            since = self.offsets.get(self.key)
+            url = (f"http://{self.src}/__meta__/subscribe?"
+                   + urllib.parse.urlencode({
+                       "since": str(since),
+                       "prefix": self.prefix,
+                       "live": "true" if live else "false"}))
+            try:
+                with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                    for raw in r:
+                        if stop.is_set():
+                            return
+                        line = raw.strip()
+                        if not line:
+                            continue  # keepalive
+                        ev = json.loads(line)
+                        if not self._apply(ev):
+                            # event still failing after retries: reconnect
+                            # from the last good offset rather than skip it
+                            raise ConnectionError("replicate failed; "
+                                                  "will retry from offset")
+                if not live:
+                    return
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    json.JSONDecodeError, TimeoutError) as e:
+                if not live:
+                    raise
+                log.warning("%s: stream error, reconnecting: %s",
+                            self.key, e)
+                stop.wait(2.0)
+
+    def _apply(self, ev: dict) -> bool:
+        """Apply one event; the offset advances ONLY on success so a
+        transient sink failure re-replays instead of silently dropping
+        (events are idempotent overwrites)."""
+        if self.dst_sig in (ev.get("signatures") or []):
+            self.skipped += 1  # originated on dst; don't echo back
+            self.offsets.put(self.key, ev["ts_ns"])
+            return True
+        path = (ev.get("new_entry") or ev.get("old_entry")
+                or {}).get("full_path")
+        for attempt in range(MAX_APPLY_RETRIES):
+            try:
+                if self.replicator.replicate(ev):
+                    self.applied += 1
+                self.offsets.put(self.key, ev["ts_ns"])
+                return True
+            except Exception as e:
+                log.warning("%s: replicate %s failed (try %d/%d): %s",
+                            self.key, path, attempt + 1, MAX_APPLY_RETRIES, e)
+                if attempt + 1 < MAX_APPLY_RETRIES:
+                    import time
+                    time.sleep(min(2 ** attempt, 10))
+        return False
+
+
+class FilerSync:
+    """Bidirectional filer.sync (reference: weed filer.sync -a -b)."""
+
+    def __init__(self, filer_a: str, filer_b: str, prefix: str = "/",
+                 offset_path: str | None = None, one_way: bool = False):
+        offsets = SyncOffsetStore(offset_path)
+        self.a2b = SyncDirection(filer_a, filer_b, prefix, offsets)
+        self.b2a = None if one_way else SyncDirection(filer_b, filer_a,
+                                                      prefix, offsets)
+        self.stop_event = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for d in filter(None, (self.a2b, self.b2a)):
+            th = threading.Thread(target=d.run, args=(self.stop_event,),
+                                  daemon=True, name=f"sync-{d.key}")
+            th.start()
+            self._threads.append(th)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        for th in self._threads:
+            th.join(5)
+
+    def run_forever(self) -> None:
+        self.start()
+        try:
+            while True:
+                self.stop_event.wait(3600)
+        except KeyboardInterrupt:
+            self.stop()

@@ -1,0 +1,186 @@
+"""Replication sinks: apply a stream of filer meta events to a target.
+
+Reference: weed/replication/sink/replication_sink.go (interface:
+CreateEntry / UpdateEntry / DeleteEntry + IsIncremental) and the concrete
+sinks under weed/replication/sink/{filersink,localsink,s3sink,...}.  Here:
+FilerSink (another weedtpu filer over HTTP) and LocalSink (a local
+directory tree), registered by name like the reference's sink registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.parse
+import urllib.request
+
+
+def entry_is_directory(entry: dict) -> bool:
+    """Entry dicts carry directoriness in attr.mode (S_IFDIR), matching
+    Entry.to_dict / Attr.is_directory."""
+    import stat
+    if "is_directory" in entry:
+        return bool(entry["is_directory"])
+    return stat.S_ISDIR((entry.get("attr") or {}).get("mode", 0))
+
+
+class ReplicationSink:
+    """create/update/delete against a replication target."""
+
+    name = "abstract"
+
+    def create_entry(self, path: str, entry: dict, data: bytes | None) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, path: str, entry: dict, data: bytes | None) -> None:
+        self.create_entry(path, entry, data)
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        raise NotImplementedError
+
+    def is_incremental(self) -> bool:
+        """Incremental sinks only append dated copies, never delete
+        (reference: IsIncremental + -filer.backup)."""
+        return False
+
+
+class FilerSink(ReplicationSink):
+    """Replicate into another filer over its HTTP API, stamping the
+    configured signature for sync-loop prevention."""
+
+    name = "filer"
+
+    def __init__(self, filer_url: str, path_prefix: str = "/",
+                 signature: int = 0, timeout: float = 60.0):
+        self.filer_url = filer_url
+        self.prefix = path_prefix.rstrip("/")
+        self.signature = signature
+        self.timeout = timeout
+
+    def _headers(self) -> dict:
+        h = {}
+        if self.signature:
+            h["X-Weed-Signatures"] = str(self.signature)
+        return h
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.filer_url}{urllib.parse.quote(self.prefix + path)}"
+
+    def create_entry(self, path: str, entry: dict, data: bytes | None) -> None:
+        if entry_is_directory(entry):
+            req = urllib.request.Request(self._url(path.rstrip("/") + "/"),
+                                         data=b"", method="POST",
+                                         headers=self._headers())
+        else:
+            headers = self._headers()
+            attr = entry.get("attr") or {}
+            if attr.get("mime"):
+                headers["Content-Type"] = attr["mime"]
+            for k, v in (entry.get("extended") or {}).items():
+                headers[f"Seaweed-{k}"] = v
+            req = urllib.request.Request(self._url(path), data=data or b"",
+                                         method="POST", headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        url = self._url(path) + "?recursive=true"
+        req = urllib.request.Request(url, method="DELETE",
+                                     headers=self._headers())
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+class LocalSink(ReplicationSink):
+    """Replicate into a local directory (reference:
+    weed/replication/sink/localsink)."""
+
+    name = "local"
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _p(self, path: str) -> str:
+        return os.path.join(self.dir, path.lstrip("/"))
+
+    def create_entry(self, path: str, entry: dict, data: bytes | None) -> None:
+        p = self._p(path)
+        if entry_is_directory(entry):
+            os.makedirs(p, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data or b"")
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        p = self._p(path)
+        try:
+            if is_directory:
+                import shutil
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                os.remove(p)
+        except FileNotFoundError:
+            pass
+
+
+SINKS = {"filer": FilerSink, "local": LocalSink}
+
+
+def make_sink(kind: str, **options) -> ReplicationSink:
+    try:
+        return SINKS[kind](**options)
+    except KeyError:
+        raise ValueError(f"unknown sink {kind!r} (have {sorted(SINKS)})")
+
+
+class Replicator:
+    """Routes one meta event to a sink (reference:
+    weed/replication/replicator.go Replicate)."""
+
+    def __init__(self, sink: ReplicationSink,
+                 read_file: "callable[[str], bytes]",
+                 prefix: str = "/"):
+        self.sink = sink
+        self.read_file = read_file
+        self.prefix = prefix if prefix.endswith("/") else prefix + "/"
+
+    def _in_scope(self, path: str) -> bool:
+        return path.startswith(self.prefix) or path == self.prefix.rstrip("/")
+
+    def replicate(self, event: dict) -> bool:
+        """Apply one subscribe-stream event dict.  Returns True if the
+        event resulted in a sink action."""
+        old, new = event.get("old_entry"), event.get("new_entry")
+        old_path = old.get("full_path") if old else None
+        new_path = new.get("full_path") if new else None
+        if new is not None:
+            if not self._in_scope(new_path):
+                # rename OUT of the synced subtree: drop the sink's copy of
+                # the old path, or it diverges forever
+                if old is not None and self._in_scope(old_path) and \
+                        not self.sink.is_incremental():
+                    self.sink.delete_entry(old_path, entry_is_directory(old))
+                    return True
+                return False
+            data = None
+            if not entry_is_directory(new):
+                data = self.read_file(new_path)
+            if old is not None and old_path != new_path and \
+                    self._in_scope(old_path) and not self.sink.is_incremental():
+                self.sink.delete_entry(old_path, entry_is_directory(old))
+            if old is None:
+                self.sink.create_entry(new_path, new, data)
+            else:
+                self.sink.update_entry(new_path, new, data)
+            return True
+        if old is not None and self._in_scope(old_path) and \
+                not self.sink.is_incremental():
+            self.sink.delete_entry(old_path, entry_is_directory(old))
+            return True
+        return False

@@ -56,7 +56,7 @@ class FilerServer:
                  port: int = 8888, data_dir: str | None = None,
                  collection: str = "", replication: str = "",
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
-                 jwt_signer=None, security=None):
+                 jwt_signer=None, security=None, notification=None):
         self.master_url = master_url
         self.host, self.port = host, port
         self.collection = collection
@@ -93,10 +93,19 @@ class FilerServer:
             web.get("/metrics", self.handle_metrics),
             web.route("*", "/{path:.*}", self.handle_path),
         ])
+        self.notification = notification  # MessageQueue | None
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._subscribers: set[asyncio.Queue] = set()
         self._loop: asyncio.AbstractEventLoop | None = None
+
+    def _notify_queue(self, ev) -> None:
+        """Publish meta events to the configured notification queue
+        (reference: weed/filer/filer_notify.go -> notification backend)."""
+        try:
+            self.notification.send(ev.directory, ev.to_dict())
+        except Exception:
+            log.warning("notification send failed", exc_info=True)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -110,6 +119,8 @@ class FilerServer:
             timeout=aiohttp.ClientTimeout(total=60))
         self.deletion.start()
         self.filer.meta_log.subscribe(self._fanout_event)
+        if self.notification is not None:
+            self.filer.meta_log.subscribe(self._notify_queue)
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -348,7 +359,7 @@ class FilerServer:
         if is_dir_request and path != "/":
             d = new_directory_entry(path)
             self._apply_headers(d, req)
-            self.filer.create_entry(d)
+            self.filer.create_entry(d, signatures=_req_signatures(req))
             return web.json_response({"name": d.name}, status=201)
 
         # autochunk the body (reference: doPostAutoChunk)
@@ -405,7 +416,7 @@ class FilerServer:
                       file_size=total),
             chunks=chunks)
         self._apply_headers(entry, req)
-        self.filer.create_entry(entry)
+        self.filer.create_entry(entry, signatures=_req_signatures(req))
         return web.json_response(
             {"name": entry.name, "size": total, "eTag": md5.hexdigest()},
             status=201)
@@ -534,7 +545,8 @@ class FilerServer:
         try:
             self.filer.delete_entry(path, recursive=recursive,
                                     ignore_recursive_error=ignore,
-                                    delete_chunks=delete_chunks)
+                                    delete_chunks=delete_chunks,
+                                    signatures=_req_signatures(req))
         except OSError as e:
             if isinstance(e, (FileNotFoundError,)) or "not found" in str(e):
                 return web.json_response({"error": str(e)}, status=404)
@@ -574,7 +586,11 @@ class FilerServer:
                 d = json.loads(payload)
                 if d["ts_ns"] <= last_ts:
                     continue
-                if not dir_has_prefix(d["directory"], prefix):
+                old_dir = ((d.get("old_entry") or {}).get("full_path")
+                           or "").rsplit("/", 1)[0] or "/"
+                if not (dir_has_prefix(d["directory"], prefix)
+                        or (d.get("old_entry")
+                            and dir_has_prefix(old_dir, prefix))):
                     continue
                 await resp.write(payload.encode() + b"\n")
         except (ConnectionResetError, asyncio.CancelledError):
@@ -612,6 +628,21 @@ class FilerServer:
             "deletion_done": self.deletion.deleted_count,
         })
 
+
+
+def _req_signatures(req) -> list[int]:
+    """X-Weed-Signatures: comma-separated ints; stamped by filer.sync
+    writers for loop prevention (reference: filer_pb signatures)."""
+    raw = req.headers.get("X-Weed-Signatures", "")
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.append(int(part))
+            except ValueError:
+                pass
+    return out
 
 def _entry_json(e: Entry) -> dict:
     return {

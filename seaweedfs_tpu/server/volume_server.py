@@ -519,9 +519,8 @@ class VolumeServer:
                         f"http://{source}/admin/file",
                         params={"name": name}) as r:
                     if r.status != 200:
-                        return web.json_response(
-                            {"error": f"pull {name} from {source}: {r.status}"},
-                            status=500)
+                        raise OSError(
+                            f"pull {name} from {source}: HTTP {r.status}")
                     with open(base + tmp_ext[ext], "wb") as f:
                         async for chunk in r.content.iter_chunked(1 << 20):
                             f.write(chunk)

@@ -554,6 +554,11 @@ def cmd_volume_fsck(env: CommandEnv, args, out):
         if o or b:
             print(f"volume {vid}: {len(o)} orphan needle(s), "
                   f"{len(b)} broken ref(s)", file=out)
+    # refs into volumes that no longer exist anywhere are all broken
+    for vid in sorted(set(referenced) - set(stored)):
+        b = len(referenced[vid])
+        broken += b
+        print(f"volume {vid}: MISSING, {b} broken ref(s)", file=out)
     print(f"volume.fsck: {orphans} orphan(s), {broken} broken ref(s) "
           f"across {len(stored)} volume(s)", file=out)
 
