@@ -143,6 +143,25 @@ def main(argv=None) -> int:
     psy.add_argument("-offsetFile", default=".filer_sync_offsets.json")
     psy.add_argument("-oneway", action="store_true")
 
+    pwd = sub.add_parser("webdav",
+                         help="WebDAV gateway over a filer (webdav_server.go)")
+    pwd.add_argument("-ip", default="127.0.0.1")
+    pwd.add_argument("-port", type=int, default=7333)
+    pwd.add_argument("-filer", default="127.0.0.1:8888")
+    pwd.add_argument("-filer.path", dest="filerPath", default="/")
+
+    pmq = sub.add_parser("mq.broker",
+                         help="message queue broker (weed/mq/broker)")
+    pmq.add_argument("-ip", default="127.0.0.1")
+    pmq.add_argument("-port", type=int, default=17777)
+    pmq.add_argument("-master", default="127.0.0.1:9333")
+
+    pmt = sub.add_parser("mount",
+                         help="FUSE-mount a filer path (weed/command/mount_std.go)")
+    pmt.add_argument("-filer", default="127.0.0.1:8888")
+    pmt.add_argument("-dir", required=True, help="mountpoint")
+    pmt.add_argument("-filer.path", dest="filerPath", default="/")
+
     psc = sub.add_parser("scaffold",
                          help="print a config template (command/scaffold.go:33)")
     psc.add_argument("-config", default="filer",
@@ -150,7 +169,7 @@ def main(argv=None) -> int:
                               "notification", "shell"])
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc):
+              psy, psc, pwd, pmq, pmt):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -193,6 +212,18 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "scaffold":
         return _run_scaffold(args)
+    if args.cmd == "webdav":
+        return asyncio.run(_run_webdav(args))
+    if args.cmd == "mq.broker":
+        return asyncio.run(_run_mq_broker(args))
+    if args.cmd == "mount":
+        from seaweedfs_tpu.mount.weedfs import mount
+        try:
+            mount(args.filer, args.dir, root=args.filerPath)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        return 0
     return 2
 
 
@@ -296,6 +327,25 @@ async def _run_server(args) -> int:
         await f.stop()
     await v.stop()
     await m.stop()
+    return 0
+
+
+async def _run_webdav(args) -> int:
+    from seaweedfs_tpu.server.webdav_server import WebDavServer
+    s = WebDavServer(args.filer, args.ip, args.port, prefix=args.filerPath,
+                     security=_security(args))
+    await s.start()
+    await _serve_forever()
+    await s.stop()
+    return 0
+
+
+async def _run_mq_broker(args) -> int:
+    from seaweedfs_tpu.mq.broker import BrokerServer
+    s = BrokerServer(args.master, args.ip, args.port)
+    await s.start()
+    await _serve_forever()
+    await s.stop()
     return 0
 
 
@@ -464,14 +514,39 @@ def _run_backup(args) -> int:
         url = (f"http://{args.server}/admin/file?"
                f"name={urllib.parse.quote(name + ext)}")
         out = os.path.join(args.dir, name + ext)
-        with urllib.request.urlopen(url, timeout=600) as r, \
-                open(out + ".tmp", "wb") as f:
-            while True:
-                chunk = r.read(1 << 20)
-                if not chunk:
-                    break
-                f.write(chunk)
-        os.replace(out + ".tmp", out)
+        # incremental: .dat is append-only, so resume past the local size
+        # (reference: command/backup.go appends the remote tail)
+        local_size = os.path.getsize(out) if ext == ".dat" and \
+            os.path.exists(out) else 0
+        headers = {"Range": f"bytes={local_size}-"} if local_size else {}
+        try:
+            req = urllib.request.Request(url, headers=headers)
+            with urllib.request.urlopen(req, timeout=600) as r:
+                mode = "ab" if local_size and r.status == 206 else "wb"
+                target = out if mode == "ab" else out + ".tmp"
+                with open(target, mode) as f:
+                    while True:
+                        chunk = r.read(1 << 20)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                if mode == "wb":
+                    os.replace(out + ".tmp", out)
+        except urllib.error.HTTPError as e:
+            if e.code == 416 and local_size:  # already up to date
+                print(f"{name}{ext}: up to date")
+                continue
+            try:
+                os.remove(out + ".tmp")
+            except OSError:
+                pass
+            print(f"backup {name}{ext} from {args.server}: HTTP {e.code}",
+                  file=sys.stderr)
+            return 1
+        except urllib.error.URLError as e:
+            print(f"backup: cannot reach {args.server}: {e}",
+                  file=sys.stderr)
+            return 1
         print(f"backed up {name}{ext} -> {out}")
     return 0
 

@@ -1,0 +1,10 @@
+"""FUSE mount: filer-backed filesystem.
+
+Reference: weed/mount/ (weedfs.go WFS struct, inode_to_path.go,
+filehandle.go, dirty_pages_chunked.go, meta_cache/).  The VFS core (WFS)
+is kernel-independent and fully testable; the thin FUSE binding uses the
+`fuse` (fusepy) package when present — `python -m seaweedfs_tpu mount`
+reports clearly when it is not.
+"""
+
+from seaweedfs_tpu.mount.weedfs import WFS  # noqa: F401

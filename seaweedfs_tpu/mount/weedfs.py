@@ -1,0 +1,422 @@
+"""WFS: the filer-backed VFS core of the FUSE mount.
+
+Reference: weed/mount/weedfs.go (struct WFS), weedfs_file_read.go,
+weedfs_file_write.go:37, dirty_pages_chunked.go:74 (flush ->
+saveDataAsChunk), filehandle.go, meta_cache/meta_cache.go:28 +
+meta_cache_subscribe.go:12.  All filer interaction is plain HTTP, all
+operations synchronous (the FUSE binding calls them from its own loop).
+
+Design: reads stream from the filer; writes accumulate in per-handle
+dirty page buffers and flush as whole files on close/fsync (files at
+FUSE-write sizes round-trip fine; the filer re-chunks server-side).  The
+meta cache holds recently-seen entries and is invalidated by the filer's
+meta-subscribe stream, the same freshness contract as the reference's
+local leveldb meta cache.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from seaweedfs_tpu.mount.inode import InodeToPath
+
+log = logging.getLogger("mount")
+
+
+class FsError(OSError):
+    def __init__(self, errno_: int, msg: str = ""):
+        super().__init__(errno_, msg)
+
+
+class MetaCache:
+    """Entry attr cache invalidated by the filer meta stream
+    (reference: weed/mount/meta_cache/)."""
+
+    def __init__(self, ttl: float = 60.0):
+        self.ttl = ttl
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[float, dict | None]] = {}
+
+    def get(self, path: str):
+        with self._lock:
+            hit = self._entries.get(path)
+            if hit is None:
+                return False, None
+            ts, meta = hit
+            if time.monotonic() - ts > self.ttl:
+                del self._entries[path]
+                return False, None
+            return True, meta
+
+    def put(self, path: str, meta: dict | None) -> None:
+        with self._lock:
+            self._entries[path] = (time.monotonic(), meta)
+
+    def invalidate(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(path, None)
+            prefix = path.rstrip("/") + "/"
+            for p in [p for p in self._entries if p.startswith(prefix)]:
+                del self._entries[p]
+
+
+class FileHandle:
+    """Open-file state with chunked dirty pages
+    (reference: weed/mount/filehandle.go + dirty_pages_chunked.go)."""
+
+    def __init__(self, fh: int, path: str, wfs: "WFS"):
+        self.fh = fh
+        self.path = path
+        self.wfs = wfs
+        self._lock = threading.Lock()
+        self._dirty: io.BytesIO | None = None
+        self._dirty_base: bytes | None = None
+
+    def read(self, size: int, offset: int) -> bytes:
+        with self._lock:
+            if self._dirty is not None:
+                buf = self._dirty.getvalue()
+                return buf[offset:offset + size]
+        return self.wfs._read_range(self.path, offset, size)
+
+    def write(self, data: bytes, offset: int) -> int:
+        with self._lock:
+            if self._dirty is None:
+                # copy-on-first-write: pull current content once
+                base = b""
+                try:
+                    base = self.wfs._read_all(self.path)
+                except FsError:
+                    pass
+                self._dirty = io.BytesIO(base)
+                self._dirty_base = base
+            self._dirty.seek(offset)
+            self._dirty.write(data)
+            return len(data)
+
+    def truncate(self, length: int) -> None:
+        with self._lock:
+            cur = b""
+            if self._dirty is not None:
+                cur = self._dirty.getvalue()
+            else:
+                try:
+                    cur = self.wfs._read_all(self.path)
+                except FsError:
+                    pass
+                self._dirty_base = cur
+            cur = cur[:length].ljust(length, b"\0")
+            self._dirty = io.BytesIO(cur)
+            self._dirty.seek(0, io.SEEK_END)
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._dirty is None:
+                return
+            data = self._dirty.getvalue()
+            if self._dirty_base is not None and data == self._dirty_base:
+                self._dirty = None
+                self._dirty_base = None
+                return
+        self.wfs._write_all(self.path, data)
+        with self._lock:
+            self._dirty = None
+            self._dirty_base = None
+
+
+class WFS:
+    """Kernel-independent filesystem operations over a filer."""
+
+    def __init__(self, filer_url: str, root: str = "/",
+                 timeout: float = 60.0, subscribe: bool = True):
+        self.filer_url = filer_url
+        self.root = root.rstrip("/") or ""
+        self.timeout = timeout
+        self.inodes = InodeToPath()
+        self.meta_cache = MetaCache()
+        self._handles: dict[int, FileHandle] = {}
+        self._next_fh = 2
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sub_thread: threading.Thread | None = None
+        if subscribe:
+            self._sub_thread = threading.Thread(
+                target=self._subscribe_loop, daemon=True,
+                name="mount-meta-subscribe")
+            self._sub_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+
+    # -- filer http -----------------------------------------------------
+
+    def _fp(self, path: str) -> str:
+        return (self.root + path) or "/"
+
+    def _url(self, path: str, query: str = "") -> str:
+        u = f"http://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
+        return u + (f"?{query}" if query else "")
+
+    def _meta(self, path: str) -> dict | None:
+        hit, meta = self.meta_cache.get(path)
+        if hit:
+            return meta
+        try:
+            with urllib.request.urlopen(self._url(path, "metadata=true"),
+                                        timeout=self.timeout) as r:
+                meta = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            meta = None if e.code == 404 else None
+        except (urllib.error.URLError, OSError):
+            raise FsError(5, "filer unreachable")  # EIO
+        self.meta_cache.put(path, meta)
+        return meta
+
+    def _read_range(self, path: str, offset: int, size: int) -> bytes:
+        req = urllib.request.Request(
+            self._url(path),
+            headers={"Range": f"bytes={offset}-{offset + size - 1}"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 416:
+                return b""
+            if e.code == 404:
+                raise FsError(2, path)  # ENOENT
+            raise FsError(5, f"read: {e.code}")
+
+    def _read_all(self, path: str) -> bytes:
+        try:
+            with urllib.request.urlopen(self._url(path),
+                                        timeout=self.timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FsError(2, path)
+            raise FsError(5, f"read: {e.code}")
+
+    def _write_all(self, path: str, data: bytes) -> None:
+        req = urllib.request.Request(self._url(path), data=data,
+                                     method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            raise FsError(5, f"write: {e.code}")
+        self.meta_cache.invalidate(path)
+
+    def _subscribe_loop(self) -> None:
+        """Invalidate cached meta on filer events (reference:
+        meta_cache_subscribe.go)."""
+        since = time.time_ns()
+        while not self._stop.is_set():
+            url = (f"http://{self.filer_url}/__meta__/subscribe?"
+                   + urllib.parse.urlencode({"since": str(since),
+                                             "prefix": self.root or "/",
+                                             "live": "true"}))
+            try:
+                with urllib.request.urlopen(url, timeout=300) as r:
+                    for raw in r:
+                        if self._stop.is_set():
+                            return
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        since = max(since, ev.get("ts_ns", since))
+                        for side in ("old_entry", "new_entry"):
+                            ent = ev.get(side)
+                            if ent and ent.get("full_path"):
+                                p = ent["full_path"]
+                                if self.root and p.startswith(self.root):
+                                    p = p[len(self.root):] or "/"
+                                self.meta_cache.invalidate(p)
+            except (urllib.error.URLError, OSError, ValueError):
+                self._stop.wait(2.0)
+
+    # -- VFS operations -------------------------------------------------
+
+    @staticmethod
+    def _attr_from_meta(meta: dict) -> dict:
+        a = meta.get("attr") or {}
+        size = a.get("file_size", 0)
+        for c in meta.get("chunks") or []:
+            size = max(size, c.get("offset", 0) + c.get("size", 0))
+        return {"st_mode": a.get("mode", 0o660), "st_size": size,
+                "st_mtime": a.get("mtime", 0), "st_ctime": a.get("crtime", 0),
+                "st_uid": a.get("uid", 0), "st_gid": a.get("gid", 0),
+                "st_nlink": 1}
+
+    def getattr(self, path: str) -> dict:
+        if path == "/":
+            return {"st_mode": 0o040755, "st_size": 0, "st_nlink": 2,
+                    "st_mtime": 0, "st_ctime": 0, "st_uid": 0, "st_gid": 0}
+        meta = self._meta(path)
+        if meta is None:
+            raise FsError(2, path)  # ENOENT
+        return self._attr_from_meta(meta)
+
+    def readdir(self, path: str) -> list[str]:
+        d = self._fp(path).rstrip("/") + "/"
+        url = (f"http://{self.filer_url}{urllib.parse.quote(d)}"
+               "?limit=100000")
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                listing = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FsError(2, path)
+            raise FsError(5, str(e.code))
+        names = [e["FullPath"].rsplit("/", 1)[-1]
+                 for e in listing.get("Entries") or []]
+        return [".", ".."] + names
+
+    def mkdir(self, path: str, mode: int = 0o755) -> None:
+        req = urllib.request.Request(
+            self._url(path.rstrip("/") + "/"), data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+        self.meta_cache.invalidate(path)
+
+    def create(self, path: str, mode: int = 0o644) -> int:
+        self._write_all(path, b"")
+        return self.open(path)
+
+    def open(self, path: str) -> int:
+        with self._lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self._handles[fh] = FileHandle(fh, path, self)
+            return fh
+
+    def handle(self, fh: int) -> FileHandle:
+        h = self._handles.get(fh)
+        if h is None:
+            raise FsError(9, f"bad fh {fh}")  # EBADF
+        return h
+
+    def read(self, fh: int, size: int, offset: int) -> bytes:
+        return self.handle(fh).read(size, offset)
+
+    def write(self, fh: int, data: bytes, offset: int) -> int:
+        return self.handle(fh).write(data, offset)
+
+    def truncate(self, path: str, length: int, fh: int | None = None) -> None:
+        if fh is not None and fh in self._handles:
+            self._handles[fh].truncate(length)
+            return
+        data = b""
+        try:
+            data = self._read_all(path)
+        except FsError:
+            pass
+        self._write_all(path, data[:length].ljust(length, b"\0"))
+
+    def flush(self, fh: int) -> None:
+        self.handle(fh).flush()
+
+    def release(self, fh: int) -> None:
+        h = self._handles.pop(fh, None)
+        if h is not None:
+            h.flush()
+
+    def unlink(self, path: str) -> None:
+        req = urllib.request.Request(self._url(path), method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FsError(2, path)
+            raise FsError(5, str(e.code))
+        self.meta_cache.invalidate(path)
+        self.inodes.forget(path)
+
+    def rmdir(self, path: str) -> None:
+        if self.readdir(path) not in ([".", ".."],):
+            kids = [n for n in self.readdir(path) if n not in (".", "..")]
+            if kids:
+                raise FsError(39, path)  # ENOTEMPTY
+        self.unlink(path)
+
+    def rename(self, old: str, new: str) -> None:
+        url = self._url(new, "mv.from="
+                        + urllib.parse.quote(self._fp(old), safe=""))
+        req = urllib.request.Request(url, data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            raise FsError(5, f"rename: {e.code}")
+        self.inodes.move(old, new)
+        self.meta_cache.invalidate(old)
+        self.meta_cache.invalidate(new)
+
+
+def mount(filer_url: str, mountpoint: str, root: str = "/",
+          foreground: bool = True):
+    """Attach WFS to the kernel via fusepy.  Raises RuntimeError with a
+    clear message when the `fuse` package is absent (see weed mount,
+    weed/command/mount_std.go for the reference CLI)."""
+    try:
+        from fuse import FUSE, FuseOSError, Operations
+    except ImportError as e:
+        raise RuntimeError(
+            "FUSE mounting needs the 'fusepy' package (import fuse); "
+            "the WFS core is still usable programmatically via "
+            "seaweedfs_tpu.mount.WFS") from e
+
+    wfs = WFS(filer_url, root=root)
+
+    class _Ops(Operations):
+        def getattr(self, path, fh=None):
+            try:
+                return wfs.getattr(path)
+            except FsError as e:
+                raise FuseOSError(e.errno)
+
+        def readdir(self, path, fh):
+            return wfs.readdir(path)
+
+        def mkdir(self, path, mode):
+            wfs.mkdir(path, mode)
+
+        def create(self, path, mode, fi=None):
+            return wfs.create(path, mode)
+
+        def open(self, path, flags):
+            return wfs.open(path)
+
+        def read(self, path, size, offset, fh):
+            return wfs.read(fh, size, offset)
+
+        def write(self, path, data, offset, fh):
+            return wfs.write(fh, data, offset)
+
+        def truncate(self, path, length, fh=None):
+            wfs.truncate(path, length, fh)
+
+        def flush(self, path, fh):
+            wfs.flush(fh)
+
+        def release(self, path, fh):
+            wfs.release(fh)
+
+        def unlink(self, path):
+            wfs.unlink(path)
+
+        def rmdir(self, path):
+            wfs.rmdir(path)
+
+        def rename(self, old, new):
+            wfs.rename(old, new)
+
+    return FUSE(_Ops(), mountpoint, foreground=foreground, nothreads=False)

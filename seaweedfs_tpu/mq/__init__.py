@@ -1,0 +1,2 @@
+"""Message queue: partitioned pub/sub broker
+(reference: weed/mq/ broker + topic packages)."""

@@ -1,0 +1,160 @@
+"""MQ broker: HTTP pub/sub server over LocalPartition logs.
+
+Reference: weed/mq/broker/{broker_grpc_pub.go:37 Publish,
+broker_grpc_sub.go:13 Subscribe, broker_grpc_configure.go} — the
+reference streams over gRPC; here the same operations ride HTTP:
+
+  POST /topics/configure   {"topic": "ns.name", "partition_count": N}
+  GET  /topics/list
+  POST /pub?topic=ns.name  body=value, ?key= routes by ring slot
+  GET  /sub?topic=ns.name&partition=i&offset=K[&wait=seconds]
+                           -> NDJSON batch (long-polls when caught up)
+  GET  /status
+
+Brokers register in the master's cluster registry (type=broker) just like
+filers, standing in for the reference's pub_balancer broker ring.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+import aiohttp
+from aiohttp import web
+
+from seaweedfs_tpu.mq.topic import (LocalPartition, Topic, ring_slot,
+                                    split_ring)
+
+log = logging.getLogger("mq.broker")
+
+
+class BrokerServer:
+    def __init__(self, master_url: str, host: str = "127.0.0.1",
+                 port: int = 17777):
+        self.master_url = master_url
+        self.host, self.port = host, port
+        # str(topic) -> list[LocalPartition]
+        self.topics: dict[str, list[LocalPartition]] = {}
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.add_routes([
+            web.post("/topics/configure", self.handle_configure),
+            web.get("/topics/list", self.handle_list),
+            web.post("/pub", self.handle_pub),
+            web.get("/sub", self.handle_sub),
+            web.get("/status", self.handle_status),
+        ])
+        self._runner: web.AppRunner | None = None
+        self._session: aiohttp.ClientSession | None = None
+        self._register_task: asyncio.Task | None = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self._register_task = asyncio.create_task(self._register_loop())
+        log.info("mq broker on %s", self.url)
+
+    async def stop(self) -> None:
+        if self._register_task:
+            self._register_task.cancel()
+        if self._session:
+            await self._session.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _register_loop(self) -> None:
+        while True:
+            try:
+                async with self._session.post(
+                        f"http://{self.master_url}/cluster/register",
+                        json={"type": "broker", "address": self.url}):
+                    pass
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(10)
+
+    # -- handlers -------------------------------------------------------
+
+    def _get_topic(self, name: str,
+                   auto_create: bool = False) -> list[LocalPartition] | None:
+        key = str(Topic.parse(name))
+        parts = self.topics.get(key)
+        if parts is None and auto_create:
+            parts = [LocalPartition(p) for p in split_ring(4)]
+            self.topics[key] = parts
+        return parts
+
+    async def handle_configure(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        topic = str(Topic.parse(body["topic"]))
+        n = int(body.get("partition_count", 4))
+        if n <= 0 or n > 4096:
+            return web.json_response({"error": "bad partition_count"},
+                                     status=400)
+        existing = self.topics.get(topic)
+        if existing is not None and len(existing) != n:
+            return web.json_response(
+                {"error": "cannot repartition a live topic"}, status=409)
+        if existing is None:
+            self.topics[topic] = [LocalPartition(p) for p in split_ring(n)]
+        return web.json_response({"topic": topic, "partition_count": n})
+
+    async def handle_list(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "topics": [
+                {"name": name, "partition_count": len(parts),
+                 "next_offsets": [p.next_offset for p in parts]}
+                for name, parts in sorted(self.topics.items())],
+        })
+
+    async def handle_pub(self, req: web.Request) -> web.Response:
+        topic = req.query.get("topic", "")
+        if not topic:
+            return web.json_response({"error": "topic required"}, status=400)
+        parts = self._get_topic(topic, auto_create=True)
+        key = req.query.get("key", "").encode()
+        value = await req.read()
+        slot = ring_slot(key)
+        part = next((p for p in parts if p.partition.holds_key(key)),
+                    parts[slot % len(parts)])
+        idx = parts.index(part)
+        offset = await asyncio.to_thread(part.publish, key, value)
+        return web.json_response({"partition": idx, "offset": offset})
+
+    async def handle_sub(self, req: web.Request) -> web.Response:
+        topic = req.query.get("topic", "")
+        parts = self._get_topic(topic)
+        if parts is None:
+            return web.json_response({"error": "no such topic"}, status=404)
+        try:
+            pi = int(req.query.get("partition", "0"))
+            offset = int(req.query.get("offset", "0"))
+            wait = min(float(req.query.get("wait", "0")), 60.0)
+            limit = min(int(req.query.get("limit", "1024")), 16384)
+        except ValueError:
+            return web.json_response({"error": "bad params"}, status=400)
+        if not 0 <= pi < len(parts):
+            return web.json_response({"error": "bad partition"}, status=400)
+        part = parts[pi]
+        batch = await asyncio.to_thread(part.read, offset, limit, wait)
+        lines = b"".join(
+            json.dumps(m.to_dict(), separators=(",", ":")).encode() + b"\n"
+            for m in batch)
+        return web.Response(body=lines, content_type="application/x-ndjson",
+                            headers={"X-Next-Offset": str(
+                                batch[-1].offset + 1 if batch else offset)})
+
+    async def handle_status(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "topics": len(self.topics),
+            "partitions": sum(len(p) for p in self.topics.values()),
+        })

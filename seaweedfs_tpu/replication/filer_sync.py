@@ -39,6 +39,7 @@ class SyncOffsetStore:
         self.path = path
         self._data: dict[str, int] = {}
         self._lock = threading.Lock()  # both sync directions share one store
+        self._last_flush = 0.0
         if path and os.path.exists(path):
             try:
                 with open(path) as f:
@@ -46,18 +47,34 @@ class SyncOffsetStore:
             except (OSError, ValueError):
                 self._data = {}
 
+    FLUSH_INTERVAL = 2.0  # seconds between on-disk offset snapshots
+
     def get(self, key: str) -> int:
         with self._lock:
             return self._data.get(key, 0)
 
     def put(self, key: str, ts_ns: int) -> None:
+        """Update in memory; snapshot to disk at most every FLUSH_INTERVAL
+        (events are idempotent, so a crash replays at most a couple of
+        seconds — the reference also persists offsets periodically)."""
+        import time as _time
         with self._lock:
             self._data[key] = ts_ns
+            now = _time.monotonic()
+            if self.path and now - self._last_flush >= self.FLUSH_INTERVAL:
+                self._flush_locked()
+                self._last_flush = now
+
+    def flush(self) -> None:
+        with self._lock:
             if self.path:
-                tmp = self.path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(self._data, f)
-                os.replace(tmp, self.path)
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._data, f)
+        os.replace(tmp, self.path)
 
 
 class SyncDirection:
@@ -80,8 +97,15 @@ class SyncDirection:
 
     def _read_source_file(self, path: str) -> bytes:
         url = f"http://{self.src}{urllib.parse.quote(path)}"
-        with urllib.request.urlopen(url, timeout=self.timeout) as r:
-            return r.read()
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                # the file was deleted/renamed after this event was logged;
+                # a later event supersedes it — skip, don't stall the stream
+                raise FileNotFoundError(path) from e
+            raise
 
     def run(self, stop: threading.Event, live: bool = True) -> None:
         """Pump events until `stop` is set (or the replay drains when
@@ -133,6 +157,11 @@ class SyncDirection:
                     self.applied += 1
                 self.offsets.put(self.key, ev["ts_ns"])
                 return True
+            except FileNotFoundError:
+                # source content gone; a later event will converge the sink
+                self.skipped += 1
+                self.offsets.put(self.key, ev["ts_ns"])
+                return True
             except Exception as e:
                 log.warning("%s: replicate %s failed (try %d/%d): %s",
                             self.key, path, attempt + 1, MAX_APPLY_RETRIES, e)
@@ -165,6 +194,7 @@ class FilerSync:
         self.stop_event.set()
         for th in self._threads:
             th.join(5)
+        self.a2b.offsets.flush()  # both directions share the store
 
     def run_forever(self) -> None:
         self.start()

@@ -56,12 +56,15 @@ class FilerSink(ReplicationSink):
         self.prefix = path_prefix.rstrip("/")
         self.signature = signature
         self.timeout = timeout
+        # transient, set per-event by the Replicator: the event's existing
+        # signature chain, forwarded so ring topologies terminate
+        self.event_signatures: list[int] = []
 
     def _headers(self) -> dict:
-        h = {}
+        sigs = [s for s in self.event_signatures if s]
         if self.signature:
-            h["X-Weed-Signatures"] = str(self.signature)
-        return h
+            sigs.append(self.signature)
+        return {"X-Weed-Signatures": ",".join(map(str, sigs))} if sigs else {}
 
     def _url(self, path: str) -> str:
         return f"http://{self.filer_url}{urllib.parse.quote(self.prefix + path)}"
@@ -156,6 +159,10 @@ class Replicator:
     def replicate(self, event: dict) -> bool:
         """Apply one subscribe-stream event dict.  Returns True if the
         event resulted in a sink action."""
+        # forward the event's signature chain (loop prevention must be
+        # transitive across multi-filer rings)
+        if hasattr(self.sink, "event_signatures"):
+            self.sink.event_signatures = list(event.get("signatures") or [])
         old, new = event.get("old_entry"), event.get("new_entry")
         old_path = old.get("full_path") if old else None
         new_path = new.get("full_path") if new else None

@@ -1,0 +1,221 @@
+"""WebDAV gateway, MQ broker, and FUSE-mount VFS core, end-to-end
+(reference test model: compose e2e for mount, test/s3 for gateways)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from tests.test_cluster import Cluster, free_port
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.webdav_server import WebDavServer
+    from seaweedfs_tpu.mq.broker import BrokerServer
+
+    tmp = tmp_path_factory.mktemp("gw")
+    c = Cluster(tmp, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port(),
+                        data_dir=str(tmp / "f"))
+    c.submit(filer.start())
+    dav = WebDavServer(filer.url, port=free_port())
+    c.submit(dav.start())
+    broker = BrokerServer(c.master.url, port=free_port())
+    c.submit(broker.start())
+    yield c, filer, dav, broker
+    c.submit(broker.stop())
+    c.submit(dav.stop())
+    c.submit(filer.stop())
+    c.stop()
+
+
+def req(url, method="GET", data=None, headers=None):
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers=headers or {})
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+class TestWebDav:
+    def test_options(self, stack):
+        _, _, dav, _ = stack
+        st, _, hdrs = req(f"http://{dav.url}/", method="OPTIONS")
+        assert st == 200 and "PROPFIND" in hdrs.get("Allow", "")
+        assert hdrs.get("DAV") == "1, 2"
+
+    def test_put_get_propfind_delete(self, stack):
+        _, _, dav, _ = stack
+        base = f"http://{dav.url}"
+        st, _, _ = req(f"{base}/dav/hello.txt", method="PUT",
+                       data=b"dav body")
+        assert st == 201
+        st, body, _ = req(f"{base}/dav/hello.txt")
+        assert st == 200 and body == b"dav body"
+        # PROPFIND depth 1 on the dir
+        st, body, _ = req(f"{base}/dav/", method="PROPFIND",
+                          headers={"Depth": "1"})
+        assert st == 207
+        root = ET.fromstring(body)
+        hrefs = [e.text for e in root.iter() if e.tag.endswith("href")]
+        assert any("hello.txt" in h for h in hrefs)
+        lengths = [e.text for e in root.iter()
+                   if e.tag.endswith("getcontentlength")]
+        assert "8" in lengths
+        st, _, _ = req(f"{base}/dav/hello.txt", method="DELETE")
+        assert st == 204
+        st, _, _ = req(f"{base}/dav/hello.txt")
+        assert st == 404
+
+    def test_mkcol_move_copy(self, stack):
+        _, _, dav, _ = stack
+        base = f"http://{dav.url}"
+        assert req(f"{base}/mk/sub", method="MKCOL")[0] == 201
+        req(f"{base}/mk/a.txt", method="PUT", data=b"x")
+        st, _, _ = req(f"{base}/mk/a.txt", method="MOVE",
+                       headers={"Destination": f"http://{dav.url}/mk/sub/b.txt"})
+        assert st == 201
+        assert req(f"{base}/mk/sub/b.txt")[1] == b"x"
+        assert req(f"{base}/mk/a.txt")[0] == 404
+        st, _, _ = req(f"{base}/mk/sub/b.txt", method="COPY",
+                       headers={"Destination": f"http://{dav.url}/mk/c.txt"})
+        assert st == 201
+        assert req(f"{base}/mk/c.txt")[1] == b"x"
+        assert req(f"{base}/mk/sub/b.txt")[1] == b"x"
+
+    def test_lock_unlock(self, stack):
+        _, _, dav, _ = stack
+        st, body, hdrs = req(f"http://{dav.url}/any.txt", method="LOCK",
+                             data=b"<lockinfo/>")
+        assert st == 200 and b"locktoken" in body.lower()
+        assert req(f"http://{dav.url}/any.txt", method="UNLOCK")[0] == 204
+
+
+class TestMqBroker:
+    def test_configure_pub_sub(self, stack):
+        _, _, _, broker = stack
+        base = f"http://{broker.url}"
+        st, body, _ = req(f"{base}/topics/configure", method="POST",
+                          data=json.dumps({"topic": "chat.room1",
+                                           "partition_count": 2}).encode())
+        assert st == 200
+        # publish a few messages with keys
+        offs = {}
+        for i in range(10):
+            st, body, _ = req(f"{base}/pub?topic=chat.room1&key=k{i}",
+                              method="POST", data=f"msg-{i}".encode())
+            assert st == 200
+            d = json.loads(body)
+            offs.setdefault(d["partition"], []).append(d["offset"])
+        assert set(offs) <= {0, 1} and len(offs) >= 1
+        # per-partition offsets are dense from 0
+        for plist in offs.values():
+            assert plist == list(range(len(plist)))
+        # subscribe each partition, collect all messages
+        got = []
+        for pi in range(2):
+            st, body, hdrs = req(
+                f"{base}/sub?topic=chat.room1&partition={pi}&offset=0")
+            assert st == 200
+            for line in body.splitlines():
+                got.append(json.loads(line)["value"])
+        assert sorted(got) == sorted(f"msg-{i}" for i in range(10))
+
+    def test_sub_longpoll_and_missing(self, stack):
+        _, _, _, broker = stack
+        base = f"http://{broker.url}"
+        assert req(f"{base}/sub?topic=nope.missing&partition=0")[0] == 404
+        # long-poll returns empty quickly with wait=0 on a caught-up topic
+        req(f"{base}/topics/configure", method="POST",
+            data=json.dumps({"topic": "t.empty", "partition_count": 1}).encode())
+        st, body, hdrs = req(f"{base}/sub?topic=t.empty&partition=0&offset=0")
+        assert st == 200 and body == b"" and hdrs["X-Next-Offset"] == "0"
+
+    def test_ring_math(self):
+        from seaweedfs_tpu.mq.topic import split_ring, ring_slot, Partition
+        parts = split_ring(3)
+        assert parts[0].range_start == 0 and parts[-1].range_stop == 4096
+        assert sum(p.range_stop - p.range_start for p in parts) == 4096
+        slot = ring_slot(b"some-key")
+        assert sum(1 for p in parts
+                   if p.range_start <= slot < p.range_stop) == 1
+
+
+class TestMountVFS:
+    def test_wfs_roundtrip(self, stack):
+        from seaweedfs_tpu.mount.weedfs import WFS, FsError
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=False)
+        try:
+            wfs.mkdir("/mnt-test")
+            assert "mnt-test" in wfs.readdir("/")
+            fh = wfs.create("/mnt-test/f.txt")
+            assert wfs.write(fh, b"hello ", 0) == 6
+            assert wfs.write(fh, b"world", 6) == 5
+            wfs.flush(fh)
+            wfs.release(fh)
+            attr = wfs.getattr("/mnt-test/f.txt")
+            assert attr["st_size"] == 11
+            fh2 = wfs.open("/mnt-test/f.txt")
+            assert wfs.read(fh2, 11, 0) == b"hello world"
+            assert wfs.read(fh2, 5, 6) == b"world"
+            wfs.release(fh2)
+            # rename + inode stability
+            ino = wfs.inodes.lookup("/mnt-test/f.txt")
+            wfs.rename("/mnt-test/f.txt", "/mnt-test/g.txt")
+            assert wfs.inodes.lookup("/mnt-test/g.txt") == ino
+            assert wfs.read(wfs.open("/mnt-test/g.txt"), 11, 0) == b"hello world"
+            # truncate
+            wfs.truncate("/mnt-test/g.txt", 5)
+            assert wfs.getattr("/mnt-test/g.txt")["st_size"] == 5
+            wfs.unlink("/mnt-test/g.txt")
+            with pytest.raises(FsError):
+                wfs.getattr("/mnt-test/g.txt")
+            wfs.rmdir("/mnt-test")
+        finally:
+            wfs.close()
+
+    def test_wfs_overwrite_in_place(self, stack):
+        from seaweedfs_tpu.mount.weedfs import WFS
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=False)
+        try:
+            fh = wfs.create("/ow.bin")
+            wfs.write(fh, b"AAAAAAAAAA", 0)
+            wfs.release(fh)
+            fh = wfs.open("/ow.bin")
+            wfs.write(fh, b"BB", 4)  # partial overwrite pulls base content
+            wfs.release(fh)
+            assert wfs.read(wfs.open("/ow.bin"), 10, 0) == b"AAAABBAAAA"
+        finally:
+            wfs.close()
+
+    def test_meta_cache_subscribe_invalidation(self, stack):
+        from seaweedfs_tpu.mount.weedfs import WFS
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=True)
+        try:
+            fh = wfs.create("/mc.txt")
+            wfs.write(fh, b"v1", 0)
+            wfs.release(fh)
+            assert wfs.getattr("/mc.txt")["st_size"] == 2
+            # external writer updates the file behind the mount's back
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://{filer.url}/mc.txt", data=b"longer-v2",
+                method="PUT"), timeout=15)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if wfs.getattr("/mc.txt")["st_size"] == 9:
+                    break
+                time.sleep(0.2)
+            assert wfs.getattr("/mc.txt")["st_size"] == 9
+        finally:
+            wfs.close()
