@@ -32,6 +32,11 @@ def _add_master_flags(p):
     p.add_argument("-port", type=int, default=9333)
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-peers", default="",
+                   help="comma-separated master peers (raft HA), "
+                        "including this node")
+    p.add_argument("-mdir", default=None,
+                   help="dir for raft state persistence")
 
 
 def _add_volume_flags(p, with_master=True):
@@ -236,10 +241,12 @@ async def _serve_forever():
 
 async def _run_master(args) -> int:
     from seaweedfs_tpu.server.master import MasterServer
+    peers = [p.strip() for p in args.peers.split(",") if p.strip()]
     m = MasterServer(args.ip, args.port,
                      volume_size_limit=args.volumeSizeLimitMB << 20,
                      default_replication=args.defaultReplication,
-                     security=_security(args))
+                     security=_security(args), peers=peers or None,
+                     raft_state_dir=args.mdir)
     await m.start()
     await _serve_forever()
     await m.stop()

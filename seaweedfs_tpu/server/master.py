@@ -12,6 +12,7 @@ master_grpc_server_volume.go:156), and the shell's exclusive admin lock
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import secrets
 import time
@@ -31,13 +32,46 @@ class MasterServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 9333,
                  volume_size_limit: int = 30 * 1024 * 1024 * 1024,
                  default_replication: str = "000",
-                 grow_count: int = 1, security=None):
+                 grow_count: int = 1, security=None,
+                 peers: list[str] | None = None,
+                 raft_state_dir: str | None = None):
         self.host, self.port = host, port
         self.security = security
         self.guard = security.guard if security is not None else None
+        sequencer = None
+        if peers:
+            # HA masters must never reissue file keys after failover; the
+            # snowflake sequencer is stateless-safe (reference: weed master
+            # -master.sequencerType=snowflake for multi-master)
+            import zlib
+
+            from seaweedfs_tpu.topology.sequence import SnowflakeSequencer
+            # node id must be unique per master NODE, not per port (every
+            # host runs 9333): hash host:port into the 10-bit space
+            sequencer = SnowflakeSequencer(
+                node_id=zlib.crc32(f"{host}:{port}".encode()) & 0x3FF)
         self.topo = Topology(volume_size_limit=volume_size_limit,
-                             replication=default_replication)
+                             replication=default_replication,
+                             sequencer=sequencer)
         self.grow_count = grow_count
+        # Raft among masters (reference: weed/server/raft_server.go):
+        # replicates volume-id allocations; followers proxy to the leader
+        self.raft = None
+        if peers:
+            from seaweedfs_tpu.topology.raft import RaftConfig, RaftNode
+            me = f"{host}:{port}"
+            others = [p for p in peers if p != me]
+            state_path = None
+            if raft_state_dir:
+                import os
+                os.makedirs(raft_state_dir, exist_ok=True)
+                state_path = os.path.join(
+                    raft_state_dir, f"raft_{port}.json")
+            self.raft = RaftNode(
+                RaftConfig(node_id=me, peers=others,
+                           state_path=state_path),
+                transport=self._raft_transport,
+                apply_command=self._raft_apply)
         self.app = web.Application(client_max_size=64 * 1024 * 1024,
                                    middlewares=[self._guard_middleware])
         self.app.add_routes([
@@ -52,6 +86,8 @@ class MasterServer:
             web.post("/admin/renew_lock", self.handle_renew_lock),
             web.post("/cluster/register", self.handle_cluster_register),
             web.post("/vol/vacuum", self.handle_vacuum),
+            web.post("/raft/request_vote", self.handle_raft_vote),
+            web.post("/raft/append_entries", self.handle_raft_append),
             web.get("/metrics", self.handle_metrics),
         ])
         # non-volume-server cluster members (filers, brokers, gateways):
@@ -78,15 +114,70 @@ class MasterServer:
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
         self._expire_task = asyncio.create_task(self._expire_loop())
+        if self.raft:
+            self.raft.start()
         log.info("master listening on %s", self.url)
 
     async def stop(self) -> None:
+        if self.raft:
+            self.raft.stop()
         if self._expire_task:
             self._expire_task.cancel()
         if self._session:
             await self._session.close()
         if self._runner:
             await self._runner.cleanup()
+
+    # -- raft glue ------------------------------------------------------
+
+    def _raft_transport(self, peer: str, rpc: str, payload: dict):
+        """Blocking HTTP transport, called from raft threads only."""
+        import urllib.error
+        import urllib.request
+        try:
+            req = urllib.request.Request(
+                f"http://{peer}/raft/{rpc}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=2.0) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def _raft_apply(self, command: dict) -> None:
+        if command.get("op") == "set_max_vid":
+            with self.topo._lock:
+                self.topo.max_volume_id = max(self.topo.max_volume_id,
+                                              int(command["vid"]))
+
+    async def handle_raft_vote(self, req: web.Request) -> web.Response:
+        if self.raft is None:
+            return web.json_response({"error": "raft disabled"}, status=400)
+        body = await req.json()
+        return web.json_response(
+            await asyncio.to_thread(self.raft.handle_request_vote, body))
+
+    async def handle_raft_append(self, req: web.Request) -> web.Response:
+        if self.raft is None:
+            return web.json_response({"error": "raft disabled"}, status=400)
+        body = await req.json()
+        return web.json_response(
+            await asyncio.to_thread(self.raft.handle_append_entries, body))
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader
+
+    @property
+    def leader_url(self) -> str:
+        if self.raft is None or self.raft.leader_id is None:
+            return self.url
+        return self.raft.leader_id
+
+    def _not_leader_response(self) -> web.Response:
+        return web.json_response(
+            {"error": "not the leader", "leader": self.leader_url},
+            status=409)
 
     async def _expire_loop(self) -> None:
         tick = 0
@@ -163,8 +254,12 @@ class MasterServer:
                             content_type="text/plain")
 
     async def handle_heartbeat(self, req: web.Request) -> web.Response:
+        if not self.is_leader:
+            return self._not_leader_response()
         metrics.MASTER_RECEIVED_HEARTBEATS.labels().inc()
         beat = await req.json()
+        if beat.get("max_file_key"):
+            self.topo.sequencer.set_max(int(beat["max_file_key"]))
         self.topo.register_heartbeat(
             node_id=beat["id"], url=beat["url"],
             public_url=beat.get("public_url", ""),
@@ -175,6 +270,8 @@ class MasterServer:
         })
 
     async def handle_assign(self, req: web.Request) -> web.Response:
+        if not self.is_leader:
+            return self._not_leader_response()
         q = req.query
         count = int(q.get("count", "1"))
         collection = q.get("collection", "")
@@ -238,8 +335,8 @@ class MasterServer:
 
     async def handle_cluster_status(self, req: web.Request) -> web.Response:
         return web.json_response({
-            "IsLeader": True,
-            "Leader": self.url,
+            "IsLeader": self.is_leader,
+            "Leader": self.leader_url,
             "Topology": self.topo.to_dict(),
             "Members": {k: sorted(v) for k, v in
                         self.cluster_members.items() if v},
@@ -281,6 +378,21 @@ class MasterServer:
 
     # -- growth --------------------------------------------------------
 
+    def _allocate_vid(self) -> int | None:
+        """Next volume id; raft-replicated when HA is on (the reference
+        persists MaxVolumeId through raft the same way)."""
+        if self.raft is None:
+            return self.topo.next_volume_id()
+        with self.topo._lock:
+            # reserve locally BEFORE proposing: the raft apply loop runs
+            # async, and a second allocation must not read the stale max
+            # (apply's max() keeps this idempotent)
+            self.topo.max_volume_id += 1
+            vid = self.topo.max_volume_id
+        if not self.raft.propose({"op": "set_max_vid", "vid": vid}):
+            return None
+        return vid
+
     async def _grow(self, collection: str, replication: str, ttl: str,
                     count: int) -> int:
         """Allocate `count` new volumes on free nodes (reference:
@@ -291,7 +403,10 @@ class MasterServer:
             return 0
         grown = 0
         for replica_set in slots:
-            vid = self.topo.next_volume_id()
+            vid = await asyncio.to_thread(self._allocate_vid)
+            if vid is None:
+                log.warning("vid allocation failed (lost leadership?)")
+                break
             ok = True
             for node in replica_set:
                 try:

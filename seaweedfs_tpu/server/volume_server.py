@@ -46,7 +46,10 @@ class VolumeServer:
         self.host, self.port = host, port
         self.url = f"{host}:{port}"
         self.public_url = public_url or self.url
-        self.master_url = master_url
+        # comma-separated master list (HA): heartbeats follow the leader
+        self.master_urls = [m.strip() for m in master_url.split(",")
+                            if m.strip()]
+        self.master_url = self.master_urls[0]
         self.data_center, self.rack = data_center, rack
         self.heartbeat_interval = heartbeat_interval
         self.store = Store(directories, max_volumes, self.public_url)
@@ -61,6 +64,7 @@ class VolumeServer:
             web.post("/admin/volume/readonly", self.handle_volume_readonly),
             web.post("/admin/volume/vacuum", self.handle_vacuum),
             web.post("/admin/volume/copy", self.handle_volume_copy),
+            web.post("/admin/volume/tier_move", self.handle_tier_move),
             web.get("/admin/volume/needles", self.handle_volume_needles),
             web.post("/admin/ec/generate", self.handle_ec_generate),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
@@ -86,7 +90,16 @@ class VolumeServer:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
-        await self._heartbeat_once()
+        try:
+            await self._heartbeat_once()
+        except aiohttp.ClientError as e:
+            # master not up yet; the heartbeat loop keeps retrying (and
+            # rotates through -mserver candidates under HA)
+            log.warning("initial heartbeat failed: %s", e)
+            if len(self.master_urls) > 1:
+                i = self.master_urls.index(self.master_url)
+                self.master_url = self.master_urls[
+                    (i + 1) % len(self.master_urls)]
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
         log.info("volume server on %s (dirs=%s)", self.url,
                  [l.directory for l in self.store.locations])
@@ -105,8 +118,17 @@ class VolumeServer:
             await asyncio.sleep(self.heartbeat_interval)
             try:
                 await self._heartbeat_once()
-            except aiohttp.ClientError as e:
-                log.warning("heartbeat to master failed: %s", e)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                log.warning("heartbeat to master %s failed: %s",
+                            self.master_url, e)
+                # dead leader: rotate through the configured master list so
+                # a raft failover picks up (reference: volume servers dial
+                # every master until they find the leader)
+                if len(self.master_urls) > 1:
+                    i = self.master_urls.index(self.master_url) \
+                        if self.master_url in self.master_urls else -1
+                    self.master_url = self.master_urls[
+                        (i + 1) % len(self.master_urls)]
 
     async def _heartbeat_once(self) -> None:
         beat = self.store.collect_heartbeat()
@@ -123,6 +145,21 @@ class VolumeServer:
                 data = await r.json()
                 self.volume_size_limit = data.get(
                     "volume_size_limit", self.volume_size_limit)
+                return
+            if r.status == 409:
+                # raft follower: re-point at the leader it names, else
+                # rotate through the configured master list
+                data = await r.json()
+                leader = data.get("leader")
+                if leader and leader != self.master_url:
+                    log.info("heartbeat: switching master %s -> leader %s",
+                             self.master_url, leader)
+                    self.master_url = leader
+                elif self.master_urls:
+                    i = self.master_urls.index(self.master_url) \
+                        if self.master_url in self.master_urls else -1
+                    self.master_url = self.master_urls[
+                        (i + 1) % len(self.master_urls)]
 
     # -- blob data path -------------------------------------------------
 
@@ -543,6 +580,25 @@ class VolumeServer:
         loc.collections[vid] = collection
         await self._heartbeat_once()
         return web.json_response({"file_count": vol.info().file_count})
+
+    async def handle_tier_move(self, req: web.Request) -> web.Response:
+        """Move a sealed volume's .dat to a remote tier (reference:
+        volume_grpc_tier.go VolumeTierMoveDatToRemote)."""
+        body = await req.json()
+        vid = body["volume"]
+        v = self.store.get_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        kind = body.get("kind", "local")
+        options = body.get("options", {})
+        try:
+            await asyncio.to_thread(v.tier_move, kind, options,
+                                    body.get("key"))
+        except (ValueError, TypeError, OSError, PermissionError) as e:
+            return web.json_response({"error": str(e)}, status=500)
+        await self._heartbeat_once()
+        return web.json_response({"backend": v.backend_kind})
 
     async def handle_volume_needles(self, req: web.Request) -> web.Response:
         """List needle ids + sizes of a volume (fsck / check.disk support;

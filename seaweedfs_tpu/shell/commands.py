@@ -699,6 +699,61 @@ def cmd_s3_bucket_delete(env: CommandEnv, args, out):
     print(f"deleted bucket {name}", file=out)
 
 
+@command("volume.tier.move")
+def cmd_volume_tier_move(env: CommandEnv, args, out):
+    """Move a volume's data file to a remote tier (reference:
+    command_volume_tier_move.go).  -dest kind:option, e.g.
+    -dest local:/cold-storage."""
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    dest = flags.get("dest", "")
+    kind, _, opt = dest.partition(":")
+    if (kind or "local") == "local" and not opt:
+        raise RuntimeError(
+            "volume.tier.move needs -dest local:<directory>")
+    options = {"directory": opt} if kind == "local" and opt else {}
+    for url in env.volume_locations(vid):
+        r = env.vs_post(url, "/admin/volume/tier_move",
+                        {"volume": vid, "kind": kind or "local",
+                         "options": options})
+        print(f"volume {vid} on {url} -> tier {kind or 'local'} "
+              f"(backend={r.get('backend')})", file=out)
+
+
+@command("remote.mount")
+def cmd_remote_mount(env: CommandEnv, args, out):
+    """Mount a remote store's objects under a filer directory (reference:
+    command_remote_mount.go).  -remote kind:option -dir /mounted"""
+    flags = parse_flags(args)
+    kind, _, opt = flags.get("remote", "").partition(":")
+    mount_dir = flags.get("dir", "/remote")
+    cache = flags.get("cache", "false") == "true"
+    from seaweedfs_tpu.remote_storage import make_remote, sync_remote_to_filer
+    remote = make_remote(kind or "local",
+                         **({"directory": opt} if opt else {}))
+    filer = env.find_filer()
+    n = sync_remote_to_filer(remote, filer, mount_dir, cache=cache)
+    print(f"remote.mount: {n} object(s) from {kind}:{opt} -> {mount_dir}"
+          + ("" if cache else " (placeholders; remote.cache to pull)"),
+          file=out)
+
+
+@command("remote.cache")
+def cmd_remote_cache(env: CommandEnv, args, out):
+    """Pull remote object content into the mounted directory (reference:
+    command_remote_cache.go)."""
+    flags = parse_flags(args)
+    kind, _, opt = flags.get("remote", "").partition(":")
+    mount_dir = flags.get("dir", "/remote")
+    from seaweedfs_tpu.remote_storage import make_remote, sync_remote_to_filer
+    remote = make_remote(kind or "local",
+                         **({"directory": opt} if opt else {}))
+    filer = env.find_filer()
+    n = sync_remote_to_filer(remote, filer, mount_dir, cache=True)
+    print(f"remote.cache: {n} object(s) cached under {mount_dir}", file=out)
+
+
 @command("volume.vacuum.all")
 def cmd_volume_vacuum_all(env: CommandEnv, args, out):
     """Master-driven vacuum scan (reference: topology_vacuum.go)."""

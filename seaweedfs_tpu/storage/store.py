@@ -162,9 +162,11 @@ class Store:
         (reference: store.go CollectHeartbeat, store_ec.go:25-49)."""
         vols, ec_shards = [], []
         max_slots = 0
+        max_file_key = 0
         for loc in self.locations:
             max_slots += loc.max_volumes
             for vid, v in loc.volumes.items():
+                max_file_key = max(max_file_key, v.max_file_key())
                 info = v.info()
                 vols.append({
                     "id": vid, "collection": info.collection,
@@ -182,7 +184,11 @@ class Store:
                     "shard_ids": ev.shard_ids(),
                 })
         return {"volumes": vols, "ec_shards": ec_shards,
-                "max_volume_count": max_slots, "public_url": self.public_url}
+                "max_volume_count": max_slots, "public_url": self.public_url,
+                # highest needle key on this server: the master advances its
+                # sequencer past it so ids never repeat after a master
+                # restart (reference: master_pb Heartbeat.max_file_key)
+                "max_file_key": max_file_key}
 
     def close(self) -> None:
         for loc in self.locations:

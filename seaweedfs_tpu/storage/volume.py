@@ -41,7 +41,7 @@ class VolumeInfo:
 class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: str = "000", ttl: str = "",
-                 version: int = t.CURRENT_VERSION):
+                 version: int = t.CURRENT_VERSION, backend: str = "disk"):
         self.dir = dirname
         self.collection = collection
         self.id = vid
@@ -53,23 +53,42 @@ class Volume:
         self.dat_path = self._base + ".dat"
         self.idx_path = self._base + ".idx"
 
+        from seaweedfs_tpu.storage.backend import open_backend
         existing = os.path.exists(self.dat_path)
-        self._dat = open(self.dat_path, "r+b" if existing else "w+b")
+        self.backend_kind = backend
+        self.tier_path = self._base + ".tier"
+        if os.path.exists(self.tier_path):
+            # sealed volume moved to a remote tier (reference:
+            # volume_tier.go + backend/s3_backend): .dat bytes live on the
+            # remote, reads ride RemoteFile, writes are refused
+            import json as _json
+
+            from seaweedfs_tpu.remote_storage import make_remote
+            from seaweedfs_tpu.storage.backend import RemoteFile
+            with open(self.tier_path) as f:
+                tier = _json.load(f)
+            remote = make_remote(tier["kind"], **tier.get("options", {}))
+            self._dat = RemoteFile(remote, tier["key"], tier["size"])
+            self.backend_kind = "remote"
+            self.read_only = True
+            existing = True
+        else:
+            self._dat = open_backend(self.dat_path, backend)
         if existing:
-            self._dat.seek(0)
-            head = self._dat.read(SUPER_BLOCK_SIZE + 64 * 1024)
+            head = self._dat.read_at(0, SUPER_BLOCK_SIZE + 64 * 1024)
             self.super_block = SuperBlock.from_bytes(head)
         else:
             self.super_block = SuperBlock(
                 version=version,
                 replica_placement=t.ReplicaPlacement.parse(replica_placement),
                 ttl=t.TTL.parse(ttl))
-            self._dat.write(self.super_block.to_bytes())
+            self._dat.append(self.super_block.to_bytes())
             self._dat.flush()
         self.version = self.super_block.version
 
         self.nm = NeedleMap.load_from_idx(self.idx_path)
-        self.check_and_fix_integrity()
+        if self.backend_kind != "remote":
+            self.check_and_fix_integrity()
         self._idx = open(self.idx_path, "ab")
         self.nm.attach_idx(self._idx)
 
@@ -77,8 +96,7 @@ class Volume:
 
     def data_size(self) -> int:
         with self._lock:
-            self._dat.seek(0, os.SEEK_END)
-            return self._dat.tell()
+            return self._dat.size()
 
     def check_and_fix_integrity(self) -> None:
         """Crash recovery at load (reference: volume_checking.go:17):
@@ -87,8 +105,7 @@ class Volume:
         - walk the .dat tail beyond the last indexed entry and truncate at
           the first incomplete record (tombstone records legitimately live
           there — they are complete and are kept)."""
-        self._dat.seek(0, os.SEEK_END)
-        file_end = self._dat.tell()
+        file_end = self._dat.size()
 
         end = self.super_block.block_size
         torn = []
@@ -112,8 +129,7 @@ class Volume:
         # walk complete records after the last indexed one
         offset = end + (-end) % t.NEEDLE_PADDING_SIZE
         while offset + t.NEEDLE_HEADER_SIZE <= file_end:
-            self._dat.seek(offset)
-            header = self._dat.read(t.NEEDLE_HEADER_SIZE)
+            header = self._dat.read_at(offset, t.NEEDLE_HEADER_SIZE)
             n = ndl.Needle.parse_header(header)
             if n.size < -1 or n.size > t.MAX_POSSIBLE_VOLUME_SIZE:
                 break
@@ -133,18 +149,17 @@ class Volume:
             raise PermissionError(f"volume {self.id} is read-only")
         record = n.to_bytes(self.version)
         with self._lock:
-            self._dat.seek(0, os.SEEK_END)
-            offset = self._dat.tell()
+            offset = self._dat.size()
             if offset % t.NEEDLE_PADDING_SIZE != 0:
                 pad = t.NEEDLE_PADDING_SIZE - offset % t.NEEDLE_PADDING_SIZE
-                self._dat.write(bytes(pad))
+                self._dat.append(bytes(pad))
                 offset += pad
             if offset + len(record) > t.MAX_POSSIBLE_VOLUME_SIZE:
                 raise OSError(f"volume {self.id} exceeds max size")
-            self._dat.write(record)
+            self._dat.append(record)
             self._dat.flush()
             if fsync:
-                os.fsync(self._dat.fileno())
+                self._dat.sync()
             self.nm.put(n.id, t.to_offset_units(offset), n.size)
             self.last_modified = time.time()
         return offset, n.size
@@ -164,8 +179,7 @@ class Volume:
                     raise PermissionError("cookie mismatch")
             tomb = ndl.Needle(id=needle_id, cookie=cookie or 0)
             record = tomb.to_bytes(self.version)
-            self._dat.seek(0, os.SEEK_END)
-            self._dat.write(record)
+            self._dat.append(record)
             self._dat.flush()
             freed = self.nm.delete(needle_id)
             self.last_modified = time.time()
@@ -178,8 +192,7 @@ class Volume:
         offset = t.from_offset_units(offset_units)
         length = t.actual_size(size, self.version)
         with self._lock:
-            self._dat.seek(offset)
-            record = self._dat.read(length)
+            record = self._dat.read_at(offset, length)
         if len(record) < length:
             raise EOFError(f"truncated needle at {offset}")
         try:
@@ -210,9 +223,19 @@ class Volume:
             return 0.0
         return self.nm.deleted_bytes / size
 
+    def max_file_key(self) -> int:
+        """Highest needle id present (heartbeat max_file_key), under the
+        volume lock so concurrent writers can't race the scan."""
+        with self._lock:
+            return max(self.nm._m, default=0)
+
     def compact(self) -> None:
         """Vacuum: copy live needles to .cpd/.cpx then atomically swap
         (reference: volume_vacuum.go Compact2/CommitCompact)."""
+        if self.backend_kind == "remote":
+            raise PermissionError(
+                f"volume {self.id} lives on a remote tier; decode it back "
+                f"before compacting")
         with self._lock:
             cpd, cpx = self._base + ".cpd", self._base + ".cpx"
             new_sb = SuperBlock(
@@ -236,11 +259,41 @@ class Volume:
             self._idx.close()
             os.replace(cpd, self.dat_path)
             os.replace(cpx, self.idx_path)
-            self._dat = open(self.dat_path, "r+b")
+            from seaweedfs_tpu.storage.backend import open_backend
+            self._dat = open_backend(self.dat_path, self.backend_kind)
             self.super_block = new_sb
             self.nm = NeedleMap.load_from_idx(self.idx_path)
             self._idx = open(self.idx_path, "ab")
             self.nm.attach_idx(self._idx)
+
+    def tier_move(self, kind: str, options: dict, key: str | None = None
+                  ) -> None:
+        """Move this sealed volume's .dat to a remote tier; reads keep
+        working through the RemoteFile backend (reference:
+        weed/storage/volume_tier.go + shell volume.tier.move)."""
+        import json as _json
+
+        from seaweedfs_tpu.remote_storage import make_remote
+        from seaweedfs_tpu.storage.backend import RemoteFile
+        with self._lock:
+            if self.backend_kind == "remote":
+                return
+            self._dat.flush()
+            self.nm.flush()
+            size = self._dat.size()
+            key = key or f"{self.collection or 'default'}/{self.id}.dat"
+            remote = make_remote(kind, **options)
+            remote.upload_file(key, self.dat_path)
+            tmp = self.tier_path + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump({"kind": kind, "options": options, "key": key,
+                            "size": size}, f)
+            os.replace(tmp, self.tier_path)
+            self._dat.close()
+            self._dat = RemoteFile(remote, key, size)
+            self.backend_kind = "remote"
+            self.read_only = True
+            os.remove(self.dat_path)
 
     def info(self) -> VolumeInfo:
         return VolumeInfo(
@@ -269,19 +322,16 @@ class Volume:
     def scan(self, verify_checksum: bool = False):
         """Yield (offset, Needle) for every record in .dat file order."""
         with self._lock:
-            self._dat.seek(0, os.SEEK_END)
-            end = self._dat.tell()
+            end = self._dat.size()
         offset = self.super_block.block_size
         offset += (-offset) % t.NEEDLE_PADDING_SIZE
         while offset + t.NEEDLE_HEADER_SIZE <= end:
             with self._lock:
-                # header + body under ONE lock hold: the fd position is
-                # shared with concurrent read/append seeks
-                self._dat.seek(offset)
-                header = self._dat.read(t.NEEDLE_HEADER_SIZE)
+                header = self._dat.read_at(offset, t.NEEDLE_HEADER_SIZE)
                 n = ndl.Needle.parse_header(header)
                 body_len = t.needle_body_length(max(n.size, 0), self.version)
-                body = self._dat.read(body_len)
+                body = self._dat.read_at(
+                    offset + t.NEEDLE_HEADER_SIZE, body_len)
             if len(body) < body_len:
                 return
             n.parse_body(body, self.version, verify_checksum)

@@ -1,0 +1,339 @@
+"""Raft consensus for master HA.
+
+Reference: weed/server/raft_server.go + raft_hashicorp.go — the reference
+runs Raft among masters to elect a leader and replicate the topology's
+max volume id; followers redirect clients to the leader.  This is a
+compact but real Raft: randomized election timeouts, RequestVote /
+AppendEntries over the transport callable, log replication with
+commit-on-majority, and durable (term, voted_for, log) state.
+
+The state machine here replicates the only hard state the reference
+master persists: volume-id allocations (MaxVolumeId) and admin-lock
+transitions.  Heartbeat-derived topology is soft state and rebuilt by
+volume servers re-reporting, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger("raft")
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    command: dict
+
+    def to_dict(self) -> dict:
+        return {"term": self.term, "command": self.command}
+
+
+@dataclass
+class RaftConfig:
+    node_id: str
+    peers: list[str] = field(default_factory=list)  # excludes self
+    election_timeout_ms: tuple[int, int] = (150, 300)
+    heartbeat_ms: int = 50
+    state_path: str | None = None
+
+
+class RaftNode:
+    """`transport(peer, rpc_name, payload) -> response dict | None` is
+    injected (the master wires it to HTTP POST /raft/<rpc>)."""
+
+    def __init__(self, config: RaftConfig, transport,
+                 apply_command, on_leadership_change=None):
+        self.cfg = config
+        self.transport = transport
+        self.apply_command = apply_command
+        self.on_leadership_change = on_leadership_change or (lambda l: None)
+
+        self.state = FOLLOWER
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []
+        self.commit_index = -1
+        self.last_applied = -1
+        self.leader_id: str | None = None
+
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+
+        self._lock = threading.RLock()
+        self._apply_cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._last_heartbeat = time.monotonic()
+        self._threads: list[threading.Thread] = []
+        self._load_state()
+
+    # -- persistence ----------------------------------------------------
+
+    def _load_state(self) -> None:
+        p = self.cfg.state_path
+        if not p or not os.path.exists(p):
+            return
+        try:
+            with open(p) as f:
+                d = json.load(f)
+            self.current_term = d.get("term", 0)
+            self.voted_for = d.get("voted_for")
+            self.log = [LogEntry(e["term"], e["command"])
+                        for e in d.get("log", [])]
+        except (OSError, ValueError):
+            log.warning("raft state load failed; starting fresh")
+
+    def _save_state(self) -> None:
+        p = self.cfg.state_path
+        if not p:
+            return
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term, "voted_for": self.voted_for,
+                       "log": [e.to_dict() for e in self.log]}, f)
+        os.replace(tmp, p)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        for target in (self._election_loop, self._apply_loop):
+            th = threading.Thread(target=target, daemon=True,
+                                  name=f"raft-{target.__name__}")
+            th.start()
+            self._threads.append(th)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._apply_cv:
+            self._apply_cv.notify_all()
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def quorum(self) -> int:
+        return (len(self.cfg.peers) + 1) // 2 + 1
+
+    # -- election -------------------------------------------------------
+
+    def _election_timeout(self) -> float:
+        lo, hi = self.cfg.election_timeout_ms
+        return random.uniform(lo, hi) / 1000.0
+
+    def _election_loop(self) -> None:
+        timeout = self._election_timeout()
+        while not self._stop.is_set():
+            time.sleep(0.01)
+            with self._lock:
+                if self.state == LEADER:
+                    self._send_heartbeats_locked()
+                    elapsed = 0.0
+                else:
+                    elapsed = time.monotonic() - self._last_heartbeat
+            if self.state == LEADER:
+                time.sleep(self.cfg.heartbeat_ms / 1000.0)
+                continue
+            if elapsed >= timeout:
+                self._run_election()
+                timeout = self._election_timeout()
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            term = self.current_term
+            self.voted_for = self.cfg.node_id
+            self._save_state()
+            self._last_heartbeat = time.monotonic()
+            last_idx = len(self.log) - 1
+            last_term = self.log[-1].term if self.log else 0
+        votes = 1
+        for peer in self.cfg.peers:
+            resp = self.transport(peer, "request_vote", {
+                "term": term, "candidate_id": self.cfg.node_id,
+                "last_log_index": last_idx, "last_log_term": last_term})
+            if resp is None:
+                continue
+            with self._lock:
+                if resp.get("term", 0) > self.current_term:
+                    self._become_follower(resp["term"], None)
+                    return
+            if resp.get("vote_granted"):
+                votes += 1
+        with self._lock:
+            if self.state != CANDIDATE or self.current_term != term:
+                return
+            if votes >= self.quorum():
+                self.state = LEADER
+                self.leader_id = self.cfg.node_id
+                n = len(self.log)
+                self.next_index = {p: n for p in self.cfg.peers}
+                self.match_index = {p: -1 for p in self.cfg.peers}
+                log.info("%s elected leader for term %d (%d votes)",
+                         self.cfg.node_id, term, votes)
+                self._send_heartbeats_locked()
+                self.on_leadership_change(True)
+
+    def _become_follower(self, term: int, leader: str | None) -> None:
+        was_leader = self.state == LEADER
+        self.state = FOLLOWER
+        self.current_term = term
+        self.voted_for = None
+        if leader:
+            self.leader_id = leader
+        self._save_state()
+        self._last_heartbeat = time.monotonic()
+        if was_leader:
+            self.on_leadership_change(False)
+
+    # -- replication ----------------------------------------------------
+
+    def _send_heartbeats_locked(self) -> None:
+        term = self.current_term
+        for peer in self.cfg.peers:
+            threading.Thread(target=self._replicate_to, args=(peer, term),
+                             daemon=True).start()
+
+    def _replicate_to(self, peer: str, term: int) -> None:
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            ni = self.next_index.get(peer, len(self.log))
+            prev_idx = ni - 1
+            prev_term = self.log[prev_idx].term if prev_idx >= 0 else 0
+            entries = [e.to_dict() for e in self.log[ni:]]
+            payload = {
+                "term": term, "leader_id": self.cfg.node_id,
+                "prev_log_index": prev_idx, "prev_log_term": prev_term,
+                "entries": entries, "leader_commit": self.commit_index}
+        resp = self.transport(peer, "append_entries", payload)
+        if resp is None:
+            return
+        with self._lock:
+            if resp.get("term", 0) > self.current_term:
+                self._become_follower(resp["term"], None)
+                return
+            if self.state != LEADER or self.current_term != term:
+                return
+            if resp.get("success"):
+                self.match_index[peer] = prev_idx + len(payload["entries"])
+                self.next_index[peer] = self.match_index[peer] + 1
+                self._advance_commit_locked()
+            else:
+                self.next_index[peer] = max(0, ni - 1)
+
+    def _advance_commit_locked(self) -> None:
+        for n in range(len(self.log) - 1, self.commit_index, -1):
+            if self.log[n].term != self.current_term:
+                continue
+            count = 1 + sum(1 for p in self.cfg.peers
+                            if self.match_index.get(p, -1) >= n)
+            if count >= self.quorum():
+                self.commit_index = n
+                self._apply_cv.notify_all()
+                break
+
+    def _apply_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._apply_cv:
+                while self.last_applied >= self.commit_index and \
+                        not self._stop.is_set():
+                    self._apply_cv.wait(0.2)
+                if self._stop.is_set():
+                    return
+                start = self.last_applied + 1
+                end = self.commit_index
+                to_apply = [(i, self.log[i]) for i in range(start, end + 1)]
+                self.last_applied = end
+            for i, entry in to_apply:
+                try:
+                    self.apply_command(entry.command)
+                except Exception:
+                    log.exception("apply failed at index %d", i)
+
+    # -- client API -----------------------------------------------------
+
+    def propose(self, command: dict, timeout: float = 5.0) -> bool:
+        """Leader-only: append + replicate + wait for commit."""
+        with self._lock:
+            if self.state != LEADER:
+                return False
+            self.log.append(LogEntry(self.current_term, command))
+            self._save_state()
+            index = len(self.log) - 1
+            if not self.cfg.peers:  # single-node cluster commits instantly
+                self.commit_index = index
+                self._apply_cv.notify_all()
+            else:
+                self._send_heartbeats_locked()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.commit_index >= index:
+                    return True
+                if self.state != LEADER:
+                    return False
+            time.sleep(0.005)
+        return False
+
+    # -- RPC handlers (called by the transport server) -------------------
+
+    def handle_request_vote(self, req: dict) -> dict:
+        with self._lock:
+            term = req["term"]
+            if term > self.current_term:
+                self._become_follower(term, None)
+            granted = False
+            if term == self.current_term and \
+                    self.voted_for in (None, req["candidate_id"]):
+                my_last_term = self.log[-1].term if self.log else 0
+                my_last_idx = len(self.log) - 1
+                up_to_date = (req["last_log_term"], req["last_log_index"]) \
+                    >= (my_last_term, my_last_idx)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = req["candidate_id"]
+                    self._save_state()
+                    self._last_heartbeat = time.monotonic()
+            return {"term": self.current_term, "vote_granted": granted}
+
+    def handle_append_entries(self, req: dict) -> dict:
+        with self._lock:
+            term = req["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term, req["leader_id"])
+            self.leader_id = req["leader_id"]
+            self._last_heartbeat = time.monotonic()
+            prev_idx = req["prev_log_index"]
+            if prev_idx >= 0:
+                if prev_idx >= len(self.log) or \
+                        self.log[prev_idx].term != req["prev_log_term"]:
+                    return {"term": self.current_term, "success": False}
+            # append, truncating conflicts
+            idx = prev_idx + 1
+            for e in req["entries"]:
+                if idx < len(self.log):
+                    if self.log[idx].term != e["term"]:
+                        del self.log[idx:]
+                        self.log.append(LogEntry(e["term"], e["command"]))
+                else:
+                    self.log.append(LogEntry(e["term"], e["command"]))
+                idx += 1
+            if req["entries"]:
+                self._save_state()
+            if req["leader_commit"] > self.commit_index:
+                self.commit_index = min(req["leader_commit"],
+                                        len(self.log) - 1)
+                self._apply_cv.notify_all()
+            return {"term": self.current_term, "success": True}

@@ -1,0 +1,135 @@
+"""Backend abstraction (.dat behind disk/mmap/remote), volume tier move,
+and remote storage mount (reference: weed/storage/backend/,
+volume_tier.go, weed/remote_storage/)."""
+
+import io
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage.backend import DiskFile, MmapFile, open_backend
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from tests.test_cluster import Cluster, free_port
+
+
+@pytest.mark.parametrize("kind", ["disk", "mmap"])
+def test_backend_file_roundtrip(tmp_path, kind):
+    p = str(tmp_path / f"f.{kind}")
+    b = open_backend(p, kind)
+    assert b.size() == 0
+    off = b.append(b"hello")
+    assert off == 0
+    assert b.append(b" world") == 5
+    b.flush()
+    assert b.read_at(0, 11) == b"hello world"
+    assert b.read_at(6, 5) == b"world"
+    assert b.size() == 11
+    b.truncate(5)
+    assert b.size() == 5 and b.read_at(0, 10) == b"hello"
+    b.close()
+
+
+def test_volume_on_mmap_backend(tmp_path):
+    v = Volume(str(tmp_path), "", 3, backend="mmap")
+    v.append_needle(Needle(id=1, cookie=9, data=b"mmap-data", name=b"m"))
+    assert v.read_needle(1, 9).data == b"mmap-data"
+    v.close()
+    v2 = Volume(str(tmp_path), "", 3, backend="mmap")
+    assert v2.read_needle(1).data == b"mmap-data"
+    v2.close()
+
+
+def test_tier_move_and_reload(tmp_path):
+    cold = str(tmp_path / "cold")
+    os.makedirs(tmp_path / "hot", exist_ok=True)
+    v = Volume(str(tmp_path / "hot"), "", 5)
+    payloads = {i: os.urandom(1000) for i in range(1, 6)}
+    for i, data in payloads.items():
+        v.append_needle(Needle(id=i, cookie=i, data=data))
+    v.tier_move("local", {"directory": cold})
+    # .dat gone locally, reads still work through the remote backend
+    assert not os.path.exists(v.dat_path)
+    assert os.path.exists(v.tier_path)
+    for i, data in payloads.items():
+        assert v.read_needle(i).data == data
+    with pytest.raises(PermissionError):
+        v.append_needle(Needle(id=99, cookie=1, data=b"x"))
+    v.close()
+    # reload from the tier marker
+    v2 = Volume(str(tmp_path / "hot"), "", 5)
+    assert v2.backend_kind == "remote" and v2.read_only
+    for i, data in payloads.items():
+        assert v2.read_needle(i).data == data
+    v2.close()
+
+
+def test_tier_move_via_server_and_shell(tmp_path):
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    try:
+        client = WeedClient(c.master.url)
+        fid = client.upload(b"cold data", name="c.bin")
+        vid = int(fid.split(",")[0])
+        env = CommandEnv(c.master.url)
+        env.acquire_lock()
+        buf = io.StringIO()
+        run_command(env, f"volume.tier.move -volumeId {vid} "
+                         f"-dest local:{tmp_path / 'tier'}", buf)
+        assert "tier local" in buf.getvalue()
+        # reads still served
+        assert client.download(fid) == b"cold data"
+        # data landed in the remote dir
+        assert any(f.endswith(".dat")
+                   for _, _, files in os.walk(tmp_path / "tier")
+                   for f in files)
+    finally:
+        c.stop()
+
+
+def test_remote_mount_and_cache(tmp_path):
+    from seaweedfs_tpu.remote_storage import LocalDirRemote
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    import urllib.request
+
+    # build a fake remote bucket
+    bucket = tmp_path / "bucket"
+    (bucket / "sub").mkdir(parents=True)
+    (bucket / "a.txt").write_bytes(b"remote-a")
+    (bucket / "sub" / "b.txt").write_bytes(b"remote-b")
+
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port())
+    c.submit(filer.start())
+    try:
+        env = CommandEnv(c.master.url)
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                env.find_filer()
+                break
+            except RuntimeError:
+                time.sleep(0.2)
+        buf = io.StringIO()
+        run_command(env, f"remote.mount -remote local:{bucket} -dir /r", buf)
+        assert "2 object(s)" in buf.getvalue()
+        # placeholder: entry exists with remote attrs, zero content
+        meta = __import__("json").load(urllib.request.urlopen(
+            f"http://{filer.url}/r/a.txt?metadata=true", timeout=10))
+        ext = {k.lower(): v for k, v in (meta.get("extended") or {}).items()}
+        assert ext.get("remote-size") == "8" and \
+            ext.get("remote-placeholder") == "true"
+        buf = io.StringIO()
+        run_command(env, f"remote.cache -remote local:{bucket} -dir /r", buf)
+        assert urllib.request.urlopen(
+            f"http://{filer.url}/r/a.txt", timeout=10).read() == b"remote-a"
+        assert urllib.request.urlopen(
+            f"http://{filer.url}/r/sub/b.txt", timeout=10).read() == b"remote-b"
+    finally:
+        c.submit(filer.stop())
+        c.stop()

@@ -1,0 +1,227 @@
+"""Raft consensus: election, replication, failover, persistence — on
+in-process nodes with a direct-call transport, plus a 3-master HA cluster
+test (reference: weed/server/raft_server.go semantics)."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.topology.raft import LEADER, RaftConfig, RaftNode
+
+
+class Net:
+    """In-memory transport connecting RaftNodes by id, with partitions."""
+
+    def __init__(self):
+        self.nodes: dict[str, RaftNode] = {}
+        self.down: set[str] = set()
+
+    def transport_for(self, caller: str):
+        def transport(peer: str, rpc: str, payload: dict):
+            # symmetric partition: a downed node can neither be reached
+            # nor reach anyone
+            if peer in self.down or caller in self.down:
+                return None
+            node = self.nodes.get(peer)
+            if node is None:
+                return None
+            if rpc == "request_vote":
+                return node.handle_request_vote(payload)
+            if rpc == "append_entries":
+                return node.handle_append_entries(payload)
+            return None
+        return transport
+
+
+def make_cluster(n=3, tmp_path=None):
+    net = Net()
+    ids = [f"n{i}" for i in range(n)]
+    applied = {i: [] for i in ids}
+    nodes = []
+    for nid in ids:
+        cfg = RaftConfig(
+            node_id=nid, peers=[p for p in ids if p != nid],
+            election_timeout_ms=(80, 160), heartbeat_ms=25,
+            state_path=str(tmp_path / f"{nid}.json") if tmp_path else None)
+        node = RaftNode(cfg, net.transport_for(nid),
+                        apply_command=applied[nid].append)
+        net.nodes[nid] = node
+        nodes.append(node)
+    return net, nodes, applied
+
+
+def wait_leader(nodes, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [n for n in nodes if n.is_leader]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise TimeoutError("no single leader elected")
+
+
+def test_election_and_replication(tmp_path):
+    net, nodes, applied = make_cluster(3, tmp_path)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        assert leader.propose({"op": "set_max_vid", "vid": 1})
+        assert leader.propose({"op": "set_max_vid", "vid": 2})
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(len(applied[n.cfg.node_id]) == 2 for n in nodes):
+                break
+            time.sleep(0.02)
+        for n in nodes:
+            assert applied[n.cfg.node_id] == [
+                {"op": "set_max_vid", "vid": 1},
+                {"op": "set_max_vid", "vid": 2}], n.cfg.node_id
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_leader_failover_and_log_convergence(tmp_path):
+    net, nodes, applied = make_cluster(3, tmp_path)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        assert leader.propose({"op": "set_max_vid", "vid": 10})
+        # partition the leader away
+        net.down.add(leader.cfg.node_id)
+        survivors = [n for n in nodes if n is not leader]
+        new_leader = wait_leader(survivors)
+        assert new_leader is not leader
+        assert new_leader.propose({"op": "set_max_vid", "vid": 11})
+        # heal: old leader steps down and catches up
+        net.down.clear()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            cmds = applied[leader.cfg.node_id]
+            if {"op": "set_max_vid", "vid": 11} in cmds and \
+                    not (leader.is_leader and new_leader.is_leader):
+                break
+            time.sleep(0.02)
+        assert {"op": "set_max_vid", "vid": 11} in applied[leader.cfg.node_id]
+        # exactly one leader remains
+        assert sum(1 for n in nodes if n.is_leader) == 1
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_minority_cannot_commit(tmp_path):
+    net, nodes, applied = make_cluster(3, tmp_path)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        others = [n.cfg.node_id for n in nodes if n is not leader]
+        net.down.update(others)  # leader now isolated with no quorum
+        assert not leader.propose({"op": "set_max_vid", "vid": 99},
+                                  timeout=1.0)
+        assert all({"op": "set_max_vid", "vid": 99} not in cmds
+                   for cmds in applied.values())
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_state_persistence(tmp_path):
+    net, nodes, applied = make_cluster(1, tmp_path)
+    n = nodes[0]
+    n.start()
+    try:
+        wait_leader([n])
+        assert n.propose({"op": "set_max_vid", "vid": 5})
+    finally:
+        n.stop()
+    # reload from disk
+    cfg = RaftConfig(node_id="n0", peers=[],
+                     state_path=str(tmp_path / "n0.json"))
+    replayed = []
+    n2 = RaftNode(cfg, lambda *a: None, apply_command=replayed.append)
+    assert [e.command for e in n2.log] == [{"op": "set_max_vid", "vid": 5}]
+    assert n2.current_term >= 1
+
+
+def test_master_ha_cluster(tmp_path):
+    """Three masters with raft; assigns go to the leader; a follower names
+    the leader; vid allocations replicate."""
+    import asyncio
+    import json
+    import urllib.error
+    import urllib.request
+
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from tests.test_cluster import free_port
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(60)
+
+    ports = [free_port() for _ in range(3)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    masters = [MasterServer("127.0.0.1", p, peers=peers,
+                            raft_state_dir=str(tmp_path / "raft"))
+               for p in ports]
+    for m in masters:
+        run(m.start())
+    vs = None
+    try:
+        deadline = time.time() + 15
+        leader = None
+        while time.time() < deadline:
+            leaders = [m for m in masters if m.is_leader]
+            if len(leaders) == 1:
+                leader = leaders[0]
+                break
+            time.sleep(0.05)
+        assert leader is not None, "no master leader"
+        # follower tells clients who leads
+        follower = next(m for m in masters if m is not leader)
+        st = json.load(urllib.request.urlopen(
+            f"http://{follower.url}/cluster/status", timeout=5))
+        assert st["IsLeader"] is False and st["Leader"] == leader.url
+        # volume server pointed at a FOLLOWER finds the leader
+        (tmp_path / "v").mkdir(exist_ok=True)
+        vs = VolumeServer([str(tmp_path / "v")], ",".join(peers[::-1]),
+                          port=free_port(), heartbeat_interval=0.2)
+        run(vs.start())
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if leader.topo.nodes:
+                break
+            time.sleep(0.1)
+        assert leader.topo.nodes, "volume server never reached the leader"
+        # assign via leader allocates a replicated vid
+        a = json.load(urllib.request.urlopen(
+            f"http://{leader.url}/dir/assign?count=1", timeout=10))
+        assert "fid" in a, a
+        vid = int(a["fid"].split(",")[0])
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(m.topo.max_volume_id >= vid for m in masters):
+                break
+            time.sleep(0.05)
+        assert all(m.topo.max_volume_id >= vid for m in masters)
+        # follower refuses assigns and names the leader
+        try:
+            urllib.request.urlopen(
+                f"http://{follower.url}/dir/assign?count=1", timeout=5)
+            raise AssertionError("follower accepted an assign")
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+            assert e.code == 409 and body["leader"] == leader.url
+    finally:
+        if vs is not None:
+            run(vs.stop())
+        for m in masters:
+            run(m.stop())
+        loop.call_soon_threadsafe(loop.stop)
