@@ -83,6 +83,12 @@ def main(argv=None) -> int:
     pf.add_argument("-collection", default="")
     pf.add_argument("-defaultReplication", default="")
     pf.add_argument("-maxMB", type=int, default=4)
+    pf.add_argument("-encryptVolumeData", action="store_true",
+                    help="AES-256-GCM encrypt chunks (cipher key in meta)")
+    pf.add_argument("-cacheCapacityMB", type=int, default=0,
+                    help="on-disk chunk cache size (0 = memory-only)")
+    pf.add_argument("-notification.log", dest="notificationLog", default=None,
+                    help="append meta events to this JSONL file")
 
     p3 = sub.add_parser("s3")
     p3.add_argument("-ip", default="127.0.0.1")
@@ -267,10 +273,17 @@ async def _run_volume(args) -> int:
 
 async def _run_filer(args) -> int:
     from seaweedfs_tpu.server.filer_server import FilerServer
+    notification = None
+    if args.notificationLog:
+        from seaweedfs_tpu.notification import LogQueue
+        notification = LogQueue(args.notificationLog)
     f = FilerServer(args.master, args.ip, args.port, data_dir=args.dir,
                     collection=args.collection,
                     replication=args.defaultReplication,
-                    chunk_size=args.maxMB << 20, security=_security(args))
+                    chunk_size=args.maxMB << 20, security=_security(args),
+                    encrypt_data=args.encryptVolumeData,
+                    chunk_cache_disk=args.cacheCapacityMB << 20,
+                    notification=notification)
     await f.start()
     await _serve_forever()
     await f.stop()

@@ -1,0 +1,66 @@
+"""Image operations on the read path: EXIF orientation fix, resize, crop.
+
+Reference: weed/images/ (orientation.go fixes JPEG EXIF rotation at
+needle-create time, resizing.go serves ?width=&height=&mode= on reads,
+invoked from weed/storage/needle/needle.go:101-106 and the volume read
+handler).  PIL does the pixel work here.
+"""
+
+from __future__ import annotations
+
+import io
+
+RESIZABLE = ("image/jpeg", "image/png", "image/gif", "image/webp")
+
+
+def is_image_mime(mime: str) -> bool:
+    return (mime or "").lower() in RESIZABLE
+
+
+def fix_orientation(data: bytes, mime: str = "image/jpeg") -> bytes:
+    """Bake the EXIF orientation into the pixels (reference:
+    images/orientation.go FixJpgOrientation)."""
+    if mime != "image/jpeg":
+        return data
+    try:
+        from PIL import Image, ImageOps
+        img = Image.open(io.BytesIO(data))
+        fixed = ImageOps.exif_transpose(img)
+        if fixed is img:
+            return data
+        out = io.BytesIO()
+        fixed.save(out, format="JPEG", quality=90)
+        return out.getvalue()
+    except Exception:
+        return data
+
+
+def resized(data: bytes, mime: str, width: int = 0, height: int = 0,
+            mode: str = "") -> bytes:
+    """Resize on read (reference: images/resizing.go Resized):
+      mode ''    : preserve ratio within the WxH box
+      mode 'fit' : pad to exactly WxH, preserving ratio
+      mode 'fill': crop-to-fill exactly WxH."""
+    if not (width or height) or not is_image_mime(mime):
+        return data
+    try:
+        from PIL import Image, ImageOps
+        img = Image.open(io.BytesIO(data))
+        w0, h0 = img.size
+        w, h = width or w0, height or h0
+        if mode == "fill":
+            img = ImageOps.fit(img, (w, h))
+        elif mode == "fit":
+            img = ImageOps.pad(img, (w, h))
+        else:
+            img = img.copy()
+            img.thumbnail((w, h))
+        fmt = {"image/jpeg": "JPEG", "image/png": "PNG", "image/gif": "GIF",
+               "image/webp": "WEBP"}[mime.lower()]
+        out = io.BytesIO()
+        if fmt == "JPEG" and img.mode not in ("RGB", "L"):
+            img = img.convert("RGB")
+        img.save(out, format=fmt)
+        return out.getvalue()
+    except Exception:
+        return data

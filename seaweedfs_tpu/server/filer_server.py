@@ -25,6 +25,7 @@ events, replay-then-live, for filer.sync and gateway cache invalidation.
 from __future__ import annotations
 
 import asyncio
+import gzip
 import hashlib
 import json
 import logging
@@ -56,7 +57,10 @@ class FilerServer:
                  port: int = 8888, data_dir: str | None = None,
                  collection: str = "", replication: str = "",
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
-                 jwt_signer=None, security=None, notification=None):
+                 jwt_signer=None, security=None, notification=None,
+                 encrypt_data: bool = False,
+                 chunk_cache_mem: int = 32 * 1024 * 1024,
+                 chunk_cache_disk: int = 0):
         self.master_url = master_url
         self.host, self.port = host, port
         self.collection = collection
@@ -94,6 +98,17 @@ class FilerServer:
             web.route("*", "/{path:.*}", self.handle_path),
         ])
         self.notification = notification  # MessageQueue | None
+        # per-chunk AES-GCM (reference: filer -encryptVolumeData)
+        self.encrypt_data = encrypt_data
+        # tiered chunk cache on the read path (reference: util/chunk_cache)
+        from seaweedfs_tpu.utils.chunk_cache import ChunkCache
+        cache_dir = None
+        if chunk_cache_disk and data_dir:
+            import os as _os
+            cache_dir = _os.path.join(data_dir, "chunk_cache")
+        self.chunk_cache = ChunkCache(mem_limit=chunk_cache_mem,
+                                      disk_dir=cache_dir,
+                                      disk_limit=chunk_cache_disk)
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._subscribers: set[asyncio.Queue] = set()
@@ -192,7 +207,10 @@ class FilerServer:
         return a
 
     async def _upload_chunk(self, data: bytes, collection: str,
-                            replication: str, ttl: str) -> FileChunk:
+                            replication: str, ttl: str,
+                            mime: str = "", raw: bool = False) -> FileChunk:
+        """`raw` skips compression/encryption — manifest blobs are internal
+        metadata that the resolve paths read directly."""
         a = await self._assign(collection, replication, ttl)
         headers = {"Content-Type": "application/octet-stream"}
         if a.get("auth"):
@@ -200,16 +218,34 @@ class FilerServer:
             headers["Authorization"] = "Bearer " + a["auth"]
         elif self.jwt_signer:
             headers["Authorization"] = "Bearer " + self.jwt_signer(a["fid"])
+        logical_size = len(data)
+        etag = hashlib.md5(data).hexdigest()
+        is_compressed = False
+        cipher_key = b""
+        # gzip compressible payloads when it actually helps (reference:
+        # util.MaybeGzipData in operation/upload_content.go)
+        if not raw and _is_gzippable(mime) and logical_size > 128:
+            packed = await asyncio.to_thread(gzip.compress, data, 6)
+            if len(packed) * 10 < logical_size * 9:
+                data = packed
+                is_compressed = True
+        if self.encrypt_data and not raw:
+            from seaweedfs_tpu.utils import cipher as _cipher
+            cipher_key, data = await asyncio.to_thread(_cipher.encrypt, data)
         async with self._session.put(
                 f"http://{a['url']}/{a['fid']}", data=data,
                 headers=headers) as r:
             if r.status >= 300:
                 raise RuntimeError(f"chunk upload: HTTP {r.status}")
-        return FileChunk(fid=a["fid"], offset=0, size=len(data),
-                         mtime=time.time_ns(),
-                         etag=hashlib.md5(data).hexdigest())
+        return FileChunk(fid=a["fid"], offset=0, size=logical_size,
+                         mtime=time.time_ns(), etag=etag,
+                         cipher_key=cipher_key, is_compressed=is_compressed)
 
     async def _fetch_chunk(self, fid: str) -> bytes:
+        # disk tiers do blocking IO; keep it off the event loop
+        cached = await asyncio.to_thread(self.chunk_cache.get, fid)
+        if cached is not None:
+            return cached
         vid = fid.partition(",")[0]
         async with self._session.get(
                 f"http://{self.master_url}/dir/lookup",
@@ -226,11 +262,26 @@ class FilerServer:
                 async with self._session.get(f"http://{loc['url']}/{fid}",
                                              headers=headers) as r:
                     if r.status == 200:
-                        return await r.read()
+                        blob = await r.read()
+                        await asyncio.to_thread(self.chunk_cache.put,
+                                                fid, blob)
+                        return blob
                     last = f"HTTP {r.status}"
             except aiohttp.ClientError as e:
                 last = str(e)
         raise IOError(f"chunk {fid}: {last or 'no locations'}")
+
+    async def _decode_chunk_blob(self, blob: bytes, cipher_key: bytes,
+                                 is_compressed: bool) -> bytes:
+        """Stored chunk bytes -> logical bytes: decrypt, then gunzip
+        (reference: weed/filer/stream.go fetchChunkRange +
+        util.DecompressData)."""
+        if cipher_key:
+            from seaweedfs_tpu.utils import cipher as _cipher
+            blob = await asyncio.to_thread(_cipher.decrypt, cipher_key, blob)
+        if is_compressed:
+            blob = await asyncio.to_thread(gzip.decompress, blob)
+        return blob
 
     async def _resolve_chunks(self, entry: Entry) -> list[FileChunk]:
         """Expand manifest refs, fetching manifest blobs level by level
@@ -363,6 +414,10 @@ class FilerServer:
             return web.json_response({"name": d.name}, status=201)
 
         # autochunk the body (reference: doPostAutoChunk)
+        mime = req.headers.get("Content-Type", "")
+        if mime in ("application/octet-stream", ""):
+            import mimetypes
+            mime = mimetypes.guess_type(path)[0] or mime
         chunks: list[FileChunk] = []
         md5 = hashlib.md5()
         total = 0
@@ -379,14 +434,14 @@ class FilerServer:
                     blob = bytes(pending[:chunk_size])
                     del pending[:chunk_size]
                     ck = await self._upload_chunk(blob, collection,
-                                                  replication, ttl)
+                                                  replication, ttl, mime)
                     ck.offset = total
                     total += len(blob)
                     chunks.append(ck)
             if pending:  # empty files carry no chunks, like the reference
                 blob = bytes(pending)
                 ck = await self._upload_chunk(blob, collection,
-                                              replication, ttl)
+                                              replication, ttl, mime)
                 ck.offset = total
                 total += len(blob)
                 chunks.append(ck)
@@ -405,10 +460,6 @@ class FilerServer:
                 return web.json_response({"error": str(e)}, status=500)
 
         now = time.time()
-        mime = req.headers.get("Content-Type", "")
-        if mime in ("application/octet-stream", ""):
-            import mimetypes
-            mime = mimetypes.guess_type(path)[0] or mime
         entry = Entry(
             full_path=path,
             attr=Attr(mtime=now, crtime=now, mode=0o660, mime=mime,
@@ -433,7 +484,8 @@ class FilerServer:
                 out.extend(group)
                 break
             stored = await self._upload_chunk(
-                fcm.manifest_payload(group), collection, replication, ttl)
+                fcm.manifest_payload(group), collection, replication, ttl,
+                raw=True)
             out.append(fcm.manifest_ref(stored, group))
         out.sort(key=lambda c: c.offset)
         return out
@@ -509,6 +561,8 @@ class FilerServer:
                 await _write_zeros(resp, v.logic_offset - pos)
                 pos = v.logic_offset
             blob = await self._fetch_chunk(v.fid)
+            blob = await self._decode_chunk_blob(blob, v.cipher_key,
+                                                 v.is_compressed)
             await resp.write(blob[v.offset_in_chunk:v.offset_in_chunk + v.size])
             pos += v.size
         if pos < offset + length:
@@ -629,6 +683,18 @@ class FilerServer:
         })
 
 
+
+
+_GZIPPABLE_MIME_PREFIXES = ("text/",)
+_GZIPPABLE_MIMES = {
+    "application/json", "application/javascript", "application/xml",
+    "application/x-javascript", "application/xhtml+xml", "image/svg+xml"}
+
+
+def _is_gzippable(mime: str) -> bool:
+    mime = (mime or "").lower().partition(";")[0].strip()
+    return mime.startswith(_GZIPPABLE_MIME_PREFIXES) or \
+        mime in _GZIPPABLE_MIMES
 
 def _req_signatures(req) -> list[int]:
     """X-Weed-Signatures: comma-separated ints; stamped by filer.sync

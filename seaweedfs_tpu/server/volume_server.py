@@ -75,6 +75,7 @@ class VolumeServer:
             web.post("/admin/ec/to_volume", self.handle_ec_to_volume),
             web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
             web.get("/admin/file", self.handle_file_pull),
+            web.post("/admin/query", self.handle_query),
             web.route("*", "/{fid:[^/]*,[^/]+}", self.handle_blob),
         ])
         self._runner: web.AppRunner | None = None
@@ -304,6 +305,20 @@ class VolumeServer:
             headers["Content-Disposition"] = \
                 f'inline; filename="{n.name.decode(errors="replace")}"'
         data, status = n.data, 200
+        # on-read image resize/crop (reference: images/resizing.go served
+        # via ?width= on the volume read handler, needle.go:101-106)
+        mime = n.mime.decode() if n.mime else ""
+        if ("width" in req.query or "height" in req.query):
+            from seaweedfs_tpu import images
+            try:
+                w = int(req.query.get("width", "0") or 0)
+                h = int(req.query.get("height", "0") or 0)
+            except ValueError:
+                w = h = 0  # malformed size params are ignored
+            if (w or h) and images.is_image_mime(mime):
+                data = await asyncio.to_thread(
+                    images.resized, data, mime, w, h,
+                    req.query.get("mode", ""))
         rng = req.headers.get("Range", "")
         if rng.startswith("bytes=") and data:
             from seaweedfs_tpu.utils.http import parse_range
@@ -617,6 +632,73 @@ class VolumeServer:
                     break
         return web.json_response({"volume": vid, "count": len(needles),
                                   "needles": needles})
+
+    async def handle_query(self, req: web.Request) -> web.Response:
+        """S3-Select-style JSON query pushdown over a volume's needles
+        (reference: volume_server.proto:107 Query rpc +
+        weed/server/volume_grpc_query.go, weed/query/json).  Body:
+        {volume, filter: {field, op, value}?, projections: [fields]?,
+        limit?} -> NDJSON of matching (projected) documents."""
+        import json as _json
+        body = await req.json()
+        vid = body["volume"]
+        v = self.store.get_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        flt = body.get("filter")
+        projections = body.get("projections")
+        limit = int(body.get("limit", 10000))
+
+        def match(doc: dict) -> bool:
+            if not flt:
+                return True
+            val = doc.get(flt["field"])
+            want = flt.get("value")
+            op = flt.get("op", "=")
+            try:
+                if op in ("=", "=="):
+                    return val == want
+                if op == "!=":
+                    return val != want
+                if op == ">":
+                    return val is not None and val > want
+                if op == ">=":
+                    return val is not None and val >= want
+                if op == "<":
+                    return val is not None and val < want
+                if op == "<=":
+                    return val is not None and val <= want
+                if op == "like":
+                    return isinstance(val, str) and str(want) in val
+            except TypeError:
+                return False
+            return False
+
+        def run_query() -> list[bytes]:
+            rows = []
+            for offset, n in v.scan():
+                if not n.data or not v.has_needle(n.id):
+                    continue
+                live = v.nm.get(n.id)
+                if live is None or live[0] != offset // t.NEEDLE_PADDING_SIZE:
+                    continue
+                try:
+                    doc = _json.loads(n.data)
+                except (ValueError, UnicodeDecodeError):
+                    continue
+                if not isinstance(doc, dict) or not match(doc):
+                    continue
+                if projections:
+                    doc = {k: doc.get(k) for k in projections}
+                rows.append(_json.dumps(doc, separators=(",", ":")).encode())
+                if len(rows) >= limit:
+                    break
+            return rows
+
+        rows = await asyncio.to_thread(run_query)
+        return web.Response(body=b"\n".join(rows) + (b"\n" if rows else b""),
+                            content_type="application/x-ndjson")
 
     async def handle_file_pull(self, req: web.Request) -> web.StreamResponse:
         """Serve a volume/ec file by basename for peer pulls (source side of
