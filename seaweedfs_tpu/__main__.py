@@ -83,6 +83,9 @@ def main(argv=None) -> int:
     pf.add_argument("-collection", default="")
     pf.add_argument("-defaultReplication", default="")
     pf.add_argument("-maxMB", type=int, default=4)
+    pf.add_argument("-store", default=None,
+                    help="filer store driver (memory|sqlite|logstore|redis; "
+                         "default sqlite with -dir, memory without)")
     pf.add_argument("-encryptVolumeData", action="store_true",
                     help="AES-256-GCM encrypt chunks (cipher key in meta)")
     pf.add_argument("-cacheCapacityMB", type=int, default=0,
@@ -283,7 +286,7 @@ async def _run_filer(args) -> int:
                     chunk_size=args.maxMB << 20, security=_security(args),
                     encrypt_data=args.encryptVolumeData,
                     chunk_cache_disk=args.cacheCapacityMB << 20,
-                    notification=notification)
+                    notification=notification, store_kind=args.store)
     await f.start()
     await _serve_forever()
     await f.stop()

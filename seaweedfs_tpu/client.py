@@ -21,7 +21,10 @@ class WeedClient:
         `jwt_read_signer(fid)` signs reads, when the cluster enforces JWTs
         (reference: operation callers hold the security.toml signing keys,
         security/jwt.go GenJwtForVolumeServer)."""
-        self.master = master
+        # `master` may be a comma-separated HA list; requests follow the
+        # raft leader like the reference wdclient (masterclient.go:20-45)
+        self.masters = [m.strip() for m in master.split(",") if m.strip()]
+        self.master = self.masters[0]
         self.timeout = timeout
         self.jwt_signer = jwt_signer
         self.jwt_read_signer = jwt_read_signer
@@ -29,6 +32,38 @@ class WeedClient:
         self.vid_cache_ttl = 10.0
 
     # -- raw http ------------------------------------------------------
+
+    def _master_json(self, path: str) -> dict:
+        """GET a master endpoint, following 409 leader hints and rotating
+        through the HA list on dead masters."""
+        last: Exception | None = None
+        for attempt in range(2 * max(1, len(self.masters))):
+            try:
+                with urllib.request.urlopen(
+                        f"http://{self.master}{path}",
+                        timeout=self.timeout) as r:
+                    return json.load(r)
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    try:
+                        body = json.loads(e.read())
+                        leader = body.get("leader") \
+                            if isinstance(body, dict) else None
+                    except ValueError:
+                        leader = None
+                    if leader and leader != self.master:
+                        self.master = leader
+                        continue
+                raise
+            except OSError as e:
+                last = e
+                if len(self.masters) > 1:
+                    i = self.masters.index(self.master) \
+                        if self.master in self.masters else -1
+                    self.master = self.masters[(i + 1) % len(self.masters)]
+                else:
+                    break
+        raise RuntimeError(f"no reachable master in {self.masters}: {last}")
 
     def _get_json(self, url: str) -> dict:
         with urllib.request.urlopen(f"http://{url}", timeout=self.timeout) as r:
@@ -46,7 +81,7 @@ class WeedClient:
         if ttl:
             params["ttl"] = ttl
         qs = urllib.parse.urlencode(params)
-        r = self._get_json(f"{self.master}/dir/assign?{qs}")
+        r = self._master_json(f"/dir/assign?{qs}")
         if "error" in r:
             raise RuntimeError(f"assign failed: {r['error']}")
         return r
@@ -55,7 +90,7 @@ class WeedClient:
         cached = self._vid_cache.get(vid)
         if cached and time.time() - cached[1] < self.vid_cache_ttl:
             return cached[0]
-        r = self._get_json(f"{self.master}/dir/lookup?volumeId={vid}")
+        r = self._master_json(f"/dir/lookup?volumeId={vid}")
         urls = [l["url"] for l in r.get("locations", [])]
         if urls:
             self._vid_cache[vid] = (urls, time.time())

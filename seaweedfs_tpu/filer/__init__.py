@@ -7,3 +7,6 @@ from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk  # noqa: F401
 from seaweedfs_tpu.filer.filer import Filer, MetaEvent  # noqa: F401
 from seaweedfs_tpu.filer.filerstore import (  # noqa: F401
     FilerStore, MemoryStore, NotFound, SqliteStore, make_store)
+# extra drivers register themselves in STORES on import (the analogue of
+# the reference's blank-import registration, weed/command/imports.go)
+from seaweedfs_tpu.filer import stores_extra  # noqa: F401,E402

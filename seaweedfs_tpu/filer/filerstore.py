@@ -158,6 +158,21 @@ class MemoryStore(FilerStore):
 
     update_entry = insert_entry
 
+    def iter_all_entries(self):
+        """Snapshot iterator over every entry (LogStore compaction)."""
+        with self._lock:
+            blobs = [b for d in self._dirs.values() for b in d.values()]
+        for blob in blobs:
+            yield Entry.decode(blob)
+
+    def iter_kv(self):
+        with self._lock:
+            return list(self._kv.items())
+
+    def count_entries(self) -> int:
+        with self._lock:
+            return sum(len(d) for d in self._dirs.values())
+
     def find_entry(self, full_path: str) -> Entry:
         d, n = split_path(full_path)
         with self._lock:

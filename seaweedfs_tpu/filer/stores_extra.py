@@ -1,0 +1,243 @@
+"""Additional filer store drivers.
+
+Reference: the 20+ one-directory-per-backend stores under weed/filer/
+(leveldb2/, redis2/, mysql2/, cassandra/, ...), registered by blank
+import and chosen by the enabled block in filer.toml.  This module adds:
+
+  - LogStore: an embedded log-structured store (LevelDB-class role:
+    single-writer local persistence with an in-memory index, JSONL WAL +
+    snapshot compaction) — no external dependency.
+  - RedisStore: registered only when the `redis` client package is
+    importable (like the reference's build-tag-gated drivers).
+
+Every driver implements the same 8-method FilerStore SPI
+(weed/filer/filerstore.go:21-45)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filerstore import (STORES, FilerStore, NotFound,
+                                            MemoryStore)
+
+
+class LogStore(FilerStore):
+    """In-memory maps + append-only JSONL WAL, snapshot-compacted when the
+    WAL outgrows the live set (the LSM idea at its smallest)."""
+
+    name = "logstore"
+    COMPACT_RATIO = 4  # compact when wal lines > live entries * ratio
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._mem = MemoryStore()
+        self._lock = threading.Lock()
+        self.wal_path = os.path.join(directory, "wal.jsonl")
+        self.snap_path = os.path.join(directory, "snapshot.jsonl")
+        self._wal_lines = 0
+        self._replay()
+        self._wal = open(self.wal_path, "a", encoding="utf-8")
+
+    # -- persistence ----------------------------------------------------
+
+    def _replay(self) -> None:
+        for path in (self.snap_path, self.wal_path):
+            if not os.path.exists(path):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail
+                    self._apply(rec)
+                    if path == self.wal_path:
+                        self._wal_lines += 1
+
+    def _apply(self, rec: dict) -> None:
+        op = rec.get("op")
+        try:
+            if op == "put":
+                self._mem.insert_entry(Entry.from_dict(rec["entry"]))
+            elif op == "del":
+                self._mem.delete_entry(rec["path"])
+            elif op == "delkids":
+                self._mem.delete_folder_children(rec["path"])
+            elif op == "kvput":
+                self._mem.kv_put(bytes.fromhex(rec["k"]),
+                                 bytes.fromhex(rec["v"]))
+            elif op == "kvdel":
+                self._mem.kv_delete(bytes.fromhex(rec["k"]))
+        except NotFound:
+            pass
+
+    def _log(self, rec: dict) -> None:
+        self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal.flush()
+        self._wal_lines += 1
+        if self._wal_lines > self.COMPACT_RATIO * max(
+                64, self._mem.count_entries()):
+            self._compact()
+
+    def _compact(self) -> None:
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for e in self._mem.iter_all_entries():
+                f.write(json.dumps({"op": "put", "entry": e.to_dict()},
+                                   separators=(",", ":")) + "\n")
+            for k, v in self._mem.iter_kv():
+                f.write(json.dumps({"op": "kvput", "k": k.hex(),
+                                    "v": v.hex()},
+                                   separators=(",", ":")) + "\n")
+        os.replace(tmp, self.snap_path)
+        self._wal.close()
+        with open(self.wal_path, "w"):
+            pass
+        self._wal = open(self.wal_path, "a", encoding="utf-8")
+        self._wal_lines = 0
+
+    # -- SPI ------------------------------------------------------------
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._mem.insert_entry(entry)
+            self._log({"op": "put", "entry": entry.to_dict()})
+
+    def update_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._mem.update_entry(entry)
+            self._log({"op": "put", "entry": entry.to_dict()})
+
+    def find_entry(self, full_path: str) -> Entry:
+        with self._lock:
+            return self._mem.find_entry(full_path)
+
+    def delete_entry(self, full_path: str) -> None:
+        with self._lock:
+            self._mem.delete_entry(full_path)
+            self._log({"op": "del", "path": full_path})
+
+    def delete_folder_children(self, full_path: str) -> None:
+        with self._lock:
+            self._mem.delete_folder_children(full_path)
+            self._log({"op": "delkids", "path": full_path})
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        with self._lock:
+            return self._mem.list_directory_entries(
+                dir_path, start_from, include_start, limit, prefix)
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._mem.kv_put(key, value)
+            self._log({"op": "kvput", "k": key.hex(), "v": value.hex()})
+
+    def kv_get(self, key: bytes) -> bytes:
+        with self._lock:
+            return self._mem.kv_get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        with self._lock:
+            self._mem.kv_delete(key)
+            self._log({"op": "kvdel", "k": key.hex()})
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._wal.close()
+
+
+STORES["logstore"] = LogStore
+
+
+try:  # pragma: no cover - depends on environment
+    import redis as _redis  # noqa: F401
+
+    class RedisStore(FilerStore):
+        """Entries + directory sets in Redis (reference: weed/filer/redis2).
+        Key layout: 'e:<path>' -> entry json; 'd:<dir>' -> sorted-set of
+        child names; 'kv:<key>' -> bytes."""
+
+        name = "redis"
+
+        def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                     db: int = 0, password: str | None = None):
+            self.r = _redis.Redis(host=host, port=port, db=db,
+                                  password=password)
+
+        def insert_entry(self, entry: Entry) -> None:
+            self.r.set(b"e:" + entry.full_path.encode(),
+                       json.dumps(entry.to_dict()).encode())
+            if entry.full_path != "/":
+                d = entry.full_path.rsplit("/", 1)[0] or "/"
+                self.r.zadd(b"d:" + d.encode(), {entry.name.encode(): 0})
+
+        update_entry = insert_entry
+
+        def find_entry(self, full_path: str) -> Entry:
+            raw = self.r.get(b"e:" + full_path.encode())
+            if raw is None:
+                raise NotFound(full_path)
+            return Entry.from_dict(json.loads(raw))
+
+        def delete_entry(self, full_path: str) -> None:
+            self.r.delete(b"e:" + full_path.encode())
+            d = full_path.rsplit("/", 1)[0] or "/"
+            name = full_path.rsplit("/", 1)[-1]
+            self.r.zrem(b"d:" + d.encode(), name.encode())
+
+        def delete_folder_children(self, full_path: str) -> None:
+            for e in self.list_directory_entries(full_path, limit=1 << 30):
+                if e.is_directory:
+                    self.delete_folder_children(e.full_path)
+                self.delete_entry(e.full_path)
+
+        def list_directory_entries(self, dir_path: str, start_from: str = "",
+                                   include_start: bool = False,
+                                   limit: int = 1024,
+                                   prefix: str = "") -> list[Entry]:
+            d = dir_path.rstrip("/") or "/"
+            names = [n.decode() for n in self.r.zrange(
+                b"d:" + d.encode(), 0, -1)]
+            names.sort()
+            out = []
+            for name in names:
+                if prefix and not name.startswith(prefix):
+                    continue
+                if start_from:
+                    if name < start_from or \
+                            (name == start_from and not include_start):
+                        continue
+                try:
+                    out.append(self.find_entry(
+                        dir_path.rstrip("/") + "/" + name))
+                except NotFound:
+                    continue
+                if len(out) >= limit:
+                    break
+            return out
+
+        def kv_put(self, key: bytes, value: bytes) -> None:
+            self.r.set(b"kv:" + key, value)
+
+        def kv_get(self, key: bytes) -> bytes:
+            raw = self.r.get(b"kv:" + key)
+            if raw is None:
+                raise NotFound(key.decode(errors="replace"))
+            return raw
+
+        def kv_delete(self, key: bytes) -> None:
+            self.r.delete(b"kv:" + key)
+
+    STORES["redis"] = RedisStore
+except ImportError:
+    pass

@@ -60,7 +60,7 @@ class FilerServer:
                  jwt_signer=None, security=None, notification=None,
                  encrypt_data: bool = False,
                  chunk_cache_mem: int = 32 * 1024 * 1024,
-                 chunk_cache_disk: int = 0):
+                 chunk_cache_disk: int = 0, store_kind: str | None = None):
         self.master_url = master_url
         self.host, self.port = host, port
         self.collection = collection
@@ -75,8 +75,24 @@ class FilerServer:
         if data_dir:
             import os
             os.makedirs(data_dir, exist_ok=True)
-            store = SqliteStore(os.path.join(data_dir, "filer.db"))
+            if store_kind and store_kind not in ("sqlite",):
+                from seaweedfs_tpu.filer.filerstore import make_store
+                if store_kind == "logstore":
+                    store = make_store("logstore",
+                                       directory=os.path.join(
+                                           data_dir, "logstore"))
+                else:
+                    store = make_store(store_kind)
+            else:
+                store = SqliteStore(os.path.join(data_dir, "filer.db"))
             meta_log_path = os.path.join(data_dir, "meta_events.jsonl")
+        elif store_kind and store_kind != "memory":
+            if store_kind in ("logstore", "sqlite"):
+                raise ValueError(
+                    f"filer store {store_kind!r} needs -dir for its files")
+            from seaweedfs_tpu.filer.filerstore import make_store
+            store = make_store(store_kind)
+            meta_log_path = None
         else:
             store = MemoryStore()
             meta_log_path = None
@@ -94,6 +110,7 @@ class FilerServer:
             web.get("/__admin__/filer_conf", self.handle_get_conf),
             web.post("/__admin__/filer_conf", self.handle_put_conf),
             web.get("/__admin__/status", self.handle_status),
+            web.get("/__ui__", self.handle_ui),
             web.get("/metrics", self.handle_metrics),
             web.route("*", "/{path:.*}", self.handle_path),
         ])
@@ -242,8 +259,11 @@ class FilerServer:
                          cipher_key=cipher_key, is_compressed=is_compressed)
 
     async def _fetch_chunk(self, fid: str) -> bytes:
-        # disk tiers do blocking IO; keep it off the event loop
-        cached = await asyncio.to_thread(self.chunk_cache.get, fid)
+        # disk tiers do blocking IO; mem-only lookups stay inline
+        if self.chunk_cache.tiers:
+            cached = await asyncio.to_thread(self.chunk_cache.get, fid)
+        else:
+            cached = self.chunk_cache.get(fid)
         if cached is not None:
             return cached
         vid = fid.partition(",")[0]
@@ -263,8 +283,11 @@ class FilerServer:
                                              headers=headers) as r:
                     if r.status == 200:
                         blob = await r.read()
-                        await asyncio.to_thread(self.chunk_cache.put,
-                                                fid, blob)
+                        if self.chunk_cache.tiers:
+                            await asyncio.to_thread(self.chunk_cache.put,
+                                                    fid, blob)
+                        else:
+                            self.chunk_cache.put(fid, blob)
                         return blob
                     last = f"HTTP {r.status}"
             except aiohttp.ClientError as e:
@@ -672,6 +695,18 @@ class FilerServer:
                 if k in PathConf.__dataclass_fields__}))
         save_filer_conf(self.filer.store, self.conf)
         return web.json_response({"ok": True})
+
+    async def handle_ui(self, req: web.Request) -> web.Response:
+        """Status page (reference: weed/server/filer_ui/)."""
+        from seaweedfs_tpu.server import ui
+        return web.Response(text=ui.render(
+            f"weedtpu filer {self.url}",
+            {"master": self.master_url,
+             "store": self.filer.store.actual.name,
+             "counters": dict(self.filer.store.counters),
+             "chunk_cache": {"hits": self.chunk_cache.hits,
+                             "misses": self.chunk_cache.misses}}),
+            content_type="text/html")
 
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response({

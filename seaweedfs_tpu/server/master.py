@@ -89,6 +89,7 @@ class MasterServer:
             web.post("/raft/request_vote", self.handle_raft_vote),
             web.post("/raft/append_entries", self.handle_raft_append),
             web.get("/metrics", self.handle_metrics),
+            web.get("/", self.handle_ui),
         ])
         # non-volume-server cluster members (filers, brokers, gateways):
         # type -> {address: last_seen} (reference: weed/cluster/cluster.go)
@@ -248,6 +249,17 @@ class MasterServer:
                 and not self.guard.is_allowed(req.remote):
             return web.json_response({"error": "forbidden"}, status=403)
         return await handler(req)
+
+    async def handle_ui(self, req: web.Request) -> web.Response:
+        """Status page (reference: weed/server/master_ui/)."""
+        from seaweedfs_tpu.server import ui
+        return web.Response(text=ui.render(
+            f"weedtpu master {self.url}",
+            {"leader": self.leader_url, "is_leader": self.is_leader,
+             "topology": self.topo.to_dict(),
+             "cluster_members": {k: sorted(v) for k, v in
+                                 self.cluster_members.items()}}),
+            content_type="text/html")
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
         return web.Response(text=metrics.REGISTRY.render(),

@@ -1,0 +1,26 @@
+"""Minimal server status UIs (reference: weed/server/master_ui/,
+volume_server_ui/, filer_ui/ — templated HTML status pages)."""
+
+from __future__ import annotations
+
+import html
+import json
+
+
+def render(title: str, sections: dict[str, object]) -> str:
+    parts = [
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>",
+        f"<title>{html.escape(title)}</title>",
+        "<style>body{font-family:monospace;margin:2em;background:#fafafa}"
+        "h1{font-size:1.2em}h2{font-size:1em;margin-top:1.5em}"
+        "pre{background:#fff;border:1px solid #ddd;padding:1em;"
+        "overflow:auto}</style></head><body>",
+        f"<h1>{html.escape(title)}</h1>",
+    ]
+    for name, value in sections.items():
+        parts.append(f"<h2>{html.escape(name)}</h2>")
+        body = value if isinstance(value, str) else json.dumps(
+            value, indent=1, default=str)
+        parts.append(f"<pre>{html.escape(body)}</pre>")
+    parts.append("</body></html>")
+    return "".join(parts)

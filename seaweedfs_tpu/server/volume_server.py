@@ -57,6 +57,7 @@ class VolumeServer:
 
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
         self.app.add_routes([
+            web.get("/", self.handle_ui),
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
             web.post("/admin/assign_volume", self.handle_assign_volume),
@@ -384,6 +385,15 @@ class VolumeServer:
 
     # -- admin: volumes --------------------------------------------------
 
+    async def handle_ui(self, req: web.Request) -> web.Response:
+        """Status page (reference: weed/server/volume_server_ui/)."""
+        from seaweedfs_tpu.server import ui
+        return web.Response(text=ui.render(
+            f"weedtpu volume server {self.url}",
+            {"master": self.master_url,
+             "heartbeat": self.store.collect_heartbeat()}),
+            content_type="text/html")
+
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response(self.store.collect_heartbeat())
 
@@ -639,6 +649,14 @@ class VolumeServer:
         weed/server/volume_grpc_query.go, weed/query/json).  Body:
         {volume, filter: {field, op, value}?, projections: [fields]?,
         limit?} -> NDJSON of matching (projected) documents."""
+        # same read-auth bar as GET /{fid}: a configured read key gates
+        # bulk content export too
+        if self.security is not None and self.security.volume_read:
+            token = sjwt.token_from_request(req.headers, req.query)
+            try:
+                sjwt.decode_jwt(self.security.volume_read, token)
+            except sjwt.JwtError as e:
+                return web.json_response({"error": str(e)}, status=401)
         import json as _json
         body = await req.json()
         vid = body["volume"]

@@ -208,8 +208,13 @@ class Volume:
         n = self._read_at(loc[0], loc[1])
         if cookie is not None and n.cookie != cookie:
             raise PermissionError("cookie mismatch")
-        if n.ttl and self.super_block.ttl and bool(n.ttl):
-            pass  # expiry enforced at the store level
+        # TTL enforcement on read (reference: the volume server's read
+        # handler rejects needles past volume TTL; whole expired TTL
+        # volumes are reaped by the master scan)
+        ttl = self.super_block.ttl
+        if ttl and ttl.minutes > 0 and n.last_modified:
+            if n.last_modified + ttl.minutes * 60 < time.time():
+                raise KeyError(f"needle {needle_id:x} expired")
         return n
 
     def has_needle(self, needle_id: int) -> bool:

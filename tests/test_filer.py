@@ -73,10 +73,15 @@ def test_equal_mtime_later_append_wins():
 
 # ---------------------------------------------------------------- stores
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "logstore"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryStore()
+    elif request.param == "logstore":
+        from seaweedfs_tpu.filer.stores_extra import LogStore
+        s = LogStore(str(tmp_path / "logstore"))
+        yield s
+        s.shutdown()
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
         yield s
@@ -311,3 +316,32 @@ def test_delete_ignore_recursive_error(filer):
     filer.create_entry(_entry("/ig/a.txt"))
     filer.delete_entry("/ig", recursive=False, ignore_recursive_error=True)
     assert not filer.exists("/ig")
+
+
+def test_logstore_persistence_and_compaction(tmp_path):
+    """LogStore WAL replay + snapshot compaction (reference role: the
+    embedded leveldb-class store)."""
+    from seaweedfs_tpu.filer.stores_extra import LogStore
+    d = str(tmp_path / "ls")
+    s = LogStore(d)
+    for i in range(10):
+        s.insert_entry(_entry(f"/docs/f{i}.txt", size=i))
+    s.delete_entry("/docs/f0.txt")
+    s.kv_put(b"offset", b"\x01\x02")
+    s.shutdown()
+    # replay from disk
+    s2 = LogStore(d)
+    assert s2.find_entry("/docs/f5.txt").attr.file_size == 5
+    with pytest.raises(NotFound):
+        s2.find_entry("/docs/f0.txt")
+    assert s2.kv_get(b"offset") == b"\x01\x02"
+    # force compaction: lots of overwrites of one entry
+    s2.COMPACT_RATIO = 1
+    for _ in range(200):
+        s2.update_entry(_entry("/docs/f1.txt", size=99))
+    assert s2._wal_lines < 200  # compaction reset the WAL
+    s2.shutdown()
+    s3 = LogStore(d)
+    assert s3.find_entry("/docs/f1.txt").attr.file_size == 99
+    assert len(s3.list_directory_entries("/docs")) == 9
+    s3.shutdown()
