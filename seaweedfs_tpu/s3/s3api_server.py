@@ -18,7 +18,10 @@ then deletes part entries with skipChunkDeletion.
 from __future__ import annotations
 
 import asyncio
+import base64
+import calendar
 import hashlib
+import hmac
 import json
 import logging
 import time
@@ -77,10 +80,13 @@ def _error_response(code: str, message: str, status: int,
 class S3ApiServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
                  port: int = 8333, iam: IdentityAccessManagement | None = None,
-                 buckets_dir: str = BUCKETS_DIR, security=None):
+                 buckets_dir: str = BUCKETS_DIR, security=None,
+                 breaker=None):
         self.filer_url = filer_url
         self.host, self.port = host, port
         self.iam = iam or IdentityAccessManagement()
+        from seaweedfs_tpu.s3.circuit_breaker import CircuitBreaker
+        self.breaker = breaker or CircuitBreaker()
         self.buckets_dir = buckets_dir.rstrip("/")
         self.security = security
         self.app = web.Application(client_max_size=5 * 1024 * 1024 * 1024)
@@ -200,6 +206,28 @@ class S3ApiServer:
         bucket, _, key = path.lstrip("/").partition("/")
         q = {k: req.query.get(k, "") for k in req.query}
 
+        # circuit breaker (reference: s3api_circuit_breaker.go): shed load
+        # with 503 SlowDown before doing any work
+        upload_hint = req.content_length or 0 \
+            if req.method in ("PUT", "POST") else 0
+        if not self.breaker.acquire(bucket, upload_hint):
+            return _error_response(
+                "SlowDown", "Please reduce your request rate.", 503, path)
+        try:
+            return await self._dispatch_inner(req, raw_path, path, bucket,
+                                              key, q)
+        finally:
+            self.breaker.release(bucket, upload_hint)
+
+    async def _dispatch_inner(self, req, raw_path, path, bucket, key,
+                              q) -> web.StreamResponse:
+        # browser form upload: the POST policy in the form IS the auth
+        # (reference: s3api_object_handlers_postpolicy.go)
+        if req.method == "POST" and bucket and not key and \
+                req.headers.get("Content-Type", "").startswith(
+                    "multipart/form-data"):
+            return await self.post_policy_upload(req, bucket)
+
         # Authenticate BEFORE buffering the payload so an unauthenticated
         # client cannot make the gateway hold a multi-GB body in RAM.
         try:
@@ -257,6 +285,142 @@ class S3ApiServer:
             _el(b, "Name", name)
             _el(b, "CreationDate", _iso(e.get("Crtime", 0)))
         return web.Response(body=_xml(root), content_type="application/xml")
+
+    def _check_post_policy(self, fields: dict, bucket: str,
+                           key: str) -> tuple[int, int]:
+        """Verify the POST policy signature, expiration, and conditions
+        BEFORE any file bytes are buffered.  Returns the allowed
+        (min, max) content-length range (max<0 = unlimited).  Raises
+        AuthError on any failure."""
+        policy_b64 = fields.get("policy", "")
+        sig = fields.get("x-amz-signature", "")
+        cred = fields.get("x-amz-credential", "")
+        if not (policy_b64 and sig and cred):
+            raise AuthError("AccessDenied", "missing policy signature")
+        try:
+            access_key, datestamp, region, service = cred.split("/")[:4]
+            ident, c = self.iam.lookup(access_key)
+            skey = IdentityAccessManagement._sig_key(
+                c.secret_key, datestamp, region, service)
+            want = hmac.new(skey, policy_b64.encode(),
+                            hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, sig):
+                raise AuthError("SignatureDoesNotMatch",
+                                "post policy signature mismatch")
+            policy = json.loads(base64.b64decode(policy_b64))
+            expiration = policy.get("expiration", "")
+            if not expiration:
+                # AWS rejects never-expiring policies; a leaked signed
+                # policy must not grant writes forever
+                raise AuthError("AccessDenied", "policy has no expiration")
+            exp = calendar.timegm(time.strptime(
+                expiration.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S"))
+            if time.time() > exp:
+                raise AuthError("AccessDenied", "policy expired")
+            # enforce the signed conditions (policy/post-policy.go): the
+            # narrowly-scoped policy must not authorize other buckets/keys
+            length_min, length_max = 0, -1
+            for cond in policy.get("conditions", []):
+                if isinstance(cond, dict):
+                    for f, want_v in cond.items():
+                        f = f.lstrip("$").lower()
+                        got = {"bucket": bucket, "key": key}.get(
+                            f, fields.get(f, ""))
+                        if got != str(want_v):
+                            raise AuthError(
+                                "AccessDenied",
+                                f"policy condition failed: {f}")
+                elif isinstance(cond, list) and len(cond) == 3:
+                    op, f, want_v = cond[0], str(cond[1]), cond[2]
+                    op = str(op).lower()
+                    if op == "content-length-range":
+                        length_min, length_max = int(cond[1]), int(cond[2])
+                        continue
+                    f = f.lstrip("$").lower()
+                    got = {"bucket": bucket, "key": key}.get(
+                        f, fields.get(f, ""))
+                    if op == "eq" and got != str(want_v):
+                        raise AuthError("AccessDenied",
+                                        f"policy condition failed: {f}")
+                    if op == "starts-with" and \
+                            not got.startswith(str(want_v)):
+                        raise AuthError("AccessDenied",
+                                        f"policy condition failed: {f}")
+            if not ident.can_do(ACTION_WRITE, bucket):
+                raise AuthError("AccessDenied", "Access Denied")
+            return length_min, length_max
+        except AuthError:
+            raise
+        except (ValueError, IndexError, KeyError, TypeError):
+            raise AuthError("InvalidPolicyDocument", "cannot parse policy",
+                            400)
+
+    async def post_policy_upload(self, req, bucket) -> web.Response:
+        """Browser-based form upload with a signed POST policy
+        (reference: s3api_object_handlers_postpolicy.go +
+        policy/post-policy.go).  The form's policy document + signature
+        authenticate the request; ${filename} in the key is substituted.
+        S3 requires the file part last, so the policy is verified from the
+        preceding fields BEFORE any file bytes are buffered."""
+        fields: dict[str, str] = {}
+        file_data: bytes | None = None
+        filename = ""
+        length_max = -1
+        reader = await req.multipart()
+        while True:
+            part = await reader.next()
+            if part is None:
+                break
+            name = (part.name or "").lower()
+            if name == "file":
+                filename = part.filename or ""
+                key = fields.get("key", "").replace("${filename}", filename)
+                if not key:
+                    return _error_response("InvalidArgument",
+                                           "missing key field", 400, bucket)
+                if self.iam.enabled:
+                    try:
+                        _min, length_max = self._check_post_policy(
+                            fields, bucket, key)
+                    except AuthError as e:
+                        return _error_response(e.code, str(e), e.status, key)
+                file_data = await part.read(decode=False)
+                break  # fields after the file part are ignored, per S3
+            fields[name] = (await part.read(decode=False)).decode(
+                errors="replace")
+        if file_data is None:
+            return _error_response("InvalidArgument",
+                                   "POST requires a file field", 400, bucket)
+        key = fields.get("key", "").replace("${filename}", filename)
+        if length_max >= 0 and len(file_data) > length_max:
+            return _error_response("EntityTooLarge",
+                                   "upload exceeds the policy's "
+                                   "content-length-range", 400, key)
+
+        headers = {"Content-Type": fields.get("content-type",
+                                              "application/octet-stream")}
+        for k, v in fields.items():
+            if k.startswith("x-amz-meta-"):
+                headers[f"Seaweed-{k}"] = v
+        st, rbody = await self._filer("PUT", self._fp(bucket, key),
+                                      params={"collection": bucket},
+                                      data=file_data, headers=headers)
+        if st >= 300:
+            return _error_response("InternalError",
+                                   f"filer: {st}", 500, key)
+        try:
+            status = int(fields.get("success_action_status", "204"))
+        except ValueError:
+            status = 204
+        if status not in (200, 201, 204):
+            status = 204
+        if status == 201:
+            root = ET.Element("PostResponse")
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            return web.Response(status=201, body=_xml(root),
+                                content_type="application/xml")
+        return web.Response(status=status)
 
     # -- bucket level --------------------------------------------------
 

@@ -453,3 +453,167 @@ def test_identity_scoped_actions():
     assert not ident.can_do("Write", "public-data")
     admin = Identity("a", [], ["Admin"])
     assert admin.can_do("Write", "anything")
+
+
+class TestPostPolicyAndBreaker:
+    def test_post_policy_upload(self, stack):
+        """Browser form upload with a signed V4 POST policy
+        (reference: s3api_object_handlers_postpolicy.go)."""
+        import base64
+        import hashlib
+        import hmac as hmac_mod
+        import json as json_mod
+
+        stack.req("PUT", "/form-bucket")
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        datestamp = amz_date[:8]
+        cred = f"{CRED.access_key}/{datestamp}/us-east-1/s3/aws4_request"
+        policy = {
+            "expiration": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime(time.time() + 3600)),
+            "conditions": [{"bucket": "form-bucket"},
+                           ["starts-with", "$key", ""]],
+        }
+        policy_b64 = base64.b64encode(
+            json_mod.dumps(policy).encode()).decode()
+        skey = IdentityAccessManagement._sig_key(
+            CRED.secret_key, datestamp, "us-east-1", "s3")
+        sig = hmac_mod.new(skey, policy_b64.encode(),
+                           hashlib.sha256).hexdigest()
+
+        boundary = "----weedform"
+        parts = []
+        for name, value in [
+                ("key", "uploads/${filename}"),
+                ("policy", policy_b64),
+                ("x-amz-credential", cred),
+                ("x-amz-date", amz_date),
+                ("x-amz-signature", sig),
+                ("success_action_status", "201")]:
+            parts.append(f"--{boundary}\r\nContent-Disposition: form-data; "
+                         f"name=\"{name}\"\r\n\r\n{value}\r\n".encode())
+        parts.append(
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f"name=\"file\"; filename=\"pic.bin\"\r\n"
+            f"Content-Type: application/octet-stream\r\n\r\n".encode()
+            + b"form-body" + b"\r\n")
+        parts.append(f"--{boundary}--\r\n".encode())
+        body = b"".join(parts)
+        r = urllib.request.Request(
+            f"http://{stack.s3.url}/form-bucket", data=body, method="POST",
+            headers={"Content-Type":
+                     f"multipart/form-data; boundary={boundary}"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            assert resp.status == 201
+            assert b"uploads/pic.bin" in resp.read()
+        st, got, _ = stack.req("GET", "/form-bucket/uploads/pic.bin")
+        assert st == 200 and got == b"form-body"
+
+    def test_post_policy_bad_signature_rejected(self, stack):
+        boundary = "----weedform2"
+        body = (
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f"name=\"key\"\r\n\r\nx.bin\r\n"
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f"name=\"policy\"\r\n\r\neyJ9\r\n"
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f"name=\"x-amz-credential\"\r\n\r\n{CRED.access_key}/20260101/"
+            f"us-east-1/s3/aws4_request\r\n"
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f"name=\"x-amz-signature\"\r\n\r\nbadsig\r\n"
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f"name=\"file\"; filename=\"x\"\r\n\r\nzz\r\n"
+            f"--{boundary}--\r\n").encode()
+        r = urllib.request.Request(
+            f"http://{stack.s3.url}/form-bucket", data=body, method="POST",
+            headers={"Content-Type":
+                     f"multipart/form-data; boundary={boundary}"})
+        try:
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                raise AssertionError(f"accepted: {resp.status}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+
+    def test_circuit_breaker_sheds_load(self):
+        from seaweedfs_tpu.s3.circuit_breaker import CircuitBreaker
+        cb = CircuitBreaker(global_max_requests=2, bucket_max_requests=1)
+        assert cb.acquire("a")
+        assert not cb.acquire("a")  # bucket limit
+        assert cb.acquire("b")
+        assert not cb.acquire("c")  # global limit
+        cb.release("a")
+        assert cb.acquire("c")
+        cb.release("b"); cb.release("c")
+        # upload byte budget
+        cb2 = CircuitBreaker(global_max_upload_bytes=100)
+        assert cb2.acquire("x", 60)
+        assert not cb2.acquire("y", 60)
+        cb2.release("x", 60)
+        assert cb2.acquire("y", 60)
+
+    def test_post_policy_conditions_enforced(self, stack):
+        """A policy scoped to one bucket must not authorize another
+        (reference: policy/post-policy.go condition matching)."""
+        import base64
+        import hashlib
+        import hmac as hmac_mod
+        import json as json_mod
+        stack.req("PUT", "/scoped-bucket")
+        stack.req("PUT", "/other-bucket")
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        datestamp = amz_date[:8]
+        cred = f"{CRED.access_key}/{datestamp}/us-east-1/s3/aws4_request"
+        policy = {"expiration": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + 3600)),
+            "conditions": [{"bucket": "scoped-bucket"},
+                           ["starts-with", "$key", "up/"],
+                           ["content-length-range", 0, 4]]}
+        policy_b64 = base64.b64encode(
+            json_mod.dumps(policy).encode()).decode()
+        skey = IdentityAccessManagement._sig_key(
+            CRED.secret_key, datestamp, "us-east-1", "s3")
+        sig = hmac_mod.new(skey, policy_b64.encode(),
+                           hashlib.sha256).hexdigest()
+
+        def form(bucket, key, content=b"ab"):
+            b = "----cond"
+            parts = []
+            for n, v in [("key", key), ("policy", policy_b64),
+                         ("x-amz-credential", cred),
+                         ("x-amz-date", amz_date),
+                         ("x-amz-signature", sig)]:
+                parts.append(
+                    f"--{b}\r\nContent-Disposition: form-data; "
+                    f"name=\"{n}\"\r\n\r\n{v}\r\n".encode())
+            parts.append(f"--{b}\r\nContent-Disposition: form-data; "
+                         f"name=\"file\"; filename=\"f\"\r\n\r\n".encode()
+                         + content + b"\r\n")
+            parts.append(f"--{b}--\r\n".encode())
+            r = urllib.request.Request(
+                f"http://{stack.s3.url}/{bucket}", data=b"".join(parts),
+                method="POST",
+                headers={"Content-Type":
+                         f"multipart/form-data; boundary={b}"})
+            try:
+                with urllib.request.urlopen(r, timeout=30) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+        assert form("scoped-bucket", "up/ok.bin") == 204
+        # replay against another bucket -> bucket condition fails
+        assert form("other-bucket", "up/x.bin") == 403
+        # key outside the starts-with scope
+        assert form("scoped-bucket", "elsewhere/x.bin") == 403
+        # over the content-length-range
+        assert form("scoped-bucket", "up/big.bin", b"12345") == 400
+        # missing expiration policy rejected
+        pol2 = base64.b64encode(json_mod.dumps(
+            {"conditions": []}).encode()).decode()
+        sig2 = hmac_mod.new(skey, pol2.encode(),
+                            hashlib.sha256).hexdigest()
+        policy_b64_save, sig_save = policy_b64, sig
+        try:
+            policy_b64, sig = pol2, sig2
+            assert form("scoped-bucket", "up/z.bin") == 403
+        finally:
+            policy_b64, sig = policy_b64_save, sig_save
