@@ -83,6 +83,9 @@ def main(argv=None) -> int:
     pf.add_argument("-collection", default="")
     pf.add_argument("-defaultReplication", default="")
     pf.add_argument("-maxMB", type=int, default=4)
+    pf.add_argument("-peers", dest="filerPeers", action="store_true",
+                    help="aggregate meta events from peer filers into this "
+                         "filer's subscribe feed (meta_aggregator.go)")
     pf.add_argument("-store", default=None,
                     help="filer store driver (memory|sqlite|logstore|redis; "
                          "default sqlite with -dir, memory without)")
@@ -170,6 +173,12 @@ def main(argv=None) -> int:
     pmq.add_argument("-port", type=int, default=17777)
     pmq.add_argument("-master", default="127.0.0.1:9333")
 
+    pft = sub.add_parser("ftp",
+                         help="FTP gateway (stub, like the reference's weed/ftpd)")
+    pft.add_argument("-ip", default="127.0.0.1")
+    pft.add_argument("-port", type=int, default=8021)
+    pft.add_argument("-filer", default="127.0.0.1:8888")
+
     pmt = sub.add_parser("mount",
                          help="FUSE-mount a filer path (weed/command/mount_std.go)")
     pmt.add_argument("-filer", default="127.0.0.1:8888")
@@ -183,7 +192,7 @@ def main(argv=None) -> int:
                               "notification", "shell"])
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc, pwd, pmq, pmt):
+              psy, psc, pwd, pmq, pmt, pft):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -230,6 +239,15 @@ def main(argv=None) -> int:
         return asyncio.run(_run_webdav(args))
     if args.cmd == "mq.broker":
         return asyncio.run(_run_mq_broker(args))
+    if args.cmd == "ftp":
+        from seaweedfs_tpu.ftpd import FtpServer, FtpServerOption
+        try:
+            asyncio.run(FtpServer(FtpServerOption(
+                args.filer, args.ip, args.port)).start())
+        except NotImplementedError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        return 0
     if args.cmd == "mount":
         from seaweedfs_tpu.mount.weedfs import mount
         try:
@@ -286,7 +304,8 @@ async def _run_filer(args) -> int:
                     chunk_size=args.maxMB << 20, security=_security(args),
                     encrypt_data=args.encryptVolumeData,
                     chunk_cache_disk=args.cacheCapacityMB << 20,
-                    notification=notification, store_kind=args.store)
+                    notification=notification, store_kind=args.store,
+                    aggregate_peers=args.filerPeers)
     await f.start()
     await _serve_forever()
     await f.stop()

@@ -208,8 +208,14 @@ class S3ApiServer:
 
         # circuit breaker (reference: s3api_circuit_breaker.go): shed load
         # with 503 SlowDown before doing any work
-        upload_hint = req.content_length or 0 \
-            if req.method in ("PUT", "POST") else 0
+        if req.method in ("PUT", "POST"):
+            upload_hint = req.content_length or 0
+            if not upload_hint and self.breaker.global_max_upload_bytes:
+                # chunked transfer hides the size; reserve a conservative
+                # slice so the byte budget still bounds memory
+                upload_hint = 64 * 1024 * 1024
+        else:
+            upload_hint = 0
         if not self.breaker.acquire(bucket, upload_hint):
             return _error_response(
                 "SlowDown", "Please reduce your request rate.", 503, path)
@@ -365,7 +371,7 @@ class S3ApiServer:
         fields: dict[str, str] = {}
         file_data: bytes | None = None
         filename = ""
-        length_max = -1
+        length_min, length_max = 0, -1
         reader = await req.multipart()
         while True:
             part = await reader.next()
@@ -380,7 +386,7 @@ class S3ApiServer:
                                            "missing key field", 400, bucket)
                 if self.iam.enabled:
                     try:
-                        _min, length_max = self._check_post_policy(
+                        length_min, length_max = self._check_post_policy(
                             fields, bucket, key)
                     except AuthError as e:
                         return _error_response(e.code, str(e), e.status, key)
@@ -395,6 +401,10 @@ class S3ApiServer:
         if length_max >= 0 and len(file_data) > length_max:
             return _error_response("EntityTooLarge",
                                    "upload exceeds the policy's "
+                                   "content-length-range", 400, key)
+        if length_min > 0 and len(file_data) < length_min:
+            return _error_response("EntityTooSmall",
+                                   "upload is under the policy's "
                                    "content-length-range", 400, key)
 
         headers = {"Content-Type": fields.get("content-type",

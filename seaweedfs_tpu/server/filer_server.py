@@ -60,7 +60,8 @@ class FilerServer:
                  jwt_signer=None, security=None, notification=None,
                  encrypt_data: bool = False,
                  chunk_cache_mem: int = 32 * 1024 * 1024,
-                 chunk_cache_disk: int = 0, store_kind: str | None = None):
+                 chunk_cache_disk: int = 0, store_kind: str | None = None,
+                 aggregate_peers: bool = False):
         self.master_url = master_url
         self.host, self.port = host, port
         self.collection = collection
@@ -126,9 +127,15 @@ class FilerServer:
         self.chunk_cache = ChunkCache(mem_limit=chunk_cache_mem,
                                       disk_dir=cache_dir,
                                       disk_limit=chunk_cache_disk)
+        # peer meta aggregation (reference: weed/filer/meta_aggregator.go)
+        self.aggregate_peers = aggregate_peers
+        self._peer_tasks: dict[str, asyncio.Task] = {}
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._subscribers: set[asyncio.Queue] = set()
+        # aggregator peers subscribe local-only so relayed events don't
+        # fan back out (A->B->C duplication in 3+ filer clusters)
+        self._local_subscribers: set[asyncio.Queue] = set()
         self._loop: asyncio.AbstractEventLoop | None = None
 
     def _notify_queue(self, ev) -> None:
@@ -170,13 +177,97 @@ class FilerServer:
                         f"http://{self.master_url}/cluster/register",
                         json={"type": "filer", "address": self.url}):
                     pass
-            except aiohttp.ClientError:
-                pass
+                if self.aggregate_peers:
+                    await self._refresh_peer_aggregators()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the registration loop must survive anything (a dead
+                # master, truncated JSON, timeouts) or the filer silently
+                # drops out of the cluster until restart
+                log.warning("register/aggregate refresh failed",
+                            exc_info=True)
             await asyncio.sleep(10)
+
+    # -- meta aggregator (reference: weed/filer/meta_aggregator.go) ------
+
+    async def _refresh_peer_aggregators(self) -> None:
+        """Discover peer filers via the master and keep one subscription
+        per peer feeding this filer's live event stream, so subscribers of
+        THIS filer see a cluster-wide merged change feed."""
+        async with self._session.get(
+                f"http://{self.master_url}/cluster/status") as r:
+            members = (await r.json()).get("Members", {})
+        peers = [f for f in members.get("filer", []) if f != self.url]
+        for peer in peers:
+            if peer not in self._peer_tasks or self._peer_tasks[peer].done():
+                self._peer_tasks[peer] = asyncio.create_task(
+                    self._aggregate_from_peer(peer))
+        for peer, task in list(self._peer_tasks.items()):
+            if peer not in peers:
+                task.cancel()
+                del self._peer_tasks[peer]
+
+    async def _aggregate_from_peer(self, peer: str) -> None:
+        """Subscribe to one peer's local events and re-publish them into
+        this filer's subscriber queues (not the local meta log).  Loop
+        prevention mirrors the reference signature scheme: re-published
+        events carry the source peer's signature, and events already
+        stamped with OUR signature are skipped."""
+        from seaweedfs_tpu.replication.filer_sync import filer_signature
+        my_sig = filer_signature(self.url)
+        peer_sig = filer_signature(peer)
+        # resume from the per-peer offset persisted in the local store
+        offset_key = f"meta_aggregator.{peer}".encode()
+        try:
+            since = int(self.filer.store.kv_get(offset_key))
+        except (NotFound, ValueError):
+            since = time.time_ns()
+        log.info("aggregating meta events from peer filer %s", peer)
+        while True:
+            try:
+                async with self._session.get(
+                        f"http://{peer}/__meta__/subscribe",
+                        params={"since": str(since), "live": "true",
+                                "localOnly": "true"},
+                        timeout=aiohttp.ClientTimeout(total=None,
+                                                      sock_read=300)) as r:
+                    last_persist = 0.0
+                    async for raw in r.content:
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        d = json.loads(line)
+                        since = max(since, d.get("ts_ns", since))
+                        sigs = d.get("signatures") or []
+                        if my_sig in sigs:
+                            continue  # originated here; don't echo
+                        if peer_sig not in sigs:
+                            d["signatures"] = sigs + [peer_sig]
+                        payload = json.dumps(d, separators=(",", ":"))
+                        for q in list(self._subscribers):
+                            if q.qsize() < 4096:
+                                q.put_nowait(payload)
+                        now = time.monotonic()
+                        if now - last_persist >= 2.0:
+                            last_persist = now
+                            try:
+                                await asyncio.to_thread(
+                                    self.filer.store.kv_put, offset_key,
+                                    str(since).encode())
+                            except Exception:
+                                pass
+            except asyncio.CancelledError:
+                return
+            except (aiohttp.ClientError, json.JSONDecodeError,
+                    ConnectionError, OSError):
+                await asyncio.sleep(3)
 
     async def stop(self) -> None:
         if getattr(self, "_register_task", None):
             self._register_task.cancel()
+        for task in self._peer_tasks.values():
+            task.cancel()
         self.deletion.stop(drain=False)
         if self._session:
             await self._session.close()
@@ -191,7 +282,7 @@ class FilerServer:
         payload = json.dumps(ev.to_dict(), separators=(",", ":"))
 
         def put():
-            for q in list(self._subscribers):
+            for q in list(self._subscribers) + list(self._local_subscribers):
                 if q.qsize() < 4096:
                     q.put_nowait(payload)
         self._loop.call_soon_threadsafe(put)
@@ -636,12 +727,14 @@ class FilerServer:
         since = int(req.query.get("since", "0"))
         prefix = req.query.get("prefix", "/")
         live = req.query.get("live", "true") == "true"
+        local_only = req.query.get("localOnly") == "true"
         resp = web.StreamResponse()
         resp.content_type = "application/x-ndjson"
         await resp.prepare(req)
         q: asyncio.Queue = asyncio.Queue()
         if live:
-            self._subscribers.add(q)
+            (self._local_subscribers if local_only
+             else self._subscribers).add(q)
         try:
             last_ts = since
             for ev in self.filer.meta_log.replay(since_ts_ns=since,
@@ -674,6 +767,7 @@ class FilerServer:
             pass
         finally:
             self._subscribers.discard(q)
+            self._local_subscribers.discard(q)
         return resp
 
     # -- admin ---------------------------------------------------------

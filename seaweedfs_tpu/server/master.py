@@ -410,6 +410,13 @@ class MasterServer:
         """Allocate `count` new volumes on free nodes (reference:
         volume_growth.go GrowByCountAndType -> AllocateVolume RPCs)."""
         rp = t.ReplicaPlacement.parse(replication)
+        if count <= 0:
+            # reference volume_growth defaults: more copies -> fewer new
+            # volumes per grow (copy_1=7, copy_2=6, copy_3=3, else 1)
+            count = {1: 7, 2: 6, 3: 3}.get(rp.copy_count, 1)
+            # cap by what the cluster can actually host
+            free = sum(n.free_slots for n in self.topo.nodes.values())
+            count = max(1, min(count, free // max(1, rp.copy_count)))
         slots = self.topo.find_empty_slots(rp, count)
         if not slots:
             return 0

@@ -41,7 +41,9 @@ class VolumeServer:
                  host: str = "127.0.0.1", port: int = 8080,
                  public_url: str = "", max_volumes: int = 8,
                  data_center: str = "", rack: str = "",
-                 heartbeat_interval: float = 3.0, security=None):
+                 heartbeat_interval: float = 3.0, security=None,
+                 concurrent_uploads: int = 64,
+                 concurrent_downloads: int = 256):
         self.security = security
         self.host, self.port = host, port
         self.url = f"{host}:{port}"
@@ -79,6 +81,10 @@ class VolumeServer:
             web.post("/admin/query", self.handle_query),
             web.route("*", "/{fid:[^/]*,[^/]+}", self.handle_blob),
         ])
+        # in-flight throttling (reference: volume server
+        # -concurrentUploadLimitMB / inFlightUploadDataLimitCond)
+        self._upload_sem = asyncio.Semaphore(concurrent_uploads)
+        self._download_sem = asyncio.Semaphore(concurrent_downloads)
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._hb_task: asyncio.Task | None = None
@@ -177,8 +183,9 @@ class VolumeServer:
                 return err
         if req.method in ("POST", "PUT"):
             metrics.VOLUME_REQUEST_COUNTER.labels("write").inc()
-            with metrics.VOLUME_REQUEST_HISTOGRAM.labels("write").time():
-                return await self._write_blob(req, fid)
+            async with self._upload_sem:
+                with metrics.VOLUME_REQUEST_HISTOGRAM.labels("write").time():
+                    return await self._write_blob(req, fid)
         if req.method == "GET" or req.method == "HEAD":
             # read JWT, only when a [jwt.signing.read] key is configured
             if self.security is not None and self.security.volume_read:
@@ -189,8 +196,9 @@ class VolumeServer:
                 except sjwt.JwtError as e:
                     return web.json_response({"error": str(e)}, status=401)
             metrics.VOLUME_REQUEST_COUNTER.labels("read").inc()
-            with metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").time():
-                return await self._read_blob(req, fid)
+            async with self._download_sem:
+                with metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").time():
+                    return await self._read_blob(req, fid)
         if req.method == "DELETE":
             metrics.VOLUME_REQUEST_COUNTER.labels("delete").inc()
             return await self._delete_blob(req, fid)

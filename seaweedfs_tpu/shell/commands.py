@@ -247,20 +247,27 @@ def cmd_ec_encode(env: CommandEnv, args, out):
                 {"volume": vid, "collection": collection})
     print(f"generated 14 shards of volume {vid} on {source}", file=out)
 
-    # 3. spread shards over the cluster
+    # 3. spread shards over the cluster; copies fan out in parallel
+    # (reference: command_ec_encode.go:213 parallelCopyEcShardsFromSource)
+    import concurrent.futures
     topo = env.topology()
     nodes = sorted(topo["nodes"])
     alloc = balanced_ec_distribution(nodes)
-    for target, shards in alloc.items():
-        if not shards:
-            continue
+
+    def place(target_shards):
+        target, shards = target_shards
         if target != source:
             env.vs_post(target, "/admin/ec/copy",
                         {"volume": vid, "collection": collection,
                          "source": source, "shards": shards, "copy_ecx": True})
         env.vs_post(target, "/admin/ec/mount",
                     {"volume": vid, "collection": collection})
-        print(f"  shards {shards} -> {target}", file=out)
+        return target, shards
+
+    work = [(t, ss) for t, ss in alloc.items() if ss]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        for target, shards in ex.map(place, work):
+            print(f"  shards {shards} -> {target}", file=out)
     # 4. delete moved shard files from source, and the original volume
     moved = [s for tgt, ss in alloc.items() if tgt != source for s in ss]
     if moved:

@@ -1,0 +1,68 @@
+"""Filer meta aggregator: a filer started with aggregate_peers merges
+peer filers' live events into its own subscribe feed without echo loops
+(reference: weed/filer/meta_aggregator.go)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tests.test_cluster import Cluster, free_port
+
+
+def test_peer_events_merged_into_feed(tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    fa = FilerServer(c.master.url, port=free_port(), aggregate_peers=True)
+    fb = FilerServer(c.master.url, port=free_port(), aggregate_peers=True)
+    c.submit(fa.start())
+    c.submit(fb.start())
+    try:
+        # wait until both aggregators found each other
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if fa._peer_tasks and fb._peer_tasks:
+                break
+            time.sleep(0.2)
+        assert fa._peer_tasks and fb._peer_tasks, "aggregators not wired"
+
+        # subscribe to A's LIVE feed and write through B: the event must
+        # arrive via aggregation
+        got: list[dict] = []
+
+        def consume():
+            url = (f"http://{fa.url}/__meta__/subscribe?"
+                   f"since={time.time_ns()}&live=true")
+            with urllib.request.urlopen(url, timeout=30) as r:
+                for raw in r:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    got.append(ev)
+                    if (ev.get("new_entry") or {}).get("full_path") \
+                            == "/agg/x.txt":
+                        return
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        time.sleep(0.5)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{fb.url}/agg/x.txt", data=b"via-b", method="POST"),
+            timeout=15)
+        th.join(20)
+        assert got, "no aggregated event arrived on A's feed"
+        paths = [(e.get("new_entry") or {}).get("full_path") for e in got]
+        assert "/agg/x.txt" in paths
+        # the aggregated event carries the peer signature for loop safety
+        ev = next(e for e in got
+                  if (e.get("new_entry") or {}).get("full_path")
+                  == "/agg/x.txt")
+        assert ev.get("signatures"), ev
+    finally:
+        c.submit(fa.stop())
+        c.submit(fb.stop())
+        c.stop()
