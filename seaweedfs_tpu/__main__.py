@@ -138,6 +138,12 @@ def main(argv=None) -> int:
     pfx.add_argument("-volumeId", type=int, required=True)
     pfx.add_argument("-collection", default="")
 
+    pcp = sub.add_parser("compact",
+                         help="offline volume vacuum (command/compact.go)")
+    pcp.add_argument("-dir", required=True)
+    pcp.add_argument("-volumeId", type=int, required=True)
+    pcp.add_argument("-collection", default="")
+
     pex = sub.add_parser("export",
                          help="export volume needles to a tar (command/export.go)")
     pex.add_argument("-dir", required=True)
@@ -192,7 +198,7 @@ def main(argv=None) -> int:
                               "notification", "shell"])
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc, pwd, pmq, pmt, pft):
+              psy, psc, pwd, pmq, pmt, pft, pcp):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -223,6 +229,8 @@ def main(argv=None) -> int:
         return _run_download(args)
     if args.cmd == "fix":
         return _run_fix(args)
+    if args.cmd == "compact":
+        return _run_compact(args)
     if args.cmd == "export":
         return _run_export(args)
     if args.cmd == "backup":
@@ -506,6 +514,32 @@ def _run_fix(args) -> int:
         os.replace(idx_path + ".tmp", idx_path)
         print(f"rebuilt {idx_path}: {len(entries)} live entries")
         return 0
+    finally:
+        v.close()
+
+
+def _run_compact(args) -> int:
+    """Offline vacuum of one volume (reference: weed/command/compact.go)."""
+    import os
+
+    from seaweedfs_tpu.storage.volume import Volume
+    name = (f"{args.collection}_{args.volumeId}" if args.collection
+            else str(args.volumeId))
+    if not os.path.exists(os.path.join(args.dir, name + ".dat")):
+        print(f"{name}.dat not found in {args.dir}", file=sys.stderr)
+        return 1
+    v = Volume(args.dir, args.collection, args.volumeId)
+    try:
+        before = v.data_size()
+        ratio = v.garbage_ratio()
+        v.compact()
+        after = v.data_size()
+        print(f"compacted volume {args.volumeId}: {before} -> {after} bytes "
+              f"(garbage was {ratio:.1%})")
+        return 0
+    except PermissionError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     finally:
         v.close()
 

@@ -65,6 +65,8 @@ class VolumeServer:
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/volume/delete", self.handle_volume_delete),
             web.post("/admin/volume/readonly", self.handle_volume_readonly),
+            web.post("/admin/volume/mount", self.handle_volume_mount),
+            web.post("/admin/volume/unmount", self.handle_volume_unmount),
             web.post("/admin/volume/vacuum", self.handle_vacuum),
             web.post("/admin/volume/copy", self.handle_volume_copy),
             web.post("/admin/volume/tier_move", self.handle_tier_move),
@@ -426,6 +428,45 @@ class VolumeServer:
         self.store.delete_volume(body["volume"])
         await self._heartbeat_once()
         return web.json_response({})
+
+    async def handle_volume_unmount(self, req: web.Request) -> web.Response:
+        """Close a volume without deleting its files (reference:
+        VolumeUnmount, volume_grpc_admin.go) — frees the slot; a later
+        mount or restart picks the files back up."""
+        body = await req.json()
+        vid = body["volume"]
+        for loc in self.store.locations:
+            v = loc.volumes.pop(vid, None)
+            if v is not None:
+                await asyncio.to_thread(v.close)
+                await self._heartbeat_once()
+                return web.json_response({})
+        return web.json_response({"error": "volume not found"}, status=404)
+
+    async def handle_volume_mount(self, req: web.Request) -> web.Response:
+        """(Re)open an existing volume's files (reference: VolumeMount)."""
+        body = await req.json()
+        vid = body["volume"]
+        collection = body.get("collection", "")
+        if self.store.get_volume(vid) is not None:
+            return web.json_response({})  # already mounted
+        from seaweedfs_tpu.storage.volume import Volume
+        for loc in self.store.locations:
+            base = loc.base_path(vid, collection)
+            if os.path.exists(base + ".dat") or \
+                    os.path.exists(base + ".tier"):
+                try:
+                    vol = await asyncio.to_thread(
+                        Volume, loc.directory, collection, vid)
+                except Exception as e:
+                    return web.json_response({"error": f"load: {e}"},
+                                             status=500)
+                loc.volumes[vid] = vol
+                loc.collections[vid] = collection
+                await self._heartbeat_once()
+                return web.json_response({})
+        return web.json_response({"error": "volume files not found"},
+                                 status=404)
 
     async def handle_volume_readonly(self, req: web.Request) -> web.Response:
         body = await req.json()

@@ -420,12 +420,18 @@ def cmd_volume_balance(env: CommandEnv, args, out):
         print(f"move volume {vid}: {src} -> {dst}"
               + ("" if apply else " (dry run, -apply to move)"), file=out)
         if apply:
-            env.vs_post(dst, "/admin/volume/copy",
-                        {"volume": vid, "source": src,
-                         "collection": cols.get(vid, "")})
-            env.vs_post(src, "/admin/volume/delete", {"volume": vid})
+            move_volume(env, vid, src, dst, cols.get(vid, ""))
     print(f"volume.balance: {len(moves)} move(s)"
           + ("" if apply else " planned"), file=out)
+
+
+def move_volume(env: "CommandEnv", vid: int, source: str, target: str,
+                collection: str = "") -> None:
+    """Copy-then-delete volume move, the one protocol both volume.move and
+    volume.balance use (reference: command_volume_move.go LiveMoveVolume)."""
+    env.vs_post(target, "/admin/volume/copy",
+                {"volume": vid, "source": source, "collection": collection})
+    env.vs_post(source, "/admin/volume/delete", {"volume": vid})
 
 
 def collect_volume_infos(topo: dict) -> dict[int, dict]:
@@ -759,6 +765,103 @@ def cmd_remote_cache(env: CommandEnv, args, out):
     filer = env.find_filer()
     n = sync_remote_to_filer(remote, filer, mount_dir, cache=True)
     print(f"remote.cache: {n} object(s) cached under {mount_dir}", file=out)
+
+
+@command("volume.move")
+def cmd_volume_move(env: CommandEnv, args, out):
+    """Move one volume between servers: copy to target, delete from
+    source (reference: command_volume_move.go)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    target = flags["target"]
+    locs = env.volume_locations(vid)
+    if not locs:
+        raise RuntimeError(f"volume {vid} not found")
+    source = flags.get("source", locs[0])
+    col = collect_volume_infos(env.topology()).get(vid, {})
+    move_volume(env, vid, source, target, col.get("collection", ""))
+    print(f"moved volume {vid}: {source} -> {target}", file=out)
+
+
+@command("volume.mount")
+def cmd_volume_mount(env: CommandEnv, args, out):
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    node = flags["node"]
+    env.vs_post(node, "/admin/volume/mount",
+                {"volume": vid, "collection": flags.get("collection", "")})
+    print(f"mounted volume {vid} on {node}", file=out)
+
+
+@command("volume.unmount")
+def cmd_volume_unmount(env: CommandEnv, args, out):
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    node = flags.get("node") or env.volume_locations(vid)[0]
+    env.vs_post(node, "/admin/volume/unmount", {"volume": vid})
+    print(f"unmounted volume {vid} on {node}", file=out)
+
+
+@command("fs.tree")
+def cmd_fs_tree(env: CommandEnv, args, out):
+    """Recursive directory tree (reference: command_fs_tree.go)."""
+    path = (args and not args[-1].startswith("-") and args[-1]) or "/"
+    filer = env.find_filer()
+
+    def walk(d, depth):
+        for e in env.filer_list(filer, d):
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            print("  " * depth + ("+" if e.get("IsDirectory") else "-")
+                  + " " + name, file=out)
+            if e.get("IsDirectory"):
+                walk(e["FullPath"], depth + 1)
+    print(path, file=out)
+    walk(path.rstrip("/") or "/", 1)
+
+
+@command("s3.clean.uploads")
+def cmd_s3_clean_uploads(env: CommandEnv, args, out):
+    """Purge abandoned multipart uploads older than -timeAgo (reference:
+    command_s3_clean_uploads.go)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    max_age = _parse_duration(flags.get("timeAgo", "24h"))
+    filer = env.find_filer()
+    import time as _time
+    cutoff = _time.time() - max_age
+    removed = 0
+    for bucket in env.filer_list(filer, "/buckets"):
+        if not bucket.get("IsDirectory"):
+            continue
+        uploads_dir = bucket["FullPath"] + "/.uploads"
+        for up in env.filer_list(filer, uploads_dir):
+            if up.get("Mtime", 0) < cutoff:
+                env.filer_delete(filer, up["FullPath"], recursive=True)
+                removed += 1
+                print(f"removed {up['FullPath']}", file=out)
+    print(f"s3.clean.uploads: {removed} abandoned upload(s) removed",
+          file=out)
+
+
+def _parse_duration(s: str) -> float:
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    if s and s[-1] in units:
+        return float(s[:-1]) * units[s[-1]]
+    return float(s or 0)
+
+
+@command("cluster.ps")
+def cmd_cluster_ps(env: CommandEnv, args, out):
+    """List non-volume cluster processes (reference: command_cluster_ps.go)."""
+    members = env.master_get("/cluster/status").get("Members", {})
+    if not members:
+        print("no registered cluster processes", file=out)
+    for kind, addrs in sorted(members.items()):
+        for a in addrs:
+            print(f"{kind} {a}", file=out)
 
 
 @command("volume.vacuum.all")

@@ -99,3 +99,105 @@ class NeedleMap:
                     nm.deleted_bytes += old[1]
                 nm._m[nid] = (old[0] if old is not None else off, size)
         return nm
+
+
+class SortedFileNeedleMap:
+    """Read-only, low-memory needle map: binary search over a sorted `.sdx`
+    sidecar instead of an in-RAM table (reference:
+    weed/storage/needle_map_sorted_file.go).  Built from the `.idx` log
+    (latest entry wins, tombstones dropped) the first time a volume is
+    opened with needle_map_kind="sorted_file", rebuilt when the .idx is
+    newer than the .sdx.
+
+    Exposes the read-side NeedleMap surface (get/len/items/metrics);
+    put/delete raise — the kind is for sealed volumes, like the reference.
+    """
+
+    ENTRY = t.NEEDLE_MAP_ENTRY_SIZE  # 16 bytes, same layout as .idx
+
+    def __init__(self, sdx_path: str):
+        self.sdx_path = sdx_path
+        self._fd = os.open(sdx_path, os.O_RDONLY)
+        self._size = os.path.getsize(sdx_path)
+        self._n = self._size // self.ENTRY
+        self.file_count = self._n
+        self.deleted_count = 0
+        self.deleted_bytes = 0
+        self.maximum_key = 0
+        if self._n:
+            nid, _, _ = self._entry_at(self._n - 1)
+            self.maximum_key = nid
+
+    @classmethod
+    def build(cls, idx_path: str, sdx_path: str) -> None:
+        """Compact the .idx log into a sorted .sdx (live entries only)."""
+        nm = NeedleMap.load_from_idx(idx_path)
+        entries = sorted((nid, v) for nid, v in nm._m.items()
+                         if t.size_is_valid(v[1]))
+        tmp = sdx_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for nid, (off, size) in entries:
+                f.write(idx.pack_entry(nid, off, size))
+        os.replace(tmp, sdx_path)
+
+    @classmethod
+    def open_for(cls, idx_path: str, sdx_path: str) -> "SortedFileNeedleMap":
+        if not os.path.exists(sdx_path) or (
+                os.path.exists(idx_path) and
+                os.path.getmtime(idx_path) > os.path.getmtime(sdx_path)):
+            cls.build(idx_path, sdx_path)
+        return cls(sdx_path)
+
+    def _entry_at(self, i: int) -> tuple[int, int, int]:
+        # pread: no shared file-position state, safe for concurrent readers
+        return idx.unpack_entry(
+            os.pread(self._fd, self.ENTRY, i * self.ENTRY))
+
+    def get(self, needle_id: int) -> tuple[int, int] | None:
+        lo, hi = 0, self._n - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            nid, off, size = self._entry_at(mid)
+            if nid == needle_id:
+                return (off, size) if t.size_is_valid(size) else None
+            if nid < needle_id:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return None
+
+    def put(self, needle_id: int, offset_units: int, size: int) -> None:
+        raise PermissionError("sorted-file needle map is read-only")
+
+    def delete(self, needle_id: int) -> int:
+        raise PermissionError("sorted-file needle map is read-only")
+
+    def __len__(self) -> int:
+        return self._n
+
+    def items(self) -> Iterator[tuple[int, tuple[int, int]]]:
+        for i in range(self._n):
+            nid, off, size = self._entry_at(i)
+            yield nid, (off, size)
+
+    @property
+    def _m(self) -> dict:
+        # compatibility view for callers that introspect the table
+        # (max_file_key/export); built lazily, sealed volumes are small sets
+        return {nid: v for nid, v in self.items()}
+
+    @property
+    def content_size(self) -> int:
+        return sum(v[1] for _, v in self.items())
+
+    def attach_idx(self, f) -> None:
+        pass  # read-only; nothing to append
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass

@@ -41,7 +41,8 @@ class VolumeInfo:
 class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: str = "000", ttl: str = "",
-                 version: int = t.CURRENT_VERSION, backend: str = "disk"):
+                 version: int = t.CURRENT_VERSION, backend: str = "disk",
+                 needle_map_kind: str = "memory"):
         self.dir = dirname
         self.collection = collection
         self.id = vid
@@ -86,11 +87,22 @@ class Volume:
             self._dat.flush()
         self.version = self.super_block.version
 
-        self.nm = NeedleMap.load_from_idx(self.idx_path)
-        if self.backend_kind != "remote":
-            self.check_and_fix_integrity()
-        self._idx = open(self.idx_path, "ab")
-        self.nm.attach_idx(self._idx)
+        if needle_map_kind == "sorted_file":
+            # low-memory read-only kind (reference:
+            # needle_map_sorted_file.go): binary search in a sorted .sdx;
+            # the .idx is never opened for append (doing so would recreate
+            # a deleted .idx and poison the next .sdx rebuild)
+            from seaweedfs_tpu.storage.needle_map import SortedFileNeedleMap
+            self.nm = SortedFileNeedleMap.open_for(
+                self.idx_path, self._base + ".sdx")
+            self.read_only = True
+            self._idx = None
+        else:
+            self.nm = NeedleMap.load_from_idx(self.idx_path)
+            if self.backend_kind != "remote":
+                self.check_and_fix_integrity()
+            self._idx = open(self.idx_path, "ab")
+            self.nm.attach_idx(self._idx)
 
     # -- geometry ------------------------------------------------------
 
@@ -232,6 +244,9 @@ class Volume:
         """Highest needle id present (heartbeat max_file_key), under the
         volume lock so concurrent writers can't race the scan."""
         with self._lock:
+            mk = getattr(self.nm, "maximum_key", 0)
+            if mk:
+                return mk
             return max(self.nm._m, default=0)
 
     def compact(self) -> None:
@@ -319,7 +334,10 @@ class Volume:
     def close(self) -> None:
         with self._lock:
             self.nm.flush()
-            self._idx.close()
+            if hasattr(self.nm, "close"):
+                self.nm.close()
+            if self._idx is not None:
+                self._idx.close()
             self._dat.close()
 
     # -- scan (export/fix/EC encode feed) ------------------------------

@@ -176,3 +176,67 @@ class TestFsCommands:
         client.upload(b"orphaned blob")
         out = shell(env, "volume.fsck")
         assert "1 orphan(s)" in out
+
+
+def test_volume_move_mount_unmount(cluster3):
+    from seaweedfs_tpu.client import WeedClient
+    c = cluster3
+    client = WeedClient(c.master.url)
+    fid = client.upload(b"movable", name="m.bin")
+    vid = int(fid.split(",")[0])
+    env = CommandEnv(c.master.url)
+    env.acquire_lock()
+    src = env.volume_locations(vid)[0]
+    dst = next(vs.url for vs in c.volume_servers if vs.url != src)
+    out = shell(env, f"volume.move -volumeId {vid} -target {dst}")
+    assert "moved" in out
+    assert wait_for(lambda: env.volume_locations(vid) == [dst])
+    assert client.download(fid) == b"movable"
+    # unmount drops it from the topology, mount brings it back
+    shell(env, f"volume.unmount -volumeId {vid} -node {dst}")
+    assert wait_for(lambda: env.volume_locations(vid) == [])
+    shell(env, f"volume.mount -volumeId {vid} -node {dst}")
+    assert wait_for(lambda: env.volume_locations(vid) == [dst])
+    client._vid_cache.clear()
+    assert client.download(fid) == b"movable"
+
+
+def test_fs_tree_and_cluster_ps(cluster3, tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = cluster3
+    filer = FilerServer(c.master.url, port=free_port())
+    c.submit(filer.start())
+    try:
+        env = CommandEnv(c.master.url)
+        assert wait_for(lambda: bool(
+            env.master_get("/cluster/status").get("Members", {}).get("filer")))
+        import urllib.request
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{filer.url}/t/a/b.txt", data=b"x", method="POST"),
+            timeout=15)
+        out = shell(env, "fs.tree /t")
+        assert "+ a" in out and "- b.txt" in out
+        out = shell(env, "cluster.ps")
+        assert f"filer {filer.url}" in out
+    finally:
+        c.submit(filer.stop())
+
+
+def test_cli_compact(tmp_path):
+    from seaweedfs_tpu.__main__ import main as cli
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    import os
+    v = Volume(str(tmp_path), "", 9)
+    for i in range(1, 6):
+        v.append_needle(Needle(id=i, cookie=i, data=b"z" * 2000))
+    for i in range(1, 5):
+        v.delete_needle(i, i)
+    v.close()
+    before = os.path.getsize(tmp_path / "9.dat")
+    assert cli(["compact", "-dir", str(tmp_path), "-volumeId", "9"]) == 0
+    after = os.path.getsize(tmp_path / "9.dat")
+    assert after < before
+    v2 = Volume(str(tmp_path), "", 9)
+    assert v2.read_needle(5).data == b"z" * 2000
+    v2.close()

@@ -278,3 +278,35 @@ def test_reference_volume_43_scan(tmp_path):
         seen += 1
     assert seen > 0
     vol.close()
+
+
+def test_sorted_file_needle_map(tmp_path):
+    """Low-memory sorted-file needle map kind (reference:
+    needle_map_sorted_file.go): reads work without the in-RAM table,
+    writes are refused."""
+    import pytest
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 11)
+    payloads = {}
+    for i in range(1, 30):
+        data = bytes([i]) * (i * 10)
+        v.append_needle(Needle(id=i * 7, cookie=i, data=data))
+        payloads[i * 7] = (i, data)
+    v.delete_needle(7, 1)  # tombstone one
+    v.close()
+
+    v2 = Volume(str(tmp_path), "", 11, needle_map_kind="sorted_file")
+    assert v2.read_only
+    import os
+    assert os.path.exists(tmp_path / "11.sdx")
+    for nid, (cookie, data) in payloads.items():
+        if nid == 7:
+            assert v2.nm.get(nid) is None
+            continue
+        assert v2.read_needle(nid, cookie).data == data
+    assert v2.nm.get(99999) is None
+    with pytest.raises(PermissionError):
+        v2.append_needle(Needle(id=1000, cookie=1, data=b"x"))
+    v2.close()
