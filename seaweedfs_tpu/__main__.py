@@ -20,6 +20,8 @@ def _add_common_flags(p):
     p.add_argument("-logFile", default=None)
     p.add_argument("-securityConfig", default=None,
                    help="security.toml path (default: standard search paths)")
+    p.add_argument("-cpuprofile", default=None,
+                   help="write a cProfile dump here on exit (grace/pprof.go)")
 
 
 def _security(args):
@@ -73,6 +75,12 @@ def main(argv=None) -> int:
                     help="also run the S3 gateway (implies -filer)")
     ps.add_argument("-s3Port", type=int, default=8333)
     ps.add_argument("-s3Config", default=None)
+    ps.add_argument("-webdav", action="store_true",
+                    help="also run the WebDAV gateway (implies -filer)")
+    ps.add_argument("-webdavPort", type=int, default=7333)
+    ps.add_argument("-mq", action="store_true",
+                    help="also run the MQ broker")
+    ps.add_argument("-mqPort", type=int, default=17777)
 
     pf = sub.add_parser("filer")
     pf.add_argument("-ip", default="127.0.0.1")
@@ -203,8 +211,10 @@ def main(argv=None) -> int:
 
     args = ap.parse_args(argv)
 
-    from seaweedfs_tpu.utils import weedlog
+    from seaweedfs_tpu.utils import grace, weedlog
     weedlog.setup(args.v, args.logFile)
+    grace.setup_stack_dumps()
+    grace.setup_profiling(getattr(args, "cpuprofile", None))
 
     if args.cmd == "master":
         return asyncio.run(_run_master(args))
@@ -357,8 +367,9 @@ async def _run_server(args) -> int:
                      data_center=args.dataCenter, rack=args.rack,
                      security=sec)
     await v.start()
-    f = s3 = None
-    if getattr(args, "filer", False) or getattr(args, "s3", False):
+    f = s3 = dav = mq = None
+    if getattr(args, "filer", False) or getattr(args, "s3", False) or \
+            getattr(args, "webdav", False):
         from seaweedfs_tpu.server.filer_server import FilerServer
         f = FilerServer(m.url, args.ip, args.filerPort, data_dir=args.dir[0],
                         security=sec)
@@ -370,11 +381,18 @@ async def _run_server(args) -> int:
             if args.s3Config else IdentityAccessManagement()
         s3 = S3ApiServer(f.url, args.ip, args.s3Port, iam=iam, security=sec)
         await s3.start()
+    if getattr(args, "webdav", False):
+        from seaweedfs_tpu.server.webdav_server import WebDavServer
+        dav = WebDavServer(f.url, args.ip, args.webdavPort, security=sec)
+        await dav.start()
+    if getattr(args, "mq", False):
+        from seaweedfs_tpu.mq.broker import BrokerServer
+        mq = BrokerServer(m.url, args.ip, args.mqPort)
+        await mq.start()
     await _serve_forever()
-    if s3:
-        await s3.stop()
-    if f:
-        await f.stop()
+    for srv in (mq, dav, s3, f):
+        if srv:
+            await srv.stop()
     await v.stop()
     await m.stop()
     return 0

@@ -452,6 +452,9 @@ class VolumeServer:
             return web.json_response({})  # already mounted
         from seaweedfs_tpu.storage.volume import Volume
         for loc in self.store.locations:
+            # an earlier unmount leaves the collection recorded; try it
+            # first so `volume.mount -volumeId N` works without -collection
+            collection = collection or loc.collections.get(vid, "")
             base = loc.base_path(vid, collection)
             if os.path.exists(base + ".dat") or \
                     os.path.exists(base + ".tier"):

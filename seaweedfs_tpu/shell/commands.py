@@ -800,7 +800,12 @@ def cmd_volume_unmount(env: CommandEnv, args, out):
     env.require_lock()
     flags = parse_flags(args)
     vid = int(flags["volumeId"])
-    node = flags.get("node") or env.volume_locations(vid)[0]
+    node = flags.get("node")
+    if not node:
+        locs = env.volume_locations(vid)
+        if not locs:
+            raise RuntimeError(f"volume {vid} not found")
+        node = locs[0]
     env.vs_post(node, "/admin/volume/unmount", {"volume": vid})
     print(f"unmounted volume {vid} on {node}", file=out)
 
@@ -851,6 +856,58 @@ def _parse_duration(s: str) -> float:
     if s and s[-1] in units:
         return float(s[:-1]) * units[s[-1]]
     return float(s or 0)
+
+
+@command("s3.configure")
+def cmd_s3_configure(env: CommandEnv, args, out):
+    """Manage S3 identities in the filer-stored identity.json, which
+    running gateways hot-reload (reference: command_s3_configure.go).
+      s3.configure -user NAME -access_key AK -secret_key SK -actions Admin
+      s3.configure -user NAME -delete
+      s3.configure -list"""
+    env.require_lock()
+    flags = parse_flags(args)
+    filer = env.find_filer()
+    from seaweedfs_tpu.s3.iamapi_server import IDENTITY_PATH
+    try:
+        cfg = json.loads(env.filer_read(filer, IDENTITY_PATH))
+    except Exception:
+        cfg = {"identities": []}
+    idents = cfg.setdefault("identities", [])
+    if flags.get("list"):
+        for i in idents:
+            keys = ",".join(c.get("accessKey", "") for c in
+                            i.get("credentials", []))
+            print(f"{i.get('name')}: actions={i.get('actions')} "
+                  f"keys=[{keys}]", file=out)
+        if not idents:
+            print("no identities configured", file=out)
+        return
+    user = flags.get("user", "")
+    if not user:
+        raise RuntimeError("s3.configure needs -user (or -list)")
+    existing = next((i for i in idents if i.get("name") == user), None)
+    if flags.get("delete"):
+        if existing:
+            idents.remove(existing)
+            print(f"deleted identity {user}", file=out)
+    else:
+        if existing is None:
+            existing = {"name": user, "credentials": [], "actions": []}
+            idents.append(existing)
+        if flags.get("access_key"):
+            existing["credentials"] = [{
+                "accessKey": flags["access_key"],
+                "secretKey": flags.get("secret_key", "")}]
+        if flags.get("actions"):
+            existing["actions"] = flags["actions"].split(",")
+        print(f"configured identity {user}: {existing['actions']}", file=out)
+    payload = json.dumps(cfg, indent=1).encode()
+    req = urllib.request.Request(
+        f"http://{filer}{urllib.parse.quote(IDENTITY_PATH)}",
+        data=payload, method="PUT")
+    with urllib.request.urlopen(req, timeout=30):
+        pass
 
 
 @command("cluster.ps")

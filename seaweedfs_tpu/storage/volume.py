@@ -256,6 +256,10 @@ class Volume:
             raise PermissionError(
                 f"volume {self.id} lives on a remote tier; decode it back "
                 f"before compacting")
+        if self._idx is None:
+            raise PermissionError(
+                f"volume {self.id} is opened with a read-only needle map; "
+                f"reopen with needle_map_kind='memory' to compact")
         with self._lock:
             cpd, cpx = self._base + ".cpd", self._base + ".cpx"
             new_sb = SuperBlock(

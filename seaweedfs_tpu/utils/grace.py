@@ -1,0 +1,85 @@
+"""Process lifecycle helpers: graceful shutdown hooks, stack dumps,
+profiling.
+
+Reference: weed/util/grace/ (signal_handling.go:26-65 runs registered
+cleanup hooks on SIGINT/SIGTERM; pprof.go:11 SetupProfiling writes
+cpu/mem profiles).  Python equivalents: SIGUSR1 dumps all thread stacks
+(the pprof /debug/pprof/goroutine analogue), -cpuprofile wraps the
+process in cProfile, hooks run on termination signals.
+"""
+
+from __future__ import annotations
+
+import atexit
+import cProfile
+import faulthandler
+import logging
+import signal
+import sys
+import threading
+
+log = logging.getLogger("grace")
+
+_hooks: list = []
+_installed = False
+_profiler: cProfile.Profile | None = None
+
+
+def on_interrupt(hook) -> None:
+    """Register a cleanup hook to run on SIGINT/SIGTERM (reference:
+    grace.OnInterrupt)."""
+    _hooks.append(hook)
+    _install()
+
+
+def _run_hooks(signum=None, frame=None) -> None:
+    # drain the list so a signal-exit doesn't re-run hooks via atexit
+    hooks, _hooks[:] = list(_hooks), []
+    for hook in reversed(hooks):
+        try:
+            hook()
+        except Exception:
+            log.warning("shutdown hook failed", exc_info=True)
+    if signum is not None:
+        sys.exit(128 + signum)
+
+
+def _install() -> None:
+    global _installed
+    if _installed or threading.current_thread() is not threading.main_thread():
+        return
+    _installed = True
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, _run_hooks)
+        except (ValueError, OSError):
+            pass
+    atexit.register(_run_hooks)
+
+
+def setup_stack_dumps() -> None:
+    """SIGUSR1 prints every thread's stack to stderr — the 'what is this
+    process doing' probe the reference gets from pprof goroutine dumps."""
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError, OSError):
+        pass
+
+
+def setup_profiling(cpu_profile_path: str | None) -> None:
+    """Start cProfile and dump to the given path at exit (reference:
+    grace.SetupProfiling cpu profile)."""
+    global _profiler
+    if not cpu_profile_path or _profiler is not None:
+        return
+    _profiler = cProfile.Profile()
+    _profiler.enable()
+
+    def dump():
+        global _profiler
+        if _profiler is not None:
+            _profiler.disable()
+            _profiler.dump_stats(cpu_profile_path)
+            log.info("cpu profile written to %s", cpu_profile_path)
+            _profiler = None
+    on_interrupt(dump)

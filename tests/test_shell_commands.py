@@ -240,3 +240,41 @@ def test_cli_compact(tmp_path):
     v2 = Volume(str(tmp_path), "", 9)
     assert v2.read_needle(5).data == b"z" * 2000
     v2.close()
+
+
+def test_s3_configure_hot_reload(tmp_path):
+    """s3.configure writes filer identity.json; a running gateway hot-
+    reloads it (reference: command_s3_configure.go +
+    auth_credentials_subscribe.go)."""
+    import asyncio
+    import urllib.request
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port())
+    c.submit(filer.start())
+    s3 = S3ApiServer(filer.url, port=free_port())
+    c.submit(s3.start())
+    try:
+        env = CommandEnv(c.master.url)
+        env.acquire_lock()
+        assert wait_for(lambda: bool(
+            env.master_get("/cluster/status").get("Members", {}).get("filer")))
+        out = shell(env, "s3.configure -user ops -access_key OPSKEY "
+                         "-secret_key OPSSECRET -actions Admin")
+        assert "configured identity ops" in out
+        out = shell(env, "s3.configure -list")
+        assert "ops" in out and "OPSKEY" in out
+        # the gateway hot-loads it: auth becomes enforced
+        assert wait_for(lambda: s3.iam.enabled, timeout=15)
+        ident, cred = s3.iam.lookup("OPSKEY")
+        assert ident.name == "ops" and cred.secret_key == "OPSSECRET"
+        shell(env, "s3.configure -user ops -delete")
+        assert wait_for(
+            lambda: not any(i.name == "ops" for i in s3.iam.identities),
+            timeout=15)
+    finally:
+        c.submit(s3.stop())
+        c.submit(filer.stop())
+        c.stop()
