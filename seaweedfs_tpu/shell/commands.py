@@ -214,14 +214,26 @@ def cmd_volume_mark(env: CommandEnv, args, out):
         print(f"marked {vid} readonly={readonly} on {url}", file=out)
 
 
-def balanced_ec_distribution(nodes: list[str]) -> dict[str, list[int]]:
-    """Round-robin the 14 shards over nodes (reference:
-    command_ec_encode.go:272 balancedEcDistribution)."""
+def balanced_ec_distribution(nodes: list[str],
+                             racks: dict[str, str] | None = None
+                             ) -> dict[str, list[int]]:
+    """Spread the 14 shards rack-aware: each shard goes to the rack with
+    the fewest shards so far, then the least-loaded node inside it — a
+    rack loss never takes more shards than necessary (reference:
+    command_ec_encode.go:272 balancedEcDistribution + the rack spread of
+    command_ec_balance.go)."""
+    racks = racks or {}
     alloc: dict[str, list[int]] = {n: [] for n in nodes}
-    order = sorted(nodes)
+    rack_of = {n: racks.get(n, n) for n in nodes}  # rackless: node = rack
+    rack_load: dict[str, int] = {r: 0 for r in rack_of.values()}
     for sid in range(layout.TOTAL_SHARDS):
-        target = order[sid % len(order)]
+        # fewest-loaded rack, then fewest-loaded node within it; sorted
+        # keys make ties deterministic
+        rack = min(sorted(rack_load), key=lambda r: rack_load[r])
+        target = min(sorted(n for n in nodes if rack_of[n] == rack),
+                     key=lambda n: len(alloc[n]))
         alloc[target].append(sid)
+        rack_load[rack] += 1
     return alloc
 
 
@@ -252,7 +264,9 @@ def cmd_ec_encode(env: CommandEnv, args, out):
     import concurrent.futures
     topo = env.topology()
     nodes = sorted(topo["nodes"])
-    alloc = balanced_ec_distribution(nodes)
+    racks = {nid: f"{nd['dc']}/{nd['rack']}"
+             for nid, nd in topo["nodes"].items()}
+    alloc = balanced_ec_distribution(nodes, racks)
 
     def place(target_shards):
         target, shards = target_shards
@@ -360,11 +374,13 @@ def cmd_ec_balance(env: CommandEnv, args, out):
     env.require_lock()
     topo = env.topology()
     nodes = sorted(topo["nodes"])
+    racks = {nid: f"{nd['dc']}/{nd['rack']}"
+             for nid, nd in topo["nodes"].items()}
     ec_vids = {int(v) for node in topo["nodes"].values()
                for v in node["ec_shards"]}
     for vid in sorted(ec_vids):
         shard_locs = env.ec_shard_locations(vid)
-        want = balanced_ec_distribution(nodes)
+        want = balanced_ec_distribution(nodes, racks)
         want_by_shard = {s: tgt for tgt, ss in want.items() for s in ss}
         for s, locs in shard_locs.items():
             tgt = want_by_shard.get(s)

@@ -278,3 +278,29 @@ def test_s3_configure_hot_reload(tmp_path):
         c.submit(s3.stop())
         c.submit(filer.stop())
         c.stop()
+
+
+def test_balanced_ec_distribution_rack_aware():
+    """Shard spread minimizes per-rack loss (reference test model:
+    command_ec_test.go builds topologies in code and asserts rack
+    spread)."""
+    from seaweedfs_tpu.shell.commands import balanced_ec_distribution
+    nodes = [f"n{i}" for i in range(6)]
+    racks = {"n0": "r1", "n1": "r1", "n2": "r2", "n3": "r2",
+             "n4": "r3", "n5": "r3"}
+    alloc = balanced_ec_distribution(nodes, racks)
+    assert sum(len(s) for s in alloc.values()) == 14
+    per_rack = {}
+    for n, shards in alloc.items():
+        per_rack[racks[n]] = per_rack.get(racks[n], 0) + len(shards)
+    # 14 shards over 3 racks: 5/5/4 is the best possible spread
+    assert sorted(per_rack.values()) == [4, 5, 5], per_rack
+    # nodes inside a rack stay balanced too
+    assert all(len(s) <= 3 for s in alloc.values()), alloc
+    # no rack info -> even per-node round robin
+    alloc = balanced_ec_distribution(["a", "b", "c"])
+    assert sorted(len(s) for s in alloc.values()) == [4, 5, 5]
+    # skewed racks: a lone node in its own rack absorbs a full rack share
+    racks = {"a": "r1", "b": "r1", "c": "r1", "d": "r2"}
+    alloc = balanced_ec_distribution(["a", "b", "c", "d"], racks)
+    assert len(alloc["d"]) == 7
