@@ -106,9 +106,22 @@ class ShardedRSEncoder:
         """[k, n] -> [k+m, n]; columns sharded over `col_axis`, no collectives."""
         return self._encode(self.parity_bits, data)
 
+    def encode_parity(self, data: jax.Array) -> jax.Array:
+        """[k, n] -> [m, n] parity, column-sharded; pads n up to a
+        device-count multiple internally (shard_map needs even splits)."""
+        k, n = data.shape
+        D = self.mesh.shape[self.col_axis]
+        pad = (-n) % D
+        if pad:
+            data = jnp.pad(data, ((0, 0), (0, pad)))
+        out = self._apply_cols(self.parity_bits, data)
+        return out[:, :n] if pad else out
+
     def reconstruct(self, shards: dict[int, jax.Array],
                     wanted: list[int] | None = None) -> dict[int, jax.Array]:
-        """Column-parallel rebuild of missing shards from >= k survivors."""
+        """Column-parallel rebuild of missing shards from >= k survivors.
+        Pads columns to a device-count multiple like encode_parity
+        (shard_map needs even splits)."""
         present = sorted(shards)
         if wanted is None:
             wanted = [i for i in range(self.n_shards) if i not in shards]
@@ -117,7 +130,14 @@ class ShardedRSEncoder:
         D = self.code.decode_matrix(present, wanted)
         dbits = jnp.asarray(gf.gf_matrix_to_bitmatrix(D), dtype=jnp.int8)
         stack = jnp.stack([shards[i] for i in present[: self.k]], axis=0)
+        n = stack.shape[1]
+        ndev = self.mesh.shape[self.col_axis]
+        pad = (-n) % ndev
+        if pad:
+            stack = jnp.pad(stack, ((0, 0), (0, pad)))
         out = self._apply_cols(dbits, stack)
+        if pad:
+            out = out[:, :n]
         return {w: out[i] for i, w in enumerate(wanted)}
 
     # -- batched volumes + shard placement over ICI --------------------

@@ -19,6 +19,7 @@ Functions mirror the reference's capability surface:
 
 from __future__ import annotations
 
+import functools
 import os
 
 import numpy as np
@@ -28,6 +29,13 @@ from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import layout
 
 DEFAULT_BATCH = 16 * 1024 * 1024  # bytes per shard per device round-trip
+
+
+@functools.lru_cache(maxsize=8)
+def _mesh_codec(k: int, m: int):
+    from seaweedfs_tpu.models import rs
+    from seaweedfs_tpu.parallel import mesh as pmesh
+    return pmesh.ShardedRSEncoder(rs.get_code(k, m), pmesh.make_mesh())
 
 
 def _get_codec(kind: str | None = None):
@@ -44,6 +52,11 @@ def _get_codec(kind: str | None = None):
     if kind == "numpy":
         from seaweedfs_tpu.models import rs
         return rs.get_code(k, m)
+    if kind == "mesh":
+        # multi-chip column-parallel codec (parallel/mesh.py): stripes
+        # shard over every attached device; memoized so the jitted
+        # shard_maps compile once per (k, m)
+        return _mesh_codec(k, m)
     if kind == "auto":
         import jax
         if jax.default_backend() == "tpu":

@@ -55,3 +55,47 @@ def test_batch_encode_with_shard_placement(mesh8):
     # the shard dim is sharded over 'vol': device d holds rows [2d, 2d+2)
     shardings = out.sharding
     assert shardings.spec == jax.sharding.PartitionSpec(None, "vol", "col")
+
+
+def test_ec_files_mesh_codec_roundtrip(tmp_path, monkeypatch):
+    """WEEDTPU_EC_CODEC=mesh drives the whole shard-file pipeline through
+    the device-mesh codec; bytes match the numpy reference."""
+    import numpy as np
+    monkeypatch.setenv("WEEDTPU_EC_CODEC", "mesh")
+    from seaweedfs_tpu.models import rs
+    from seaweedfs_tpu.storage.ec import ec_files, layout
+    rng = np.random.default_rng(11)
+    dat = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    base = str(tmp_path / "1")
+    with open(base + ".dat", "wb") as f:
+        f.write(dat)
+    ec_files.write_ec_files(base, large_block=10_000, small_block=100)
+    code = rs.get_code(10, 4)
+    row = np.frombuffer(dat[:100_000], dtype=np.uint8).reshape(10, 10_000)
+    parity = code.encode_numpy(row)[10:]
+    for pi in range(4):
+        with open(base + layout.to_ext(10 + pi), "rb") as f:
+            got = np.frombuffer(f.read(10_000), dtype=np.uint8)
+        assert (got == parity[pi]).all(), pi
+    # rebuild two lost shards through the mesh codec too
+    import os
+    for sid in (0, 12):
+        os.remove(base + layout.to_ext(sid))
+    rebuilt = ec_files.rebuild_ec_files(base)
+    assert sorted(rebuilt) == [0, 12]
+    with open(base + layout.to_ext(0), "rb") as f:
+        got = np.frombuffer(f.read(10_000), dtype=np.uint8)
+    assert (got == row[0]).all()
+    with open(base + layout.to_ext(12), "rb") as f:
+        got = np.frombuffer(f.read(10_000), dtype=np.uint8)
+    assert (got == parity[2]).all()
+    # odd column counts exercise the reconstruct padding path (8 devices)
+    from seaweedfs_tpu.storage.ec.ec_files import _get_codec
+    import jax.numpy as jnp
+    codec = _get_codec("mesh")
+    data = rng.integers(0, 256, (10, 1003), dtype=np.uint8)
+    full = rs.get_code(10, 4).encode_numpy(data)
+    surv = {i: jnp.asarray(full[i]) for i in range(14) if i not in (1, 13)}
+    out = codec.reconstruct(surv, wanted=[1, 13])
+    assert (np.asarray(out[1]) == full[1]).all()
+    assert (np.asarray(out[13]) == full[13]).all()
