@@ -199,6 +199,14 @@ def main(argv=None) -> int:
     pmt.add_argument("-dir", required=True, help="mountpoint")
     pmt.add_argument("-filer.path", dest="filerPath", default="/")
 
+    pfb = sub.add_parser("filer.backup",
+                         help="continuously mirror a filer subtree into a "
+                              "local directory (command/filer_backup.go)")
+    pfb.add_argument("-filer", required=True, help="source filer host:port")
+    pfb.add_argument("-dir", required=True, help="local target directory")
+    pfb.add_argument("-filerPath", default="/")
+    pfb.add_argument("-offsetFile", default=".filer_backup_offsets.json")
+
     psc = sub.add_parser("scaffold",
                          help="print a config template (command/scaffold.go:33)")
     psc.add_argument("-config", default="filer",
@@ -206,7 +214,7 @@ def main(argv=None) -> int:
                               "notification", "shell"])
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc, pwd, pmq, pmt, pft, pcp):
+              psy, psc, pwd, pmq, pmt, pft, pcp, pfb):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -251,6 +259,8 @@ def main(argv=None) -> int:
                   offset_path=args.offsetFile,
                   one_way=args.oneway).run_forever()
         return 0
+    if args.cmd == "filer.backup":
+        return _run_filer_backup(args)
     if args.cmd == "scaffold":
         return _run_scaffold(args)
     if args.cmd == "webdav":
@@ -717,6 +727,25 @@ topic = "seaweedfs_filer"
 default = "localhost:9333"
 """,
 }
+
+
+def _run_filer_backup(args) -> int:
+    """One-way filer -> local directory mirror with resume offsets
+    (reference: weed/command/filer_backup.go over the LocalSink)."""
+    import threading
+
+    from seaweedfs_tpu.replication.filer_sync import (SyncDirection,
+                                                      SyncOffsetStore)
+    from seaweedfs_tpu.replication.sink import LocalSink
+    offsets = SyncOffsetStore(args.offsetFile)
+    d = SyncDirection(args.filer, f"local:{args.dir}", prefix=args.filerPath,
+                      offsets=offsets, sink=LocalSink(args.dir))
+    try:
+        d.run(threading.Event(), live=True)
+    except KeyboardInterrupt:
+        pass
+    offsets.flush()
+    return 0
 
 
 def _run_scaffold(args) -> int:

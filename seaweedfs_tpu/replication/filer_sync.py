@@ -82,7 +82,10 @@ class SyncDirection:
 
     def __init__(self, src: str, dst: str, prefix: str = "/",
                  offsets: SyncOffsetStore | None = None,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, sink=None):
+        """`sink` defaults to a FilerSink on `dst`; pass any
+        ReplicationSink (e.g. LocalSink for filer.backup) to replicate
+        into something other than a peer filer."""
         self.src, self.dst = src, dst
         self.prefix = prefix
         self.offsets = offsets or SyncOffsetStore(None)
@@ -90,7 +93,8 @@ class SyncDirection:
         self.src_sig = filer_signature(src)
         self.dst_sig = filer_signature(dst)
         self.timeout = timeout
-        sink = FilerSink(dst, signature=self.src_sig, timeout=timeout)
+        if sink is None:
+            sink = FilerSink(dst, signature=self.src_sig, timeout=timeout)
         self.replicator = Replicator(sink, self._read_source_file, prefix)
         self.applied = 0
         self.skipped = 0

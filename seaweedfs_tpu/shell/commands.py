@@ -152,6 +152,23 @@ def parse_flags(args: list[str]) -> dict[str, str]:
     return out
 
 
+@command("help")
+def cmd_help(env: CommandEnv, args, out):
+    """List commands, or show one command's doc: help [name]."""
+    if args:
+        fn = COMMANDS.get(args[0])
+        if fn is None:
+            print(f"unknown command {args[0]!r}", file=out)
+            return
+        import inspect
+        doc = inspect.cleandoc(fn.__doc__) if fn.__doc__ else "(no help)"
+        print(f"{args[0]}: {doc}", file=out)
+        return
+    for name in sorted(COMMANDS):
+        doc = (COMMANDS[name].__doc__ or "").strip().splitlines()
+        print(f"{name:28s} {doc[0] if doc else ''}", file=out)
+
+
 @command("lock")
 def cmd_lock(env: CommandEnv, args, out):
     env.acquire_lock()
