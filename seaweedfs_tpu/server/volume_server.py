@@ -65,6 +65,8 @@ class VolumeServer:
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/volume/delete", self.handle_volume_delete),
             web.post("/admin/volume/readonly", self.handle_volume_readonly),
+            web.post("/admin/volume/configure_replication",
+                     self.handle_configure_replication),
             web.post("/admin/volume/mount", self.handle_volume_mount),
             web.post("/admin/volume/unmount", self.handle_volume_unmount),
             web.post("/admin/volume/vacuum", self.handle_vacuum),
@@ -428,6 +430,26 @@ class VolumeServer:
         self.store.delete_volume(body["volume"])
         await self._heartbeat_once()
         return web.json_response({})
+
+    async def handle_configure_replication(self, req: web.Request
+                                           ) -> web.Response:
+        """Rewrite the replica-placement byte in the super block
+        (reference: volume_grpc_admin.go VolumeConfigure)."""
+        body = await req.json()
+        v = self.store.get_volume(body["volume"])
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        try:
+            rp = t.ReplicaPlacement.parse(body.get("replication", "000"))
+        except (ValueError, KeyError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        try:
+            await asyncio.to_thread(v.set_replica_placement, rp)
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        await self._heartbeat_once()
+        return web.json_response({"replication": str(rp)})
 
     async def handle_volume_unmount(self, req: web.Request) -> web.Response:
         """Close a volume without deleting its files (reference:

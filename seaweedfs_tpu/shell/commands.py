@@ -891,6 +891,77 @@ def _parse_duration(s: str) -> float:
     return float(s or 0)
 
 
+@command("fs.meta.save")
+def cmd_fs_meta_save(env: CommandEnv, args, out):
+    """Dump a filer subtree's metadata (entries incl. chunk refs) to a
+    local JSONL file (reference: command_fs_meta_save.go).
+      fs.meta.save -o meta.jsonl [/path]"""
+    flags = parse_flags(args)
+    # first token that is neither a flag nor a flag's value is the path
+    path = flags.get("path", "/")
+    skip_next = False
+    for tok in args:
+        if skip_next:
+            skip_next = False
+            continue
+        if tok.startswith("-"):
+            skip_next = "=" not in tok
+            continue
+        path = tok
+        break
+    out_path = flags.get("o", "filer_meta.jsonl")
+    filer = env.find_filer()
+    count = 0
+    with open(out_path, "w", encoding="utf-8") as f:
+        stack = [path.rstrip("/") or "/"]
+        while stack:
+            d = stack.pop()
+            for e in env.filer_list(filer, d):
+                if e.get("IsDirectory"):
+                    stack.append(e["FullPath"])
+                meta = env._call(
+                    f"{filer}{urllib.parse.quote(e['FullPath'])}"
+                    "?metadata=true")
+                f.write(json.dumps(meta, separators=(",", ":")) + "\n")
+                count += 1
+    print(f"fs.meta.save: {count} entr(ies) -> {out_path}", file=out)
+
+
+@command("fs.meta.load")
+def cmd_fs_meta_load(env: CommandEnv, args, out):
+    """Restore entries from an fs.meta.save dump via the filer raw-entry
+    API (reference: command_fs_meta_load.go).  Chunk refs are restored
+    as-is — blob data must still exist on the volume servers."""
+    flags = parse_flags(args)
+    in_path = flags.get("i", args[-1] if args else "filer_meta.jsonl")
+    filer = env.find_filer()
+    count = 0
+    with open(in_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            entry = json.loads(line)
+            env._call(f"{filer}/__admin__/entry", {"entry": entry})
+            count += 1
+    print(f"fs.meta.load: {count} entr(ies) restored", file=out)
+
+
+@command("volume.configure.replication")
+def cmd_volume_configure_replication(env: CommandEnv, args, out):
+    """Change a volume's replica placement in its super block
+    (reference: command_volume_configure_replication.go)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    rp = flags.get("replication", "000")
+    t.ReplicaPlacement.parse(rp)  # validate
+    for url in env.volume_locations(vid):
+        env.vs_post(url, "/admin/volume/configure_replication",
+                    {"volume": vid, "replication": rp})
+        print(f"volume {vid} on {url}: replication -> {rp}", file=out)
+
+
 @command("s3.configure")
 def cmd_s3_configure(env: CommandEnv, args, out):
     """Manage S3 identities in the filer-stored identity.json, which

@@ -161,7 +161,12 @@ class ReplicaPlacement:
     @classmethod
     def parse(cls, s: str) -> "ReplicaPlacement":
         s = (s or "000").zfill(3)
-        return cls(int(s[0]), int(s[1]), int(s[2]))
+        if len(s) != 3 or not s.isdigit():
+            raise ValueError(f"bad replica placement {s!r} (want xyz digits)")
+        rp = cls(int(s[0]), int(s[1]), int(s[2]))
+        if rp.to_byte() > 255:
+            raise ValueError(f"replica placement {s!r} exceeds one byte")
+        return rp
 
     @classmethod
     def from_byte(cls, b: int) -> "ReplicaPlacement":

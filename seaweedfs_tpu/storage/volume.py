@@ -290,6 +290,21 @@ class Volume:
             self._idx = open(self.idx_path, "ab")
             self.nm.attach_idx(self._idx)
 
+    def set_replica_placement(self, rp: "t.ReplicaPlacement") -> None:
+        """Rewrite the placement byte (super block offset 1) in place
+        (reference: volume_super_block.go MaybeWriteSuperBlock +
+        VolumeConfigure)."""
+        with self._lock:
+            if self.backend_kind == "remote":
+                raise PermissionError("remote-tier volume is read-only")
+            # write the file first; only mutate memory on success so the
+            # two views can't diverge on error
+            self._dat.flush()
+            with open(self.dat_path, "r+b") as f:
+                f.seek(1)
+                f.write(bytes([rp.to_byte()]))
+            self.super_block.replica_placement = rp
+
     def tier_move(self, kind: str, options: dict, key: str | None = None
                   ) -> None:
         """Move this sealed volume's .dat to a remote tier; reads keep

@@ -304,3 +304,49 @@ def test_balanced_ec_distribution_rack_aware():
     racks = {"a": "r1", "b": "r1", "c": "r1", "d": "r2"}
     alloc = balanced_ec_distribution(["a", "b", "c", "d"], racks)
     assert len(alloc["d"]) == 7
+
+
+def test_fs_meta_save_load_and_configure_replication(cluster3, tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.client import WeedClient
+    import urllib.request
+    c = cluster3
+    filer = FilerServer(c.master.url, port=free_port())
+    c.submit(filer.start())
+    try:
+        env = CommandEnv(c.master.url)
+        env.acquire_lock()
+        assert wait_for(lambda: bool(
+            env.master_get("/cluster/status").get("Members", {}).get("filer")))
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{filer.url}/ms/a.txt", data=b"meta-save",
+            method="POST"), timeout=15)
+        dump = str(tmp_path / "meta.jsonl")
+        out = shell(env, f"fs.meta.save -o {dump} /ms")
+        assert "1 entr" in out
+        # delete the entry metadata only, then restore it
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{filer.url}/ms/a.txt?skipChunkDeletion=true",
+            method="DELETE"), timeout=15)
+        out = shell(env, f"fs.meta.load -i {dump}")
+        assert "1 entr" in out
+        got = urllib.request.urlopen(
+            f"http://{filer.url}/ms/a.txt", timeout=15).read()
+        assert got == b"meta-save"
+        # configure replication rewrites the super block persistently
+        client = WeedClient(c.master.url)
+        fid = client.upload(b"rp", name="rp.bin")
+        vid = int(fid.split(",")[0])
+        out = shell(env, f"volume.configure.replication -volumeId {vid} "
+                         f"-replication 001")
+        assert "replication -> 001" in out
+        def rp_seen():
+            infos = env.topology()["nodes"]
+            for nd in infos.values():
+                for vi in nd.get("volume_infos", []):
+                    if vi["id"] == vid:
+                        return vi["replica_placement"] == "001"
+            return False
+        assert wait_for(rp_seen)
+    finally:
+        c.submit(filer.stop())
