@@ -229,6 +229,86 @@ class Volume:
                 raise KeyError(f"needle {needle_id:x} expired")
         return n
 
+    def read_needle_meta(self, needle_id: int,
+                         cookie: int | None = None) -> "ndl.Needle":
+        """Header + post-data meta tail only (name/mime/last_modified,
+        checksum field) — the cheap probe for paged Range reads; enforces
+        cookie and TTL like read_needle.  Returns a Needle whose `size`
+        holds the total DATA size and whose data is empty."""
+        loc = self.nm.get(needle_id)
+        if loc is None:
+            raise KeyError(
+                f"needle {needle_id:x} not found in volume {self.id}")
+        if self.version == t.VERSION1:
+            raise ValueError("paged meta read needs a v2/v3 volume")
+        offset = t.from_offset_units(loc[0])
+        with self._lock:
+            head = self._dat.read_at(offset, t.NEEDLE_HEADER_SIZE + 4)
+        if len(head) < t.NEEDLE_HEADER_SIZE + 4:
+            raise EOFError(f"truncated needle at {offset}")
+        hcookie, _hid, hsize = struct.unpack(
+            ">IQi", head[: t.NEEDLE_HEADER_SIZE])
+        if cookie is not None and hcookie != cookie:
+            raise PermissionError("cookie mismatch")
+        n = ndl.Needle(id=needle_id, cookie=hcookie, size=max(hsize, 0))
+        if hsize <= 0:
+            n.size = 0
+            return n
+        (data_size,) = struct.unpack(">I", head[t.NEEDLE_HEADER_SIZE:])
+        tail_len = hsize - 4 - data_size  # flags..pairs block
+        if tail_len > 0:
+            with self._lock:
+                tail = self._dat.read_at(
+                    offset + t.NEEDLE_HEADER_SIZE + 4 + data_size, tail_len)
+            n.parse_meta_tail(tail)
+        # checksum sits right after the meta block
+        with self._lock:
+            crc_raw = self._dat.read_at(
+                offset + t.NEEDLE_HEADER_SIZE + hsize,
+                t.NEEDLE_CHECKSUM_SIZE)
+        if len(crc_raw) == t.NEEDLE_CHECKSUM_SIZE:
+            (n.checksum,) = struct.unpack(">I", crc_raw)
+        n.size = data_size
+        ttl = self.super_block.ttl
+        if ttl and ttl.minutes > 0 and n.last_modified:
+            if n.last_modified + ttl.minutes * 60 < time.time():
+                raise KeyError(f"needle {needle_id:x} expired")
+        return n
+
+    def read_needle_page(self, needle_id: int, page_offset: int,
+                         page_size: int, cookie: int | None = None
+                         ) -> bytes:
+        """Read only [page_offset, page_offset+page_size) of a needle's
+        data without loading the whole record (reference:
+        weed/storage/needle/needle_read_page.go; page reads skip the CRC
+        like the reference's paged path).  V2/V3 layout: header(16) |
+        DataSize(4) | Data | ..."""
+        loc = self.nm.get(needle_id)
+        if loc is None:
+            raise KeyError(
+                f"needle {needle_id:x} not found in volume {self.id}")
+        if self.version == t.VERSION1:
+            raise ValueError("paged read needs a v2/v3 volume")
+        offset = t.from_offset_units(loc[0])
+        with self._lock:
+            head = self._dat.read_at(offset, t.NEEDLE_HEADER_SIZE + 4)
+        if len(head) < t.NEEDLE_HEADER_SIZE + 4:
+            raise EOFError(f"truncated needle at {offset}")
+        hcookie, _hid, hsize = struct.unpack(
+            ">IQi", head[: t.NEEDLE_HEADER_SIZE])
+        if cookie is not None and hcookie != cookie:
+            raise PermissionError("cookie mismatch")
+        if hsize <= 0:
+            return b""
+        (data_size,) = struct.unpack(">I", head[t.NEEDLE_HEADER_SIZE:])
+        lo = max(0, min(page_offset, data_size))
+        ln = max(0, min(page_size, data_size - lo))
+        if ln == 0:
+            return b""
+        with self._lock:
+            return self._dat.read_at(
+                offset + t.NEEDLE_HEADER_SIZE + 4 + lo, ln)
+
     def has_needle(self, needle_id: int) -> bool:
         return self.nm.get(needle_id) is not None
 

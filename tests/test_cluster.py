@@ -215,3 +215,28 @@ def test_vacuum_via_shell(cluster):
     assert "garbage" in out.getvalue()
     for fid in fids[15:]:
         assert client.download(fid) == bytes(2000)
+
+
+def test_paged_range_read_large_blob(cluster):
+    """Range requests on large needles read only the page, not the whole
+    record (reference: needle_read_page.go)."""
+    import urllib.request
+    import numpy as np
+    client = WeedClient(cluster.master.url)
+    rng = np.random.default_rng(17)
+    blob = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()  # 1MB
+    fid = client.upload(blob, name="big.bin")
+    url = client.lookup(int(fid.split(",")[0]))[0]
+    req = urllib.request.Request(f"http://{url}/{fid}",
+                                 headers={"Range": "bytes=500000-500099"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 206
+        assert r.headers["Content-Range"] == f"bytes 500000-500099/{1 << 20}"
+        assert r.read() == blob[500000:500100]
+    # suffix + open-ended ranges still served correctly
+    req = urllib.request.Request(f"http://{url}/{fid}",
+                                 headers={"Range": "bytes=1048000-"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.read() == blob[1048000:]
+    # whole read unchanged
+    assert client.download(fid) == blob

@@ -310,3 +310,36 @@ def test_sorted_file_needle_map(tmp_path):
     with pytest.raises(PermissionError):
         v2.append_needle(Needle(id=1000, cookie=1, data=b"x"))
     v2.close()
+
+
+def test_read_needle_meta_and_page(tmp_path):
+    """Paged read primitives: meta probe carries name/mime/mtime/checksum
+    and enforces TTL; page reads slice data without full loads
+    (reference: needle_read_page.go)."""
+    import pytest
+    import time as _time
+    from seaweedfs_tpu.storage.needle import Needle, crc32c
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 21)
+    data = bytes(range(256)) * 1200  # ~300KB
+    v.append_needle(Needle(id=5, cookie=9, data=data, name=b"doc.bin",
+                           mime=b"application/pdf",
+                           last_modified=int(_time.time())))
+    meta = v.read_needle_meta(5, 9)
+    assert meta.size == len(data)
+    assert meta.name == b"doc.bin" and meta.mime == b"application/pdf"
+    assert meta.checksum == crc32c(data)
+    with pytest.raises(PermissionError):
+        v.read_needle_meta(5, 1234)
+    assert v.read_needle_page(5, 1000, 50, 9) == data[1000:1050]
+    assert v.read_needle_page(5, len(data) - 10, 100, 9) == data[-10:]
+    v.close()
+    # TTL expiry enforced on the meta probe too
+    (tmp_path / "sub").mkdir(exist_ok=True)
+    v2 = Volume(str(tmp_path / "sub"), "", 22, ttl="1m")
+    v2.append_needle(Needle(id=1, cookie=1, data=b"z" * 1000,
+                            last_modified=int(_time.time()) - 3600))
+    with pytest.raises(KeyError):
+        v2.read_needle_meta(1, 1)
+    v2.close()
