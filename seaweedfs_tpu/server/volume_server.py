@@ -697,9 +697,13 @@ class VolumeServer:
         body = await req.json()
         vid, source = body["volume"], body["source"]
         collection = body.get("collection", "")
-        if self.store.get_volume(vid) is not None:
-            return web.json_response({"error": "volume exists here"},
-                                     status=409)
+        existing = self.store.get_volume(vid)
+        if existing is not None:
+            # incremental catch-up (reference:
+            # volume_grpc_copy_incremental.go): .dat is append-only, so
+            # pull only the tail past our size, then refresh the .idx
+            return await self._volume_copy_incremental(
+                existing, vid, source, collection)
         loc = min(self.store.locations, key=lambda l: len(l.volumes))
         base = loc.base_path(vid, collection)
         # pull into .cpd/.cpx temp names, rename only when both succeed, so
@@ -756,6 +760,98 @@ class VolumeServer:
             return web.json_response({"error": str(e)}, status=500)
         await self._heartbeat_once()
         return web.json_response({"backend": v.backend_kind})
+
+    async def _volume_copy_incremental(self, v, vid: int, source: str,
+                                       collection: str) -> web.Response:
+        """Stage the source's .dat tail and .idx WITHOUT touching the live
+        volume, then apply both atomically under the volume lock
+        (Volume.apply_catch_up) — concurrent writers either land before
+        the size snapshot (copied) or make the apply fail cleanly."""
+        name = os.path.basename(v.dat_path)
+        # divergence guard: a vacuumed source has a different compaction
+        # revision; appending its tail to our pre-vacuum bytes would
+        # corrupt the replica even when its file is larger
+        try:
+            async with self._session.get(
+                    f"http://{source}/admin/file",
+                    params={"name": name},
+                    headers={"Range": "bytes=0-7"}) as r:
+                if r.status not in (200, 206):
+                    return web.json_response(
+                        {"error": f"probe super block: HTTP {r.status}"},
+                        status=500)
+                remote_sb = await r.read()
+        except aiohttp.ClientError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        from seaweedfs_tpu.storage.super_block import SuperBlock
+        try:
+            remote_rev = SuperBlock.from_bytes(
+                remote_sb.ljust(64, b"\0")).compaction_revision
+        except Exception:
+            return web.json_response({"error": "bad source super block"},
+                                     status=500)
+        if remote_rev != v.super_block.compaction_revision:
+            return web.json_response(
+                {"error": "source compaction revision differs; full "
+                          "re-copy required (delete the local copy)"},
+                status=409)
+
+        local_size = v.data_size()
+        tail_path = v.dat_path + ".cptail"
+        appended_hint = 0
+        try:
+            async with self._session.get(
+                    f"http://{source}/admin/file",
+                    params={"name": name},
+                    headers={"Range": f"bytes={local_size}-"}) as r:
+                if r.status == 416:
+                    cr = r.headers.get("Content-Range", "")  # "bytes */N"
+                    try:
+                        src_size = int(cr.rpartition("/")[2])
+                    except ValueError:
+                        src_size = local_size
+                    if src_size < local_size:
+                        return web.json_response(
+                            {"error": "local replica is ahead of the "
+                                      "source; refusing incremental copy"},
+                            status=409)
+                    with open(tail_path, "wb"):
+                        pass
+                elif r.status == 206:
+                    with open(tail_path, "wb") as f:
+                        async for chunk in r.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+                            appended_hint += len(chunk)
+                elif r.status == 200:
+                    return web.json_response(
+                        {"error": "source ignored the Range; refusing "
+                                  "incremental copy"}, status=409)
+                else:
+                    return web.json_response(
+                        {"error": f"pull tail: HTTP {r.status}"}, status=500)
+            idx_name = os.path.basename(v.idx_path)
+            async with self._session.get(
+                    f"http://{source}/admin/file",
+                    params={"name": idx_name}) as r:
+                if r.status != 200:
+                    return web.json_response(
+                        {"error": f"pull idx: HTTP {r.status}"}, status=500)
+                idx_raw = await r.read()
+            try:
+                appended = await asyncio.to_thread(
+                    v.apply_catch_up, local_size, tail_path, idx_raw)
+            except (RuntimeError, PermissionError) as e:
+                return web.json_response({"error": str(e)}, status=409)
+        except aiohttp.ClientError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            try:
+                os.remove(tail_path)
+            except OSError:
+                pass
+        await self._heartbeat_once()
+        return web.json_response({"incremental": True,
+                                  "appended_bytes": appended})
 
     async def handle_volume_needles(self, req: web.Request) -> web.Response:
         """List needle ids + sizes of a volume (fsck / check.disk support;

@@ -800,6 +800,20 @@ def cmd_remote_cache(env: CommandEnv, args, out):
     print(f"remote.cache: {n} object(s) cached under {mount_dir}", file=out)
 
 
+@command("volume.grow")
+def cmd_volume_grow(env: CommandEnv, args, out):
+    """Pre-allocate writable volumes (reference: command_volume_grow /
+    the master /vol/grow endpoint)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    r = env.master_post("/vol/grow",
+                        count=flags.get("count", "1"),
+                        collection=flags.get("collection", ""),
+                        replication=flags.get("replication", ""),
+                        ttl=flags.get("ttl", ""))
+    print(f"grew {r.get('count', 0)} volume(s)", file=out)
+
+
 @command("volume.move")
 def cmd_volume_move(env: CommandEnv, args, out):
     """Move one volume between servers: copy to target, delete from

@@ -370,6 +370,37 @@ class Volume:
             self._idx = open(self.idx_path, "ab")
             self.nm.attach_idx(self._idx)
 
+    def apply_catch_up(self, base_size: int, tail_path: str,
+                       idx_raw: bytes) -> int:
+        """Atomically apply an incremental replica catch-up staged by the
+        volume server (reference: volume_grpc_copy_incremental.go):
+        append the pulled .dat tail and swap in the source's .idx, all
+        under the volume lock so concurrent writers are excluded.  Fails
+        if the volume changed since `base_size` was observed."""
+        if self._idx is None:
+            raise PermissionError("read-only needle map")
+        appended = 0
+        with self._lock:
+            if self._dat.size() != base_size:
+                raise RuntimeError(
+                    "volume changed during catch-up; retry")
+            with open(tail_path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    self._dat.append(chunk)
+                    appended += len(chunk)
+            self._dat.flush()
+            self._idx.close()
+            with open(self.idx_path, "wb") as f:
+                f.write(idx_raw)
+            self.nm = NeedleMap.load_from_idx(self.idx_path)
+            self._idx = open(self.idx_path, "ab")
+            self.nm.attach_idx(self._idx)
+            self.last_modified = time.time()
+        return appended
+
     def set_replica_placement(self, rp: "t.ReplicaPlacement") -> None:
         """Rewrite the placement byte (super block offset 1) in place
         (reference: volume_super_block.go MaybeWriteSuperBlock +
