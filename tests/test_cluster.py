@@ -240,3 +240,55 @@ def test_paged_range_read_large_blob(cluster):
         assert r.read() == blob[1048000:]
     # whole read unchanged
     assert client.download(fid) == blob
+
+
+def test_concurrent_write_read_delete_hammer(cluster):
+    """Thread hammer on one volume server: concurrent uploads, reads,
+    paged reads, and deletes stay consistent (the reference's promise of
+    the per-volume write batching + -race e2e images)."""
+    import concurrent.futures
+    import secrets
+    import urllib.request
+
+    client = WeedClient(cluster.master.url)
+    blobs: dict[str, bytes] = {}
+
+    def write_one(i):
+        data = secrets.token_bytes(1000 + (i % 7) * 3777)
+        fid = client.upload(data, name=f"h{i}.bin")
+        return fid, data
+
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        for fid, data in ex.map(write_one, range(60)):
+            blobs[fid] = data
+
+    def read_one(item):
+        fid, data = item
+        assert client.download(fid) == data
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        assert all(ex.map(read_one, blobs.items()))
+
+    # interleaved deletes + reads of the survivors
+    fids = list(blobs)
+    doomed, kept = set(fids[::3]), [f for i, f in enumerate(fids) if i % 3]
+
+    def delete_one(fid):
+        client.delete(fid)
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        futs = [ex.submit(delete_one, f) for f in doomed]
+        futs += [ex.submit(read_one, (f, blobs[f])) for f in kept]
+        for f in futs:
+            assert f.result()
+
+    for fid in doomed:
+        try:
+            client.download(fid)
+            raise AssertionError(f"{fid} still readable after delete")
+        except RuntimeError:
+            pass
+    for fid in kept:
+        assert client.download(fid) == blobs[fid]
