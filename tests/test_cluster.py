@@ -243,14 +243,27 @@ def test_paged_range_read_large_blob(cluster):
 
 
 def test_concurrent_write_read_delete_hammer(cluster):
-    """Thread hammer on one volume server: concurrent uploads, reads,
-    paged reads, and deletes stay consistent (the reference's promise of
-    the per-volume write batching + -race e2e images)."""
+    """Thread hammer on one volume server: concurrent uploads, whole and
+    paged (Range) reads, and deletes stay consistent (the reference's
+    promise of the per-volume write batching + -race e2e images)."""
     import concurrent.futures
     import secrets
     import urllib.request
 
     client = WeedClient(cluster.master.url)
+    # one large blob so concurrent paged reads hit read_needle_page
+    big = secrets.token_bytes(512 * 1024)
+    big_fid = client.upload(big, name="big.bin")
+    big_url = client.lookup(int(big_fid.split(",")[0]))[0]
+
+    def paged_read(i):
+        lo = (i * 37) % (len(big) - 64)
+        req = urllib.request.Request(
+            f"http://{big_url}/{big_fid}",
+            headers={"Range": f"bytes={lo}-{lo + 63}"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.read() == big[lo:lo + 64]
+        return True
     blobs: dict[str, bytes] = {}
 
     def write_one(i):
@@ -268,7 +281,10 @@ def test_concurrent_write_read_delete_hammer(cluster):
         return True
 
     with concurrent.futures.ThreadPoolExecutor(8) as ex:
-        assert all(ex.map(read_one, blobs.items()))
+        futs = [ex.submit(read_one, it) for it in blobs.items()]
+        futs += [ex.submit(paged_read, i) for i in range(30)]
+        for f in futs:
+            assert f.result()
 
     # interleaved deletes + reads of the survivors
     fids = list(blobs)
