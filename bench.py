@@ -33,15 +33,29 @@ KLAUSPOST_AVX2_GBPS = 5.0  # single-stream 10+4 AVX2 baseline (see docstring)
 def _tpu_reachable(timeout: float = 180.0) -> bool:
     """Probe TPU init in a subprocess: the tunneled chip can hang backend
     initialisation entirely when the tunnel is down, which would wedge
-    this benchmark (and its caller) forever."""
+    this benchmark (and its caller) forever.  The probe child itself can
+    get stuck in uninterruptible IO on the dead tunnel, so on timeout it
+    is killed and ABANDONED (never waited on) — subprocess.run would
+    block reaping it."""
     import subprocess
     try:
-        r = subprocess.run(
+        p = subprocess.Popen(
             [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout, capture_output=True)
-        return r.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+    except OSError:
         return False
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rc = p.poll()
+        if rc is not None:
+            return rc == 0
+        time.sleep(1.0)
+    try:
+        p.kill()
+    except OSError:
+        pass
+    return False
 
 
 def main() -> None:
