@@ -11,13 +11,19 @@ figure (~5 GB/s on a modern x86 core, see their README benchmarks), which
 is generous to the reference (SeaweedFS encodes one volume per call, with
 256KB buffers and file IO in the loop).
 
-Timing method: the TPU here is reached through a tunnel where a device sync
-costs ~70ms and `block_until_ready` is unreliable, so we chain iterations
-inside one jit via lax.fori_loop with a data dependency (parity folded back
-into the carry), difference two iteration counts, and subtract a baseline
-loop with identical data movement but no encode.
+Timing method (TPU): the chip is reached through a tunnel where a device
+sync costs ~70ms and `block_until_ready` is unreliable, so we chain
+iterations inside one jit via lax.fori_loop with a data dependency (parity
+folded back into the carry), difference two iteration counts, and subtract
+a baseline loop with identical data movement but no encode.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Fallback (tunnel down): benchmarks the best CPU backend available — the
+native C++ AVX2 codec (ops/native_codec.py) when the extension builds,
+else the XLA bit-sliced path — and says so in the `backend` field.
+
+Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "backend"}
+where backend is "tpu" | "cpu-native" | "cpu-xla".
 """
 
 import functools
@@ -30,7 +36,7 @@ import numpy as np
 KLAUSPOST_AVX2_GBPS = 5.0  # single-stream 10+4 AVX2 baseline (see docstring)
 
 
-def _tpu_reachable(timeout: float = 180.0) -> bool:
+def _probe_once(timeout: float) -> bool:
     """Probe TPU init in a subprocess: the tunneled chip can hang backend
     initialisation entirely when the tunnel is down, which would wedge
     this benchmark (and its caller) forever.  The probe child itself can
@@ -58,6 +64,52 @@ def _tpu_reachable(timeout: float = 180.0) -> bool:
     return False
 
 
+def _tpu_reachable(attempts: int = 3, timeout: float = 120.0,
+                   gap: float = 45.0) -> bool:
+    """Retry the tunnel probe across a window: transient tunnel flaps cost
+    a whole round's provenance (round 1 recorded a CPU number because one
+    probe failed at driver time), so a few minutes of retries are cheap."""
+    for i in range(attempts):
+        if _probe_once(timeout):
+            return True
+        if i + 1 < attempts:
+            print(f"bench: TPU probe {i + 1}/{attempts} failed, "
+                  f"retrying in {gap:.0f}s", file=sys.stderr)
+            time.sleep(gap)
+    return False
+
+
+def _emit(gbps: float, backend: str) -> None:
+    print(json.dumps({
+        "metric": "ec_encode_rs10_4",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / KLAUSPOST_AVX2_GBPS, 2),
+        "backend": backend,
+    }))
+
+
+def _bench_cpu_native() -> float | None:
+    """Time the C++ AVX2 codec directly on host buffers (no jit)."""
+    from seaweedfs_tpu import native
+    if not native.available():
+        return None
+    from seaweedfs_tpu.ops import native_codec
+    codec = native_codec.get_codec(10, 4)
+    n = 4 * 1024 * 1024  # 4 MiB per shard, 40 MiB of volume data per call
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (10, n), dtype=np.uint8)
+    codec.encode_parity(data)  # warm up caches / tables
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        iters = 4
+        for _ in range(iters):
+            codec.encode_parity(data)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return 10 * n / 1e9 / best
+
+
 def main() -> None:
     import os
     force_cpu = False
@@ -69,6 +121,17 @@ def main() -> None:
         os.environ["JAX_PLATFORMS"] = "cpu"
         force_cpu = True
 
+    if force_cpu:
+        # best CPU story first: the native AVX2 codec needs no jax at all
+        try:
+            gbps = _bench_cpu_native()
+        except Exception as e:
+            print(f"bench: native codec failed ({e})", file=sys.stderr)
+            gbps = None
+        if gbps is not None:
+            _emit(gbps, "cpu-native")
+            return
+
     import jax
     if force_cpu:
         # the env var alone is too late when sitecustomize pre-imported
@@ -79,14 +142,14 @@ def main() -> None:
             # last-resort fallback failed: report a degenerate result
             # instead of hanging on the dead tunnel
             print(f"bench: cannot force CPU backend ({e})", file=sys.stderr)
-            print(json.dumps({"metric": "ec_encode_rs10_4", "value": 0.0,
-                              "unit": "GB/s", "vs_baseline": 0.0}))
+            _emit(0.0, "cpu-xla")
             return
     import jax.numpy as jnp
 
     from seaweedfs_tpu.ops import gfmat_jax, pallas_gf
 
     on_tpu = jax.default_backend() == "tpu"
+    backend = "tpu" if on_tpu else "cpu-xla"
     # 64 MiB per data shard on TPU (640 MiB of volume data); tiny on CPU.
     n = 64 * 1024 * 1024 if on_tpu else 1024 * 1024
     # fused Pallas kernel on TPU; XLA bit-sliced path elsewhere (the Pallas
@@ -126,17 +189,11 @@ def main() -> None:
         if net > 0:
             best = min(best, net)
     if not np.isfinite(best):
-        print(json.dumps({"metric": "ec_encode_rs10_4", "value": 0.0,
-                          "unit": "GB/s", "vs_baseline": 0.0}))
+        _emit(0.0, backend)
         return
 
     gbps = 10 * n / 1e9 / best
-    print(json.dumps({
-        "metric": "ec_encode_rs10_4",
-        "value": round(gbps, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(gbps / KLAUSPOST_AVX2_GBPS, 2),
-    }))
+    _emit(gbps, backend)
 
 
 if __name__ == "__main__":

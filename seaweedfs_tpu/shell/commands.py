@@ -461,9 +461,23 @@ def cmd_volume_balance(env: CommandEnv, args, out):
 def move_volume(env: "CommandEnv", vid: int, source: str, target: str,
                 collection: str = "") -> None:
     """Copy-then-delete volume move, the one protocol both volume.move and
-    volume.balance use (reference: command_volume_move.go LiveMoveVolume)."""
-    env.vs_post(target, "/admin/volume/copy",
-                {"volume": vid, "source": source, "collection": collection})
+    volume.balance use (reference: command_volume_move.go LiveMoveVolume).
+
+    Live-safe: the bulk copy happens while the source still takes writes,
+    then incremental catch-ups drain the tail, then the source is frozen
+    read-only and one final catch-up runs so nothing written after the
+    snapshot can be lost — only then is the source deleted."""
+    body = {"volume": vid, "source": source, "collection": collection}
+    env.vs_post(target, "/admin/volume/copy", body)
+    # drain the append tail while the source is still live
+    for _ in range(10):
+        r = env.vs_post(target, "/admin/volume/copy", body)
+        if r.get("appended_bytes", 0) == 0:
+            break
+    # freeze writes, then the final catch-up closes the race window
+    env.vs_post(source, "/admin/volume/readonly",
+                {"volume": vid, "readonly": True})
+    env.vs_post(target, "/admin/volume/copy", body)
     env.vs_post(source, "/admin/volume/delete", {"volume": vid})
 
 
