@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import functools
 import os
+import queue
+import threading
 
 import numpy as np
 
@@ -75,18 +77,6 @@ def _get_codec(kind: str | None = None):
     return gfmat_jax.get_codec(k, m)
 
 
-def _encode_parity_batch(codec, batch: np.ndarray) -> np.ndarray:
-    """[10, B] host bytes -> [4, B] parity bytes via the selected codec."""
-    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
-    from seaweedfs_tpu.models.rs import RSCode
-    if isinstance(codec, NativeRSCodec):
-        return codec.encode_parity(batch)
-    if isinstance(codec, RSCode):
-        return codec.encode_numpy(batch)[layout.DATA_SHARDS:]
-    import jax.numpy as jnp
-    return np.asarray(codec.encode_parity(jnp.asarray(batch)))
-
-
 def _reconstruct_batch(codec, shards: dict[int, np.ndarray],
                        wanted: list[int]) -> dict[int, np.ndarray]:
     """Rebuild `wanted` shard rows from >=k survivor rows (host bytes in/out)."""
@@ -102,6 +92,9 @@ def _reconstruct_batch(codec, shards: dict[int, np.ndarray],
     return {i: np.asarray(v) for i, v in out.items()}
 
 
+PIPELINE_DEPTH = 3  # host batch buffers in flight: read N+1 / encode N / drain N-1
+
+
 def write_ec_files(base: str, dat_path: str | None = None,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
@@ -110,7 +103,15 @@ def write_ec_files(base: str, dat_path: str | None = None,
     plus a `<base>.vif` volume-info sidecar recording the encode-time dat
     size and version (the reference's .vif, volume_info.go:16-40, as JSON):
     the layout was cut from the FILE size, which later lookups cannot
-    reliably re-derive from the index once tail needles get deleted."""
+    reliably re-derive from the index once tail needles get deleted.
+
+    The encode is a three-stage pipeline mirroring (and overlapping) the
+    reference's streaming loop (ec_encoder.go:120-235): a reader thread
+    fills host batch N+1 from the .dat while the main thread dispatches the
+    device encode of batch N (JAX dispatch is async — the parity array is
+    not materialised here) and a writer thread blocks on batch N-1's parity
+    and drains all 14 shard files. Batch buffers come from a fixed pool of
+    PIPELINE_DEPTH, so steady-state allocation is zero."""
     dat_path = dat_path or base + ".dat"
     dat_size = os.path.getsize(dat_path)
     write_vif(base, dat_size)
@@ -119,42 +120,137 @@ def write_ec_files(base: str, dat_path: str | None = None,
     outputs = [open(base + layout.to_ext(i), "wb")
                for i in range(layout.TOTAL_SHARDS)]
     try:
-        with open(dat_path, "rb") as dat:
-            processed = 0
-            remaining = dat_size
-            while remaining > large_block * layout.DATA_SHARDS:
-                _encode_row(codec, dat, dat_size, processed, large_block,
-                            batch_size, outputs)
-                processed += large_block * layout.DATA_SHARDS
-                remaining -= large_block * layout.DATA_SHARDS
-            while remaining > 0:
-                _encode_row(codec, dat, dat_size, processed, small_block,
-                            batch_size, outputs)
-                processed += small_block * layout.DATA_SHARDS
-                remaining -= small_block * layout.DATA_SHARDS
+        _encode_stream(codec, dat_path, dat_size, large_block, small_block,
+                       batch_size, outputs)
     finally:
         for f in outputs:
             f.close()
 
 
-def _encode_row(codec, dat, dat_size: int, row_start: int, block: int,
-                batch_size: int, outputs) -> None:
-    """Encode one 10-wide row of `block`-sized blocks in column batches."""
-    step = min(batch_size, block)
-    assert block % step == 0, (block, step)
-    for col in range(0, block, step):
-        batch = np.zeros((layout.DATA_SHARDS, step), dtype=np.uint8)
-        for j in range(layout.DATA_SHARDS):
-            off = row_start + j * block + col
-            n = max(0, min(step, dat_size - off))
-            if n > 0:
-                dat.seek(off)
-                raw = dat.read(n)
-                batch[j, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
-        parity = _encode_parity_batch(codec, batch)
-        for i in range(layout.TOTAL_SHARDS):
-            buf = batch[i] if i < layout.DATA_SHARDS else parity[i - layout.DATA_SHARDS]
-            outputs[i].write(buf.tobytes())
+def _iter_units(dat_size: int, large_block: int, small_block: int,
+                batch_size: int):
+    """Yield (row_start, block, col, step) column-batch work units in shard
+    file order: N full rows of 10 large blocks, then small-block rows."""
+    processed = 0
+    remaining = dat_size
+    while remaining > large_block * layout.DATA_SHARDS:
+        step = min(batch_size, large_block)
+        assert large_block % step == 0, (large_block, step)
+        for col in range(0, large_block, step):
+            yield processed, large_block, col, step
+        processed += large_block * layout.DATA_SHARDS
+        remaining -= large_block * layout.DATA_SHARDS
+    while remaining > 0:
+        step = min(batch_size, small_block)
+        assert small_block % step == 0, (small_block, step)
+        for col in range(0, small_block, step):
+            yield processed, small_block, col, step
+        processed += small_block * layout.DATA_SHARDS
+        remaining -= small_block * layout.DATA_SHARDS
+
+
+def _dispatch_parity(codec, batch: np.ndarray):
+    """Dispatch [k, B] -> [m, B] parity. JAX backends return the device
+    array WITHOUT materialising it (dispatch is async; the writer's
+    np.asarray is the sync point); host backends compute eagerly."""
+    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
+    from seaweedfs_tpu.models.rs import RSCode
+    if isinstance(codec, NativeRSCodec):
+        return codec.encode_parity(batch)
+    if isinstance(codec, RSCode):
+        return codec.encode_numpy(batch)[codec.k:]
+    import jax.numpy as jnp
+    return codec.encode_parity(jnp.asarray(batch))
+
+
+def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
+                   small_block: int, batch_size: int, outputs) -> None:
+    """Reader -> dispatch -> writer pipeline over the work units.
+
+    A batch buffer is only returned to the pool after the writer has both
+    written its data rows and materialised its parity — until then the
+    device may still be reading the (possibly zero-copy-aliased on CPU
+    backends) host memory."""
+    max_step = min(batch_size, max(large_block, small_block))
+    pool: queue.Queue = queue.Queue()
+    for _ in range(PIPELINE_DEPTH):
+        pool.put(np.empty((layout.DATA_SHARDS, max_step), dtype=np.uint8))
+    q_read: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
+    q_write: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
+    errors: list[BaseException] = []
+
+    def reader() -> None:
+        try:
+            with open(dat_path, "rb") as dat:
+                for row_start, block, col, step in _iter_units(
+                        dat_size, large_block, small_block, batch_size):
+                    if errors:  # writer failed: stop reading the volume
+                        break
+                    buf = pool.get()
+                    batch = buf[:, :step]
+                    for j in range(layout.DATA_SHARDS):
+                        off = row_start + j * block + col
+                        n = max(0, min(step, dat_size - off))
+                        if n > 0:
+                            dat.seek(off)
+                            raw = dat.read(n)
+                            batch[j, : len(raw)] = np.frombuffer(
+                                raw, dtype=np.uint8)
+                        if n < step:  # only the file's tail needs zero-fill
+                            batch[j, max(n, 0):] = 0
+                    q_read.put((buf, step))
+        except BaseException as e:  # surfaced by the main thread
+            errors.append(e)
+        finally:
+            q_read.put(None)
+
+    def writer() -> None:
+        failed = False
+        while True:
+            item = q_write.get()
+            if item is None:
+                return
+            buf, step, parity = item
+            if not failed:
+                try:
+                    pnp = np.asarray(parity)  # sync point for device encode
+                    for j in range(layout.DATA_SHARDS):
+                        outputs[j].write(buf[j, :step].tobytes())
+                    for i in range(pnp.shape[0]):
+                        outputs[layout.DATA_SHARDS + i].write(pnp[i].tobytes())
+                except BaseException as e:
+                    errors.append(e)
+                    failed = True  # keep draining so nothing deadlocks
+            pool.put(buf)
+
+    t_r = threading.Thread(target=reader, name="ec-reader", daemon=True)
+    t_w = threading.Thread(target=writer, name="ec-writer", daemon=True)
+    t_r.start()
+    t_w.start()
+    try:
+        while True:
+            item = q_read.get()
+            if item is None:
+                break
+            buf, step = item
+            if errors:  # writer failed: stop dispatching, surface below
+                pool.put(buf)
+                continue
+            parity = _dispatch_parity(codec, buf[:, :step])
+            q_write.put((buf, step, parity))
+    finally:
+        q_write.put(None)
+        t_w.join()
+        while t_r.is_alive():  # unblock a reader stuck on a full q_read
+            try:
+                item = q_read.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if item is not None:
+                pool.put(item[0])  # keep the pool whole or the reader starves
+        t_r.join()
+    if errors:
+        raise errors[0]
 
 
 def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH) -> list[int]:
