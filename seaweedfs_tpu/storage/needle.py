@@ -44,6 +44,8 @@ TTL_BYTES = 2
 
 
 def crc32c(data: bytes) -> int:
+    if not isinstance(data, bytes):
+        data = bytes(data)  # google_crc32c rejects writable buffers
     if google_crc32c is not None:
         return int(google_crc32c.value(data))
     from seaweedfs_tpu import native

@@ -19,7 +19,7 @@ from dataclasses import dataclass
 from seaweedfs_tpu.storage import idx as idxf
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
-from seaweedfs_tpu.storage.needle_map import NeedleMap
+from seaweedfs_tpu.storage.needle_map import load_needle_map
 from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
 
 
@@ -42,10 +42,11 @@ class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: str = "000", ttl: str = "",
                  version: int = t.CURRENT_VERSION, backend: str = "disk",
-                 needle_map_kind: str = "memory"):
+                 needle_map_kind: str = "compact"):
         self.dir = dirname
         self.collection = collection
         self.id = vid
+        self.needle_map_kind = needle_map_kind
         self.read_only = False
         self.last_modified = 0.0
         self._lock = threading.RLock()
@@ -98,10 +99,10 @@ class Volume:
             self.read_only = True
             self._idx = None
         else:
-            self.nm = NeedleMap.load_from_idx(self.idx_path)
+            self.nm = load_needle_map(needle_map_kind, self.idx_path)
             if self.backend_kind != "remote":
                 self.check_and_fix_integrity()
-            self._idx = open(self.idx_path, "ab")
+            self._idx = open(self.idx_path, "ab", buffering=0)
             self.nm.attach_idx(self._idx)
 
     # -- geometry ------------------------------------------------------
@@ -135,11 +136,16 @@ class Volume:
             # into whatever bytes were appended after the truncate
             with open(self.idx_path, "ab") as f:
                 for nid in torn:
-                    self.nm._m.pop(nid, None)
+                    self.nm.drop(nid)
                     f.write(idxf.pack_entry(nid, 0, t.TOMBSTONE_FILE_SIZE))
 
-        # walk complete records after the last indexed one
+        # walk complete records after the last indexed one, re-indexing them
+        # (a killed process may have appended data the .idx never saw; the
+        # reference leaves these for `weed fix`, but since the walk already
+        # parses each header, healing the map at boot is free), and truncate
+        # at the first incomplete record
         offset = end + (-end) % t.NEEDLE_PADDING_SIZE
+        recovered: list[tuple[int, int, int]] = []
         while offset + t.NEEDLE_HEADER_SIZE <= file_end:
             header = self._dat.read_at(offset, t.NEEDLE_HEADER_SIZE)
             n = ndl.Needle.parse_header(header)
@@ -149,7 +155,18 @@ class Volume:
                 max(n.size, 0), self.version)
             if offset + rec_len > file_end:
                 break
+            recovered.append((n.id, t.to_offset_units(offset), n.size))
             offset += rec_len
+        if recovered:
+            with open(self.idx_path, "ab") as f:
+                for nid, off_units, size in recovered:
+                    if size > 0:
+                        self.nm.put(nid, off_units, size)
+                        f.write(idxf.pack_entry(nid, off_units, size))
+                    else:  # zero-data record = tombstone (delete_needle)
+                        self.nm.delete(nid)
+                        f.write(idxf.pack_entry(
+                            nid, off_units, t.TOMBSTONE_FILE_SIZE))
         if offset < file_end:
             self._dat.truncate(max(offset, self.super_block.block_size))
 
@@ -321,13 +338,10 @@ class Volume:
         return self.nm.deleted_bytes / size
 
     def max_file_key(self) -> int:
-        """Highest needle id present (heartbeat max_file_key), under the
-        volume lock so concurrent writers can't race the scan."""
+        """Highest needle id ever stored (heartbeat max_file_key) — part of
+        every needle-map kind's surface, so no reaching into map internals."""
         with self._lock:
-            mk = getattr(self.nm, "maximum_key", 0)
-            if mk:
-                return mk
-            return max(self.nm._m, default=0)
+            return self.nm.maximum_key
 
     def compact(self) -> None:
         """Vacuum: copy live needles to .cpd/.cpx then atomically swap
@@ -339,7 +353,7 @@ class Volume:
         if self._idx is None:
             raise PermissionError(
                 f"volume {self.id} is opened with a read-only needle map; "
-                f"reopen with needle_map_kind='memory' to compact")
+                f"reopen with a writable needle map kind to compact")
         with self._lock:
             cpd, cpx = self._base + ".cpd", self._base + ".cpx"
             new_sb = SuperBlock(
@@ -366,8 +380,8 @@ class Volume:
             from seaweedfs_tpu.storage.backend import open_backend
             self._dat = open_backend(self.dat_path, self.backend_kind)
             self.super_block = new_sb
-            self.nm = NeedleMap.load_from_idx(self.idx_path)
-            self._idx = open(self.idx_path, "ab")
+            self.nm = load_needle_map(self.needle_map_kind, self.idx_path)
+            self._idx = open(self.idx_path, "ab", buffering=0)
             self.nm.attach_idx(self._idx)
 
     def apply_catch_up(self, base_size: int, tail_path: str,
@@ -395,8 +409,8 @@ class Volume:
             self._idx.close()
             with open(self.idx_path, "wb") as f:
                 f.write(idx_raw)
-            self.nm = NeedleMap.load_from_idx(self.idx_path)
-            self._idx = open(self.idx_path, "ab")
+            self.nm = load_needle_map(self.needle_map_kind, self.idx_path)
+            self._idx = open(self.idx_path, "ab", buffering=0)
             self.nm.attach_idx(self._idx)
             self.last_modified = time.time()
         return appended

@@ -343,3 +343,90 @@ def test_read_needle_meta_and_page(tmp_path):
     with pytest.raises(KeyError):
         v2.read_needle_meta(1, 1)
     v2.close()
+
+
+def test_compact_needle_map_parity_with_dict_kind(tmp_path):
+    """CompactNeedleMap must agree with NeedleMap on every operation and
+    every metric, across overwrites, tombstones, drops, and a forced
+    base<->overflow merge (reference semantics: compact_map.go + metrics)."""
+    from seaweedfs_tpu.storage.needle_map import CompactNeedleMap
+
+    rng = np.random.default_rng(42)
+    ref, cm = NeedleMap(), CompactNeedleMap()
+    cm.MERGE_THRESHOLD = 32  # force frequent merges
+    fa = open(tmp_path / "a.idx", "wb")
+    fb = open(tmp_path / "b.idx", "wb")
+    ref.attach_idx(fa)
+    cm.attach_idx(fb)
+    ids = list(rng.integers(1, 200, 600))
+    for i, nid in enumerate(ids):
+        nid = int(nid)
+        op = i % 5
+        if op < 3:
+            sz = int(rng.integers(1, 1000))
+            ref.put(nid, i, sz)
+            cm.put(nid, i, sz)
+        elif op == 3:
+            assert ref.delete(nid) == cm.delete(nid)
+        else:
+            ref.drop(nid)
+            cm.drop(nid)
+    fa.close()
+    fb.close()
+    for nid in range(1, 201):
+        assert ref.get(nid) == cm.get(nid), nid
+    assert len(ref) == len(cm)
+    assert ref.file_count == cm.file_count
+    assert ref.deleted_count == cm.deleted_count
+    assert ref.deleted_bytes == cm.deleted_bytes
+    assert ref.maximum_key == cm.maximum_key
+    assert ref.content_size == cm.content_size
+    assert dict(ref.items()) == dict(cm.items())
+    # both idx logs replay to identical state in either kind
+    r2 = NeedleMap.load_from_idx(str(tmp_path / "b.idx"))
+    c2 = CompactNeedleMap.load_from_idx(str(tmp_path / "a.idx"))
+    for nid in range(1, 201):
+        assert r2.get(nid) == c2.get(nid), nid
+    assert r2.deleted_bytes == c2.deleted_bytes
+    assert r2.file_count == c2.file_count
+
+
+def test_compact_needle_map_vectorized_load(tmp_path):
+    """Latest-entry-wins replay: overwrites and tombstones in the log."""
+    from seaweedfs_tpu.storage.needle_map import CompactNeedleMap
+
+    path = str(tmp_path / "v.idx")
+    with open(path, "wb") as f:
+        f.write(idxf.pack_entry(5, 1, 100))
+        f.write(idxf.pack_entry(7, 2, 200))
+        f.write(idxf.pack_entry(5, 3, 150))   # overwrite
+        f.write(idxf.pack_entry(7, 2, -1))    # tombstone
+        f.write(idxf.pack_entry(9, 4, 300))
+    nm = CompactNeedleMap.load_from_idx(path)
+    assert nm.get(5) == (3, 150)
+    assert nm.get(7) is None
+    assert nm.get(9) == (4, 300)
+    assert len(nm) == 2
+    assert nm.file_count == 4            # 4 valid-size entries written
+    assert nm.deleted_count == 2         # one overwrite + one tombstone
+    assert nm.deleted_bytes == 300       # 100 (overwritten) + 200 (deleted)
+    assert nm.content_size == 450
+    assert nm.maximum_key == 9
+
+
+def test_volume_roundtrip_compact_kind(tmp_path):
+    """Full volume write/read/delete/compact cycle on the compact map."""
+    v = Volume(str(tmp_path), "", 31, needle_map_kind="compact")
+    put_blob(v, 1, b"a" * 100)
+    put_blob(v, 2, b"b" * 200)
+    assert v.read_needle(1).data == b"a" * 100
+    v.delete_needle(1)
+    assert v.has_needle(1) is False
+    assert v.max_file_key() == 2
+    v.compact()
+    assert v.read_needle(2).data == b"b" * 200
+    assert v.has_needle(1) is False
+    v.close()
+    v2 = Volume(str(tmp_path), "", 31, needle_map_kind="compact")
+    assert v2.read_needle(2).data == b"b" * 200
+    v2.close()
