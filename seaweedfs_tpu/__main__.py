@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import sys
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 
 def _add_common_flags(p):
@@ -213,8 +214,15 @@ def main(argv=None) -> int:
                      choices=["filer", "security", "master", "replication",
                               "notification", "shell"])
 
+    pcrt = sub.add_parser(
+        "certs", help="generate a cluster CA + node cert/key and print the "
+                      "[tls] table for security.toml (security/tls.py)")
+    pcrt.add_argument("-dir", default="./certs")
+    pcrt.add_argument("-hosts", default="localhost,127.0.0.1",
+                      help="comma-separated SAN hosts/IPs")
+
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc, pwd, pmq, pmt, pft, pcp, pfb):
+              psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -222,6 +230,11 @@ def main(argv=None) -> int:
     from seaweedfs_tpu.utils import grace, weedlog
     weedlog.setup(args.v, args.logFile)
     grace.setup_stack_dumps()
+    # every subcommand — servers AND client-side tools (backup, upload,
+    # shell, mount, filer.sync, mq.broker ...) — loads security.toml here so
+    # JWT keys and process-wide TLS (security/tls.py) are live before any
+    # cluster URL is built
+    _security(args)
     grace.setup_profiling(getattr(args, "cpuprofile", None))
 
     if args.cmd == "master":
@@ -261,6 +274,14 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "filer.backup":
         return _run_filer_backup(args)
+    if args.cmd == "certs":
+        from seaweedfs_tpu.security import tls as tls_mod
+        table = tls_mod.generate_certs(
+            args.dir, [h.strip() for h in args.hosts.split(",") if h.strip()])
+        print("[tls]")
+        for k, v in table.items():
+            print(f'{k} = {str(v).lower() if isinstance(v, bool) else chr(34) + str(v) + chr(34)}')
+        return 0
     if args.cmd == "scaffold":
         return _run_scaffold(args)
     if args.cmd == "webdav":
@@ -615,7 +636,7 @@ def _run_backup(args) -> int:
             else str(args.volumeId))
     os.makedirs(args.dir, exist_ok=True)
     for ext in (".dat", ".idx"):
-        url = (f"http://{args.server}/admin/file?"
+        url = (f"{_tls_scheme()}://{args.server}/admin/file?"
                f"name={urllib.parse.quote(name + ext)}")
         out = os.path.join(args.dir, name + ext)
         # incremental: .dat is append-only, so resume past the local size
@@ -683,6 +704,14 @@ key = ""
 ui = false
 [guard]
 white_list = []
+
+# cluster HTTPS/mTLS (reference wraps gRPC in mTLS, weed/security/tls.go);
+# generate with: weedtpu certs -dir ./certs
+[tls]
+# ca = "certs/ca.crt"
+# cert = "certs/server.crt"
+# key = "certs/server.key"
+# verify_client = true
 """,
     "master": """\
 # master.toml

@@ -12,6 +12,7 @@ import json
 import time
 import urllib.parse
 import urllib.request
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 
 class WeedClient:
@@ -40,7 +41,7 @@ class WeedClient:
         for attempt in range(2 * max(1, len(self.masters))):
             try:
                 with urllib.request.urlopen(
-                        f"http://{self.master}{path}",
+                        f"{_tls_scheme()}://{self.master}{path}",
                         timeout=self.timeout) as r:
                     return json.load(r)
             except urllib.error.HTTPError as e:
@@ -66,7 +67,7 @@ class WeedClient:
         raise RuntimeError(f"no reachable master in {self.masters}: {last}")
 
     def _get_json(self, url: str) -> dict:
-        with urllib.request.urlopen(f"http://{url}", timeout=self.timeout) as r:
+        with urllib.request.urlopen(f"{_tls_scheme()}://{url}", timeout=self.timeout) as r:
             return json.load(r)
 
     # -- master ops ----------------------------------------------------
@@ -118,7 +119,7 @@ class WeedClient:
         if name:
             headers["X-File-Name"] = name
         req = urllib.request.Request(
-            f"http://{url}/{fid}", data=data, method="PUT", headers=headers)
+            f"{_tls_scheme()}://{url}/{fid}", data=data, method="PUT", headers=headers)
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             if r.status >= 300:
                 raise RuntimeError(f"upload {fid} to {url}: HTTP {r.status}")
@@ -131,7 +132,7 @@ class WeedClient:
         last_err: Exception | None = None
         for url in self.lookup(vid):
             try:
-                req = urllib.request.Request(f"http://{url}/{fid}",
+                req = urllib.request.Request(f"{_tls_scheme()}://{url}/{fid}",
                                              headers=headers)
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     return r.read()
@@ -142,7 +143,7 @@ class WeedClient:
     def delete(self, fid: str) -> None:
         vid = int(fid.partition(",")[0])
         for url in self.lookup(vid):
-            req = urllib.request.Request(f"http://{url}/{fid}", method="DELETE",
+            req = urllib.request.Request(f"{_tls_scheme()}://{url}/{fid}", method="DELETE",
                                          headers=self._auth_headers(fid))
             try:
                 urllib.request.urlopen(req, timeout=self.timeout).close()

@@ -26,6 +26,7 @@ import urllib.parse
 import urllib.request
 
 from seaweedfs_tpu.mount.inode import InodeToPath
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 log = logging.getLogger("mount")
 
@@ -161,7 +162,7 @@ class WFS:
         return (self.root + path) or "/"
 
     def _url(self, path: str, query: str = "") -> str:
-        u = f"http://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
+        u = f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
         return u + (f"?{query}" if query else "")
 
     def _meta(self, path: str) -> dict | None:
@@ -218,7 +219,7 @@ class WFS:
         meta_cache_subscribe.go)."""
         since = time.time_ns()
         while not self._stop.is_set():
-            url = (f"http://{self.filer_url}/__meta__/subscribe?"
+            url = (f"{_tls_scheme()}://{self.filer_url}/__meta__/subscribe?"
                    + urllib.parse.urlencode({"since": str(since),
                                              "prefix": self.root or "/",
                                              "live": "true"}))
@@ -266,7 +267,7 @@ class WFS:
 
     def readdir(self, path: str) -> list[str]:
         d = self._fp(path).rstrip("/") + "/"
-        url = (f"http://{self.filer_url}{urllib.parse.quote(d)}"
+        url = (f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(d)}"
                "?limit=100000")
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as r:

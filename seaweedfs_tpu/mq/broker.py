@@ -26,6 +26,8 @@ from aiohttp import web
 
 from seaweedfs_tpu.mq.topic import (LocalPartition, Topic, ring_slot,
                                     split_ring)
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("mq.broker")
 
@@ -55,10 +57,12 @@ class BrokerServer:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=30))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=_tls.server_ssl())
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
         log.info("mq broker on %s", self.url)
@@ -75,7 +79,7 @@ class BrokerServer:
         while True:
             try:
                 async with self._session.post(
-                        f"http://{self.master_url}/cluster/register",
+                        f"{_tls_scheme()}://{self.master_url}/cluster/register",
                         json={"type": "broker", "address": self.url}):
                     pass
             except aiohttp.ClientError:

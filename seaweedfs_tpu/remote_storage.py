@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 
 @dataclass
@@ -139,7 +140,7 @@ def sync_remote_to_filer(remote: RemoteStorageClient, filer_url: str,
         path = mount_dir.rstrip("/") + "/" + e.key
         if e.is_directory:
             req = urllib.request.Request(
-                f"http://{filer_url}{urllib.parse.quote(path + '/')}",
+                f"{_tls_scheme()}://{filer_url}{urllib.parse.quote(path + '/')}",
                 data=b"", method="POST")
             with urllib.request.urlopen(req, timeout=timeout):
                 pass
@@ -153,7 +154,7 @@ def sync_remote_to_filer(remote: RemoteStorageClient, filer_url: str,
         if not cache:
             headers["Seaweed-remote-placeholder"] = "true"
         req = urllib.request.Request(
-            f"http://{filer_url}{urllib.parse.quote(path)}",
+            f"{_tls_scheme()}://{filer_url}{urllib.parse.quote(path)}",
             data=data, method="POST", headers=headers)
         with urllib.request.urlopen(req, timeout=timeout):
             pass

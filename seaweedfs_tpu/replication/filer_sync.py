@@ -21,6 +21,7 @@ import urllib.request
 import zlib
 
 from seaweedfs_tpu.replication.sink import FilerSink, Replicator
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 MAX_APPLY_RETRIES = 5
 
@@ -100,7 +101,7 @@ class SyncDirection:
         self.skipped = 0
 
     def _read_source_file(self, path: str) -> bytes:
-        url = f"http://{self.src}{urllib.parse.quote(path)}"
+        url = f"{_tls_scheme()}://{self.src}{urllib.parse.quote(path)}"
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as r:
                 return r.read()
@@ -116,7 +117,7 @@ class SyncDirection:
         live=False)."""
         while not stop.is_set():
             since = self.offsets.get(self.key)
-            url = (f"http://{self.src}/__meta__/subscribe?"
+            url = (f"{_tls_scheme()}://{self.src}/__meta__/subscribe?"
                    + urllib.parse.urlencode({
                        "since": str(since),
                        "prefix": self.prefix,

@@ -13,6 +13,7 @@ import json
 import os
 import urllib.parse
 import urllib.request
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 
 def entry_is_directory(entry: dict) -> bool:
@@ -67,7 +68,7 @@ class FilerSink(ReplicationSink):
         return {"X-Weed-Signatures": ",".join(map(str, sigs))} if sigs else {}
 
     def _url(self, path: str) -> str:
-        return f"http://{self.filer_url}{urllib.parse.quote(self.prefix + path)}"
+        return f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self.prefix + path)}"
 
     def create_entry(self, path: str, entry: dict, data: bytes | None) -> None:
         if entry_is_directory(entry):

@@ -21,6 +21,8 @@ from aiohttp import web
 
 from seaweedfs_tpu.s3.auth import (Credential, Identity,
                                    IdentityAccessManagement)
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("iam")
 
@@ -72,11 +74,13 @@ class IamApiServer:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=30))
         await self._load()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=_tls.server_ssl())
         await site.start()
         log.info("iam api on %s", self.url)
 
@@ -100,7 +104,7 @@ class IamApiServer:
     async def _load(self) -> None:
         try:
             async with self._session.get(
-                    f"http://{self.filer_url}{IDENTITY_PATH}",
+                    f"{_tls_scheme()}://{self.filer_url}{IDENTITY_PATH}",
                     headers=self._auth(write=False)) as r:
                 if r.status == 200:
                     data = json.loads(await r.read())
@@ -118,7 +122,7 @@ class IamApiServer:
              "actions": i.actions}
             for i in self.iam.identities]}
         async with self._session.put(
-                f"http://{self.filer_url}{IDENTITY_PATH}",
+                f"{_tls_scheme()}://{self.filer_url}{IDENTITY_PATH}",
                 data=json.dumps(data, indent=1).encode(),
                 headers=self._auth(write=True)) as r:
             if r.status >= 300:

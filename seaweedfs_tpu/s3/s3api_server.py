@@ -35,6 +35,8 @@ from aiohttp import web
 from seaweedfs_tpu.s3.auth import (ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                                    ACTION_WRITE, AuthError, Identity,
                                    IdentityAccessManagement)
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("s3")
 
@@ -100,10 +102,12 @@ class S3ApiServer:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=3600))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=_tls.server_ssl())
         await site.start()
         self._ident_task = asyncio.create_task(self._identity_sync())
         log.info("s3 gateway on %s -> filer %s", self.url, self.filer_url)
@@ -131,7 +135,7 @@ class S3ApiServer:
         while True:
             try:
                 await load_once()
-                url = f"http://{self.filer_url}/__meta__/subscribe"
+                url = f"{_tls_scheme()}://{self.filer_url}/__meta__/subscribe"
                 async with self._session.get(
                         url, params={"prefix": prefix, "live": "true"},
                         headers=self._filer_auth(write=False)) as r:
@@ -171,7 +175,7 @@ class S3ApiServer:
 
     async def _filer(self, method: str, path: str, *, params=None, data=None,
                      headers=None, ok=(200, 201, 204)) -> tuple[int, bytes]:
-        url = f"http://{self.filer_url}{urllib.parse.quote(path)}"
+        url = f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(path)}"
         headers = dict(headers or {})
         headers.update(self._filer_auth(write=method not in ("GET", "HEAD")))
         async with self._session.request(method, url, params=params,
@@ -734,7 +738,7 @@ class S3ApiServer:
         headers = self._filer_auth(write=False)
         if "Range" in req.headers:
             headers["Range"] = req.headers["Range"]
-        url = f"http://{self.filer_url}{urllib.parse.quote(self._fp(bucket, key))}"
+        url = f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(bucket, key))}"
         async with self._session.request(req.method, url,
                                          headers=headers) as r:
             if r.status == 404:
@@ -981,7 +985,7 @@ class S3ApiServer:
                           params={"recursive": "true",
                                   "skipChunkDeletion": "true"})
         root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_XMLNS)
-        _el(root, "Location", f"http://{self.url}/{bucket}/{key}")
+        _el(root, "Location", f"{_tls_scheme()}://{self.url}/{bucket}/{key}")
         _el(root, "Bucket", bucket)
         _el(root, "Key", key)
         _el(root, "ETag", f'"{final_etag}"')

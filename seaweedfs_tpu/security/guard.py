@@ -67,6 +67,12 @@ class SecurityConfig:
         self.filer_read = key("filer.signing.read")
         self.guard = Guard(data.get("access", {}).get("ui", {}).get(
             "white_list", data.get("access", {}).get("white_list")))
+        # [tls] table: installs process-wide HTTPS/mTLS for every server
+        # and client in this process (reference: weed/security/tls.go:26-60
+        # wraps all gRPC ends the same way from [grpc] sections)
+        from seaweedfs_tpu.security import tls
+        self.tls = data.get("tls") or {}
+        tls.configure(self.tls)
 
     @classmethod
     def load(cls, path: str | None = None) -> "SecurityConfig":

@@ -46,6 +46,8 @@ from seaweedfs_tpu.filer.filerstore import (MemoryStore, NotFound,
                                             SqliteStore)
 from seaweedfs_tpu.stats import metrics
 from seaweedfs_tpu.utils.http import parse_range
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("filer")
 
@@ -155,6 +157,7 @@ class FilerServer:
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=60))
         self.deletion.start()
         self.filer.meta_log.subscribe(self._fanout_event)
@@ -162,7 +165,8 @@ class FilerServer:
             self.filer.meta_log.subscribe(self._notify_queue)
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=_tls.server_ssl())
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
         log.info("filer listening on %s", self.url)
@@ -174,7 +178,7 @@ class FilerServer:
         while True:
             try:
                 async with self._session.post(
-                        f"http://{self.master_url}/cluster/register",
+                        f"{_tls_scheme()}://{self.master_url}/cluster/register",
                         json={"type": "filer", "address": self.url}):
                     pass
                 if self.aggregate_peers:
@@ -196,7 +200,7 @@ class FilerServer:
         per peer feeding this filer's live event stream, so subscribers of
         THIS filer see a cluster-wide merged change feed."""
         async with self._session.get(
-                f"http://{self.master_url}/cluster/status") as r:
+                f"{_tls_scheme()}://{self.master_url}/cluster/status") as r:
             members = (await r.json()).get("Members", {})
         peers = [f for f in members.get("filer", []) if f != self.url]
         for peer in peers:
@@ -227,7 +231,7 @@ class FilerServer:
         while True:
             try:
                 async with self._session.get(
-                        f"http://{peer}/__meta__/subscribe",
+                        f"{_tls_scheme()}://{peer}/__meta__/subscribe",
                         params={"since": str(since), "live": "true",
                                 "localOnly": "true"},
                         timeout=aiohttp.ClientTimeout(total=None,
@@ -308,7 +312,7 @@ class FilerServer:
         if ttl:
             params["ttl"] = ttl
         async with self._session.get(
-                f"http://{self.master_url}/dir/assign", params=params) as r:
+                f"{_tls_scheme()}://{self.master_url}/dir/assign", params=params) as r:
             a = await r.json()
         if "error" in a:
             raise RuntimeError(f"assign: {a['error']}")
@@ -341,7 +345,7 @@ class FilerServer:
             from seaweedfs_tpu.utils import cipher as _cipher
             cipher_key, data = await asyncio.to_thread(_cipher.encrypt, data)
         async with self._session.put(
-                f"http://{a['url']}/{a['fid']}", data=data,
+                f"{_tls_scheme()}://{a['url']}/{a['fid']}", data=data,
                 headers=headers) as r:
             if r.status >= 300:
                 raise RuntimeError(f"chunk upload: HTTP {r.status}")
@@ -359,7 +363,7 @@ class FilerServer:
             return cached
         vid = fid.partition(",")[0]
         async with self._session.get(
-                f"http://{self.master_url}/dir/lookup",
+                f"{_tls_scheme()}://{self.master_url}/dir/lookup",
                 params={"volumeId": vid}) as r:
             locs = (await r.json()).get("locations", [])
         headers = {}
@@ -370,7 +374,7 @@ class FilerServer:
         last = None
         for loc in locs:
             try:
-                async with self._session.get(f"http://{loc['url']}/{fid}",
+                async with self._session.get(f"{_tls_scheme()}://{loc['url']}/{fid}",
                                              headers=headers) as r:
                     if r.status == 200:
                         blob = await r.read()

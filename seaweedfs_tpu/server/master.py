@@ -24,6 +24,8 @@ from seaweedfs_tpu.security.jwt import gen_jwt
 from seaweedfs_tpu.stats import metrics
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.topology.topology import Topology
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("master")
 
@@ -109,10 +111,12 @@ class MasterServer:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=30))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=_tls.server_ssl())
         await site.start()
         self._expire_task = asyncio.create_task(self._expire_loop())
         if self.raft:
@@ -137,7 +141,7 @@ class MasterServer:
         import urllib.request
         try:
             req = urllib.request.Request(
-                f"http://{peer}/raft/{rpc}",
+                f"{_tls_scheme()}://{peer}/raft/{rpc}",
                 data=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=2.0) as r:
@@ -213,7 +217,7 @@ class MasterServer:
         for vid, url in candidates:
             try:
                 async with self._session.post(
-                        f"http://{url}/admin/volume/vacuum",
+                        f"{_tls_scheme()}://{url}/admin/volume/vacuum",
                         json={"volume": vid}) as r:
                     if r.status == 200:
                         vacuumed += 1
@@ -430,7 +434,7 @@ class MasterServer:
             for node in replica_set:
                 try:
                     async with self._session.post(
-                            f"http://{node.url}/admin/assign_volume",
+                            f"{_tls_scheme()}://{node.url}/admin/assign_volume",
                             json={"volume": vid, "collection": collection,
                                   "replication": replication, "ttl": ttl}) as r:
                         ok &= r.status == 200

@@ -29,6 +29,8 @@ from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import ec_files, ec_volume as ecv, layout
 from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("volume")
 
@@ -97,10 +99,12 @@ class VolumeServer:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=300))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=_tls.server_ssl())
         await site.start()
         try:
             await self._heartbeat_once()
@@ -152,7 +156,7 @@ class VolumeServer:
                      "public_url": self.public_url,
                      "data_center": self.data_center, "rack": self.rack})
         async with self._session.post(
-                f"http://{self.master_url}/heartbeat", json=beat) as r:
+                f"{_tls_scheme()}://{self.master_url}/heartbeat", json=beat) as r:
             if r.status == 200:
                 data = await r.json()
                 self.volume_size_limit = data.get(
@@ -272,7 +276,7 @@ class VolumeServer:
             return None
         try:
             async with self._session.get(
-                    f"http://{self.master_url}/dir/lookup",
+                    f"{_tls_scheme()}://{self.master_url}/dir/lookup",
                     params={"volumeId": str(fid.volume_id)}) as r:
                 locations = (await r.json()).get("locations", [])
         except aiohttp.ClientError as e:
@@ -287,7 +291,7 @@ class VolumeServer:
         if name:
             headers["X-File-Name"] = name.decode(errors="replace")
         for peer in peers:
-            url = f"http://{peer}/{fid}?type=replicate"
+            url = f"{_tls_scheme()}://{peer}/{fid}?type=replicate"
             try:
                 if method == "PUT":
                     async with self._session.put(url, data=data,
@@ -432,14 +436,14 @@ class VolumeServer:
             import json as _json
             try:
                 with urllib.request.urlopen(
-                        f"http://{self.master_url}/dir/ec/lookup?volumeId={vid}",
+                        f"{_tls_scheme()}://{self.master_url}/dir/ec/lookup?volumeId={vid}",
                         timeout=10) as r:
                     shards = _json.load(r).get("shards", {})
                 for loc in shards.get(str(shard_id), []):
                     if loc["url"] == self.url:
                         continue
                     try:
-                        req = (f"http://{loc['url']}/admin/ec/shard_read?"
+                        req = (f"{_tls_scheme()}://{loc['url']}/admin/ec/shard_read?"
                                f"volume={vid}&shard={shard_id}"
                                f"&offset={offset}&size={size}")
                         with urllib.request.urlopen(req, timeout=30) as rr:
@@ -674,7 +678,7 @@ class VolumeServer:
             name = os.path.basename(base + ext)
             try:
                 async with self._session.get(
-                        f"http://{source}/admin/file",
+                        f"{_tls_scheme()}://{source}/admin/file",
                         params={"name": name}) as r:
                     if r.status != 200:
                         if ext in (".ecj", ".vif"):
@@ -714,7 +718,7 @@ class VolumeServer:
             for ext in (".dat", ".idx"):
                 name = os.path.basename(base + ext)
                 async with self._session.get(
-                        f"http://{source}/admin/file",
+                        f"{_tls_scheme()}://{source}/admin/file",
                         params={"name": name}) as r:
                     if r.status != 200:
                         raise OSError(
@@ -773,7 +777,7 @@ class VolumeServer:
         # corrupt the replica even when its file is larger
         try:
             async with self._session.get(
-                    f"http://{source}/admin/file",
+                    f"{_tls_scheme()}://{source}/admin/file",
                     params={"name": name},
                     headers={"Range": "bytes=0-7"}) as r:
                 if r.status not in (200, 206):
@@ -801,7 +805,7 @@ class VolumeServer:
         appended_hint = 0
         try:
             async with self._session.get(
-                    f"http://{source}/admin/file",
+                    f"{_tls_scheme()}://{source}/admin/file",
                     params={"name": name},
                     headers={"Range": f"bytes={local_size}-"}) as r:
                 if r.status == 416:
@@ -831,7 +835,7 @@ class VolumeServer:
                         {"error": f"pull tail: HTTP {r.status}"}, status=500)
             idx_name = os.path.basename(v.idx_path)
             async with self._session.get(
-                    f"http://{source}/admin/file",
+                    f"{_tls_scheme()}://{source}/admin/file",
                     params={"name": idx_name}) as r:
                 if r.status != 200:
                     return web.json_response(

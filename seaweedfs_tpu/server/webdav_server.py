@@ -16,6 +16,8 @@ import xml.etree.ElementTree as ET
 
 import aiohttp
 from aiohttp import web
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("webdav")
 
@@ -48,10 +50,12 @@ class WebDavServer:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=3600))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=_tls.server_ssl())
         await site.start()
         log.info("webdav on %s -> filer %s", self.url, self.filer_url)
 
@@ -75,7 +79,7 @@ class WebDavServer:
                 "Bearer " + gen_jwt(self.security.filer_write, "")}
 
     async def _meta(self, path: str) -> dict | None:
-        url = (f"http://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
+        url = (f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
                "?metadata=true")
         async with self._session.get(url, headers=self._filer_auth()) as r:
             if r.status != 200:
@@ -84,7 +88,7 @@ class WebDavServer:
 
     async def _list(self, path: str) -> list[dict]:
         d = self._fp(path).rstrip("/") + "/"
-        url = (f"http://{self.filer_url}{urllib.parse.quote(d)}"
+        url = (f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(d)}"
                "?limit=10000")
         async with self._session.get(
                 url, headers={"Accept": "application/json",
@@ -188,7 +192,7 @@ class WebDavServer:
     # -- data verbs -----------------------------------------------------
 
     async def do_get(self, req, path) -> web.StreamResponse:
-        url = f"http://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
+        url = f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
         headers = self._filer_auth()
         if "Range" in req.headers:
             headers["Range"] = req.headers["Range"]
@@ -213,7 +217,7 @@ class WebDavServer:
 
     async def do_put(self, req, path) -> web.Response:
         body = await req.read()
-        url = f"http://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
+        url = f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
         headers = {**self._filer_auth(),
                    "Content-Type": req.headers.get(
                        "Content-Type", "application/octet-stream")}
@@ -223,7 +227,7 @@ class WebDavServer:
         return web.Response(status=201)
 
     async def do_delete(self, req, path) -> web.Response:
-        url = (f"http://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
+        url = (f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
                "?recursive=true")
         async with self._session.delete(url, headers=self._filer_auth()) as r:
             if r.status == 404:
@@ -231,7 +235,7 @@ class WebDavServer:
             return web.Response(status=204)
 
     async def do_mkcol(self, req, path) -> web.Response:
-        url = (f"http://{self.filer_url}"
+        url = (f"{_tls_scheme()}://{self.filer_url}"
                f"{urllib.parse.quote(self._fp(path).rstrip('/') + '/')}")
         async with self._session.post(url, data=b"",
                                       headers=self._filer_auth()) as r:
@@ -250,7 +254,7 @@ class WebDavServer:
         dest = self._dest_path(req)
         if not dest:
             return web.Response(status=400)
-        url = (f"http://{self.filer_url}{urllib.parse.quote(self._fp(dest))}"
+        url = (f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(dest))}"
                f"?mv.from={urllib.parse.quote(self._fp(path))}")
         async with self._session.post(url, data=b"",
                                       headers=self._filer_auth()) as r:
@@ -262,14 +266,14 @@ class WebDavServer:
         dest = self._dest_path(req)
         if not dest:
             return web.Response(status=400)
-        src = f"http://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
+        src = f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(path))}"
         async with self._session.get(src, headers=self._filer_auth()) as r:
             if r.status != 200:
                 return web.Response(status=404)
             data = await r.read()
             ctype = r.headers.get("Content-Type",
                                   "application/octet-stream")
-        dst = f"http://{self.filer_url}{urllib.parse.quote(self._fp(dest))}"
+        dst = f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self._fp(dest))}"
         async with self._session.put(
                 dst, data=data,
                 headers={**self._filer_auth(), "Content-Type": ctype}) as r:

@@ -16,6 +16,7 @@ import urllib.request
 
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import layout
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 
 class CommandEnv:
@@ -29,7 +30,7 @@ class CommandEnv:
               method: str | None = None, timeout: float = 600.0) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
-            f"http://{url}", data=data,
+            f"{_tls_scheme()}://{url}", data=data,
             method=method or ("POST" if body is not None else "GET"),
             headers={"Content-Type": "application/json"} if body is not None else {})
         try:
@@ -75,7 +76,7 @@ class CommandEnv:
 
     def filer_read(self, filer: str, path: str) -> bytes:
         req = urllib.request.Request(
-            f"http://{filer}{urllib.parse.quote(path)}")
+            f"{_tls_scheme()}://{filer}{urllib.parse.quote(path)}")
         with urllib.request.urlopen(req, timeout=600) as r:
             return r.read()
 
@@ -1036,7 +1037,7 @@ def cmd_s3_configure(env: CommandEnv, args, out):
         print(f"configured identity {user}: {existing['actions']}", file=out)
     payload = json.dumps(cfg, indent=1).encode()
     req = urllib.request.Request(
-        f"http://{filer}{urllib.parse.quote(IDENTITY_PATH)}",
+        f"{_tls_scheme()}://{filer}{urllib.parse.quote(IDENTITY_PATH)}",
         data=payload, method="PUT")
     with urllib.request.urlopen(req, timeout=30):
         pass
