@@ -25,9 +25,16 @@ def _add_common_flags(p):
                    help="write a cProfile dump here on exit (grace/pprof.go)")
 
 
+_SEC_CACHE = None
+
+
 def _security(args):
-    from seaweedfs_tpu.security.guard import SecurityConfig
-    return SecurityConfig.load(getattr(args, "securityConfig", None))
+    global _SEC_CACHE
+    if _SEC_CACHE is None:
+        from seaweedfs_tpu.security.guard import SecurityConfig
+        _SEC_CACHE = SecurityConfig.load(
+            getattr(args, "securityConfig", None))
+    return _SEC_CACHE
 
 
 def _add_master_flags(p):
@@ -96,7 +103,8 @@ def main(argv=None) -> int:
                     help="aggregate meta events from peer filers into this "
                          "filer's subscribe feed (meta_aggregator.go)")
     pf.add_argument("-store", default=None,
-                    help="filer store driver (memory|sqlite|logstore|redis; "
+                    help="filer store driver (memory|sqlite|logstore|redis|"
+                         "postgres|mysql; "
                          "default sqlite with -dir, memory without)")
     pf.add_argument("-encryptVolumeData", action="store_true",
                     help="AES-256-GCM encrypt chunks (cipher key in meta)")
@@ -233,8 +241,10 @@ def main(argv=None) -> int:
     # every subcommand — servers AND client-side tools (backup, upload,
     # shell, mount, filer.sync, mq.broker ...) — loads security.toml here so
     # JWT keys and process-wide TLS (security/tls.py) are live before any
-    # cluster URL is built
-    _security(args)
+    # cluster URL is built. `certs` and `scaffold` are the bootstrap tools
+    # that must run even when the configured cert files are missing.
+    if args.cmd not in ("certs", "scaffold"):
+        _security(args)
     grace.setup_profiling(getattr(args, "cpuprofile", None))
 
     if args.cmd == "master":

@@ -6,7 +6,9 @@ weed/filer/ in the reference (see SURVEY.md §2.4)."""
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk  # noqa: F401
 from seaweedfs_tpu.filer.filer import Filer, MetaEvent  # noqa: F401
 from seaweedfs_tpu.filer.filerstore import (  # noqa: F401
-    FilerStore, MemoryStore, NotFound, SqliteStore, make_store)
+    FilerStore, MemoryStore, NotFound, make_store)
+from seaweedfs_tpu.filer.abstract_sql import (  # noqa: F401
+    AbstractSqlStore, MysqlStore, PostgresStore, SqliteStore)
 # extra drivers register themselves in STORES on import (the analogue of
 # the reference's blank-import registration, weed/command/imports.go)
 from seaweedfs_tpu.filer import stores_extra  # noqa: F401,E402

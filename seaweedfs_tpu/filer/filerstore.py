@@ -228,142 +228,10 @@ class MemoryStore(FilerStore):
             self._kv.pop(key, None)
 
 
-class SqliteStore(FilerStore):
-    """Embedded persistent store on stdlib sqlite3 — the same schema shape
-    as the reference's abstract_sql layer (weed/filer/abstract_sql/
-    abstract_sql_store.go: (dirhash, name, directory, meta) rows with a
-    (dirhash, name) primary key; the reference's sqlite driver rides that
-    layer too)."""
-
-    name = "sqlite"
-
-    def __init__(self, path: str):
-        import sqlite3
-        self.path = path
-        self._local = threading.local()
-        self._sqlite3 = sqlite3
-        conn = self._conn()
-        conn.executescript("""
-            CREATE TABLE IF NOT EXISTS filemeta (
-                dirhash INTEGER NOT NULL,
-                name TEXT NOT NULL,
-                directory TEXT NOT NULL,
-                meta BLOB,
-                PRIMARY KEY (dirhash, name)
-            );
-            CREATE INDEX IF NOT EXISTS idx_dir ON filemeta (directory);
-            CREATE TABLE IF NOT EXISTS kv (
-                key BLOB PRIMARY KEY,
-                value BLOB
-            );
-        """)
-        conn.commit()
-
-    def _conn(self):
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = self._sqlite3.connect(self.path, timeout=30.0)
-            conn.execute("PRAGMA journal_mode=WAL")
-            conn.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = conn
-        return conn
-
-    @staticmethod
-    def _dirhash(directory: str) -> int:
-        """Stable 64-bit dir hash (reference: util.HashStringToLong), kept
-        signed for sqlite INTEGER."""
-        import hashlib
-        h = hashlib.md5(directory.encode()).digest()
-        v = int.from_bytes(h[:8], "big", signed=True)
-        return v
-
-    def insert_entry(self, entry: Entry) -> None:
-        d, n = split_path(entry.full_path)
-        conn = self._conn()
-        conn.execute(
-            "INSERT OR REPLACE INTO filemeta (dirhash,name,directory,meta) "
-            "VALUES (?,?,?,?)", (self._dirhash(d), n, d, entry.encode()))
-        conn.commit()
-
-    update_entry = insert_entry
-
-    def find_entry(self, full_path: str) -> Entry:
-        d, n = split_path(full_path)
-        cur = self._conn().execute(
-            "SELECT meta FROM filemeta WHERE dirhash=? AND name=?",
-            (self._dirhash(d), n))
-        row = cur.fetchone()
-        if row is None:
-            raise NotFound(full_path)
-        return Entry.decode(row[0])
-
-    def delete_entry(self, full_path: str) -> None:
-        d, n = split_path(full_path)
-        conn = self._conn()
-        conn.execute("DELETE FROM filemeta WHERE dirhash=? AND name=?",
-                     (self._dirhash(d), n))
-        conn.commit()
-
-    def delete_folder_children(self, full_path: str) -> None:
-        full_path = full_path.rstrip("/") or "/"
-        conn = self._conn()
-        pref = full_path if full_path.endswith("/") else full_path + "/"
-        esc = (pref.replace("\\", r"\\").replace("%", r"\%")
-               .replace("_", r"\_"))
-        conn.execute(
-            r"DELETE FROM filemeta WHERE directory=? "
-            r"OR directory LIKE ? ESCAPE '\'",
-            (full_path, esc + "%"))
-        conn.commit()
-
-    def list_directory_entries(self, dir_path: str, start_from: str = "",
-                               include_start: bool = False,
-                               limit: int = 1024,
-                               prefix: str = "") -> list[Entry]:
-        dir_path = dir_path.rstrip("/") or "/"
-        cmp = ">=" if include_start else ">"
-        sql = "SELECT meta FROM filemeta WHERE dirhash=? AND directory=?"
-        params: list = [self._dirhash(dir_path), dir_path]
-        if start_from:
-            sql += f" AND name {cmp} ?"
-            params.append(start_from)
-        if prefix:
-            sql += r" AND name LIKE ? ESCAPE '\'"
-            params.append(prefix.replace("\\", r"\\").replace("%", r"\%")
-                          .replace("_", r"\_") + "%")
-        sql += " ORDER BY name LIMIT ?"
-        params.append(limit)
-        cur = self._conn().execute(sql, params)
-        return [Entry.decode(row[0]) for row in cur.fetchall()]
-
-    def kv_put(self, key: bytes, value: bytes) -> None:
-        conn = self._conn()
-        conn.execute("INSERT OR REPLACE INTO kv (key,value) VALUES (?,?)",
-                     (key, value))
-        conn.commit()
-
-    def kv_get(self, key: bytes) -> bytes:
-        cur = self._conn().execute("SELECT value FROM kv WHERE key=?", (key,))
-        row = cur.fetchone()
-        if row is None:
-            raise NotFound(key)
-        return row[0]
-
-    def kv_delete(self, key: bytes) -> None:
-        conn = self._conn()
-        conn.execute("DELETE FROM kv WHERE key=?", (key,))
-        conn.commit()
-
-    def shutdown(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
-
-
 STORES: dict[str, type] = {
     "memory": MemoryStore,
-    "sqlite": SqliteStore,
+    # "sqlite"/"postgres"/"mysql" register from abstract_sql.py,
+    # "logstore"/"redis" from stores_extra.py
 }
 
 

@@ -62,7 +62,7 @@ class BrokerServer:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=_tls.server_ssl())
+                           ssl_context=_tls.server_ssl("broker"))
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
         log.info("mq broker on %s", self.url)

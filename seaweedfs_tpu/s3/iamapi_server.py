@@ -80,7 +80,7 @@ class IamApiServer:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=_tls.server_ssl())
+                           ssl_context=_tls.server_ssl("iam"))
         await site.start()
         log.info("iam api on %s", self.url)
 

@@ -107,7 +107,7 @@ class S3ApiServer:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=_tls.server_ssl())
+                           ssl_context=_tls.server_ssl("s3"))
         await site.start()
         self._ident_task = asyncio.create_task(self._identity_sync())
         log.info("s3 gateway on %s -> filer %s", self.url, self.filer_url)

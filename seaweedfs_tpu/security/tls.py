@@ -125,13 +125,11 @@ def client_ssl() -> ssl.SSLContext | None:
         return None
     if _state._client_ctx is None:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-        # cluster addresses are host:port, frequently raw IPs; the CA is
-        # private so CA pinning (not hostname matching) is the trust root,
-        # like the reference's InsecureSkipVerify=false + private CA pool
-        ctx.check_hostname = False
-        # system CAs load alongside the cluster CA so urllib requests that
-        # happen to target external HTTPS endpoints from this process still
-        # verify (this opener is global; see configure())
+        # hostname verification stays ON: node certs must carry their
+        # host/IP in SAN (the `certs` subcommand's -hosts flag does this),
+        # and since this context also serves process-global urllib traffic
+        # (see configure()), system-CA endpoints keep full verification
+        ctx.check_hostname = True
         ctx.load_default_certs()
         if _state.ca:
             ctx.load_verify_locations(_state.ca)

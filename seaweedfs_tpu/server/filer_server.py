@@ -42,8 +42,8 @@ from seaweedfs_tpu.filer.filer import Filer, dir_has_prefix
 from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
                                             load_filer_conf, save_filer_conf)
 from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
-from seaweedfs_tpu.filer.filerstore import (MemoryStore, NotFound,
-                                            SqliteStore)
+from seaweedfs_tpu.filer.abstract_sql import SqliteStore
+from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFound
 from seaweedfs_tpu.stats import metrics
 from seaweedfs_tpu.utils.http import parse_range
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
@@ -166,7 +166,7 @@ class FilerServer:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=_tls.server_ssl())
+                           ssl_context=_tls.server_ssl("filer"))
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
         log.info("filer listening on %s", self.url)

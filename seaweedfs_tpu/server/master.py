@@ -116,7 +116,7 @@ class MasterServer:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=_tls.server_ssl())
+                           ssl_context=_tls.server_ssl("master"))
         await site.start()
         self._expire_task = asyncio.create_task(self._expire_loop())
         if self.raft:

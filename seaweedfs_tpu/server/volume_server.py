@@ -104,7 +104,7 @@ class VolumeServer:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=_tls.server_ssl())
+                           ssl_context=_tls.server_ssl("volume"))
         await site.start()
         try:
             await self._heartbeat_once()

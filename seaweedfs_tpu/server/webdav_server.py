@@ -55,7 +55,7 @@ class WebDavServer:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=_tls.server_ssl())
+                           ssl_context=_tls.server_ssl("webdav"))
         await site.start()
         log.info("webdav on %s -> filer %s", self.url, self.filer_url)
 

@@ -9,6 +9,7 @@ import pytest
 
 from seaweedfs_tpu.filer import (Entry, FileChunk, Filer, MemoryStore,
                                  NotFound, SqliteStore)
+from seaweedfs_tpu.filer import abstract_sql
 from seaweedfs_tpu.filer import filechunks as fc
 from seaweedfs_tpu.filer import filechunk_manifest as fcm
 from seaweedfs_tpu.filer.entry import Attr, new_directory_entry, split_path
@@ -73,13 +74,61 @@ def test_equal_mtime_later_append_wins():
 
 # ---------------------------------------------------------------- stores
 
-@pytest.fixture(params=["memory", "sqlite", "logstore"])
+class _FormatCursorShim:
+    """DB-API cursor translating %s placeholders back to sqlite's ? — lets
+    the abstract layer's "format" paramstyle path (postgres/mysql) run for
+    real against sqlite."""
+
+    def __init__(self, cur):
+        self._cur = cur
+
+    def execute(self, q, params=()):
+        assert "?" not in q, f"format dialect leaked qmark SQL: {q}"
+        return self._cur.execute(q.replace("%s", "?"), params)
+
+    def __getattr__(self, name):
+        return getattr(self._cur, name)
+
+
+class _FormatConnShim:
+    def __init__(self, conn):
+        self._conn = conn
+
+    def cursor(self):
+        return _FormatCursorShim(self._conn.cursor())
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
+class FormatDialect(abstract_sql.SqliteDialect):
+    """Second dialect for the driver matrix: the shared SQL layer compiled
+    to the %s parameter style (as postgres/mysql use), executed on sqlite
+    through the shim — proves AbstractSqlStore is dialect-generic."""
+
+    name = "format-shim"
+    paramstyle = "format"
+    # exercise the generic upsert translation too (sqlite >= 3.24 supports
+    # ON CONFLICT ... DO UPDATE, the same spelling as postgres)
+
+    def connect(self):
+        return _FormatConnShim(super().connect())
+
+    def create_tables(self, conn):
+        super().create_tables(conn._conn)
+
+
+@pytest.fixture(params=["memory", "sqlite", "logstore", "sql-format"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryStore()
     elif request.param == "logstore":
         from seaweedfs_tpu.filer.stores_extra import LogStore
         s = LogStore(str(tmp_path / "logstore"))
+        yield s
+        s.shutdown()
+    elif request.param == "sql-format":
+        s = abstract_sql.AbstractSqlStore(FormatDialect(str(tmp_path / "f.db")))
         yield s
         s.shutdown()
     else:
