@@ -73,7 +73,9 @@ class MasterServer:
                 RaftConfig(node_id=me, peers=others,
                            state_path=state_path),
                 transport=self._raft_transport,
-                apply_command=self._raft_apply)
+                apply_command=self._raft_apply,
+                take_snapshot=self._raft_take_snapshot,
+                restore_snapshot=self._raft_restore_snapshot)
         self.app = web.Application(client_max_size=64 * 1024 * 1024,
                                    middlewares=[self._guard_middleware])
         self.app.add_routes([
@@ -90,6 +92,7 @@ class MasterServer:
             web.post("/vol/vacuum", self.handle_vacuum),
             web.post("/raft/request_vote", self.handle_raft_vote),
             web.post("/raft/append_entries", self.handle_raft_append),
+            web.post("/raft/install_snapshot", self.handle_raft_install),
             web.get("/metrics", self.handle_metrics),
             web.get("/", self.handle_ui),
         ])
@@ -154,6 +157,24 @@ class MasterServer:
             with self.topo._lock:
                 self.topo.max_volume_id = max(self.topo.max_volume_id,
                                               int(command["vid"]))
+
+    def _raft_take_snapshot(self) -> dict:
+        """The only raft-hard state is the vid high-water mark; soft
+        topology is rebuilt from heartbeats (raft_server.go comment)."""
+        with self.topo._lock:
+            return {"max_volume_id": self.topo.max_volume_id}
+
+    def _raft_restore_snapshot(self, data: dict) -> None:
+        with self.topo._lock:
+            self.topo.max_volume_id = max(self.topo.max_volume_id,
+                                          int(data.get("max_volume_id", 0)))
+
+    async def handle_raft_install(self, req: web.Request) -> web.Response:
+        if self.raft is None:
+            return web.json_response({"error": "raft disabled"}, status=400)
+        body = await req.json()
+        return web.json_response(
+            await asyncio.to_thread(self.raft.handle_install_snapshot, body))
 
     async def handle_raft_vote(self, req: web.Request) -> web.Response:
         if self.raft is None:

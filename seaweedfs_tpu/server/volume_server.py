@@ -701,13 +701,35 @@ class VolumeServer:
         body = await req.json()
         vid, source = body["volume"], body["source"]
         collection = body.get("collection", "")
+        # staging=True keeps the copy OUT of the write path for the whole
+        # move: hidden from heartbeats (no master lookup / replicate
+        # fan-out can reach it) and read-only, with an on-disk .staging
+        # marker so a crash mid-move never boots it as live data.
+        # finalize=True flips it live after the frozen-source catch-up —
+        # the reference gets the same safety by mounting only at the end
+        # (command_volume_move.go LiveMoveVolume).
+        staging = bool(body.get("staging"))
+        finalize = bool(body.get("finalize"))
         existing = self.store.get_volume(vid)
         if existing is not None:
             # incremental catch-up (reference:
             # volume_grpc_copy_incremental.go): .dat is append-only, so
             # pull only the tail past our size, then refresh the .idx
-            return await self._volume_copy_incremental(
+            resp = await self._volume_copy_incremental(
                 existing, vid, source, collection)
+            if finalize and resp.status == 200 and \
+                    getattr(existing, "staging", False):
+                # only a staged copy flips live here — a pre-existing
+                # replica that is read-only for structural reasons
+                # (remote tier, sorted-file map) must stay read-only
+                try:
+                    os.remove(existing._base + ".staging")
+                except OSError:
+                    pass
+                existing.staging = False
+                existing.read_only = False
+                await self._heartbeat_once()
+            return resp
         loc = min(self.store.locations, key=lambda l: len(l.volumes))
         base = loc.base_path(vid, collection)
         # pull into .cpd/.cpx temp names, rename only when both succeed, so
@@ -726,10 +748,15 @@ class VolumeServer:
                     with open(base + tmp_ext[ext], "wb") as f:
                         async for chunk in r.content.iter_chunked(1 << 20):
                             f.write(chunk)
+            if staging:
+                # marker lands BEFORE the .dat appears: a crash between the
+                # renames can only leave a marked (= never-booted) copy
+                with open(base + ".staging", "w"):
+                    pass
             for ext in (".dat", ".idx"):
                 os.replace(base + tmp_ext[ext], base + ext)
         except (aiohttp.ClientError, OSError) as e:
-            for ext in (".cpd", ".cpx"):
+            for ext in (".cpd", ".cpx", ".staging"):
                 try:
                     os.remove(base + ext)
                 except OSError:
@@ -741,9 +768,13 @@ class VolumeServer:
                                           vid)
         except Exception as e:
             return web.json_response({"error": f"load: {e}"}, status=500)
+        if staging:
+            vol.staging = True
+            vol.read_only = True
         loc.volumes[vid] = vol
         loc.collections[vid] = collection
-        await self._heartbeat_once()
+        if not staging:  # staged copies stay invisible until finalize
+            await self._heartbeat_once()
         return web.json_response({"file_count": vol.info().file_count})
 
     async def handle_tier_move(self, req: web.Request) -> web.Response:

@@ -464,21 +464,48 @@ def move_volume(env: "CommandEnv", vid: int, source: str, target: str,
     """Copy-then-delete volume move, the one protocol both volume.move and
     volume.balance use (reference: command_volume_move.go LiveMoveVolume).
 
-    Live-safe: the bulk copy happens while the source still takes writes,
-    then incremental catch-ups drain the tail, then the source is frozen
-    read-only and one final catch-up runs so nothing written after the
-    snapshot can be lost — only then is the source deleted."""
-    body = {"volume": vid, "source": source, "collection": collection}
+    Live-safe: the bulk copy and tail drains run in STAGING mode — the
+    target copy is read-only, hidden from heartbeats, and marked on disk,
+    so neither lookups nor replicate fan-out can reach it and a crash
+    mid-move can never boot it as live data. Then the source is frozen
+    read-only, one finalizing catch-up closes the race window and flips
+    the target live, and only then is the source deleted. If anything
+    fails after the freeze, the source is made writable again before the
+    error propagates (the reference rolls back the same way via a
+    deferred VolumeMarkWritable, command_volume_move.go)."""
+    import time as _time
+    body = {"volume": vid, "source": source, "collection": collection,
+            "staging": True}
     env.vs_post(target, "/admin/volume/copy", body)
-    # drain the append tail while the source is still live
+    # drain the append tail while the source is still live; stop early
+    # when the tail stops shrinking — the post-freeze copy closes whatever
+    # remains, so chasing a write-hot volume here is wasted round-trips
+    last = None
     for _ in range(10):
         r = env.vs_post(target, "/admin/volume/copy", body)
-        if r.get("appended_bytes", 0) == 0:
+        appended = r.get("appended_bytes", 0)
+        if appended == 0 or (last is not None and appended >= last):
             break
-    # freeze writes, then the final catch-up closes the race window
+        last = appended
+        _time.sleep(0.2)
+    # freeze writes, then the finalizing catch-up closes the race window
     env.vs_post(source, "/admin/volume/readonly",
                 {"volume": vid, "readonly": True})
-    env.vs_post(target, "/admin/volume/copy", body)
+    try:
+        env.vs_post(target, "/admin/volume/copy",
+                    dict(body, finalize=True))
+    except Exception:
+        # finalize failed: the target never went live, so re-enabling the
+        # source is safe and restores service
+        try:
+            env.vs_post(source, "/admin/volume/readonly",
+                        {"volume": vid, "readonly": False})
+        except Exception:
+            pass  # rollback is best-effort; the original error matters more
+        raise
+    # past this point the target IS live: never unfreeze the source (two
+    # writable copies would silently diverge) — a failed delete leaves a
+    # read-only source replica the operator can delete by hand
     env.vs_post(source, "/admin/volume/delete", {"volume": vid})
 
 

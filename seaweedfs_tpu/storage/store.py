@@ -42,6 +42,10 @@ class DiskLocation:
                 continue
             vid = int(m.group("vid"))
             col = m.group("col") or ""
+            if os.path.exists(path[: -len(".dat")] + ".staging"):
+                # half-moved copy from a crashed volume.move: never mount
+                # it as live data (shell re-runs the move from scratch)
+                continue
             if vid not in self.volumes:
                 self.volumes[vid] = Volume(self.directory, col, vid)
                 self.collections[vid] = col
@@ -163,9 +167,17 @@ class Store:
         vols, ec_shards = [], []
         max_slots = 0
         max_file_key = 0
+        staged = 0
         for loc in self.locations:
             max_slots += loc.max_volumes
             for vid, v in loc.volumes.items():
+                if getattr(v, "staging", False):
+                    # mid-move target copies stay invisible to the master
+                    # so no lookup/replicate traffic reaches them — but
+                    # they do hold a slot (counted below so the master's
+                    # free-slot math stays honest)
+                    staged += 1
+                    continue
                 max_file_key = max(max_file_key, v.max_file_key())
                 info = v.info()
                 vols.append({
@@ -184,7 +196,8 @@ class Store:
                     "shard_ids": ev.shard_ids(),
                 })
         return {"volumes": vols, "ec_shards": ec_shards,
-                "max_volume_count": max_slots, "public_url": self.public_url,
+                "max_volume_count": max_slots - staged,
+                "public_url": self.public_url,
                 # highest needle key on this server: the master advances its
                 # sequencer past it so ids never repeat after a master
                 # restart (reference: master_pb Heartbeat.max_file_key)

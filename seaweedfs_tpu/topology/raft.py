@@ -44,6 +44,10 @@ class RaftConfig:
     election_timeout_ms: tuple[int, int] = (150, 300)
     heartbeat_ms: int = 50
     state_path: str | None = None
+    # compact the log into a state-machine snapshot once this many applied
+    # entries accumulate (reference: raft_hashicorp.go snapshots; without
+    # this an admin-lock-churning master replays an unbounded log at boot)
+    snapshot_threshold: int = 1000
 
 
 class RaftNode:
@@ -51,16 +55,26 @@ class RaftNode:
     injected (the master wires it to HTTP POST /raft/<rpc>)."""
 
     def __init__(self, config: RaftConfig, transport,
-                 apply_command, on_leadership_change=None):
+                 apply_command, on_leadership_change=None,
+                 take_snapshot=None, restore_snapshot=None):
         self.cfg = config
         self.transport = transport
         self.apply_command = apply_command
         self.on_leadership_change = on_leadership_change or (lambda l: None)
+        # state-machine hooks for log compaction: take_snapshot() -> dict
+        # captures applied state; restore_snapshot(dict) reinstates it
+        self.take_snapshot = take_snapshot
+        self.restore_snapshot = restore_snapshot
 
         self.state = FOLLOWER
         self.current_term = 0
         self.voted_for: str | None = None
+        # self.log holds entries AFTER the snapshot; absolute index i lives
+        # at position i - snap_index - 1
         self.log: list[LogEntry] = []
+        self.snap_index = -1   # last absolute index covered by the snapshot
+        self.snap_term = 0
+        self._snapshot_data: dict | None = None
         self.commit_index = -1
         self.last_applied = -1
         self.leader_id: str | None = None
@@ -70,6 +84,11 @@ class RaftNode:
 
         self._lock = threading.RLock()
         self._apply_cv = threading.Condition(self._lock)
+        # serializes apply_command batches against snapshot restores so a
+        # restored snapshot can never be followed by re-application of
+        # entries it already covers (double-apply)
+        self._apply_mu = threading.Lock()
+        self._restored_through = -1
         self._stop = threading.Event()
         self._last_heartbeat = time.monotonic()
         self._threads: list[threading.Thread] = []
@@ -88,8 +107,20 @@ class RaftNode:
             self.voted_for = d.get("voted_for")
             self.log = [LogEntry(e["term"], e["command"])
                         for e in d.get("log", [])]
+            self.snap_index = d.get("snap_index", -1)
+            self.snap_term = d.get("snap_term", 0)
+            self._snapshot_data = d.get("snapshot")
         except (OSError, ValueError):
             log.warning("raft state load failed; starting fresh")
+            return
+        if self.snap_index >= 0:
+            # snapshot state is committed by definition: reinstate it and
+            # resume applying from the log tail
+            if self.restore_snapshot and self._snapshot_data is not None:
+                self.restore_snapshot(self._snapshot_data)
+            self.commit_index = self.snap_index
+            self.last_applied = self.snap_index
+            self._restored_through = self.snap_index
 
     def _save_state(self) -> None:
         p = self.cfg.state_path
@@ -98,8 +129,26 @@ class RaftNode:
         tmp = p + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"term": self.current_term, "voted_for": self.voted_for,
-                       "log": [e.to_dict() for e in self.log]}, f)
+                       "log": [e.to_dict() for e in self.log],
+                       "snap_index": self.snap_index,
+                       "snap_term": self.snap_term,
+                       "snapshot": self._snapshot_data}, f)
         os.replace(tmp, p)
+
+    # -- index math (absolute <-> log position) --------------------------
+
+    def _last_index_locked(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def _term_at_locked(self, abs_idx: int) -> int:
+        if abs_idx == self.snap_index:
+            return self.snap_term
+        if abs_idx < self.snap_index:
+            return 0  # inside the snapshot: term unknown, never needed
+        return self.log[abs_idx - self.snap_index - 1].term
+
+    def _entry_at_locked(self, abs_idx: int) -> LogEntry:
+        return self.log[abs_idx - self.snap_index - 1]
 
     # -- lifecycle ------------------------------------------------------
 
@@ -154,8 +203,8 @@ class RaftNode:
             self.voted_for = self.cfg.node_id
             self._save_state()
             self._last_heartbeat = time.monotonic()
-            last_idx = len(self.log) - 1
-            last_term = self.log[-1].term if self.log else 0
+            last_idx = self._last_index_locked()
+            last_term = self._term_at_locked(last_idx) if last_idx >= 0 else 0
         votes = 1
         for peer in self.cfg.peers:
             resp = self.transport(peer, "request_vote", {
@@ -175,7 +224,7 @@ class RaftNode:
             if votes >= self.quorum():
                 self.state = LEADER
                 self.leader_id = self.cfg.node_id
-                n = len(self.log)
+                n = self._last_index_locked() + 1
                 self.next_index = {p: n for p in self.cfg.peers}
                 self.match_index = {p: -1 for p in self.cfg.peers}
                 log.info("%s elected leader for term %d (%d votes)",
@@ -207,15 +256,28 @@ class RaftNode:
         with self._lock:
             if self.state != LEADER or self.current_term != term:
                 return
-            ni = self.next_index.get(peer, len(self.log))
-            prev_idx = ni - 1
-            prev_term = self.log[prev_idx].term if prev_idx >= 0 else 0
-            entries = [e.to_dict() for e in self.log[ni:]]
-            payload = {
-                "term": term, "leader_id": self.cfg.node_id,
-                "prev_log_index": prev_idx, "prev_log_term": prev_term,
-                "entries": entries, "leader_commit": self.commit_index}
-        resp = self.transport(peer, "append_entries", payload)
+            ni = self.next_index.get(peer, self._last_index_locked() + 1)
+            if ni <= self.snap_index:
+                # peer lags behind the compacted log: ship the snapshot
+                # (InstallSnapshot, raft §7) and retry entries after it
+                payload = {
+                    "term": term, "leader_id": self.cfg.node_id,
+                    "last_included_index": self.snap_index,
+                    "last_included_term": self.snap_term,
+                    "data": self._snapshot_data}
+                rpc = "install_snapshot"
+            else:
+                prev_idx = ni - 1
+                prev_term = self._term_at_locked(prev_idx) \
+                    if prev_idx >= 0 else 0
+                entries = [e.to_dict()
+                           for e in self.log[ni - self.snap_index - 1:]]
+                payload = {
+                    "term": term, "leader_id": self.cfg.node_id,
+                    "prev_log_index": prev_idx, "prev_log_term": prev_term,
+                    "entries": entries, "leader_commit": self.commit_index}
+                rpc = "append_entries"
+        resp = self.transport(peer, rpc, payload)
         if resp is None:
             return
         with self._lock:
@@ -224,16 +286,23 @@ class RaftNode:
                 return
             if self.state != LEADER or self.current_term != term:
                 return
+            if rpc == "install_snapshot":
+                if resp.get("success"):
+                    self.match_index[peer] = payload["last_included_index"]
+                    self.next_index[peer] = \
+                        payload["last_included_index"] + 1
+                return
             if resp.get("success"):
-                self.match_index[peer] = prev_idx + len(payload["entries"])
+                self.match_index[peer] = \
+                    payload["prev_log_index"] + len(payload["entries"])
                 self.next_index[peer] = self.match_index[peer] + 1
                 self._advance_commit_locked()
             else:
-                self.next_index[peer] = max(0, ni - 1)
+                self.next_index[peer] = max(self.snap_index + 1, ni - 1)
 
     def _advance_commit_locked(self) -> None:
-        for n in range(len(self.log) - 1, self.commit_index, -1):
-            if self.log[n].term != self.current_term:
+        for n in range(self._last_index_locked(), self.commit_index, -1):
+            if self._term_at_locked(n) != self.current_term:
                 continue
             count = 1 + sum(1 for p in self.cfg.peers
                             if self.match_index.get(p, -1) >= n)
@@ -252,13 +321,39 @@ class RaftNode:
                     return
                 start = self.last_applied + 1
                 end = self.commit_index
-                to_apply = [(i, self.log[i]) for i in range(start, end + 1)]
+                to_apply = [(i, self._entry_at_locked(i))
+                            for i in range(start, end + 1)]
                 self.last_applied = end
-            for i, entry in to_apply:
-                try:
-                    self.apply_command(entry.command)
-                except Exception:
-                    log.exception("apply failed at index %d", i)
+            with self._apply_mu:
+                for i, entry in to_apply:
+                    if i <= self._restored_through:
+                        continue  # a restored snapshot already covers it
+                    try:
+                        self.apply_command(entry.command)
+                    except Exception:
+                        log.exception("apply failed at index %d", i)
+            with self._lock:
+                self._maybe_compact_locked()
+
+    def _maybe_compact_locked(self) -> None:
+        """Fold applied entries into a state-machine snapshot and truncate
+        the log (reference analogue: raft_hashicorp.go snapshot config)."""
+        if self.take_snapshot is None:
+            return
+        if len(self.log) < self.cfg.snapshot_threshold:
+            return
+        upto = self.last_applied
+        if upto <= self.snap_index:
+            return
+        data = self.take_snapshot()
+        term = self._term_at_locked(upto)
+        self.log = self.log[upto - self.snap_index:]
+        self.snap_index = upto
+        self.snap_term = term
+        self._snapshot_data = data
+        self._save_state()
+        log.info("%s compacted log through index %d (%d entries remain)",
+                 self.cfg.node_id, upto, len(self.log))
 
     # -- client API -----------------------------------------------------
 
@@ -269,7 +364,7 @@ class RaftNode:
                 return False
             self.log.append(LogEntry(self.current_term, command))
             self._save_state()
-            index = len(self.log) - 1
+            index = self._last_index_locked()
             if not self.cfg.peers:  # single-node cluster commits instantly
                 self.commit_index = index
                 self._apply_cv.notify_all()
@@ -295,8 +390,9 @@ class RaftNode:
             granted = False
             if term == self.current_term and \
                     self.voted_for in (None, req["candidate_id"]):
-                my_last_term = self.log[-1].term if self.log else 0
-                my_last_idx = len(self.log) - 1
+                my_last_idx = self._last_index_locked()
+                my_last_term = self._term_at_locked(my_last_idx) \
+                    if my_last_idx >= 0 else 0
                 up_to_date = (req["last_log_term"], req["last_log_index"]) \
                     >= (my_last_term, my_last_idx)
                 if up_to_date:
@@ -316,24 +412,69 @@ class RaftNode:
             self.leader_id = req["leader_id"]
             self._last_heartbeat = time.monotonic()
             prev_idx = req["prev_log_index"]
-            if prev_idx >= 0:
-                if prev_idx >= len(self.log) or \
-                        self.log[prev_idx].term != req["prev_log_term"]:
+            entries = req["entries"]
+            if prev_idx < self.snap_index:
+                # a prefix of these entries is already inside our snapshot
+                # (committed by definition): skip it
+                cut = self.snap_index - prev_idx
+                entries = entries[cut:]
+                prev_idx = self.snap_index
+            elif prev_idx >= 0:
+                if prev_idx > self._last_index_locked() or \
+                        (prev_idx > self.snap_index and
+                         self._term_at_locked(prev_idx) !=
+                         req["prev_log_term"]):
                     return {"term": self.current_term, "success": False}
-            # append, truncating conflicts
+            # append, truncating conflicts (positions are log-relative)
             idx = prev_idx + 1
-            for e in req["entries"]:
-                if idx < len(self.log):
-                    if self.log[idx].term != e["term"]:
-                        del self.log[idx:]
+            for e in entries:
+                pos = idx - self.snap_index - 1
+                if pos < len(self.log):
+                    if self.log[pos].term != e["term"]:
+                        del self.log[pos:]
                         self.log.append(LogEntry(e["term"], e["command"]))
                 else:
                     self.log.append(LogEntry(e["term"], e["command"]))
                 idx += 1
-            if req["entries"]:
+            if entries:
                 self._save_state()
             if req["leader_commit"] > self.commit_index:
                 self.commit_index = min(req["leader_commit"],
-                                        len(self.log) - 1)
+                                        self._last_index_locked())
                 self._apply_cv.notify_all()
+            return {"term": self.current_term, "success": True}
+
+    def handle_install_snapshot(self, req: dict) -> dict:
+        """Follower side of InstallSnapshot (raft §7): replace state with
+        the leader's snapshot, keep any log tail that extends past it."""
+        with self._lock:
+            term = req["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term, req["leader_id"])
+            self.leader_id = req["leader_id"]
+            self._last_heartbeat = time.monotonic()
+            li = req["last_included_index"]
+            lt = req["last_included_term"]
+            if li <= self.snap_index:  # stale snapshot
+                return {"term": self.current_term, "success": True}
+            if li <= self._last_index_locked() and \
+                    self._term_at_locked(li) == lt:
+                self.log = self.log[li - self.snap_index:]
+            else:
+                self.log = []
+            self.snap_index, self.snap_term = li, lt
+            self._snapshot_data = req.get("data")
+            if self.restore_snapshot and self._snapshot_data is not None:
+                # _apply_mu excludes a concurrent apply_command batch; the
+                # marker stops any already-captured batch from re-applying
+                # entries the snapshot includes (lock order: _lock then
+                # _apply_mu here; the apply loop never nests the reverse)
+                with self._apply_mu:
+                    self.restore_snapshot(self._snapshot_data)
+                    self._restored_through = li
+            self.commit_index = max(self.commit_index, li)
+            self.last_applied = max(self.last_applied, li)
+            self._save_state()
             return {"term": self.current_term, "success": True}

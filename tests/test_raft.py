@@ -30,6 +30,8 @@ class Net:
                 return node.handle_request_vote(payload)
             if rpc == "append_entries":
                 return node.handle_append_entries(payload)
+            if rpc == "install_snapshot":
+                return node.handle_install_snapshot(payload)
             return None
         return transport
 
@@ -225,3 +227,116 @@ def test_master_ha_cluster(tmp_path):
         for m in masters:
             run(m.stop())
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_snapshot_compaction_and_restart(tmp_path):
+    """Leader compacts its log into a snapshot at the threshold; a node
+    restarted from disk restores snapshot + tail and reaches the same
+    applied state (reference: raft_hashicorp.go snapshot config)."""
+    net = Net()
+    ids = ["n0", "n1", "n2"]
+    state = {i: {"sum": 0} for i in ids}  # toy state machine: running sum
+
+    def make(nid, threshold=20):
+        cfg = RaftConfig(
+            node_id=nid, peers=[p for p in ids if p != nid],
+            election_timeout_ms=(80, 160), heartbeat_ms=25,
+            state_path=str(tmp_path / f"{nid}.json"),
+            snapshot_threshold=threshold)
+
+        def apply(cmd):
+            state[nid]["sum"] += cmd["add"]
+
+        node = RaftNode(cfg, net.transport_for(nid), apply_command=apply,
+                        take_snapshot=lambda: dict(state[nid]),
+                        restore_snapshot=lambda d: state[nid].update(d))
+        net.nodes[nid] = node
+        return node
+
+    nodes = [make(i) for i in ids]
+    for n in nodes:
+        n.start()
+    leader = wait_leader(nodes)
+    total = 0
+    for i in range(60):
+        assert leader.propose({"add": i}, timeout=5.0)
+        total += i
+    deadline = time.time() + 10
+    while time.time() < deadline and any(
+            state[i]["sum"] != total for i in ids):
+        time.sleep(0.02)
+    assert all(state[i]["sum"] == total for i in ids)
+    # compaction happened: log shrank and a snapshot exists
+    deadline = time.time() + 5
+    while time.time() < deadline and leader.snap_index < 0:
+        time.sleep(0.02)
+    assert leader.snap_index >= 0
+    assert len(leader.log) < 60
+
+    # restart one follower from disk: snapshot + tail replay
+    victim = next(n for n in nodes if not n.is_leader)
+    vid = victim.cfg.node_id
+    victim.stop()
+    net.down.add(vid)
+    time.sleep(0.2)
+    state[vid] = {"sum": 0}
+    net.down.discard(vid)
+    revived = make(vid)
+    revived.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and state[vid]["sum"] != total:
+        time.sleep(0.02)
+    assert state[vid]["sum"] == total
+    for n in nodes + [revived]:
+        n.stop()
+
+
+def test_fresh_follower_catches_up_via_install_snapshot(tmp_path):
+    """A brand-new follower with an empty log and no snapshot must be
+    brought current through the InstallSnapshot RPC once the leader has
+    compacted entries it would otherwise need to replay."""
+    net = Net()
+    ids = ["n0", "n1", "n2"]
+    state = {i: {"sum": 0} for i in ids}
+
+    def make(nid):
+        cfg = RaftConfig(
+            node_id=nid, peers=[p for p in ids if p != nid],
+            election_timeout_ms=(80, 160), heartbeat_ms=25,
+            snapshot_threshold=10)
+
+        def apply(cmd):
+            state[nid]["sum"] += cmd["add"]
+
+        node = RaftNode(cfg, net.transport_for(nid), apply_command=apply,
+                        take_snapshot=lambda: dict(state[nid]),
+                        restore_snapshot=lambda d: state[nid].update(d))
+        net.nodes[nid] = node
+        return node
+
+    # n2 stays dark while the others commit + compact
+    net.down.add("n2")
+    nodes = [make(i) for i in ids]
+    for n in nodes[:2]:
+        n.start()
+    leader = wait_leader(nodes[:2])
+    total = 0
+    for i in range(40):
+        assert leader.propose({"add": i}, timeout=5.0)
+        total += i
+    deadline = time.time() + 5
+    while time.time() < deadline and leader.snap_index < 0:
+        time.sleep(0.02)
+    assert leader.snap_index >= 0, "leader never compacted"
+
+    # n2 joins fresh: its needed entries are gone from the leader's log,
+    # so only InstallSnapshot can catch it up
+    net.down.discard("n2")
+    nodes[2].start()
+    deadline = time.time() + 10
+    while time.time() < deadline and state["n2"]["sum"] != total:
+        time.sleep(0.02)
+    assert state["n2"]["sum"] == total
+    assert nodes[2].snap_index >= 0  # arrived via snapshot, not replay
+    for n in nodes:
+        n.stop()
