@@ -6,17 +6,18 @@ saveDataAsChunk), filehandle.go, meta_cache/meta_cache.go:28 +
 meta_cache_subscribe.go:12.  All filer interaction is plain HTTP, all
 operations synchronous (the FUSE binding calls them from its own loop).
 
-Design: reads stream from the filer; writes accumulate in per-handle
-dirty page buffers and flush as whole files on close/fsync (files at
-FUSE-write sizes round-trip fine; the filer re-chunks server-side).  The
-meta cache holds recently-seen entries and is invalidated by the filer's
-meta-subscribe stream, the same freshness contract as the reference's
-local leveldb meta cache.
+Design: reads stream from the filer; writes accumulate in fixed-size
+dirty PAGES per handle (interval-tracked), written back as ranged
+`?offset=` chunk patches when the page budget fills and on flush — RAM
+stays bounded for any file size, like the reference's chunked dirty pages
++ page_writer. Truncate is a metadata-only server op. The meta cache holds
+recently-seen entries and is invalidated by the filer's meta-subscribe
+stream, the same freshness contract as the reference's local leveldb meta
+cache.
 """
 
 from __future__ import annotations
 
-import io
 import json
 import logging
 import threading
@@ -68,68 +69,128 @@ class MetaCache:
                 del self._entries[p]
 
 
+PAGE_SIZE = 2 * 1024 * 1024   # dirty-page chunk size (reference: 2MB pages)
+MAX_DIRTY_PAGES = 16          # per-handle RAM budget: 32MB, then writeback
+
+
 class FileHandle:
-    """Open-file state with chunked dirty pages
-    (reference: weed/mount/filehandle.go + dirty_pages_chunked.go)."""
+    """Open-file state with chunked dirty pages (reference:
+    weed/mount/filehandle.go + dirty_pages_chunked.go + page_writer/).
+
+    Writes land in fixed-size page buffers, each tracking its written
+    interval list; when the dirty-page budget is exceeded the lowest pages
+    are flushed as ranged `PUT ?offset=` patches (the filer turns each into
+    chunk refs whose mtime shadows older overlapping data). RSS for a
+    streaming write of any file size is bounded by MAX_DIRTY_PAGES pages —
+    the old whole-file buffer needed the entire file in RAM."""
 
     def __init__(self, fh: int, path: str, wfs: "WFS"):
         self.fh = fh
         self.path = path
         self.wfs = wfs
         self._lock = threading.Lock()
-        self._dirty: io.BytesIO | None = None
-        self._dirty_base: bytes | None = None
+        # page index -> (buffer, [(lo, hi) written intervals, sorted])
+        self._pages: dict[int, tuple[bytearray, list[tuple[int, int]]]] = {}
+        self._truncate_to: int | None = None
 
-    def read(self, size: int, offset: int) -> bytes:
-        with self._lock:
-            if self._dirty is not None:
-                buf = self._dirty.getvalue()
-                return buf[offset:offset + size]
-        return self.wfs._read_range(self.path, offset, size)
+    # -- interval bookkeeping ------------------------------------------
+
+    @staticmethod
+    def _add_interval(ivals: list[tuple[int, int]], lo: int, hi: int) -> None:
+        """Insert [lo,hi) and coalesce touching/overlapping neighbours."""
+        out = []
+        for a, b in ivals:
+            if b < lo or a > hi:
+                out.append((a, b))
+            else:
+                lo, hi = min(lo, a), max(hi, b)
+        out.append((lo, hi))
+        out.sort()
+        ivals[:] = out
 
     def write(self, data: bytes, offset: int) -> int:
         with self._lock:
-            if self._dirty is None:
-                # copy-on-first-write: pull current content once
-                base = b""
-                try:
-                    base = self.wfs._read_all(self.path)
-                except FsError:
-                    pass
-                self._dirty = io.BytesIO(base)
-                self._dirty_base = base
-            self._dirty.seek(offset)
-            self._dirty.write(data)
+            pos = 0
+            while pos < len(data):
+                page = (offset + pos) // PAGE_SIZE
+                in_page = (offset + pos) % PAGE_SIZE
+                n = min(len(data) - pos, PAGE_SIZE - in_page)
+                buf, ivals = self._pages.get(page) or (bytearray(PAGE_SIZE),
+                                                       [])
+                buf[in_page:in_page + n] = data[pos:pos + n]
+                self._add_interval(ivals, in_page, in_page + n)
+                self._pages[page] = (buf, ivals)
+                pos += n
+            if len(self._pages) > MAX_DIRTY_PAGES:
+                self._writeback_locked(keep=MAX_DIRTY_PAGES // 2)
             return len(data)
+
+    def read(self, size: int, offset: int) -> bytes:
+        with self._lock:
+            pages = {i: (bytes(b), list(iv))
+                     for i, (b, iv) in self._pages.items()}
+            trunc = self._truncate_to
+        base = b""
+        if trunc is None or offset < trunc:
+            want = size if trunc is None else min(size, trunc - offset)
+            try:
+                base = self.wfs._read_range(self.path, offset, want)
+            except FsError as e:
+                if e.errno != 2:  # ENOENT = not flushed yet, all dirty
+                    raise
+        out = bytearray(base.ljust(size, b"\0"))
+        n_out = len(base)
+        # overlay dirty intervals; track the furthest dirty byte so the
+        # returned span includes unflushed tail data past the filer size
+        for page, (buf, ivals) in pages.items():
+            pbase = page * PAGE_SIZE
+            for lo, hi in ivals:
+                a = max(pbase + lo, offset)
+                b = min(pbase + hi, offset + size)
+                if a < b:
+                    out[a - offset:b - offset] = \
+                        buf[a - pbase:b - pbase]
+                    n_out = max(n_out, b - offset)
+        if trunc is not None:
+            # a pending grow must read as a zero-filled tail (POSIX)
+            n_out = max(n_out, min(size, max(0, trunc - offset)))
+        return bytes(out[:n_out])
 
     def truncate(self, length: int) -> None:
         with self._lock:
-            cur = b""
-            if self._dirty is not None:
-                cur = self._dirty.getvalue()
-            else:
-                try:
-                    cur = self.wfs._read_all(self.path)
-                except FsError:
-                    pass
-                self._dirty_base = cur
-            cur = cur[:length].ljust(length, b"\0")
-            self._dirty = io.BytesIO(cur)
-            self._dirty.seek(0, io.SEEK_END)
+            # drop dirty data past the cut, trim straddling intervals
+            for page in list(self._pages):
+                pbase = page * PAGE_SIZE
+                if pbase >= length:
+                    del self._pages[page]
+                    continue
+                buf, ivals = self._pages[page]
+                cut = length - pbase
+                if cut < PAGE_SIZE:
+                    ivals[:] = [(lo, min(hi, cut))
+                                for lo, hi in ivals if lo < cut]
+            self._truncate_to = length
+
+    def _writeback_locked(self, keep: int = 0) -> None:
+        """Flush lowest-indexed dirty pages (sequential writers evict the
+        already-complete prefix) down to `keep` resident pages. A page
+        leaves _pages only after its patches succeed — a failed upload
+        keeps the data so the application's fsync retry actually retries."""
+        pending_trunc = self._truncate_to
+        if pending_trunc is not None:
+            self.wfs._truncate_server(self.path, pending_trunc)
+            self._truncate_to = None
+        for page in sorted(self._pages)[:max(0, len(self._pages) - keep)]:
+            buf, ivals = self._pages[page]
+            pbase = page * PAGE_SIZE
+            for lo, hi in ivals:
+                self.wfs._patch_range(self.path, pbase + lo,
+                                      bytes(buf[lo:hi]))
+            del self._pages[page]
 
     def flush(self) -> None:
         with self._lock:
-            if self._dirty is None:
-                return
-            data = self._dirty.getvalue()
-            if self._dirty_base is not None and data == self._dirty_base:
-                self._dirty = None
-                self._dirty_base = None
-                return
-        self.wfs._write_all(self.path, data)
-        with self._lock:
-            self._dirty = None
-            self._dirty_base = None
+            self._writeback_locked(keep=0)
 
 
 class WFS:
@@ -212,6 +273,35 @@ class WFS:
                 pass
         except urllib.error.HTTPError as e:
             raise FsError(5, f"write: {e.code}")
+        self.meta_cache.invalidate(path)
+
+    def _patch_range(self, path: str, offset: int, data: bytes) -> None:
+        """Ranged chunk write (`?offset=`): the filer stores just this span
+        as new chunk refs — the dirty-page flush primitive."""
+        req = urllib.request.Request(self._url(path, f"offset={offset}"),
+                                     data=data, method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            raise FsError(5, f"patch: {e.code}")
+        self.meta_cache.invalidate(path)
+
+    def _truncate_server(self, path: str, length: int) -> None:
+        """Metadata-only server-side resize (`?truncate=`)."""
+        req = urllib.request.Request(self._url(path, f"truncate={length}"),
+                                     data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                # file not flushed/created yet: create then resize
+                self._write_all(path, b"")
+                if length:
+                    self._truncate_server(path, length)
+                return
+            raise FsError(5, f"truncate: {e.code}")
         self.meta_cache.invalidate(path)
 
     def _subscribe_loop(self) -> None:
@@ -314,12 +404,9 @@ class WFS:
         if fh is not None and fh in self._handles:
             self._handles[fh].truncate(length)
             return
-        data = b""
-        try:
-            data = self._read_all(path)
-        except FsError:
-            pass
-        self._write_all(path, data[:length].ljust(length, b"\0"))
+        # pathwise truncate is metadata-only on the server — O(1), not the
+        # old O(file size) read-modify-write
+        self._truncate_server(path, length)
 
     def flush(self, fh: int) -> None:
         self.handle(fh).flush()

@@ -99,6 +99,7 @@ class FilerServer:
         else:
             store = MemoryStore()
             meta_log_path = None
+        self._rmw_locks: dict[str, asyncio.Lock] = {}
         self.deletion = DeletionQueue(
             WeedClient(master_url, jwt_signer=self.jwt_signer),
             resolve_manifest=self._resolve_for_delete)
@@ -531,6 +532,12 @@ class FilerServer:
             self.filer.create_entry(d, signatures=_req_signatures(req))
             return web.json_response({"name": d.name}, status=201)
 
+        if "offset" in req.query:
+            return await self._handle_patch(req, path, collection,
+                                            replication, ttl, chunk_size)
+        if "truncate" in req.query:
+            return await self._handle_truncate(req, path)
+
         # autochunk the body (reference: doPostAutoChunk)
         mime = req.headers.get("Content-Type", "")
         if mime in ("application/octet-stream", ""):
@@ -538,31 +545,10 @@ class FilerServer:
             mime = mimetypes.guess_type(path)[0] or mime
         chunks: list[FileChunk] = []
         md5 = hashlib.md5()
-        total = 0
-        pending = bytearray()
-        content = req.content
         try:
-            while True:
-                piece = await content.read(min(chunk_size, 1 << 20))
-                if not piece:
-                    break
-                md5.update(piece)
-                pending.extend(piece)
-                while len(pending) >= chunk_size:
-                    blob = bytes(pending[:chunk_size])
-                    del pending[:chunk_size]
-                    ck = await self._upload_chunk(blob, collection,
-                                                  replication, ttl, mime)
-                    ck.offset = total
-                    total += len(blob)
-                    chunks.append(ck)
-            if pending:  # empty files carry no chunks, like the reference
-                blob = bytes(pending)
-                ck = await self._upload_chunk(blob, collection,
-                                              replication, ttl, mime)
-                ck.offset = total
-                total += len(blob)
-                chunks.append(ck)
+            total = await self._stream_chunks(
+                req.content, chunk_size, 0, collection, replication, ttl,
+                mime, chunks, md5)
         except (RuntimeError, OSError, aiohttp.ClientError) as e:
             # clean up already-written chunks on failure
             self.deletion.enqueue_chunks(chunks)
@@ -589,6 +575,167 @@ class FilerServer:
         return web.json_response(
             {"name": entry.name, "size": total, "eTag": md5.hexdigest()},
             status=201)
+
+    async def _stream_chunks(self, content, chunk_size: int,
+                             base_offset: int, collection: str,
+                             replication: str, ttl: str, mime: str,
+                             chunks: list[FileChunk],
+                             md5=None) -> int:
+        """Stream a request body into blob-store chunks at logical offsets
+        base_offset.. — shared by whole-file uploads and ranged patches.
+        Appends into the caller's `chunks` list so a failure mid-stream
+        leaves the partial refs visible for cleanup. Returns byte count."""
+        total = 0
+        pending = bytearray()
+
+        async def emit(blob: bytes) -> None:
+            nonlocal total
+            ck = await self._upload_chunk(blob, collection, replication,
+                                          ttl, mime)
+            ck.offset = base_offset + total
+            total += len(blob)
+            chunks.append(ck)
+
+        while True:
+            piece = await content.read(min(chunk_size, 1 << 20))
+            if not piece:
+                break
+            if md5 is not None:
+                md5.update(piece)
+            pending.extend(piece)
+            while len(pending) >= chunk_size:
+                blob = bytes(pending[:chunk_size])
+                del pending[:chunk_size]
+                await emit(blob)
+        if pending:  # empty files carry no chunks, like the reference
+            await emit(bytes(pending))
+        return total
+
+    def _path_lock(self, path: str) -> asyncio.Lock:
+        """Per-path mutex serializing entry read-modify-writes (patch /
+        truncate): without it two concurrent patches each base their
+        update_entry on the pre-other chunk list and one range silently
+        reverts. Locks are pruned opportunistically when uncontended."""
+        if len(self._rmw_locks) > 1024:
+            for p, lk in list(self._rmw_locks.items()):
+                if not lk.locked():
+                    del self._rmw_locks[p]
+        return self._rmw_locks.setdefault(path, asyncio.Lock())
+
+    async def _handle_patch(self, req: web.Request, path: str,
+                            collection: str, replication: str, ttl: str,
+                            chunk_size: int) -> web.Response:
+        """Ranged write `PUT path?offset=N`: store the body as chunks at
+        logical offset N without touching the file's other bytes — the
+        chunk model's mtime-wins interval resolution (filechunks.py) makes
+        the new range shadow whatever it overlaps. This is the server half
+        of the mount's chunked dirty-page flush (the reference pairs
+        dirty_pages_chunked.go saveDataAsChunk with filer UpdateEntry the
+        same way), and gives any HTTP client O(range) random writes."""
+        try:
+            off = int(req.query["offset"])
+        except ValueError:
+            return web.json_response({"error": "bad offset"}, status=400)
+        if off < 0:
+            return web.json_response({"error": "negative offset"},
+                                     status=400)
+        mime = req.headers.get("Content-Type", "")
+        async with self._path_lock(path):
+            entry = None
+            try:
+                entry = self.filer.find_entry(path)
+                if entry.is_directory:
+                    return web.json_response({"error": "is a directory"},
+                                             status=409)
+            except NotFound:
+                pass
+            chunks: list[FileChunk] = []
+            try:
+                total = await self._stream_chunks(
+                    req.content, chunk_size, off, collection, replication,
+                    ttl, mime, chunks)
+            except (RuntimeError, OSError, aiohttp.ClientError) as e:
+                self.deletion.enqueue_chunks(chunks)
+                return web.json_response({"error": str(e)}, status=500)
+            now = time.time()
+            if entry is None:
+                entry = Entry(
+                    full_path=path,
+                    attr=Attr(mtime=now, crtime=now, mode=0o660, mime=mime,
+                              file_size=off + total),
+                    chunks=chunks)
+                self._apply_headers(entry, req)
+                self.filer.create_entry(entry,
+                                        signatures=_req_signatures(req))
+            else:
+                merged = list(entry.chunks) + chunks
+                # prune fully-shadowed refs so a rewrite-heavy workload
+                # (database file through the mount) can't grow the chunk
+                # list and leak blobs forever; shadowed manifests keep
+                # their metadata (their inner refs would leak otherwise)
+                compacted, garbage = fc.compact_chunks(merged)
+                keep = [c for c in garbage if c.is_chunk_manifest]
+                drop = [c for c in garbage if not c.is_chunk_manifest]
+                entry.chunks = compacted + keep
+                if len(entry.chunks) > fcm.MANIFEST_BATCH:
+                    entry.chunks = await self._maybe_manifestize_async(
+                        entry.chunks, collection, replication, ttl)
+                entry.attr.mtime = now
+                entry.attr.file_size = max(entry.size(), off + total)
+                entry.attr.md5 = ""  # no longer a whole-body hash
+                self.filer.update_entry(entry)
+                if drop:
+                    self.deletion.enqueue_chunks(drop)
+        return web.json_response(
+            {"name": entry.name, "offset": off, "size": total}, status=201)
+
+    async def _handle_truncate(self, req: web.Request,
+                               path: str) -> web.Response:
+        """`POST path?truncate=N`: metadata-only resize. Shrink drops/trims
+        chunk refs beyond N (freed chunks go to the deletion queue; a
+        straddling manifest is resolved to its inner refs first so the trim
+        is real); grow just raises file_size — the read path zero-fills
+        past the last chunk (filer/stream semantics, like the reference)."""
+        try:
+            length = int(req.query["truncate"])
+        except ValueError:
+            return web.json_response({"error": "bad length"}, status=400)
+        if length < 0:
+            return web.json_response({"error": "negative length"},
+                                     status=400)
+        async with self._path_lock(path):
+            entry = self.filer.find_entry(path)  # NotFound -> 404
+            if entry.is_directory:
+                return web.json_response({"error": "is a directory"},
+                                         status=409)
+            chunks = entry.chunks
+            resolved_manifests: list[FileChunk] = []
+            if any(c.is_chunk_manifest and c.offset < length <
+                   c.offset + c.size for c in chunks):
+                resolved_manifests = [c for c in chunks
+                                      if c.is_chunk_manifest]
+                chunks = await self._resolve_chunks(entry)
+            kept, freed = [], []
+            for c in chunks:
+                if c.offset >= length:
+                    freed.append(c)
+                elif c.offset + c.size > length:
+                    c.size = length - c.offset  # straddler: trim the tail
+                    kept.append(c)
+                else:
+                    kept.append(c)
+            entry.chunks = kept
+            entry.attr.file_size = length
+            entry.attr.mtime = time.time()
+            entry.attr.md5 = ""
+            self.filer.update_entry(entry)
+            # resolved manifest blobs left the entry: free them too (their
+            # inner refs are now inlined in kept/freed)
+            freed = [c for c in freed if not c.is_chunk_manifest] \
+                + resolved_manifests
+            if freed:
+                self.deletion.enqueue_chunks(freed)
+        return web.json_response({"name": entry.name, "size": length})
 
     async def _maybe_manifestize_async(self, chunks, collection,
                                        replication, ttl):

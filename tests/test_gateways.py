@@ -219,3 +219,84 @@ class TestMountVFS:
             assert wfs.getattr("/mc.txt")["st_size"] == 9
         finally:
             wfs.close()
+
+    def test_wfs_sparse_and_append_patterns(self, stack):
+        """Chunked dirty pages: sparse writes leave zero-filled gaps,
+        appends extend, page-budget writeback keeps RAM bounded
+        (reference: dirty_pages_chunked.go / page_writer)."""
+        from seaweedfs_tpu.mount import weedfs as wmod
+        from seaweedfs_tpu.mount.weedfs import WFS
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=False)
+        old_page, old_max = wmod.PAGE_SIZE, wmod.MAX_DIRTY_PAGES
+        wmod.PAGE_SIZE, wmod.MAX_DIRTY_PAGES = 1024, 4  # tiny for the test
+        try:
+            # sparse: write at 0 and far beyond, gap must read as zeros
+            fh = wfs.create("/sparse.bin")
+            wfs.write(fh, b"head", 0)
+            wfs.write(fh, b"tail", 5000)
+            wfs.flush(fh)
+            wfs.release(fh)
+            assert wfs.getattr("/sparse.bin")["st_size"] == 5004
+            fh = wfs.open("/sparse.bin")
+            got = wfs.read(fh, 5004, 0)
+            assert got[:4] == b"head"
+            assert got[5000:] == b"tail"
+            assert got[4:5000] == b"\0" * 4996
+            wfs.release(fh)
+
+            # streaming append far beyond the page budget: dirty pages are
+            # written back mid-stream, never more than MAX_DIRTY_PAGES held
+            fh = wfs.create("/big.bin")
+            blob = bytes(range(256)) * 4  # 1KB
+            n_pages = 40  # 40KB through a 4-page budget
+            for i in range(n_pages):
+                wfs.write(fh, blob, i * len(blob))
+                h = wfs.handle(fh)
+                assert len(h._pages) <= wmod.MAX_DIRTY_PAGES + 1
+            wfs.flush(fh)
+            wfs.release(fh)
+            fh = wfs.open("/big.bin")
+            back = wfs.read(fh, n_pages * len(blob), 0)
+            assert back == blob * n_pages
+            wfs.release(fh)
+
+            # read-your-writes before flush + handle truncate
+            fh = wfs.open("/big.bin")
+            wfs.write(fh, b"XYZ", 10)
+            assert wfs.read(fh, 3, 10) == b"XYZ"  # dirty overlay
+            wfs.truncate("/big.bin", 100, fh)
+            wfs.flush(fh)
+            wfs.release(fh)
+            assert wfs.getattr("/big.bin")["st_size"] == 100
+            assert wfs.read(wfs.open("/big.bin"), 3, 10) == b"XYZ"
+        finally:
+            wmod.PAGE_SIZE, wmod.MAX_DIRTY_PAGES = old_page, old_max
+            wfs.close()
+
+    def test_filer_ranged_patch_and_truncate_http(self, stack):
+        """The filer-side primitives directly: PUT ?offset= patches a span
+        as chunks; POST ?truncate= is a metadata-only resize."""
+        c, filer, _, _ = stack
+        base = f"http://{filer.url}/patch.bin"
+        urllib.request.urlopen(urllib.request.Request(
+            base, data=b"0123456789", method="PUT"), timeout=15)
+        urllib.request.urlopen(urllib.request.Request(
+            base + "?offset=3", data=b"ABC", method="PUT"), timeout=15)
+        with urllib.request.urlopen(base, timeout=15) as r:
+            assert r.read() == b"012ABC6789"
+        # extend past the end through a hole
+        urllib.request.urlopen(urllib.request.Request(
+            base + "?offset=12", data=b"ZZ", method="PUT"), timeout=15)
+        with urllib.request.urlopen(base, timeout=15) as r:
+            assert r.read() == b"012ABC6789\0\0ZZ"
+        # shrink
+        urllib.request.urlopen(urllib.request.Request(
+            base + "?truncate=4", data=b"", method="POST"), timeout=15)
+        with urllib.request.urlopen(base, timeout=15) as r:
+            assert r.read() == b"012A"
+        # grow (zero-filled tail)
+        urllib.request.urlopen(urllib.request.Request(
+            base + "?truncate=8", data=b"", method="POST"), timeout=15)
+        with urllib.request.urlopen(base, timeout=15) as r:
+            assert r.read() == b"012A\0\0\0\0"
