@@ -1,31 +1,53 @@
-"""MQ broker: HTTP pub/sub server over LocalPartition logs.
+"""MQ broker: HTTP pub/sub with partition balancing, follower replication,
+broker failover, and subscriber-group coordination.
 
 Reference: weed/mq/broker/{broker_grpc_pub.go:37 Publish,
-broker_grpc_sub.go:13 Subscribe, broker_grpc_configure.go} — the
-reference streams over gRPC; here the same operations ride HTTP:
+broker_grpc_sub.go:13 Subscribe, broker_grpc_configure.go} plus the
+coordination plane in weed/mq/pub_balancer/ (partition->broker assignment)
+and weed/mq/sub_coordinator/ (consumer-group partition assignment +
+progress). The reference streams over gRPC with an elected balancer
+broker; here the same roles ride HTTP with a DETERMINISTIC balance rule —
+partition i of a topic is owned by sorted(live_brokers)[i % n], its
+follower is the next broker in that ring — so every broker (and client)
+computes identical assignments from the shared live-broker view instead of
+holding leader state:
 
   POST /topics/configure   {"topic": "ns.name", "partition_count": N}
   GET  /topics/list
-  POST /pub?topic=ns.name  body=value, ?key= routes by ring slot
+  POST /pub?topic=ns.name  body=value, ?key= routes by ring slot;
+                           forwarded to the owning broker, synchronously
+                           replicated to the follower
   GET  /sub?topic=ns.name&partition=i&offset=K[&wait=seconds]
                            -> NDJSON batch (long-polls when caught up)
+  POST /replicate          follower append (leader pushes a snapshot on gap)
+  GET/POST /partition/state  full-partition snapshot pull / push
+  POST /coordinator/join   {"group","topic","member"} -> partitions for
+                           this member (round-robin over live members)
+  POST /offsets/commit     {"group","topic","partition","offset"}
+  GET  /offsets/get?group=&topic=&partition=
   GET  /status
 
-Brokers register in the master's cluster registry (type=broker) just like
-filers, standing in for the reference's pub_balancer broker ring.
+Brokers register in the master's cluster registry (type=broker); each
+broker's peer view = master's member list filtered by a direct liveness
+probe, refreshed continuously. Killing a broker re-routes its partitions
+to survivors, which already hold the data via follower replication —
+publishes keep succeeding and subscribers lose nothing. Group offsets are
+broadcast to every live broker on commit so they also survive failover.
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import logging
+import time
 
 import aiohttp
 from aiohttp import web
 
-from seaweedfs_tpu.mq.topic import (LocalPartition, Topic, ring_slot,
-                                    split_ring)
+from seaweedfs_tpu.mq.topic import (LocalPartition, Message, Topic,
+                                    ring_slot, split_ring)
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
 
@@ -34,17 +56,32 @@ log = logging.getLogger("mq.broker")
 
 class BrokerServer:
     def __init__(self, master_url: str, host: str = "127.0.0.1",
-                 port: int = 17777):
+                 port: int = 17777, peer_refresh: float = 2.0,
+                 member_ttl: float = 15.0):
         self.master_url = master_url
         self.host, self.port = host, port
+        self.peer_refresh = peer_refresh
+        self.member_ttl = member_ttl
         # str(topic) -> list[LocalPartition]
         self.topics: dict[str, list[LocalPartition]] = {}
+        self.peer_brokers: list[str] = [self.url]  # sorted, self included
+        # (group, topic) -> {member: last_seen}
+        self.group_members: dict[tuple[str, str], dict[str, float]] = {}
+        # (group, topic, partition) -> committed offset
+        self.group_offsets: dict[tuple[str, str, int], int] = {}
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes([
             web.post("/topics/configure", self.handle_configure),
             web.get("/topics/list", self.handle_list),
             web.post("/pub", self.handle_pub),
             web.get("/sub", self.handle_sub),
+            web.post("/replicate", self.handle_replicate),
+            web.get("/partition/state", self.handle_partition_state_get),
+            web.post("/partition/state", self.handle_partition_state_put),
+            web.post("/coordinator/join", self.handle_coordinator_join),
+            web.post("/offsets/commit", self.handle_offsets_commit),
+            web.post("/offsets/sync", self.handle_offsets_sync),
+            web.get("/offsets/get", self.handle_offsets_get),
             web.get("/status", self.handle_status),
         ])
         self._runner: web.AppRunner | None = None
@@ -75,25 +112,121 @@ class BrokerServer:
         if self._runner:
             await self._runner.cleanup()
 
+    # -- membership / balance --------------------------------------------
+
     async def _register_loop(self) -> None:
         while True:
             try:
                 async with self._session.post(
                         f"{_tls_scheme()}://{self.master_url}/cluster/register",
-                        json={"type": "broker", "address": self.url}):
+                        json={"type": "broker", "address": self.url},
+                        timeout=aiohttp.ClientTimeout(total=10)):
                     pass
-            except aiohttp.ClientError:
-                pass
-            await asyncio.sleep(10)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # the loop must outlive any transient failure
+            try:
+                await self._refresh_peers()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("peer refresh failed")
+            await asyncio.sleep(self.peer_refresh)
 
-    # -- handlers -------------------------------------------------------
+    async def _refresh_peers(self) -> None:
+        """Live-broker view = master registry ∩ direct probe. The balance
+        rule is pure arithmetic over this sorted list, so agreement on the
+        list IS agreement on every partition assignment."""
+        candidates = {self.url}
+        try:
+            async with self._session.get(
+                    f"{_tls_scheme()}://{self.master_url}/cluster/status",
+                    timeout=aiohttp.ClientTimeout(total=5)) as r:
+                members = (await r.json()).get("Members", {})
+                candidates.update(members.get("broker", []))
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            pass
+
+        async def probe(addr: str) -> str | None:
+            if addr == self.url:
+                return addr
+            try:
+                async with self._session.get(
+                        f"{_tls_scheme()}://{addr}/status",
+                        timeout=aiohttp.ClientTimeout(total=2)) as r:
+                    return addr if r.status == 200 else None
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                return None
+
+        alive = sorted(a for a in await asyncio.gather(
+            *(probe(a) for a in sorted(candidates))) if a)
+        if alive != self.peer_brokers:
+            log.info("broker ring: %s -> %s", self.peer_brokers, alive)
+            self.peer_brokers = alive
+        # anti-entropy every cycle (and the takeover path after a ring
+        # change): a broker that accepted publishes under a stale ring view
+        # holds data its settled owner lacks; comparing next_offsets and
+        # pulling the longer log converges every such divergence
+        await self._reconcile()
+
+    async def _reconcile(self) -> None:
+        for peer in self.peer_brokers:
+            if peer == self.url:
+                continue
+            try:
+                async with self._session.get(
+                        f"{_tls_scheme()}://{peer}/topics/list",
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    listing = await r.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                continue
+            for t in listing.get("topics", []):
+                name = t["name"]
+                parts = self._get_topic(name, auto_create=True,
+                                        n=t["partition_count"])
+                if len(parts) != t["partition_count"]:
+                    continue  # partition-count conflict; leave it alone
+                for pi, peer_next in enumerate(t["next_offsets"]):
+                    mine = self._owner_of(pi) == self.url or \
+                        self._follower_of(pi) == self.url
+                    if mine and peer_next > parts[pi].next_offset:
+                        await self._pull_state(peer, name, pi, parts[pi])
+
+    async def _pull_state(self, peer: str, topic: str, pi: int,
+                          part: LocalPartition) -> None:
+        try:
+            async with self._session.get(
+                    f"{_tls_scheme()}://{peer}/partition/state",
+                    params={"topic": topic, "partition": str(pi)},
+                    timeout=aiohttp.ClientTimeout(total=30)) as r:
+                if r.status != 200:
+                    return
+                st = await r.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return
+        part.load_snapshot(st["base_offset"],
+                           _decode_messages(st["messages"]))
+
+    def _owner_of(self, pi: int) -> str:
+        b = self.peer_brokers
+        return b[pi % len(b)] if b else self.url
+
+    def _follower_of(self, pi: int) -> str | None:
+        b = self.peer_brokers
+        if len(b) < 2:
+            return None
+        return b[(pi + 1) % len(b)]
+
+    # -- topic admin -----------------------------------------------------
 
     def _get_topic(self, name: str,
-                   auto_create: bool = False) -> list[LocalPartition] | None:
+                   auto_create: bool = False,
+                   n: int = 4) -> list[LocalPartition] | None:
         key = str(Topic.parse(name))
         parts = self.topics.get(key)
         if parts is None and auto_create:
-            parts = [LocalPartition(p) for p in split_ring(4)]
+            parts = [LocalPartition(p) for p in split_ring(n)]
             self.topics[key] = parts
         return parts
 
@@ -110,6 +243,20 @@ class BrokerServer:
                 {"error": "cannot repartition a live topic"}, status=409)
         if existing is None:
             self.topics[topic] = [LocalPartition(p) for p in split_ring(n)]
+        if not req.query.get("propagated"):
+            # every broker holds every partition object (leader for some,
+            # follower for others) so configuration fans out
+            for peer in self.peer_brokers:
+                if peer == self.url:
+                    continue
+                try:
+                    async with self._session.post(
+                            f"{_tls_scheme()}://{peer}/topics/configure"
+                            "?propagated=1", json=body,
+                            timeout=aiohttp.ClientTimeout(total=5)):
+                        pass
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    pass
         return web.json_response({"topic": topic, "partition_count": n})
 
     async def handle_list(self, req: web.Request) -> web.Response:
@@ -118,7 +265,10 @@ class BrokerServer:
                 {"name": name, "partition_count": len(parts),
                  "next_offsets": [p.next_offset for p in parts]}
                 for name, parts in sorted(self.topics.items())],
+            "brokers": self.peer_brokers,
         })
+
+    # -- publish ---------------------------------------------------------
 
     async def handle_pub(self, req: web.Request) -> web.Response:
         topic = req.query.get("topic", "")
@@ -130,9 +280,120 @@ class BrokerServer:
         slot = ring_slot(key)
         part = next((p for p in parts if p.partition.holds_key(key)),
                     parts[slot % len(parts)])
-        idx = parts.index(part)
+        pi = parts.index(part)
+
+        owner = self._owner_of(pi)
+        if owner != self.url and not req.query.get("forwarded"):
+            resp = await self._forward_pub(owner, req.query, value)
+            if resp is not None:
+                return resp
+            # owner unreachable: refresh the ring and serve it ourselves if
+            # ownership moved here, else fail loudly
+            await self._refresh_peers()
+            if self._owner_of(pi) != self.url:
+                return web.json_response(
+                    {"error": f"partition {pi} owner unreachable"},
+                    status=503)
+
         offset = await asyncio.to_thread(part.publish, key, value)
-        return web.json_response({"partition": idx, "offset": offset})
+        await self._replicate_out(topic, pi, part, offset, key, value)
+        return web.json_response({"partition": pi, "offset": offset})
+
+    async def _forward_pub(self, owner: str, query, value: bytes):
+        try:
+            params = dict(query)
+            params["forwarded"] = "1"
+            async with self._session.post(
+                    f"{_tls_scheme()}://{owner}/pub", params=params,
+                    data=value,
+                    timeout=aiohttp.ClientTimeout(total=15)) as r:
+                return web.json_response(await r.json(), status=r.status)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return None
+
+    async def _replicate_out(self, topic: str, pi: int,
+                             part: LocalPartition, offset: int,
+                             key: bytes, value: bytes) -> None:
+        """Synchronous replication to the partition's follower (reference:
+        partition followers); a gap answer triggers a snapshot push so a
+        rejoining follower converges."""
+        follower = self._follower_of(pi)
+        if follower is None:
+            return
+        msg = {
+            "topic": topic, "partition": pi, "offset": offset,
+            "partition_count": len(self.topics[str(Topic.parse(topic))]),
+            "ts_ns": time.time_ns(),
+            "key": base64.b64encode(key).decode(),
+            "value": base64.b64encode(value).decode(),
+        }
+        try:
+            async with self._session.post(
+                    f"{_tls_scheme()}://{follower}/replicate", json=msg,
+                    timeout=aiohttp.ClientTimeout(total=10)) as r:
+                if r.status == 409:  # follower has a gap: push everything
+                    await self._push_state(follower, topic, pi, part)
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass  # follower down; the ring refresh will re-route it
+
+    async def _push_state(self, peer: str, topic: str, pi: int,
+                          part: LocalPartition) -> None:
+        base, msgs = part.snapshot()
+        try:
+            async with self._session.post(
+                    f"{_tls_scheme()}://{peer}/partition/state",
+                    params={"topic": topic, "partition": str(pi)},
+                    json={"base_offset": base,
+                          "partition_count": len(
+                              self.topics[str(Topic.parse(topic))]),
+                          "messages": _encode_messages(msgs)},
+                    timeout=aiohttp.ClientTimeout(total=30)):
+                pass
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass
+
+    async def handle_replicate(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        topic = body["topic"]
+        pi = int(body["partition"])
+        parts = self._get_topic(topic, auto_create=True,
+                                n=int(body.get("partition_count", 4)))
+        if not 0 <= pi < len(parts):
+            return web.json_response({"error": "bad partition"}, status=400)
+        ok = parts[pi].append_replica(
+            int(body["offset"]), int(body["ts_ns"]),
+            base64.b64decode(body["key"]), base64.b64decode(body["value"]))
+        if not ok:
+            return web.json_response({"error": "gap"}, status=409)
+        return web.json_response({"ok": True})
+
+    async def handle_partition_state_get(self,
+                                         req: web.Request) -> web.Response:
+        parts = self._get_topic(req.query.get("topic", ""))
+        if parts is None:
+            return web.json_response({"error": "no such topic"}, status=404)
+        pi = int(req.query.get("partition", "0"))
+        if not 0 <= pi < len(parts):
+            return web.json_response({"error": "bad partition"}, status=400)
+        base, msgs = parts[pi].snapshot()
+        return web.json_response({"base_offset": base,
+                                  "partition_count": len(parts),
+                                  "messages": _encode_messages(msgs)})
+
+    async def handle_partition_state_put(self,
+                                         req: web.Request) -> web.Response:
+        body = await req.json()
+        parts = self._get_topic(req.query.get("topic", ""),
+                                auto_create=True,
+                                n=int(body.get("partition_count", 4)))
+        pi = int(req.query.get("partition", "0"))
+        if not 0 <= pi < len(parts):
+            return web.json_response({"error": "bad partition"}, status=400)
+        parts[pi].load_snapshot(body["base_offset"],
+                                _decode_messages(body["messages"]))
+        return web.json_response({"ok": True})
+
+    # -- subscribe -------------------------------------------------------
 
     async def handle_sub(self, req: web.Request) -> web.Response:
         topic = req.query.get("topic", "")
@@ -148,6 +409,12 @@ class BrokerServer:
             return web.json_response({"error": "bad params"}, status=400)
         if not 0 <= pi < len(parts):
             return web.json_response({"error": "bad partition"}, status=400)
+        owner = self._owner_of(pi)
+        if owner != self.url and self._follower_of(pi) != self.url:
+            # this broker holds no replica of pi: an empty 200 here would
+            # read as "caught up" forever — send the subscriber to the owner
+            raise web.HTTPTemporaryRedirect(
+                f"{_tls_scheme()}://{owner}/sub?{req.query_string}")
         part = parts[pi]
         batch = await asyncio.to_thread(part.read, offset, limit, wait)
         lines = b"".join(
@@ -157,8 +424,108 @@ class BrokerServer:
                             headers={"X-Next-Offset": str(
                                 batch[-1].offset + 1 if batch else offset)})
 
+    # -- consumer-group coordination (reference: sub_coordinator/) -------
+
+    def _coordinator_of(self, group: str) -> str:
+        """One broker coordinates each group (reference: sub_coordinator is
+        the balancer-leader's job); deterministic over the ring so every
+        member lands on the same one."""
+        b = self.peer_brokers
+        return b[ring_slot(group.encode()) % len(b)] if b else self.url
+
+    async def handle_coordinator_join(self, req: web.Request) -> web.Response:
+        """Register/renew a group member and return its partitions: the
+        round-robin split of the topic's partitions over the live members
+        (ConsumerGroup.BalanceConsumerGroupInstances in the reference).
+        Joins are forwarded to the group's coordinator broker — membership
+        lives in one place, so members joining via different brokers can
+        never get overlapping assignments."""
+        body = await req.json()
+        group = body["group"]
+        topic = str(Topic.parse(body["topic"]))
+        member = body["member"]
+        coord = self._coordinator_of(group)
+        if coord != self.url and not req.query.get("forwarded"):
+            try:
+                async with self._session.post(
+                        f"{_tls_scheme()}://{coord}/coordinator/join"
+                        "?forwarded=1", json=body,
+                        timeout=aiohttp.ClientTimeout(total=10)) as r:
+                    return web.json_response(await r.json(),
+                                             status=r.status)
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                await self._refresh_peers()
+                if self._coordinator_of(group) != self.url:
+                    return web.json_response(
+                        {"error": "group coordinator unreachable"},
+                        status=503)
+        parts = self._get_topic(topic)
+        if parts is None:
+            return web.json_response({"error": "no such topic"}, status=404)
+        gm = self.group_members.setdefault((group, topic), {})
+        now = time.monotonic()
+        gm[member] = now
+        for m, seen in list(gm.items()):
+            if now - seen > self.member_ttl:
+                del gm[m]
+        members = sorted(gm)
+        mine = [i for i in range(len(parts))
+                if members[i % len(members)] == member]
+        return web.json_response({"partitions": mine, "members": members,
+                                  "generation": len(members)})
+
+    async def handle_offsets_commit(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        key = (body["group"], str(Topic.parse(body["topic"])),
+               int(body["partition"]))
+        self.group_offsets[key] = int(body["offset"])
+
+        # fan the commit out (concurrently — a dead peer must not stall the
+        # consumer) so any surviving broker can answer offsets/get later
+        async def push(peer: str) -> None:
+            try:
+                async with self._session.post(
+                        f"{_tls_scheme()}://{peer}/offsets/sync",
+                        json={"entries": [[key[0], key[1], key[2],
+                                           self.group_offsets[key]]]},
+                        timeout=aiohttp.ClientTimeout(total=5)):
+                    pass
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
+        await asyncio.gather(*(push(p) for p in self.peer_brokers
+                               if p != self.url))
+        return web.json_response({"ok": True})
+
+    async def handle_offsets_sync(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        for g, t, p, off in body.get("entries", []):
+            # exact value, not max: a deliberate rewind commit must
+            # propagate, or brokers diverge and a failover skips the replay
+            self.group_offsets[(g, t, int(p))] = int(off)
+        return web.json_response({"ok": True})
+
+    async def handle_offsets_get(self, req: web.Request) -> web.Response:
+        key = (req.query.get("group", ""),
+               str(Topic.parse(req.query.get("topic", ""))),
+               int(req.query.get("partition", "0")))
+        return web.json_response({"offset": self.group_offsets.get(key, 0)})
+
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response({
             "topics": len(self.topics),
             "partitions": sum(len(p) for p in self.topics.values()),
+            "brokers": self.peer_brokers,
+            "groups": len(self.group_members),
         })
+
+
+def _encode_messages(msgs: list[Message]) -> list[list]:
+    return [[m.offset, m.ts_ns,
+             base64.b64encode(m.key).decode(),
+             base64.b64encode(m.value).decode()] for m in msgs]
+
+
+def _decode_messages(rows: list[list]) -> list[Message]:
+    return [Message(int(o), int(ts),
+                    base64.b64decode(k), base64.b64decode(v))
+            for o, ts, k, v in rows]

@@ -114,3 +114,38 @@ class LocalPartition:
     def next_offset(self) -> int:
         with self._lock:
             return self.base_offset + len(self.messages)
+
+    # -- replication (reference: partition followers, mq/broker) ---------
+
+    def append_replica(self, offset: int, ts_ns: int, key: bytes,
+                       value: bytes) -> bool:
+        """Follower-side append at an explicit offset. Returns False on a
+        gap (the leader then pushes a full snapshot); stale offsets are
+        acknowledged as already-held."""
+        with self._lock:
+            nxt = self.base_offset + len(self.messages)
+            if offset < nxt:
+                return True
+            if offset > nxt:
+                return False
+            self.messages.append(Message(offset, ts_ns, key, value))
+            if len(self.messages) > self.max_messages:
+                drop = len(self.messages) - self.max_messages
+                self.messages = self.messages[drop:]
+                self.base_offset += drop
+            self._lock.notify_all()
+            return True
+
+    def snapshot(self) -> tuple[int, list[Message]]:
+        with self._lock:
+            return self.base_offset, list(self.messages)
+
+    def load_snapshot(self, base_offset: int,
+                      messages: list[Message]) -> None:
+        """Replace local state when the incoming log extends further."""
+        with self._lock:
+            if base_offset + len(messages) <=                     self.base_offset + len(self.messages):
+                return
+            self.base_offset = base_offset
+            self.messages = list(messages)
+            self._lock.notify_all()

@@ -371,12 +371,16 @@ class MasterServer:
         })
 
     async def handle_cluster_status(self, req: web.Request) -> web.Response:
+        # members go stale when their register loop stops (reference:
+        # cluster.go removes nodes on connection loss) — 30s covers three
+        # missed 10s registration beats
+        horizon = time.time() - 30.0
         return web.json_response({
             "IsLeader": self.is_leader,
             "Leader": self.leader_url,
             "Topology": self.topo.to_dict(),
-            "Members": {k: sorted(v) for k, v in
-                        self.cluster_members.items() if v},
+            "Members": {k: sorted(a for a, ts in v.items() if ts > horizon)
+                        for k, v in self.cluster_members.items() if v},
         })
 
     async def handle_grow(self, req: web.Request) -> web.Response:

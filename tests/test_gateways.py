@@ -300,3 +300,134 @@ class TestMountVFS:
             base + "?truncate=8", data=b"", method="POST"), timeout=15)
         with urllib.request.urlopen(base, timeout=15) as r:
             assert r.read() == b"012A\0\0\0\0"
+
+
+class TestMqBrokerCluster:
+    """Two-broker coordination plane: deterministic partition balance,
+    forwarding, follower replication, failover without loss, group
+    offsets surviving broker death (reference: weed/mq/pub_balancer/ +
+    sub_coordinator/ + partition followers)."""
+
+    def _pub(self, broker_url, topic, key, value):
+        st, body, _ = req(f"http://{broker_url}/pub?topic={topic}&key={key}",
+                          method="POST", data=value)
+        assert st == 200, body
+        return json.loads(body)
+
+    def _read_all(self, ring, topic, n_parts):
+        """Read every partition from its owner under the current ring —
+        how a balanced subscriber consumes."""
+        got = []
+        for pi in range(n_parts):
+            owner = ring[pi % len(ring)]
+            st, body, _ = req(f"http://{owner}/sub?topic={topic}"
+                              f"&partition={pi}&offset=0&limit=16384")
+            assert st == 200
+            got += [json.loads(l) for l in body.splitlines() if l]
+        return got
+
+    def test_two_brokers_failover_no_loss(self, stack):
+        from seaweedfs_tpu.mq.broker import BrokerServer
+        from tests.test_cluster import free_port
+        c, _, _, b1 = stack
+        # b1 from the stack refreshes slowly; spin up a fast pair instead
+        fast1 = BrokerServer(c.master.url, port=free_port(),
+                             peer_refresh=0.3)
+        fast2 = BrokerServer(c.master.url, port=free_port(),
+                             peer_refresh=0.3)
+        c.submit(fast1.start())
+        c.submit(fast2.start())
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline and not (
+                    len(fast1.peer_brokers) >= 3 and
+                    len(fast2.peer_brokers) >= 3):
+                time.sleep(0.1)
+            assert fast1.peer_brokers == fast2.peer_brokers
+            assert len(fast1.peer_brokers) >= 3  # stack broker + the pair
+
+            topic = "t.failover"
+            st, _, _ = req(f"http://{fast1.url}/topics/configure",
+                           method="POST",
+                           data=json.dumps({"topic": topic,
+                                            "partition_count": 4}).encode())
+            assert st == 200
+            # publish through BOTH brokers: forwarding routes each key to
+            # its owner, which replicates to its follower
+            sent = {}
+            for i in range(60):
+                via = fast1.url if i % 2 == 0 else fast2.url
+                r = self._pub(via, topic, f"k{i}", f"v{i}".encode())
+                sent[f"k{i}"] = r["partition"]
+            got = self._read_all(fast1.peer_brokers, topic, 4)
+            assert len(got) == 60
+
+            # commit a group offset via fast2, readable via fast1
+            req(f"http://{fast2.url}/offsets/commit", method="POST",
+                data=json.dumps({"group": "g1", "topic": topic,
+                                 "partition": 0, "offset": 7}).encode())
+            st, body, _ = req(f"http://{fast1.url}/offsets/get?group=g1"
+                              f"&topic={topic}&partition=0")
+            assert json.loads(body)["offset"] == 7
+
+            # kill fast2; survivors re-route its partitions and still hold
+            # every message via replication
+            c.submit(fast2.stop())
+            deadline = time.time() + 20
+            while time.time() < deadline and \
+                    fast2.url in fast1.peer_brokers:
+                time.sleep(0.2)
+            assert fast2.url not in fast1.peer_brokers
+
+            # give survivors a beat to pull any partitions they took over
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                got = self._read_all(fast1.peer_brokers, topic, 4)
+                if len(got) == 60:
+                    break
+                time.sleep(0.3)
+            assert len(got) == 60, "messages lost in failover"
+            values = {g["key"]: g["value"] for g in got}
+            assert values["k3"] == "v3" and values["k59"] == "v59"
+
+            # publishing continues through the survivor
+            for i in range(60, 80):
+                self._pub(fast1.url, topic, f"k{i}", f"v{i}".encode())
+            got = self._read_all(fast1.peer_brokers, topic, 4)
+            assert len(got) == 80
+            # committed offsets survived the dead broker too
+            st, body, _ = req(f"http://{fast1.url}/offsets/get?group=g1"
+                              f"&topic={topic}&partition=0")
+            assert json.loads(body)["offset"] == 7
+        finally:
+            for b in (fast1, fast2):
+                try:
+                    c.submit(b.stop())
+                except Exception:
+                    pass
+
+    def test_consumer_group_assignment(self, stack):
+        _, _, _, broker = stack
+        base = f"http://{broker.url}"
+        topic = "t.groups"
+        req(f"{base}/topics/configure", method="POST",
+            data=json.dumps({"topic": topic,
+                             "partition_count": 4}).encode())
+        def join(member):
+            st, body, _ = req(f"{base}/coordinator/join", method="POST",
+                              data=json.dumps({"group": "g", "topic": topic,
+                                               "member": member}).encode())
+            assert st == 200
+            return json.loads(body)
+        a = join("alpha")
+        assert a["partitions"] == [0, 1, 2, 3]  # sole member owns all
+        b = join("beta")
+        a = join("alpha")
+        # two members: disjoint, covering split
+        assert sorted(a["partitions"] + b["partitions"]) == [0, 1, 2, 3]
+        assert not set(a["partitions"]) & set(b["partitions"])
+        # a member that stops heartbeating is dropped after the TTL
+        broker.member_ttl = 0.2
+        time.sleep(0.4)
+        a = join("alpha")
+        assert a["partitions"] == [0, 1, 2, 3]
