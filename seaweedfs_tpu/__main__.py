@@ -175,6 +175,17 @@ def main(argv=None) -> int:
     pbk.add_argument("-collection", default="")
     pbk.add_argument("-dir", default=".")
 
+    prs = sub.add_parser(
+        "filer.remote.sync",
+        help="continuously push local changes under a mounted dir to its "
+             "remote (command/filer_remote_sync.go)")
+    prs.add_argument("-filer", default="127.0.0.1:8888")
+    prs.add_argument("-dir", required=True, help="mounted directory")
+    prs.add_argument("-remote", required=True,
+                     help="kind:spec, e.g. s3:endpoint=..,bucket=..")
+    prs.add_argument("-offsetFile", default=None,
+                     help="resume-offset persistence path")
+
     psy = sub.add_parser("filer.sync",
                          help="continuous filer A<->B sync (command/filer_sync.go)")
     psy.add_argument("-a", required=True, help="filer A host:port")
@@ -230,7 +241,7 @@ def main(argv=None) -> int:
                       help="comma-separated SAN hosts/IPs")
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt):
+              psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -291,6 +302,18 @@ def main(argv=None) -> int:
         print("[tls]")
         for k, v in table.items():
             print(f'{k} = {str(v).lower() if isinstance(v, bool) else chr(34) + str(v) + chr(34)}')
+        return 0
+    if args.cmd == "filer.remote.sync":
+        from seaweedfs_tpu.remote_storage import (make_remote,
+                                                  parse_remote_spec,
+                                                  remote_sync_loop)
+        kind, options = parse_remote_spec(args.remote)
+        remote = make_remote(kind, **options)
+        try:
+            remote_sync_loop(remote, args.filer, args.dir,
+                             offset_file=args.offsetFile)
+        except KeyboardInterrupt:
+            pass
         return 0
     if args.cmd == "scaffold":
         return _run_scaffold(args)

@@ -10,10 +10,37 @@ directory tree), registered by name like the reference's sink registry.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import time
 import urllib.parse
 import urllib.request
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+
+log = logging.getLogger("replication.sink")
+
+
+def retry(fn, attempts: int = 4, base_delay: float = 0.5,
+          retriable=(urllib.error.URLError, ConnectionError, OSError)):
+    """Exponential-backoff retry for sink IO (reference: util.Retry wraps
+    every sink write) — without it one transient 500 during filer.sync
+    drops the event permanently."""
+    delay = base_delay
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except urllib.error.HTTPError as e:
+            # client errors won't heal by retrying; server errors might
+            if e.code < 500 or attempt == attempts - 1:
+                raise
+            log.warning("sink call failed (HTTP %s), retry in %.1fs",
+                        e.code, delay)
+        except retriable as e:
+            if attempt == attempts - 1:
+                raise
+            log.warning("sink call failed (%s), retry in %.1fs", e, delay)
+        time.sleep(delay)
+        delay *= 2
 
 
 def entry_is_directory(entry: dict) -> bool:
@@ -84,19 +111,25 @@ class FilerSink(ReplicationSink):
                 headers[f"Seaweed-{k}"] = v
             req = urllib.request.Request(self._url(path), data=data or b"",
                                          method="POST", headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout):
-            pass
+
+        def send():
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        retry(send)
 
     def delete_entry(self, path: str, is_directory: bool) -> None:
         url = self._url(path) + "?recursive=true"
         req = urllib.request.Request(url, method="DELETE",
                                      headers=self._headers())
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout):
-                pass
-        except urllib.error.HTTPError as e:
-            if e.code != 404:
-                raise
+
+        def send():
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout):
+                    pass
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    raise
+        retry(send)
 
 
 class LocalSink(ReplicationSink):

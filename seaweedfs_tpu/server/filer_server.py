@@ -111,6 +111,7 @@ class FilerServer:
         self.app.add_routes([
             web.get("/__meta__/subscribe", self.handle_meta_subscribe),
             web.post("/__admin__/entry", self.handle_raw_entry),
+            web.get("/status", self.handle_server_status),
             web.get("/__admin__/filer_conf", self.handle_get_conf),
             web.post("/__admin__/filer_conf", self.handle_put_conf),
             web.get("/__admin__/status", self.handle_status),
@@ -922,6 +923,12 @@ class FilerServer:
         return resp
 
     # -- admin ---------------------------------------------------------
+
+    async def handle_server_status(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "version": "weedtpu", "role": "filer", "url": self.url,
+            "master": self.master_url,
+        })
 
     async def handle_get_conf(self, req: web.Request) -> web.Response:
         return web.Response(text=self.conf.to_json(),

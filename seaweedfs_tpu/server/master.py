@@ -90,6 +90,10 @@ class MasterServer:
             web.post("/admin/renew_lock", self.handle_renew_lock),
             web.post("/cluster/register", self.handle_cluster_register),
             web.post("/vol/vacuum", self.handle_vacuum),
+            web.post("/vol/vacuum_toggle", self.handle_vacuum_toggle),
+            web.post("/raft/peers/add", self.handle_raft_peer_add),
+            web.post("/raft/peers/remove", self.handle_raft_peer_remove),
+            web.get("/raft/status", self.handle_raft_status),
             web.post("/raft/request_vote", self.handle_raft_vote),
             web.post("/raft/append_entries", self.handle_raft_append),
             web.post("/raft/install_snapshot", self.handle_raft_install),
@@ -99,6 +103,7 @@ class MasterServer:
         # non-volume-server cluster members (filers, brokers, gateways):
         # type -> {address: last_seen} (reference: weed/cluster/cluster.go)
         self.cluster_members: dict[str, dict[str, float]] = {}
+        self.vacuum_enabled = True
         self.garbage_threshold = 0.3
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
@@ -219,7 +224,8 @@ class MasterServer:
             tick += 1
             if tick % 12 == 0:  # every minute: vacuum scan
                 try:
-                    await self._vacuum_scan(self.garbage_threshold)
+                    if self.vacuum_enabled:
+                        await self._vacuum_scan(self.garbage_threshold)
                 except Exception:
                     log.warning("vacuum scan failed", exc_info=True)
 
@@ -246,6 +252,50 @@ class MasterServer:
             except aiohttp.ClientError as e:
                 log.warning("vacuum of %d on %s failed: %s", vid, url, e)
         return vacuumed
+
+    async def handle_vacuum_toggle(self, req: web.Request) -> web.Response:
+        """Pause/resume the automatic vacuum scan (reference: shell
+        volume.vacuum.disable / volume.vacuum.enable)."""
+        body = await req.json()
+        self.vacuum_enabled = bool(body.get("enabled", True))
+        return web.json_response({"enabled": self.vacuum_enabled})
+
+    async def handle_raft_status(self, req: web.Request) -> web.Response:
+        if self.raft is None:
+            return web.json_response({"raft": "disabled",
+                                      "leader": self.leader_url})
+        r = self.raft
+        return web.json_response({
+            "node_id": r.cfg.node_id, "state": r.state,
+            "term": r.current_term, "leader": r.leader_id,
+            "peers": r.cfg.peers, "log_len": len(r.log),
+            "snap_index": r.snap_index,
+            "commit_index": r.commit_index,
+        })
+
+    async def handle_raft_peer_add(self, req: web.Request) -> web.Response:
+        """Runtime peer addition (reference: cluster.raft.add; the
+        reference's hashicorp raft AddVoter). Single-entry change applied
+        locally — run against every member."""
+        if self.raft is None:
+            return web.json_response({"error": "raft disabled"}, status=400)
+        body = await req.json()
+        peer = body.get("peer", "")
+        if peer and peer != self.raft.cfg.node_id and \
+                peer not in self.raft.cfg.peers:
+            self.raft.cfg.peers.append(peer)
+        return web.json_response({"peers": self.raft.cfg.peers})
+
+    async def handle_raft_peer_remove(self, req: web.Request) -> web.Response:
+        if self.raft is None:
+            return web.json_response({"error": "raft disabled"}, status=400)
+        body = await req.json()
+        peer = body.get("peer", "")
+        if peer in self.raft.cfg.peers:
+            self.raft.cfg.peers.remove(peer)
+            self.raft.next_index.pop(peer, None)
+            self.raft.match_index.pop(peer, None)
+        return web.json_response({"peers": self.raft.cfg.peers})
 
     async def handle_vacuum(self, req: web.Request) -> web.Response:
         threshold = float(req.query.get("garbageThreshold",

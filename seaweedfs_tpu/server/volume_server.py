@@ -74,6 +74,8 @@ class VolumeServer:
             web.post("/admin/volume/vacuum", self.handle_vacuum),
             web.post("/admin/volume/copy", self.handle_volume_copy),
             web.post("/admin/volume/tier_move", self.handle_tier_move),
+            web.post("/admin/volume/tier_download",
+                     self.handle_tier_download),
             web.get("/admin/volume/needles", self.handle_volume_needles),
             web.post("/admin/ec/generate", self.handle_ec_generate),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
@@ -791,6 +793,23 @@ class VolumeServer:
         try:
             await asyncio.to_thread(v.tier_move, kind, options,
                                     body.get("key"))
+        except (ValueError, TypeError, OSError, PermissionError) as e:
+            return web.json_response({"error": str(e)}, status=500)
+        await self._heartbeat_once()
+        return web.json_response({"backend": v.backend_kind})
+
+    async def handle_tier_download(self, req: web.Request) -> web.Response:
+        """Pull a tiered volume's .dat back from the remote (reference:
+        volume_grpc_tier.go VolumeTierMoveDatFromRemote)."""
+        body = await req.json()
+        vid = body["volume"]
+        v = self.store.get_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        try:
+            await asyncio.to_thread(
+                v.tier_download, bool(body.get("delete_remote")))
         except (ValueError, TypeError, OSError, PermissionError) as e:
             return web.json_response({"error": str(e)}, status=500)
         await self._heartbeat_once()

@@ -23,6 +23,16 @@ class CommandEnv:
     def __init__(self, master: str):
         self.master = master
         self.lock_token: str | None = None
+        self.cwd = "/"  # fs.cd / fs.pwd working directory
+
+    def resolve(self, path: str) -> str:
+        """Join a possibly-relative shell path against the REPL cwd."""
+        if not path or path == ".":
+            return self.cwd
+        if not path.startswith("/"):
+            path = self.cwd.rstrip("/") + "/" + path
+        import posixpath
+        return posixpath.normpath(path)
 
     # -- http helpers --------------------------------------------------
 
@@ -687,7 +697,8 @@ def cmd_collection_delete(env: CommandEnv, args, out):
 @command("fs.ls")
 def cmd_fs_ls(env: CommandEnv, args, out):
     flags = parse_flags(args)
-    path = (args and not args[-1].startswith("-") and args[-1]) or "/"
+    path = env.resolve(
+        (args and not args[-1].startswith("-") and args[-1]) or ".")
     long = "l" in flags or "long" in flags
     filer = env.find_filer()
     for e in env.filer_list(filer, path):
@@ -702,7 +713,7 @@ def cmd_fs_ls(env: CommandEnv, args, out):
 
 @command("fs.cat")
 def cmd_fs_cat(env: CommandEnv, args, out):
-    path = args[-1]
+    path = env.resolve(args[-1])
     filer = env.find_filer()
     data = env.filer_read(filer, path)
     out.write(data.decode(errors="replace"))
@@ -711,7 +722,7 @@ def cmd_fs_cat(env: CommandEnv, args, out):
 @command("fs.rm")
 def cmd_fs_rm(env: CommandEnv, args, out):
     flags = parse_flags(args)
-    path = args[-1]
+    path = env.resolve(args[-1])
     filer = env.find_filer()
     env.filer_delete(filer, path, recursive="r" in flags or "rf" in flags)
     print(f"removed {path}", file=out)
@@ -719,7 +730,7 @@ def cmd_fs_rm(env: CommandEnv, args, out):
 
 @command("fs.mkdir")
 def cmd_fs_mkdir(env: CommandEnv, args, out):
-    path = args[-1].rstrip("/") + "/"
+    path = env.resolve(args[-1]).rstrip("/") + "/"
     filer = env.find_filer()
     env._call(f"{filer}{urllib.parse.quote(path)}", {}, method="POST")
     print(f"created {path}", file=out)
@@ -727,7 +738,7 @@ def cmd_fs_mkdir(env: CommandEnv, args, out):
 
 @command("fs.mv")
 def cmd_fs_mv(env: CommandEnv, args, out):
-    src, dst = args[-2], args[-1]
+    src, dst = env.resolve(args[-2]), env.resolve(args[-1])
     filer = env.find_filer()
     env._call(f"{filer}{urllib.parse.quote(dst)}?mv.from="
               f"{urllib.parse.quote(src)}", {}, method="POST")
@@ -736,7 +747,8 @@ def cmd_fs_mv(env: CommandEnv, args, out):
 
 @command("fs.du")
 def cmd_fs_du(env: CommandEnv, args, out):
-    path = (args and not args[-1].startswith("-") and args[-1]) or "/"
+    path = env.resolve(
+        (args and not args[-1].startswith("-") and args[-1]) or ".")
     filer = env.find_filer()
     total = [0]
     files = [0]
@@ -754,7 +766,7 @@ def cmd_fs_du(env: CommandEnv, args, out):
 
 @command("fs.meta.cat")
 def cmd_fs_meta_cat(env: CommandEnv, args, out):
-    path = args[-1]
+    path = env.resolve(args[-1])
     filer = env.find_filer()
     meta = env._call(f"{filer}{urllib.parse.quote(path)}?metadata=true")
     print(json.dumps(meta, indent=2, default=str), file=out)
@@ -795,17 +807,17 @@ def cmd_volume_tier_move(env: CommandEnv, args, out):
     env.require_lock()
     flags = parse_flags(args)
     vid = int(flags["volumeId"])
-    dest = flags.get("dest", "")
-    kind, _, opt = dest.partition(":")
-    if (kind or "local") == "local" and not opt:
+    from seaweedfs_tpu.remote_storage import parse_remote_spec
+    kind, options = parse_remote_spec(flags.get("dest", ""))
+    if kind == "local" and not options.get("directory"):
         raise RuntimeError(
-            "volume.tier.move needs -dest local:<directory>")
-    options = {"directory": opt} if kind == "local" and opt else {}
+            "volume.tier.move needs -dest local:<directory> or "
+            "-dest s3:endpoint=..,bucket=..")
     for url in env.volume_locations(vid):
         r = env.vs_post(url, "/admin/volume/tier_move",
-                        {"volume": vid, "kind": kind or "local",
+                        {"volume": vid, "kind": kind,
                          "options": options})
-        print(f"volume {vid} on {url} -> tier {kind or 'local'} "
+        print(f"volume {vid} on {url} -> tier {kind} "
               f"(backend={r.get('backend')})", file=out)
 
 
@@ -814,15 +826,16 @@ def cmd_remote_mount(env: CommandEnv, args, out):
     """Mount a remote store's objects under a filer directory (reference:
     command_remote_mount.go).  -remote kind:option -dir /mounted"""
     flags = parse_flags(args)
-    kind, _, opt = flags.get("remote", "").partition(":")
+    from seaweedfs_tpu.remote_storage import (make_remote,
+                                              parse_remote_spec,
+                                              sync_remote_to_filer)
+    kind, options = parse_remote_spec(flags.get("remote", ""))
     mount_dir = flags.get("dir", "/remote")
     cache = flags.get("cache", "false") == "true"
-    from seaweedfs_tpu.remote_storage import make_remote, sync_remote_to_filer
-    remote = make_remote(kind or "local",
-                         **({"directory": opt} if opt else {}))
+    remote = make_remote(kind, **options)
     filer = env.find_filer()
     n = sync_remote_to_filer(remote, filer, mount_dir, cache=cache)
-    print(f"remote.mount: {n} object(s) from {kind}:{opt} -> {mount_dir}"
+    print(f"remote.mount: {n} object(s) from {kind} -> {mount_dir}"
           + ("" if cache else " (placeholders; remote.cache to pull)"),
           file=out)
 
@@ -832,11 +845,12 @@ def cmd_remote_cache(env: CommandEnv, args, out):
     """Pull remote object content into the mounted directory (reference:
     command_remote_cache.go)."""
     flags = parse_flags(args)
-    kind, _, opt = flags.get("remote", "").partition(":")
+    from seaweedfs_tpu.remote_storage import (make_remote,
+                                              parse_remote_spec,
+                                              sync_remote_to_filer)
+    kind, options = parse_remote_spec(flags.get("remote", ""))
     mount_dir = flags.get("dir", "/remote")
-    from seaweedfs_tpu.remote_storage import make_remote, sync_remote_to_filer
-    remote = make_remote(kind or "local",
-                         **({"directory": opt} if opt else {}))
+    remote = make_remote(kind, **options)
     filer = env.find_filer()
     n = sync_remote_to_filer(remote, filer, mount_dir, cache=True)
     print(f"remote.cache: {n} object(s) cached under {mount_dir}", file=out)
@@ -1100,3 +1114,449 @@ def run_command(env: CommandEnv, line: str, out) -> None:
         raise RuntimeError(f"unknown command {parts[0]!r} "
                            f"(have: {', '.join(sorted(COMMANDS))})")
     fn(env, parts[1:], out)
+
+
+# ---- breadth pass: cluster/raft/fs/tier/remote/mq commands --------------
+# (reference command set: weed/shell/commands.go:41-48 — these close the
+# largest remaining gaps against its ~80 commands)
+
+@command("cluster.raft.ps")
+def cmd_cluster_raft_ps(env: CommandEnv, args, out):
+    """Show each master's raft state (reference: command_cluster_raft_ps)."""
+    masters = {env.master}
+    try:
+        st = env.master_get("/raft/status")
+        masters.update(st.get("peers", []))
+        rows = [st]
+    except RuntimeError:
+        rows = []
+    for m in sorted(masters - {env.master}):
+        try:
+            rows.append(env.master_get_raw(m, "/raft/status"))
+        except RuntimeError as e:
+            rows.append({"node_id": m, "state": f"unreachable ({e})"})
+    for r in rows:
+        print(f"{r.get('node_id', env.master):24s} state={r.get('state')} "
+              f"term={r.get('term', '-')} leader={r.get('leader', '-')} "
+              f"log={r.get('log_len', '-')} snap@{r.get('snap_index', '-')}",
+              file=out)
+
+
+@command("cluster.raft.add")
+def cmd_cluster_raft_add(env: CommandEnv, args, out):
+    """Add a master peer to every member's raft config:
+    cluster.raft.add -peer host:port (reference: command_cluster_raft_add)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    peer = flags["peer"]
+    st = env.master_get("/raft/status")
+    members = set(st.get("peers", [])) | {st.get("node_id", env.master)}
+    for m in sorted(members):
+        r = env._call(f"{m}/raft/peers/add", {"peer": peer})
+        print(f"{m}: peers now {r.get('peers')}", file=out)
+    # the new member must also learn every existing peer, or it sees a
+    # single-node cluster, elects itself, and split-brains
+    for m in sorted(members):
+        r = env._call(f"{peer}/raft/peers/add", {"peer": m})
+    print(f"{peer}: peers now {r.get('peers')}", file=out)
+
+
+@command("cluster.raft.remove")
+def cmd_cluster_raft_remove(env: CommandEnv, args, out):
+    """Remove a master peer from every member's raft config
+    (reference: command_cluster_raft_remove)."""
+    env.require_lock()
+    flags = parse_flags(args)
+    peer = flags["peer"]
+    st = env.master_get("/raft/status")
+    members = set(st.get("peers", [])) | {st.get("node_id", env.master)}
+    for m in sorted(members - {peer}):
+        r = env._call(f"{m}/raft/peers/remove", {"peer": peer})
+        print(f"{m}: peers now {r.get('peers')}", file=out)
+
+
+@command("cluster.leader")
+def cmd_cluster_leader(env: CommandEnv, args, out):
+    """Print the master leader address."""
+    st = env.master_get("/cluster/status")
+    print(st.get("Leader") or env.master, file=out)
+
+
+@command("cluster.check")
+def cmd_cluster_check(env: CommandEnv, args, out):
+    """Reachability sweep over every registered cluster process
+    (reference: command_cluster_check)."""
+    st = env.master_get("/cluster/status")
+    print(f"master {env.master:24s} ok (leader={st.get('Leader')})",
+          file=out)
+    topo = st.get("Topology", {})
+    for nid in sorted(topo.get("nodes", {})):
+        try:
+            env.master_get_raw(nid, "/status")
+            print(f"volume {nid:24s} ok", file=out)
+        except RuntimeError as e:
+            print(f"volume {nid:24s} UNREACHABLE: {e}", file=out)
+    for kind, members in sorted(
+            (st.get("Members") or {}).items()):
+        for m in members:
+            try:
+                env.master_get_raw(m, "/status")
+                print(f"{kind:6s} {m:24s} ok", file=out)
+            except RuntimeError as e:
+                print(f"{kind:6s} {m:24s} UNREACHABLE: {e}", file=out)
+
+
+@command("fs.pwd")
+def cmd_fs_pwd(env: CommandEnv, args, out):
+    """Print the shell's filer working directory."""
+    print(env.cwd, file=out)
+
+
+@command("fs.cd")
+def cmd_fs_cd(env: CommandEnv, args, out):
+    """Change the shell's filer working directory: fs.cd /buckets"""
+    target = env.resolve(args[0] if args else "/")
+    filer = env.find_filer()
+    if target != "/":
+        env.filer_list(filer, target)  # raises if missing
+    env.cwd = target
+    print(env.cwd, file=out)
+
+
+@command("fs.cp")
+def cmd_fs_cp(env: CommandEnv, args, out):
+    """Copy one filer file: fs.cp /src/path /dst/path."""
+    if len(args) < 2:
+        raise RuntimeError("fs.cp needs <src> <dst>")
+    src, dst = env.resolve(args[0]), env.resolve(args[1])
+    filer = env.find_filer()
+    data = env.filer_read(filer, src)
+    import urllib.request
+    req = urllib.request.Request(
+        f"{_tls_scheme()}://{filer}{urllib.parse.quote(dst)}",
+        data=data, method="PUT")
+    with urllib.request.urlopen(req, timeout=600):
+        pass
+    print(f"copied {src} -> {dst} ({len(data)} bytes)", file=out)
+
+
+@command("fs.verify")
+def cmd_fs_verify(env: CommandEnv, args, out):
+    """Verify every chunk of a file (or tree) is readable on its volume
+    server (reference: command_fs_verify)."""
+    path = env.resolve(args[0] if args and not args[0].startswith("-")
+                       else ".")
+    filer = env.find_filer()
+    import json as _json
+    import urllib.request
+
+    def chunks_of(p):
+        with urllib.request.urlopen(
+                f"{_tls_scheme()}://{filer}{urllib.parse.quote(p)}"
+                "?metadata=true&resolveManifest=true", timeout=60) as r:
+            meta = _json.loads(r.read())
+        return meta.get("chunks") or []
+
+    bad = ok = 0
+    for ck in chunks_of(path):
+        fid = ck.get("fid", "")
+        vid = fid.split(",")[0]
+        locs = env.volume_locations(int(vid)) if vid.isdigit() else []
+        readable = False
+        for url in locs:
+            try:
+                req = urllib.request.Request(
+                    f"{_tls_scheme()}://{url}/{fid}", method="HEAD")
+                with urllib.request.urlopen(req, timeout=30):
+                    readable = True
+                    break
+            except Exception:
+                continue
+        if readable:
+            ok += 1
+        else:
+            bad += 1
+            print(f"  missing chunk {fid} ({path})", file=out)
+    print(f"fs.verify: {ok} chunk(s) ok, {bad} missing", file=out)
+
+
+@command("fs.configure")
+def cmd_fs_configure(env: CommandEnv, args, out):
+    """Show or set per-path filer rules (reference: command_fs_configure +
+    filer.conf): fs.configure [-locationPrefix /p -collection c
+    -replication 010 -ttl 1d -readOnly true -apply]"""
+    flags = parse_flags(args)
+    filer = env.find_filer()
+    conf = env.master_get_raw(filer, "/__admin__/filer_conf")
+    if not flags.get("locationPrefix"):
+        print(json.dumps(conf, indent=2), file=out)
+        return
+    rule = {"location_prefix": flags["locationPrefix"]}
+    for src, dst in (("collection", "collection"),
+                     ("replication", "replication"), ("ttl", "ttl")):
+        if flags.get(src):
+            rule[dst] = flags[src]
+    if flags.get("readOnly"):
+        rule["read_only"] = flags["readOnly"] == "true"
+    rules = [r for r in conf.get("locations", [])
+             if r.get("location_prefix") != rule["location_prefix"]]
+    if not flags.get("delete"):
+        rules.append(rule)
+    if flags.get("apply"):
+        env._call(f"{filer}/__admin__/filer_conf", {"locations": rules})
+        print(f"applied {len(rules)} rule(s)", file=out)
+    else:
+        print(json.dumps({"locations": rules}, indent=2), file=out)
+        print("(dry run; add -apply)", file=out)
+
+
+@command("volume.tier.upload")
+def cmd_volume_tier_upload(env: CommandEnv, args, out):
+    """Upload a volume's data to a remote tier — alias of volume.tier.move
+    matching the reference's command name (command_volume_tier_upload)."""
+    cmd_volume_tier_move(env, args, out)
+
+
+@command("volume.tier.download")
+def cmd_volume_tier_download(env: CommandEnv, args, out):
+    """Bring a tiered volume's data back to local disk (reference:
+    command_volume_tier_download): volume.tier.download -volumeId N
+    [-deleteRemote true]"""
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    for url in env.volume_locations(vid):
+        r = env.vs_post(url, "/admin/volume/tier_download",
+                        {"volume": vid,
+                         "delete_remote":
+                             flags.get("deleteRemote", "false") == "true"})
+        print(f"volume {vid} on {url} back on local disk "
+              f"(backend={r.get('backend')})", file=out)
+
+
+@command("volume.deleteEmpty")
+def cmd_volume_delete_empty(env: CommandEnv, args, out):
+    """Delete volumes holding no live needles (reference:
+    command_volume_delete_empty): volume.deleteEmpty [-apply]"""
+    env.require_lock()
+    flags = parse_flags(args)
+    apply = flags.get("apply", "false") == "true" or "apply" in args
+    topo = env.topology()
+    n = 0
+    for vid, rec in sorted(collect_volume_infos(topo).items()):
+        if rec.get("file_count", 0) - rec.get("delete_count", 0) > 0:
+            continue
+        if rec.get("size", 0) <= 64 * 1024:  # header-only .dat
+            n += 1
+            print(f"empty volume {vid} on {rec['nodes']}"
+                  + ("" if apply else " (dry run, -apply to delete)"),
+                  file=out)
+            if apply:
+                for url in rec["nodes"]:
+                    env.vs_post(url, "/admin/volume/delete", {"volume": vid})
+    print(f"volume.deleteEmpty: {n} volume(s)"
+          + ("" if apply else " planned"), file=out)
+
+
+@command("volume.copy")
+def cmd_volume_copy(env: CommandEnv, args, out):
+    """Copy a volume to another server WITHOUT deleting the source
+    (reference: command_volume_copy): volume.copy -volumeId N -target url"""
+    env.require_lock()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    target = flags["target"]
+    locs = env.volume_locations(vid)
+    if not locs:
+        raise RuntimeError(f"volume {vid} not found")
+    source = flags.get("source", locs[0])
+    cols = {v: rec.get("collection", "")
+            for v, rec in collect_volume_infos(env.topology()).items()}
+    r = env.vs_post(target, "/admin/volume/copy",
+                    {"volume": vid, "source": source,
+                     "collection": cols.get(vid, "")})
+    print(f"copied volume {vid}: {source} -> {target} "
+          f"({r.get('file_count', r.get('appended_bytes', 0))})", file=out)
+
+
+@command("volume.vacuum.disable")
+def cmd_volume_vacuum_disable(env: CommandEnv, args, out):
+    """Pause the master's automatic vacuum scan (reference:
+    command_volume_vacuum_disable)."""
+    env.require_lock()
+    env.master_post("/vol/vacuum_toggle", {"enabled": False})
+    print("automatic vacuum disabled", file=out)
+
+
+@command("volume.vacuum.enable")
+def cmd_volume_vacuum_enable(env: CommandEnv, args, out):
+    """Resume the master's automatic vacuum scan (reference:
+    command_volume_vacuum_enable)."""
+    env.require_lock()
+    env.master_post("/vol/vacuum_toggle", {"enabled": True})
+    print("automatic vacuum enabled", file=out)
+
+
+@command("remote.meta.sync")
+def cmd_remote_meta_sync(env: CommandEnv, args, out):
+    """Reconcile a mounted directory's metadata against the remote's
+    current object list (reference: command_remote_meta_sync):
+    remote.meta.sync -remote kind:spec -dir /mounted"""
+    flags = parse_flags(args)
+    from seaweedfs_tpu.remote_storage import (make_remote,
+                                              meta_sync_remote_to_filer,
+                                              parse_remote_spec)
+    kind, options = parse_remote_spec(flags.get("remote", ""))
+    remote = make_remote(kind, **options)
+    filer = env.find_filer()
+    changed, deleted, same = meta_sync_remote_to_filer(
+        remote, filer, flags.get("dir", "/remote"))
+    print(f"remote.meta.sync: {changed} updated, {deleted} deleted, "
+          f"{same} unchanged", file=out)
+
+
+@command("remote.uncache")
+def cmd_remote_uncache(env: CommandEnv, args, out):
+    """Drop cached content under a mounted directory, reverting entries to
+    placeholders (reference: command_remote_uncache):
+    remote.uncache -dir /mounted"""
+    flags = parse_flags(args)
+    mount = flags.get("dir", "/remote")
+    filer = env.find_filer()
+    from seaweedfs_tpu.remote_storage import _filer_walk
+    import urllib.request
+    n = 0
+    for path, meta in _filer_walk(filer, mount):
+        ext = {k.lower(): v
+               for k, v in (meta.get("extended") or {}).items()}
+        if "remote-key" not in ext or \
+                ext.get("remote-placeholder") == "true":
+            continue
+        headers = {
+            "Seaweed-remote-size": ext.get("remote-size", "0"),
+            "Seaweed-remote-mtime": ext.get("remote-mtime", "0"),
+            "Seaweed-remote-key": ext["remote-key"],
+            "Seaweed-remote-placeholder": "true",
+        }
+        req = urllib.request.Request(
+            f"{_tls_scheme()}://{filer}{urllib.parse.quote(path)}",
+            data=b"", method="POST", headers=headers)
+        with urllib.request.urlopen(req, timeout=60):
+            pass
+        n += 1
+    print(f"remote.uncache: {n} file(s) reverted to placeholders", file=out)
+
+
+@command("remote.configure")
+def cmd_remote_configure(env: CommandEnv, args, out):
+    """Store named remote specs on the filer (reference:
+    command_remote_configure): remote.configure -name cold
+    -spec s3:endpoint=..,bucket=.. | -list | -delete -name cold"""
+    flags = parse_flags(args)
+    filer = env.find_filer()
+    path = "/etc/remote.conf"
+    import urllib.error
+    import urllib.request
+    try:
+        conf = json.loads(env.filer_read(filer, path) or b"{}")
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise  # a transient failure must NOT read as "no remotes"
+        conf = {}
+    mutated = False
+    if flags.get("name") and flags.get("spec"):
+        conf[flags["name"]] = flags["spec"]
+        mutated = True
+    elif flags.get("delete") and flags.get("name"):
+        mutated = conf.pop(flags["name"], None) is not None
+    if mutated:  # plain listing never rewrites the config file
+        req = urllib.request.Request(
+            f"{_tls_scheme()}://{filer}{urllib.parse.quote(path)}",
+            data=json.dumps(conf, indent=2).encode(), method="PUT")
+        with urllib.request.urlopen(req, timeout=60):
+            pass
+    for name, spec in sorted(conf.items()):
+        print(f"{name:16s} {spec}", file=out)
+    if not conf:
+        print("(no remotes configured)", file=out)
+
+
+def _find_broker(env: CommandEnv) -> str:
+    members = env.master_get("/cluster/status").get("Members", {})
+    brokers = members.get("broker", [])
+    if not brokers:
+        raise RuntimeError("no mq broker registered with the master")
+    return brokers[0]
+
+
+@command("mq.topic.list")
+def cmd_mq_topic_list(env: CommandEnv, args, out):
+    """List MQ topics with partition next-offsets (reference:
+    command_mq_topic_list)."""
+    broker = _find_broker(env)
+    r = env.master_get_raw(broker, "/topics/list")
+    for t_ in r.get("topics", []):
+        print(f"{t_['name']:32s} partitions={t_['partition_count']} "
+              f"next_offsets={t_['next_offsets']}", file=out)
+    if not r.get("topics"):
+        print("(no topics)", file=out)
+
+
+@command("mq.topic.configure")
+def cmd_mq_topic_configure(env: CommandEnv, args, out):
+    """Create/configure an MQ topic (reference: command_mq_topic_configure):
+    mq.topic.configure -topic ns.name -partitionCount 4"""
+    flags = parse_flags(args)
+    broker = _find_broker(env)
+    r = env._call(f"{broker}/topics/configure",
+                  {"topic": flags["topic"],
+                   "partition_count": int(flags.get("partitionCount", "4"))})
+    print(f"topic {r.get('topic')} partitions={r.get('partition_count')}",
+          file=out)
+
+
+@command("mq.topic.desc")
+def cmd_mq_topic_desc(env: CommandEnv, args, out):
+    """Describe one topic's partitions and broker assignment (reference:
+    command_mq_topic_describe)."""
+    flags = parse_flags(args)
+    topic = flags["topic"]
+    broker = _find_broker(env)
+    r = env.master_get_raw(broker, "/topics/list")
+    brokers = r.get("brokers", [broker])
+    for t_ in r.get("topics", []):
+        if t_["name"] != topic:
+            continue
+        for pi, nxt in enumerate(t_["next_offsets"]):
+            owner = brokers[pi % len(brokers)]
+            print(f"partition {pi}: owner={owner} next_offset={nxt}",
+                  file=out)
+        return
+    raise RuntimeError(f"topic {topic!r} not found")
+
+
+@command("ec.cleanup")
+def cmd_ec_cleanup(env: CommandEnv, args, out):
+    """Remove leftover EC shards for volumes that are back to normal
+    replication (post-decode orphans): ec.cleanup [-apply]"""
+    env.require_lock()
+    flags = parse_flags(args)
+    apply = flags.get("apply", "false") == "true" or "apply" in args
+    topo = env.topology()
+    normal_vids = {vid for node in topo["nodes"].values()
+                   for vid in node["volumes"]}
+    n = 0
+    for nid, node in sorted(topo["nodes"].items()):
+        for vid_s, shards in sorted(node.get("ec_shards", {}).items()):
+            vid = int(vid_s)
+            if vid not in normal_vids:
+                continue
+            n += 1
+            print(f"orphan ec shards of volume {vid} on {nid}: {shards}"
+                  + ("" if apply else " (dry run, -apply to delete)"),
+                  file=out)
+            if apply:
+                env.vs_post(nid, "/admin/ec/delete_shards",
+                            {"volume": vid, "shards": shards})
+    print(f"ec.cleanup: {n} orphan group(s)"
+          + ("" if apply else " planned"), file=out)

@@ -459,6 +459,37 @@ class Volume:
             self.read_only = True
             os.remove(self.dat_path)
 
+    def tier_download(self, delete_remote: bool = False) -> None:
+        """Bring a tiered volume's .dat back to local disk and resume
+        normal (writable) service — the inverse of tier_move (reference:
+        shell volume.tier.download + volume_tier.go)."""
+        import json as _json
+
+        from seaweedfs_tpu.remote_storage import make_remote
+        from seaweedfs_tpu.storage.backend import open_backend
+        with self._lock:
+            if self.backend_kind != "remote":
+                return
+            with open(self.tier_path) as f:
+                tier = _json.load(f)
+            remote = make_remote(tier["kind"], **tier.get("options", {}))
+            tmp = self.dat_path + ".dl"
+            with open(tmp, "wb") as f:
+                size = tier["size"]
+                off = 0
+                while off < size:
+                    n = min(8 << 20, size - off)
+                    f.write(remote.read_range(tier["key"], off, n))
+                    off += n
+            os.replace(tmp, self.dat_path)
+            self._dat.close()
+            self._dat = open_backend(self.dat_path, "disk")
+            self.backend_kind = "disk"
+            self.read_only = False
+            os.remove(self.tier_path)
+            if delete_remote:
+                remote.delete_file(tier["key"])
+
     def info(self) -> VolumeInfo:
         return VolumeInfo(
             id=self.id, size=self.data_size(), collection=self.collection,

@@ -133,3 +133,106 @@ def test_remote_mount_and_cache(tmp_path):
     finally:
         c.submit(filer.stop())
         c.stop()
+
+
+def _s3_stack(tmp_path):
+    """master + volume + filer + S3 gateway, in-process."""
+    from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port())
+    c.submit(filer.start())
+    s3 = S3ApiServer(filer.url, port=free_port())
+    c.submit(s3.start())
+    return c, filer, s3
+
+
+def test_s3_remote_client_against_own_gateway(tmp_path):
+    """The SDK-free S3Remote speaks real wire S3 (SigV4 optional) to the
+    framework's own gateway: CRUD + ranged read + paginated traverse
+    (reference: weed/remote_storage/s3/s3_storage_client.go)."""
+    import urllib.request
+    from seaweedfs_tpu.remote_storage import S3Remote
+    c, filer, s3 = _s3_stack(tmp_path)
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{s3.url}/tier-bucket", method="PUT"), timeout=10)
+        r = S3Remote(endpoint=s3.url, bucket="tier-bucket")
+        r.write_file("a/x.bin", b"payload-x")
+        r.write_file("a/y.bin", b"payload-y" * 100)
+        r.write_file("z.bin", b"zzz")
+        assert r.read_file("a/x.bin") == b"payload-x"
+        assert r.read_range("a/y.bin", 9, 9) == b"payload-y"
+        keys = {e.key: e.size for e in r.traverse()}
+        assert keys == {"a/x.bin": 9, "a/y.bin": 900, "z.bin": 3}
+        assert [e.key for e in r.traverse(prefix="a/")] == \
+            ["a/x.bin", "a/y.bin"]
+        r.delete_file("z.bin")
+        assert "z.bin" not in {e.key for e in r.traverse()}
+        r.delete_file("z.bin")  # idempotent
+    finally:
+        c.submit(s3.stop())
+        c.submit(filer.stop())
+        c.stop()
+
+
+def test_tier_move_and_remote_mount_via_s3(tmp_path):
+    """volume.tier.move and remote.mount against a real S3 wire protocol
+    (the framework's own gateway as the remote), per the reference's
+    s3-backed tier (weed/storage/backend/s3_backend, command_remote_mount)."""
+    import io
+    import json as _json
+    import time
+    import urllib.request
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    c, filer, s3 = _s3_stack(tmp_path)
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{s3.url}/cold", method="PUT"), timeout=10)
+        client = WeedClient(c.master.url)
+        fid = client.upload(b"frozen bytes", name="f.bin")
+        vid = int(fid.split(",")[0])
+        env = CommandEnv(c.master.url)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                env.find_filer()
+                break
+            except RuntimeError:
+                time.sleep(0.2)
+        env.acquire_lock()
+        buf = io.StringIO()
+        run_command(env, f"volume.tier.move -volumeId {vid} "
+                         f"-dest s3:endpoint={s3.url},bucket=cold", buf)
+        assert "tier s3" in buf.getvalue()
+        # volume reads now ride the S3 remote; blob still served
+        assert client.download(fid) == b"frozen bytes"
+        # the .dat landed as an object in the bucket
+        st, body = 0, b""
+        with urllib.request.urlopen(
+                f"http://{s3.url}/cold?list-type=2", timeout=10) as resp:
+            body = resp.read()
+        assert b".dat" in body
+
+        # remote.mount the same bucket through the S3 wire
+        buf = io.StringIO()
+        run_command(env, f"remote.mount -remote s3:endpoint={s3.url},"
+                         f"bucket=cold -dir /s3r -cache true", buf)
+        assert "object(s)" in buf.getvalue()
+        listing = _json.load(urllib.request.urlopen(
+            f"http://{filer.url}/s3r/default/?limit=100", timeout=10))
+        names = [e["FullPath"] for e in listing.get("Entries") or []]
+        assert any(n.endswith(".dat") for n in names), names
+        # cached content equals the tiered .dat object bytes
+        from seaweedfs_tpu.remote_storage import S3Remote
+        r = S3Remote(endpoint=s3.url, bucket="cold")
+        key = next(e.key for e in r.traverse() if e.key.endswith(".dat"))
+        assert urllib.request.urlopen(
+            f"http://{filer.url}/s3r/{key}", timeout=10).read() == \
+            r.read_file(key)
+    finally:
+        c.submit(s3.stop())
+        c.submit(filer.stop())
+        c.stop()
