@@ -78,6 +78,8 @@ class VolumeServer:
                      self.handle_tier_download),
             web.get("/admin/volume/needles", self.handle_volume_needles),
             web.post("/admin/ec/generate", self.handle_ec_generate),
+            web.get("/admin/ec/progress", self.handle_ec_progress),
+            web.post("/admin/ec/cancel", self.handle_ec_cancel),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
             web.post("/admin/ec/mount", self.handle_ec_mount),
             web.post("/admin/ec/unmount", self.handle_ec_unmount),
@@ -92,6 +94,9 @@ class VolumeServer:
         # in-flight throttling (reference: volume server
         # -concurrentUploadLimitMB / inFlightUploadDataLimitCond)
         self._upload_sem = asyncio.Semaphore(concurrent_uploads)
+        # vid -> live EC-generate job state (observable + cancellable; the
+        # reference streams this over its gRPC seam)
+        self._ec_jobs: dict[int, dict] = {}
         self._download_sem = asyncio.Semaphore(concurrent_downloads)
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
@@ -595,14 +600,56 @@ class VolumeServer:
         if v is None:
             return web.json_response({"error": "volume not found"}, status=404)
         base = v._base
+        if self._ec_jobs.get(vid, {}).get("state") == "running":
+            return web.json_response({"error": "encode already running"},
+                                     status=409)
+        job = {"state": "running", "bytes_done": 0,
+               "total": os.path.getsize(base + ".dat"),
+               "cancel": False, "error": None, "started": time.time()}
+        self._ec_jobs[vid] = job
+
         def gen():
             v.nm.flush()
-            ec_files.write_ec_files(base)
+            ec_files.write_ec_files(
+                base,
+                progress=lambda n: job.__setitem__("bytes_done", n),
+                cancel=lambda: job["cancel"])
             ec_files.write_sorted_ecx(base + ".idx")
-            metrics.EC_ENCODE_BYTES.labels("tpu").inc(
-                os.path.getsize(base + ".dat"))
-        await asyncio.to_thread(gen)
+            metrics.EC_ENCODE_BYTES.labels("tpu").inc(job["total"])
+
+        try:
+            await asyncio.to_thread(gen)
+        except ec_files.EncodeCancelled:
+            # write_ec_files builds under temp names: a cancelled encode
+            # already cleaned up after itself and any previous valid shard
+            # set is untouched
+            job["state"] = "cancelled"
+            return web.json_response({"error": "cancelled"}, status=409)
+        except Exception as e:
+            job["state"] = "failed"
+            job["error"] = str(e)
+            raise
+        job["state"] = "done"
+        job["bytes_done"] = job["total"]
         return web.json_response({"shards": list(range(layout.TOTAL_SHARDS))})
+
+    async def handle_ec_progress(self, req: web.Request) -> web.Response:
+        """Observability for a long-running encode (weak spot the reference
+        covers with streamed gRPC progress)."""
+        vid = int(req.query.get("volumeId", "0"))
+        job = self._ec_jobs.get(vid)
+        if job is None:
+            return web.json_response({"error": "no encode job"}, status=404)
+        return web.json_response({k: v for k, v in job.items()})
+
+    async def handle_ec_cancel(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        job = self._ec_jobs.get(body["volume"])
+        if job is None or job["state"] != "running":
+            return web.json_response({"error": "no running encode"},
+                                     status=404)
+        job["cancel"] = True
+        return web.json_response({"ok": True})
 
     async def handle_ec_rebuild(self, req: web.Request) -> web.Response:
         """VolumeEcShardsRebuild (volume_grpc_erasure_coding.go:84)."""

@@ -1560,3 +1560,37 @@ def cmd_ec_cleanup(env: CommandEnv, args, out):
                             {"volume": vid, "shards": shards})
     print(f"ec.cleanup: {n} orphan group(s)"
           + ("" if apply else " planned"), file=out)
+
+
+@command("ec.progress")
+def cmd_ec_progress(env: CommandEnv, args, out):
+    """Watch a running EC encode: ec.progress -volumeId N [-server url]
+    [-cancel true]"""
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    if flags.get("cancel") == "true":
+        # cancelling aborts another operator's encode: single-writer rule
+        env.require_lock()
+    urls = [flags["server"]] if flags.get("server") else \
+        env.volume_locations(vid) or \
+        [n for n in env.topology()["nodes"]]
+    cancelled = 0
+    for url in urls:
+        try:
+            if flags.get("cancel") == "true":
+                env.vs_post(url, "/admin/ec/cancel", {"volume": vid})
+                print(f"{url}: cancel requested", file=out)
+                cancelled += 1
+                continue
+            r = env.master_get_raw(url, "/admin/ec/progress",
+                                   volumeId=str(vid))
+        except RuntimeError:
+            continue
+        pct = 100.0 * r.get("bytes_done", 0) / max(1, r.get("total", 1))
+        print(f"{url}: {r.get('state')} {pct:.1f}% "
+              f"({r.get('bytes_done', 0)}/{r.get('total', 0)} bytes)"
+              + (f" error={r['error']}" if r.get("error") else ""),
+              file=out)
+        return
+    if flags.get("cancel") != "true" or not cancelled:
+        print(f"no encode job found for volume {vid}", file=out)

@@ -98,12 +98,17 @@ PIPELINE_DEPTH = 3  # host batch buffers in flight: read N+1 / encode N / drain 
 def write_ec_files(base: str, dat_path: str | None = None,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
-                   batch_size: int = DEFAULT_BATCH) -> None:
+                   batch_size: int = DEFAULT_BATCH,
+                   progress=None, cancel=None) -> None:
     """Encode `<base>.dat` (or dat_path) into `<base>.ec00` .. `.ec13`,
     plus a `<base>.vif` volume-info sidecar recording the encode-time dat
     size and version (the reference's .vif, volume_info.go:16-40, as JSON):
     the layout was cut from the FILE size, which later lookups cannot
     reliably re-derive from the index once tail needles get deleted.
+
+    `progress(bytes_done)` is called per batch and `cancel()` (returning
+    True) aborts mid-stream — a 30GB encode must be observable and
+    stoppable (the reference streams progress over its gRPC seam).
 
     The encode is a three-stage pipeline mirroring (and overlapping) the
     reference's streaming loop (ec_encoder.go:120-235): a reader thread
@@ -114,17 +119,32 @@ def write_ec_files(base: str, dat_path: str | None = None,
     PIPELINE_DEPTH, so steady-state allocation is zero."""
     dat_path = dat_path or base + ".dat"
     dat_size = os.path.getsize(dat_path)
-    write_vif(base, dat_size)
     codec = _get_codec()
 
-    outputs = [open(base + layout.to_ext(i), "wb")
-               for i in range(layout.TOTAL_SHARDS)]
+    # shards build under temp names and commit by rename only when the
+    # whole encode succeeds: a cancelled/crashed encode leaves any
+    # previous valid shard set (and its .ecx/.vif) untouched
+    tmp_paths = [base + layout.to_ext(i) + ".tmp"
+                 for i in range(layout.TOTAL_SHARDS)]
+    outputs = [open(p_, "wb") for p_ in tmp_paths]
+    ok = False
     try:
         _encode_stream(codec, dat_path, dat_size, large_block, small_block,
-                       batch_size, outputs)
+                       batch_size, outputs, progress, cancel)
+        ok = True
     finally:
         for f in outputs:
             f.close()
+        if ok:
+            write_vif(base, dat_size)
+            for i, p_ in enumerate(tmp_paths):
+                os.replace(p_, base + layout.to_ext(i))
+        else:
+            for p_ in tmp_paths:
+                try:
+                    os.remove(p_)
+                except OSError:
+                    pass
 
 
 def _iter_units(dat_size: int, large_block: int, small_block: int,
@@ -163,8 +183,13 @@ def _dispatch_parity(codec, batch: np.ndarray):
     return codec.encode_parity(jnp.asarray(batch))
 
 
+class EncodeCancelled(RuntimeError):
+    pass
+
+
 def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
-                   small_block: int, batch_size: int, outputs) -> None:
+                   small_block: int, batch_size: int, outputs,
+                   progress=None, cancel=None) -> None:
     """Reader -> dispatch -> writer pipeline over the work units.
 
     A batch buffer is only returned to the pool after the writer has both
@@ -179,13 +204,18 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
     q_write: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
     errors: list[BaseException] = []
 
+    done = 0
+
     def reader() -> None:
+        nonlocal done
         try:
             with open(dat_path, "rb") as dat:
                 for row_start, block, col, step in _iter_units(
                         dat_size, large_block, small_block, batch_size):
                     if errors:  # writer failed: stop reading the volume
                         break
+                    if cancel is not None and cancel():
+                        raise EncodeCancelled("ec encode cancelled")
                     buf = pool.get()
                     batch = buf[:, :step]
                     for j in range(layout.DATA_SHARDS):
@@ -199,6 +229,10 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
                         if n < step:  # only the file's tail needs zero-fill
                             batch[j, max(n, 0):] = 0
                     q_read.put((buf, step))
+                    done = min(dat_size,
+                               done + step * layout.DATA_SHARDS)
+                    if progress is not None:
+                        progress(done)
         except BaseException as e:  # surfaced by the main thread
             errors.append(e)
         finally:
