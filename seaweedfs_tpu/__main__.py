@@ -112,6 +112,9 @@ def main(argv=None) -> int:
                     help="on-disk chunk cache size (0 = memory-only)")
     pf.add_argument("-notification.log", dest="notificationLog", default=None,
                     help="append meta events to this JSONL file")
+    pf.add_argument("-notification.webhook", dest="notificationWebhook",
+                    default=None,
+                    help="POST meta events to this HTTP endpoint")
 
     p3 = sub.add_parser("s3")
     p3.add_argument("-ip", default="127.0.0.1")
@@ -377,7 +380,10 @@ async def _run_volume(args) -> int:
 async def _run_filer(args) -> int:
     from seaweedfs_tpu.server.filer_server import FilerServer
     notification = None
-    if args.notificationLog:
+    if getattr(args, "notificationWebhook", None):
+        from seaweedfs_tpu.notification import WebhookQueue
+        notification = WebhookQueue(args.notificationWebhook)
+    elif args.notificationLog:
         from seaweedfs_tpu.notification import LogQueue
         notification = LogQueue(args.notificationLog)
     f = FilerServer(args.master, args.ip, args.port, data_dir=args.dir,

@@ -241,3 +241,174 @@ try:  # pragma: no cover - depends on environment
     STORES["redis"] = RedisStore
 except ImportError:
     pass
+
+
+try:  # pragma: no cover - depends on environment
+    import pymongo as _pymongo  # noqa: F401
+
+    class MongoStore(FilerStore):
+        """Entries in a MongoDB collection keyed (directory, name)
+        (reference: weed/filer/mongodb/mongodb_store.go)."""
+
+        name = "mongodb"
+
+        def __init__(self, uri: str = "mongodb://127.0.0.1:27017",
+                     database: str = "seaweedfs"):
+            client = _pymongo.MongoClient(uri)
+            db = client[database]
+            self.files = db["filemeta"]
+            self.kv = db["kv"]
+            self.files.create_index([("directory", 1), ("name", 1)],
+                                    unique=True)
+
+        @staticmethod
+        def _split(full_path: str) -> tuple[str, str]:
+            from seaweedfs_tpu.filer.entry import split_path
+            return split_path(full_path)
+
+        def insert_entry(self, entry: Entry) -> None:
+            d, n = self._split(entry.full_path)
+            self.files.replace_one(
+                {"directory": d, "name": n},
+                {"directory": d, "name": n,
+                 "meta": json.dumps(entry.to_dict())},
+                upsert=True)
+
+        update_entry = insert_entry
+
+        def find_entry(self, full_path: str) -> Entry:
+            d, n = self._split(full_path)
+            doc = self.files.find_one({"directory": d, "name": n})
+            if doc is None:
+                raise NotFound(full_path)
+            return Entry.from_dict(json.loads(doc["meta"]))
+
+        def delete_entry(self, full_path: str) -> None:
+            d, n = self._split(full_path)
+            self.files.delete_one({"directory": d, "name": n})
+
+        def delete_folder_children(self, full_path: str) -> None:
+            full_path = full_path.rstrip("/") or "/"
+            pref = full_path if full_path.endswith("/") else full_path + "/"
+            import re
+            self.files.delete_many({"$or": [
+                {"directory": full_path},
+                {"directory": {"$regex": "^" + re.escape(pref)}}]})
+
+        def list_directory_entries(self, dir_path: str, start_from: str = "",
+                                   include_start: bool = False,
+                                   limit: int = 1024,
+                                   prefix: str = "") -> list[Entry]:
+            d = dir_path.rstrip("/") or "/"
+            q: dict = {"directory": d}
+            cmp = "$gte" if include_start else "$gt"
+            if start_from:
+                q["name"] = {cmp: start_from}
+            if prefix:
+                import re
+                q.setdefault("name", {})
+                if isinstance(q["name"], dict):
+                    q["name"]["$regex"] = "^" + re.escape(prefix)
+            cur = self.files.find(q).sort("name", 1).limit(limit)
+            return [Entry.from_dict(json.loads(doc["meta"])) for doc in cur]
+
+        def kv_put(self, key: bytes, value: bytes) -> None:
+            self.kv.replace_one({"_id": key.hex()},
+                                {"_id": key.hex(), "v": value.hex()},
+                                upsert=True)
+
+        def kv_get(self, key: bytes) -> bytes:
+            doc = self.kv.find_one({"_id": key.hex()})
+            if doc is None:
+                raise NotFound(key.decode(errors="replace"))
+            return bytes.fromhex(doc["v"])
+
+        def kv_delete(self, key: bytes) -> None:
+            self.kv.delete_one({"_id": key.hex()})
+
+    STORES["mongodb"] = MongoStore
+except ImportError:
+    pass
+
+
+try:  # pragma: no cover - depends on environment
+    import etcd3 as _etcd3  # noqa: F401
+
+    class EtcdStore(FilerStore):
+        """Entries as etcd keys under a prefix (reference:
+        weed/filer/etcd/etcd_store.go). Key layout mirrors the reference:
+        'e<dir>/<name>' so directory listings are prefix range reads."""
+
+        name = "etcd"
+
+        def __init__(self, host: str = "127.0.0.1", port: int = 2379,
+                     key_prefix: str = "seaweedfs."):
+            self.c = _etcd3.client(host=host, port=port)
+            self.prefix = key_prefix
+
+        def _ek(self, full_path: str) -> str:
+            from seaweedfs_tpu.filer.entry import split_path
+            d, n = split_path(full_path)
+            return f"{self.prefix}e{d.rstrip('/')}/{n}"
+
+        def insert_entry(self, entry: Entry) -> None:
+            self.c.put(self._ek(entry.full_path),
+                       json.dumps(entry.to_dict()))
+
+        update_entry = insert_entry
+
+        def find_entry(self, full_path: str) -> Entry:
+            raw, _ = self.c.get(self._ek(full_path))
+            if raw is None:
+                raise NotFound(full_path)
+            return Entry.from_dict(json.loads(raw))
+
+        def delete_entry(self, full_path: str) -> None:
+            self.c.delete(self._ek(full_path))
+
+        def delete_folder_children(self, full_path: str) -> None:
+            d = full_path.rstrip("/") or ""
+            self.c.delete_prefix(f"{self.prefix}e{d}/")
+
+        def list_directory_entries(self, dir_path: str, start_from: str = "",
+                                   include_start: bool = False,
+                                   limit: int = 1024,
+                                   prefix: str = "") -> list[Entry]:
+            # python-etcd3 exposes no server-side limit on range reads, so
+            # pagination filters client-side with an early break; very
+            # large directories belong on a store with server-side paging
+            # (the SQL family or mongodb)
+            d = dir_path.rstrip("/") or ""
+            out = []
+            for raw, md in self.c.get_prefix(f"{self.prefix}e{d}/",
+                                             sort_order="ascend"):
+                key = md.key.decode()
+                name = key.rsplit("/", 1)[-1]
+                if "/" in key[len(f"{self.prefix}e{d}/"):]:
+                    continue  # deeper than one level
+                if prefix and not name.startswith(prefix):
+                    continue
+                if start_from and (name < start_from or
+                                   (name == start_from and
+                                    not include_start)):
+                    continue
+                out.append(Entry.from_dict(json.loads(raw)))
+                if len(out) >= limit:
+                    break
+            return out
+
+        def kv_put(self, key: bytes, value: bytes) -> None:
+            self.c.put(f"{self.prefix}kv{key.hex()}", value)
+
+        def kv_get(self, key: bytes) -> bytes:
+            raw, _ = self.c.get(f"{self.prefix}kv{key.hex()}")
+            if raw is None:
+                raise NotFound(key.decode(errors="replace"))
+            return raw
+
+        def kv_delete(self, key: bytes) -> None:
+            self.c.delete(f"{self.prefix}kv{key.hex()}")
+
+    STORES["etcd"] = EtcdStore
+except ImportError:
+    pass

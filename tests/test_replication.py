@@ -275,3 +275,40 @@ def test_shell_help(tmp_path):
     buf = io.StringIO()
     run_command(env, "help ec.encode", buf)
     assert "Convert a volume to EC shards" in buf.getvalue()
+
+
+def test_webhook_notification_queue():
+    """The SDK-free webhook backend POSTs each meta event as JSON (with
+    sink-style retry) — verified against a local collector."""
+    import http.server
+    import json as _json
+    import threading
+
+    got = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            got.append(_json.loads(body))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        from seaweedfs_tpu.notification import make_queue
+        q = make_queue("webhook",
+                       url=f"http://127.0.0.1:{srv.server_port}/events")
+        q.send("/dir/f.txt", {"event": "create", "size": 12})
+        # delivery is asynchronous (worker thread): poll for arrival
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline and not got:
+            time.sleep(0.05)
+        assert got == [{"key": "/dir/f.txt", "event": "create", "size": 12}]
+        q.close()
+    finally:
+        srv.shutdown()
