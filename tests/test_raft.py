@@ -53,7 +53,7 @@ def make_cluster(n=3, tmp_path=None):
     return net, nodes, applied
 
 
-def wait_leader(nodes, timeout=10.0):
+def wait_leader(nodes, timeout=20.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         leaders = [n for n in nodes if n.is_leader]
@@ -259,7 +259,7 @@ def test_snapshot_compaction_and_restart(tmp_path):
     leader = wait_leader(nodes)
     total = 0
     for i in range(60):
-        assert leader.propose({"add": i}, timeout=5.0)
+        assert leader.propose({"add": i}, timeout=10.0)
         total += i
     deadline = time.time() + 10
     while time.time() < deadline and any(
@@ -322,7 +322,7 @@ def test_fresh_follower_catches_up_via_install_snapshot(tmp_path):
     leader = wait_leader(nodes[:2])
     total = 0
     for i in range(40):
-        assert leader.propose({"add": i}, timeout=5.0)
+        assert leader.propose({"add": i}, timeout=10.0)
         total += i
     deadline = time.time() + 5
     while time.time() < deadline and leader.snap_index < 0:
