@@ -113,6 +113,8 @@ class FilerServer:
             web.post("/__admin__/entry", self.handle_raw_entry),
             web.get("/status", self.handle_server_status),
             web.get("/__admin__/filer_conf", self.handle_get_conf),
+            web.get("/__admin__/remote_mounts", self.handle_get_mounts),
+            web.post("/__admin__/remote_mounts", self.handle_put_mounts),
             web.post("/__admin__/filer_conf", self.handle_put_conf),
             web.get("/__admin__/status", self.handle_status),
             web.get("/__ui__", self.handle_ui),
@@ -779,6 +781,16 @@ class FilerServer:
 
         chunks = await self._resolve_chunks(entry)
         size = max(entry.size(), fc.total_size(chunks))
+        # read-through for remote placeholders (reference: read_remote.go —
+        # a mounted-but-uncached object serves straight from the remote)
+        ext_lower = {k.lower(): v for k, v in entry.extended.items()}
+        remote_read = None
+        if not chunks and ext_lower.get("remote-placeholder") == "true" \
+                and ext_lower.get("remote-key"):
+            remote, _ = self._remote_for(path)
+            if remote is not None:
+                remote_read = (remote, ext_lower["remote-key"])
+                size = max(size, int(ext_lower.get("remote-size", "0") or 0))
         headers = {
             "Accept-Ranges": "bytes",
             "Last-Modified": time.strftime(
@@ -812,7 +824,20 @@ class FilerServer:
         resp.content_type = mime
         resp.content_length = length
         await resp.prepare(req)
-        await self._stream_range(resp, chunks, offset, length)
+        if remote_read is not None:
+            remote, key = remote_read
+            pos = offset
+            end = offset + length
+            while pos < end:
+                n = min(4 * 1024 * 1024, end - pos)
+                data = await asyncio.to_thread(remote.read_range, key,
+                                               pos, n)
+                if not data:
+                    break
+                await resp.write(data)
+                pos += len(data)
+        else:
+            await self._stream_range(resp, chunks, offset, length)
         await resp.write_eof()
         return resp
 
@@ -929,6 +954,63 @@ class FilerServer:
             "version": "weedtpu", "role": "filer", "url": self.url,
             "master": self.master_url,
         })
+
+    # -- remote mount mappings (reference: filer/remote_mapping.go) ----
+
+    _MOUNTS_KV = b"remote.mounts"
+
+    def _load_mounts(self) -> dict:
+        now = time.monotonic()
+        if now - getattr(self, "_mounts_ts", 0.0) < 10.0:
+            return self._mounts_map
+        try:
+            raw = self.filer.store.kv_get(self._MOUNTS_KV)
+            self._mounts_map = json.loads(raw)
+        except (NotFound, ValueError):
+            self._mounts_map = {}
+        self._mounts_ts = now
+        return self._mounts_map
+
+    async def handle_get_mounts(self, req: web.Request) -> web.Response:
+        return web.json_response(self._load_mounts())
+
+    async def handle_put_mounts(self, req: web.Request) -> web.Response:
+        err = self._check_filer_jwt(req, write=True)
+        if err is not None:
+            return err
+        body = await req.json()
+        mounts = self._load_mounts()
+        for d, spec in (body.get("set") or {}).items():
+            mounts[d.rstrip("/") or "/"] = spec
+        for d in body.get("remove") or []:
+            mounts.pop(d.rstrip("/") or "/", None)
+        self.filer.store.kv_put(
+            self._MOUNTS_KV, json.dumps(mounts).encode())
+        self._mounts_ts = 0.0
+        return web.json_response(mounts)
+
+    def _remote_for(self, path: str):
+        """Longest-prefix mount mapping -> remote client (cached by spec);
+        the read-through half of the reference's read_remote.go."""
+        mounts = self._load_mounts()
+        best = ""
+        for d in mounts:
+            pref = d.rstrip("/") + "/"
+            if (path.startswith(pref) or path == d) and len(d) > len(best):
+                best = d
+        if not best:
+            return None, None
+        spec = mounts[best]
+        cache = getattr(self, "_remote_clients", None)
+        if cache is None:
+            cache = self._remote_clients = {}
+        client = cache.get(spec)
+        if client is None:
+            from seaweedfs_tpu.remote_storage import (make_remote,
+                                                      parse_remote_spec)
+            kind, options = parse_remote_spec(spec)
+            client = cache[spec] = make_remote(kind, **options)
+        return client, best
 
     async def handle_get_conf(self, req: web.Request) -> web.Response:
         return web.Response(text=self.conf.to_json(),

@@ -835,8 +835,13 @@ def cmd_remote_mount(env: CommandEnv, args, out):
     remote = make_remote(kind, **options)
     filer = env.find_filer()
     n = sync_remote_to_filer(remote, filer, mount_dir, cache=cache)
+    # record the mapping so the filer can read placeholders THROUGH the
+    # remote on demand (reference: remote_mapping.go + read_remote.go)
+    env._call(f"{filer}/__admin__/remote_mounts",
+              {"set": {mount_dir: flags.get("remote", "")}})
     print(f"remote.mount: {n} object(s) from {kind} -> {mount_dir}"
-          + ("" if cache else " (placeholders; remote.cache to pull)"),
+          + ("" if cache else " (placeholders; read-through live, "
+                              "remote.cache to pin)"),
           file=out)
 
 

@@ -236,3 +236,60 @@ def test_tier_move_and_remote_mount_via_s3(tmp_path):
         c.submit(s3.stop())
         c.submit(filer.stop())
         c.stop()
+
+
+def test_remote_mount_read_through(tmp_path):
+    """A mounted-but-uncached placeholder serves its bytes straight from
+    the remote (reference: filer/read_remote.go), including ranged reads;
+    the mapping registry survives filer queries."""
+    import io
+    import json as _json
+    import time
+    import urllib.request
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    bucket = tmp_path / "rt-bucket"
+    bucket.mkdir()
+    payload = bytes(range(256)) * 40  # 10240 bytes
+    (bucket / "big.bin").write_bytes(payload)
+
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port())
+    c.submit(filer.start())
+    try:
+        env = CommandEnv(c.master.url)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                env.find_filer()
+                break
+            except RuntimeError:
+                time.sleep(0.2)
+        buf = io.StringIO()
+        run_command(env, f"remote.mount -remote local:{bucket} -dir /rt", buf)
+        assert "read-through live" in buf.getvalue()
+        # mapping registered
+        mounts = _json.load(urllib.request.urlopen(
+            f"http://{filer.url}/__admin__/remote_mounts", timeout=10))
+        assert mounts.get("/rt", "").startswith("local:")
+        # full read through the placeholder
+        got = urllib.request.urlopen(
+            f"http://{filer.url}/rt/big.bin", timeout=15).read()
+        assert got == payload
+        # ranged read-through
+        req = urllib.request.Request(f"http://{filer.url}/rt/big.bin",
+                                     headers={"Range": "bytes=1000-1999"})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert r.status == 206
+            assert r.read() == payload[1000:2000]
+        # caching afterwards still works and serves the same bytes
+        run_command(env, f"remote.cache -remote local:{bucket} -dir /rt",
+                    io.StringIO())
+        got = urllib.request.urlopen(
+            f"http://{filer.url}/rt/big.bin", timeout=15).read()
+        assert got == payload
+    finally:
+        c.submit(filer.stop())
+        c.stop()
