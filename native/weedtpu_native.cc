@@ -144,15 +144,18 @@ void wn_gf_mul_slice(uint8_t c, const uint8_t* in, uint8_t* out, size_t n,
 }
 
 // out[rows x n] = mat[rows x k] . in[k x n] over GF(2^8).
-// Buffers are contiguous row-major.  This is the whole RS encode when `mat`
-// is the parity sub-matrix, and the whole decode when `mat` is the inverted
-// recovery matrix (reference hot loop: ec_encoder.go:120-196 enc.Encode).
+// Rows may live in scattered buffers (ptr-per-row), which lets the encode
+// path feed the kernel straight from an mmap of the volume .dat with no
+// staging copy.  This is the whole RS encode when `mat` is the parity
+// sub-matrix, and the whole decode when `mat` is the inverted recovery
+// matrix (reference hot loop: ec_encoder.go:120-196 enc.Encode).
 #if defined(__AVX2__)
 // Up to 4 output rows at once, accumulated in ymm registers across the k
 // inputs: each input byte is read exactly once per row-group and each output
 // byte written exactly once (the klauspost mulAvxTwo_NxM codegen scheme).
 static void gf_matmul_avx2_group(const uint8_t* mat, int r0, int nrows, int k,
-                                 const uint8_t* in, uint8_t* out, size_t n) {
+                                 const uint8_t* const* in_rows,
+                                 uint8_t* const* out_rows, size_t n) {
   const __m256i mask = _mm256_set1_epi8(0x0F);
   size_t col = 0;
   for (; col + 64 <= n; col += 64) {
@@ -160,7 +163,7 @@ static void gf_matmul_avx2_group(const uint8_t* mat, int r0, int nrows, int k,
     for (int r = 0; r < nrows; r++)
       acc[r][0] = acc[r][1] = _mm256_setzero_si256();
     for (int j = 0; j < k; j++) {
-      const uint8_t* src = in + (size_t)j * n + col;
+      const uint8_t* src = in_rows[j] + col;
       __m256i v0 = _mm256_loadu_si256((const __m256i*)src);
       __m256i v1 = _mm256_loadu_si256((const __m256i*)(src + 32));
       __m256i lo0 = _mm256_and_si256(v0, mask);
@@ -183,7 +186,7 @@ static void gf_matmul_avx2_group(const uint8_t* mat, int r0, int nrows, int k,
       }
     }
     for (int r = 0; r < nrows; r++) {
-      uint8_t* dst = out + (size_t)(r0 + r) * n + col;
+      uint8_t* dst = out_rows[r0 + r] + col;
       _mm256_storeu_si256((__m256i*)dst, acc[r][0]);
       _mm256_storeu_si256((__m256i*)(dst + 32), acc[r][1]);
     }
@@ -194,21 +197,22 @@ static void gf_matmul_avx2_group(const uint8_t* mat, int r0, int nrows, int k,
       uint8_t a = 0;
       for (int j = 0; j < k; j++) {
         uint8_t c = mat[(size_t)(r0 + r) * k + j];
-        if (c) a ^= GF_MUL[c][in[(size_t)j * n + col]];
+        if (c) a ^= GF_MUL[c][in_rows[j][col]];
       }
-      out[(size_t)(r0 + r) * n + col] = a;
+      out_rows[r0 + r][col] = a;
     }
   }
 }
 #endif
 
-void wn_gf_matmul(const uint8_t* mat, int rows, int k, const uint8_t* in,
-                  uint8_t* out, size_t n) {
-  wn_gf_init();
+// Shared ptr-based core used by both entry points.
+static void gf_matmul_rows(const uint8_t* mat, int rows, int k,
+                           const uint8_t* const* in_rows,
+                           uint8_t* const* out_rows, size_t n) {
 #if defined(__AVX2__)
   for (int r0 = 0; r0 < rows; r0 += 4) {
     int nrows = rows - r0 < 4 ? rows - r0 : 4;
-    gf_matmul_avx2_group(mat, r0, nrows, k, in, out, n);
+    gf_matmul_avx2_group(mat, r0, nrows, k, in_rows, out_rows, n);
   }
 #else
   // Cache-blocked fallback: 16KB column panels keep the k input sub-blocks
@@ -217,12 +221,12 @@ void wn_gf_matmul(const uint8_t* mat, int rows, int k, const uint8_t* in,
   for (size_t col = 0; col < n; col += BLK) {
     size_t w = n - col < BLK ? n - col : BLK;
     for (int r = 0; r < rows; r++) {
-      uint8_t* dst = out + (size_t)r * n + col;
+      uint8_t* dst = out_rows[r] + col;
       int first = 1;
       for (int j = 0; j < k; j++) {
         uint8_t c = mat[(size_t)r * k + j];
         if (c == 0) continue;
-        wn_gf_mul_slice(c, in + (size_t)j * n + col, dst, w, !first);
+        wn_gf_mul_slice(c, in_rows[j] + col, dst, w, !first);
         first = 0;
       }
       if (first) memset(dst, 0, w);
@@ -231,23 +235,23 @@ void wn_gf_matmul(const uint8_t* mat, int rows, int k, const uint8_t* in,
 #endif
 }
 
+void wn_gf_matmul(const uint8_t* mat, int rows, int k, const uint8_t* in,
+                  uint8_t* out, size_t n) {
+  wn_gf_init();
+  const uint8_t* in_rows[256];
+  uint8_t* out_rows[256];
+  for (int j = 0; j < k; j++) in_rows[j] = in + (size_t)j * n;
+  for (int r = 0; r < rows; r++) out_rows[r] = out + (size_t)r * n;
+  gf_matmul_rows(mat, rows, k, in_rows, out_rows, n);
+}
+
 // Same matmul but over scattered row pointers (avoids staging copies when
-// shards live in separate buffers).
+// shards live in separate buffers / an mmap'd .dat).
 void wn_gf_matmul_ptrs(const uint8_t* mat, int rows, int k,
                        const uint8_t* const* in_rows, uint8_t* const* out_rows,
                        size_t n) {
   wn_gf_init();
-  for (int r = 0; r < rows; r++) {
-    uint8_t* dst = out_rows[r];
-    int first = 1;
-    for (int j = 0; j < k; j++) {
-      uint8_t c = mat[(size_t)r * k + j];
-      if (c == 0) continue;
-      wn_gf_mul_slice(c, in_rows[j], dst, n, !first);
-      first = 0;
-    }
-    if (first) memset(dst, 0, n);
-  }
+  gf_matmul_rows(mat, rows, k, in_rows, out_rows, n);
 }
 
 // ---------------------------------------------------------------------------

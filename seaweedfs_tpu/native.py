@@ -77,6 +77,9 @@ def _load():
             ctypes.c_uint8, _u8p, _u8p, ctypes.c_size_t, ctypes.c_int]
         lib.wn_gf_matmul.argtypes = [
             _u8p, ctypes.c_int, ctypes.c_int, _u8p, _u8p, ctypes.c_size_t]
+        lib.wn_gf_matmul_ptrs.argtypes = [
+            _u8p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(_u8p), ctypes.POINTER(_u8p), ctypes.c_size_t]
         lib.wn_crc32c.restype = ctypes.c_uint32
         lib.wn_crc32c.argtypes = [_u8p, ctypes.c_size_t, ctypes.c_uint32]
         lib.wn_aes256_ctr.argtypes = [_u8p, _u8p, _u8p, _u8p, ctypes.c_size_t]
@@ -123,6 +126,23 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     lib.wn_gf_matmul(_as_u8p(mat), rows, k, _as_u8p(data), _as_u8p(out),
                      ctypes.c_size_t(n))
     return out
+
+
+def gf_matmul_ptrs(mat: np.ndarray, in_rows: list[np.ndarray],
+                   out_rows: list[np.ndarray], n: int) -> None:
+    """out_rows[r][:n] = sum_j mat[r, j] * in_rows[j][:n] over GF(2^8).
+
+    Row buffers may be scattered (e.g. views straight into an mmap'd .dat),
+    so the encode path runs with zero staging copies.  Each in_rows[j] /
+    out_rows[r] must be C-contiguous uint8 with >= n bytes."""
+    lib = _require()
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    rows, k = mat.shape
+    assert len(in_rows) == k and len(out_rows) == rows, (mat.shape,)
+    ins = (_u8p * k)(*[r.ctypes.data_as(_u8p) for r in in_rows])
+    outs = (_u8p * rows)(*[r.ctypes.data_as(_u8p) for r in out_rows])
+    lib.wn_gf_matmul_ptrs(_as_u8p(mat), rows, k, ins, outs,
+                          ctypes.c_size_t(n))
 
 
 def gf_mul_slice(c: int, src: np.ndarray, dst: np.ndarray,

@@ -603,7 +603,7 @@ class VolumeServer:
         if self._ec_jobs.get(vid, {}).get("state") == "running":
             return web.json_response({"error": "encode already running"},
                                      status=409)
-        job = {"state": "running", "bytes_done": 0,
+        job = {"state": "running", "kind": "encode", "bytes_done": 0,
                "total": os.path.getsize(base + ".dat"),
                "cancel": False, "error": None, "started": time.time()}
         self._ec_jobs[vid] = job
@@ -652,12 +652,41 @@ class VolumeServer:
         return web.json_response({"ok": True})
 
     async def handle_ec_rebuild(self, req: web.Request) -> web.Response:
-        """VolumeEcShardsRebuild (volume_grpc_erasure_coding.go:84)."""
+        """VolumeEcShardsRebuild (volume_grpc_erasure_coding.go:84).
+
+        Registers under the same per-vid job state as encode, so
+        /admin/ec/progress and /admin/ec/cancel observe and abort a
+        long-running rebuild identically."""
         body = await req.json()
-        base = self._ec_base(body["volume"])
+        vid = body["volume"]
+        base = self._ec_base(vid)
         if base is None:
             return web.json_response({"error": "no shards here"}, status=404)
-        rebuilt = await asyncio.to_thread(ec_files.rebuild_ec_files, base)
+        if self._ec_jobs.get(vid, {}).get("state") == "running":
+            return web.json_response({"error": "ec job already running"},
+                                     status=409)
+        present = [i for i in range(layout.TOTAL_SHARDS)
+                   if os.path.exists(base + layout.to_ext(i))]
+        total = (os.path.getsize(base + layout.to_ext(present[0]))
+                 * layout.DATA_SHARDS) if present else 0
+        job = {"state": "running", "kind": "rebuild", "bytes_done": 0,
+               "total": total, "cancel": False, "error": None,
+               "started": time.time()}
+        self._ec_jobs[vid] = job
+        try:
+            rebuilt = await asyncio.to_thread(
+                ec_files.rebuild_ec_files, base,
+                progress=lambda n: job.__setitem__("bytes_done", n),
+                cancel=lambda: job["cancel"])
+        except ec_files.EncodeCancelled:
+            job["state"] = "cancelled"
+            return web.json_response({"error": "cancelled"}, status=409)
+        except Exception as e:
+            job["state"] = "failed"
+            job["error"] = str(e)
+            raise
+        job["state"] = "done"
+        job["bytes_done"] = job["total"]
         return web.json_response({"rebuilt": rebuilt})
 
     async def handle_ec_mount(self, req: web.Request) -> web.Response:

@@ -23,6 +23,7 @@ import functools
 import os
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -99,42 +100,44 @@ def write_ec_files(base: str, dat_path: str | None = None,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
                    batch_size: int = DEFAULT_BATCH,
-                   progress=None, cancel=None) -> None:
+                   progress=None, cancel=None, stats=None) -> None:
     """Encode `<base>.dat` (or dat_path) into `<base>.ec00` .. `.ec13`,
     plus a `<base>.vif` volume-info sidecar recording the encode-time dat
     size and version (the reference's .vif, volume_info.go:16-40, as JSON):
     the layout was cut from the FILE size, which later lookups cannot
     reliably re-derive from the index once tail needles get deleted.
 
-    `progress(bytes_done)` is called per batch and `cancel()` (returning
-    True) aborts mid-stream — a 30GB encode must be observable and
-    stoppable (the reference streams progress over its gRPC seam).
+    `progress(bytes_done)` is called per batch with ACTUAL volume bytes
+    consumed and `cancel()` (returning True) aborts mid-stream — a 30GB
+    encode must be observable and stoppable (the reference streams progress
+    over its gRPC seam).  `stats`, when a dict, receives per-stage wall-time
+    attribution (read/encode/write seconds) for bench.py.
 
-    The encode is a three-stage pipeline mirroring (and overlapping) the
-    reference's streaming loop (ec_encoder.go:120-235): a reader thread
-    fills host batch N+1 from the .dat while the main thread dispatches the
-    device encode of batch N (JAX dispatch is async — the parity array is
-    not materialised here) and a writer thread blocks on batch N-1's parity
-    and drains all 14 shard files. Batch buffers come from a fixed pool of
-    PIPELINE_DEPTH, so steady-state allocation is zero."""
+    Shards build under `.tmp` names and commit by rename only when the
+    whole encode succeeds, so a cancelled/crashed encode leaves any
+    previous valid shard set (and its .ecx/.vif) untouched.  Stale `.tmp`
+    files from an earlier failed/cancelled attempt are recycled in place
+    (opened without O_TRUNC): a retried encode overwrites the already-
+    allocated pages instead of faulting in fresh ones, which matters both
+    on hosts with lazy page allocation and for filesystems that would
+    otherwise re-extend the files block by block."""
     dat_path = dat_path or base + ".dat"
     dat_size = os.path.getsize(dat_path)
     codec = _get_codec()
 
-    # shards build under temp names and commit by rename only when the
-    # whole encode succeeds: a cancelled/crashed encode leaves any
-    # previous valid shard set (and its .ecx/.vif) untouched
     tmp_paths = [base + layout.to_ext(i) + ".tmp"
                  for i in range(layout.TOTAL_SHARDS)]
-    outputs = [open(p_, "wb") for p_ in tmp_paths]
+    # O_RDWR without O_TRUNC: recycle pages of stale tmp files (see above);
+    # _encode_stream ftruncates each fd to its exact final size.
+    out_fds = [os.open(p_, os.O_RDWR | os.O_CREAT, 0o644) for p_ in tmp_paths]
     ok = False
     try:
         _encode_stream(codec, dat_path, dat_size, large_block, small_block,
-                       batch_size, outputs, progress, cancel)
+                       batch_size, out_fds, progress, cancel, stats)
         ok = True
     finally:
-        for f in outputs:
-            f.close()
+        for fd in out_fds:
+            os.close(fd)
         if ok:
             write_vif(base, dat_size)
             for i, p_ in enumerate(tmp_paths):
@@ -149,24 +152,29 @@ def write_ec_files(base: str, dat_path: str | None = None,
 
 def _iter_units(dat_size: int, large_block: int, small_block: int,
                 batch_size: int):
-    """Yield (row_start, block, col, step) column-batch work units in shard
-    file order: N full rows of 10 large blocks, then small-block rows."""
+    """Yield (row_start, block, col, step, shard_off) column-batch work
+    units in shard file order: N full rows of 10 large blocks, then
+    small-block rows.  shard_off is the unit's byte offset inside every
+    shard file (all 14 shard files are parallel arrays of blocks)."""
     processed = 0
     remaining = dat_size
+    shard_base = 0
     while remaining > large_block * layout.DATA_SHARDS:
         step = min(batch_size, large_block)
         assert large_block % step == 0, (large_block, step)
         for col in range(0, large_block, step):
-            yield processed, large_block, col, step
+            yield processed, large_block, col, step, shard_base + col
         processed += large_block * layout.DATA_SHARDS
         remaining -= large_block * layout.DATA_SHARDS
+        shard_base += large_block
     while remaining > 0:
         step = min(batch_size, small_block)
         assert small_block % step == 0, (small_block, step)
         for col in range(0, small_block, step):
-            yield processed, small_block, col, step
+            yield processed, small_block, col, step, shard_base + col
         processed += small_block * layout.DATA_SHARDS
         remaining -= small_block * layout.DATA_SHARDS
+        shard_base += small_block
 
 
 def _dispatch_parity(codec, batch: np.ndarray):
@@ -187,52 +195,262 @@ class EncodeCancelled(RuntimeError):
     pass
 
 
-def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
-                   small_block: int, batch_size: int, outputs,
-                   progress=None, cancel=None) -> None:
-    """Reader -> dispatch -> writer pipeline over the work units.
+_CFR_OK = True  # copy_file_range support, latched off on first failure
 
-    A batch buffer is only returned to the pool after the writer has both
-    written its data rows and materialised its parity — until then the
-    device may still be reading the (possibly zero-copy-aliased on CPU
-    backends) host memory."""
+
+def _copy_range(src_fd: int, dst_fd: int, src_off: int, dst_off: int,
+                count: int, src_view: np.ndarray | None = None) -> None:
+    """In-kernel copy of a .dat slice into a shard file (no user-space
+    transit), falling back to pwrite from the mmap view where
+    copy_file_range is unsupported (non-regular files, cross-fs, old
+    kernels)."""
+    global _CFR_OK
+    if _CFR_OK and hasattr(os, "copy_file_range"):
+        so, do, left = src_off, dst_off, count
+        try:
+            while left > 0:
+                n = os.copy_file_range(src_fd, dst_fd, left, so, do)
+                if n <= 0:
+                    raise OSError("copy_file_range returned 0")
+                so += n
+                do += n
+                left -= n
+            return
+        except OSError:
+            _CFR_OK = False
+            src_off, dst_off, count = so, do, left  # resume where CFR died
+    if count > 0 and src_view is not None:
+        _pwrite_all(dst_fd, src_view[src_off:src_off + count], dst_off)
+
+
+class _Timer:
+    """Accumulates wall seconds into stats[key]; no-op when stats is None."""
+
+    def __init__(self, stats, key):
+        self.stats, self.key = stats, key
+
+    def __enter__(self):
+        if self.stats is not None:
+            self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self.stats is not None:
+            self.stats[self.key] = self.stats.get(self.key, 0.0) + \
+                (time.perf_counter() - self.t0)
+        return False
+
+
+def _finalize_shards(out_fds, highwater, shard_size: int) -> None:
+    """Cut every shard file to exactly shard_size: truncate to the written
+    high-water mark first (drops stale bytes of a recycled tmp file), then
+    extend — the zero suffix becomes a filesystem hole, so fully-padded
+    regions (e.g. a 40MB volume in a 16MB-block layout) cost no write I/O
+    at all."""
+    for fd, hw in zip(out_fds, highwater):
+        os.ftruncate(fd, min(hw, shard_size))
+        if hw < shard_size:
+            os.ftruncate(fd, shard_size)
+
+
+def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
+                   small_block: int, batch_size: int, out_fds,
+                   progress=None, cancel=None, stats=None) -> None:
+    """Stream the .dat through the codec into the 14 shard fds.
+
+    Two strategies behind one surface:
+      - host codecs (native AVX2 / numpy): a serial zero-copy loop — the
+        kernel reads straight from an mmap of the .dat via per-row
+        pointers, data shards move by in-kernel copy_file_range, parity
+        lands in a pooled buffer and is pwritten.  On a storage host the
+        encode is bandwidth-bound; removing every staging copy beats any
+        amount of thread pipelining (and a 1-core host has nothing to
+        overlap anyway).
+      - device codecs (Pallas/XLA/mesh): the 3-stage reader -> dispatch ->
+        writer pipeline, since JAX dispatch is async and the device round-
+        trip genuinely overlaps host I/O.  Reads stage from the mmap into
+        pooled buffers (no per-batch allocation); only parity rides the
+        device — data shards still copy_file_range straight to disk.
+
+    Rows wholly beyond the .dat are never read, encoded, or written: the
+    parity of an all-zero row region is zero, so those regions become
+    holes (_finalize_shards).  Partially-covered units encode only the
+    rows that carry data, against a column-sliced parity matrix."""
+    if stats is not None:
+        stats["bytes"] = dat_size
+    shard_size = layout.shard_file_size(dat_size, large_block, small_block)
+    k = layout.DATA_SHARDS
+    highwater = [0] * layout.TOTAL_SHARDS
+    if dat_size == 0:
+        _finalize_shards(out_fds, highwater, shard_size)
+        return
+
+    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
+    native_host = isinstance(codec, NativeRSCodec)
+    if stats is not None:
+        stats["mode"] = "host-serial" if native_host else "pipelined"
+
+    import mmap as mmap_mod
+    with open(dat_path, "rb") as datf:
+        dat_fd = datf.fileno()
+        mm = mmap_mod.mmap(dat_fd, 0, prot=mmap_mod.PROT_READ)
+        try:
+            mm.madvise(mmap_mod.MADV_SEQUENTIAL)
+        except (AttributeError, OSError):
+            pass
+        dat_view = np.frombuffer(mm, dtype=np.uint8)
+        try:
+            if native_host:
+                _encode_serial_host(codec, dat_fd, dat_view, dat_size,
+                                    large_block, small_block, batch_size,
+                                    out_fds, highwater, progress, cancel,
+                                    stats)
+            else:
+                _encode_pipelined(codec, dat_fd, dat_view, dat_size,
+                                  large_block, small_block, batch_size,
+                                  out_fds, highwater, progress, cancel,
+                                  stats)
+        finally:
+            del dat_view
+            try:
+                mm.close()
+            except BufferError:
+                # an in-flight exception's traceback frames still hold
+                # views into the map; GC reaps the mapping with them
+                pass
+    _finalize_shards(out_fds, highwater, shard_size)
+
+
+def _unit_coverage(dat_size: int, row_start: int, block: int, col: int,
+                   step: int) -> tuple[int, int]:
+    """-> (nz, tail): nz = number of leading rows carrying any data in this
+    unit, tail = valid bytes in row nz-1 (== step when that row is full)."""
+    nz = 0
+    tail = step
+    for j in range(layout.DATA_SHARDS):
+        off = row_start + j * block + col
+        n = min(step, dat_size - off)
+        if n <= 0:
+            break
+        nz = j + 1
+        tail = n
+    return nz, tail
+
+
+def _pwrite_all(fd: int, view, off: int) -> None:
+    """pwrite may write short (RLIMIT_FSIZE edge, fs under pressure); a
+    silent short write would commit a shard with a zero gap."""
+    mv = memoryview(view)
+    while len(mv) > 0:
+        n = os.pwrite(fd, mv, off)
+        if n <= 0:
+            raise OSError("pwrite returned 0")
+        mv = mv[n:]
+        off += n
+
+
+def _encode_serial_host(codec, dat_fd: int, dat_view: np.ndarray,
+                        dat_size: int, large_block: int, small_block: int,
+                        batch_size: int, out_fds, highwater,
+                        progress=None, cancel=None, stats=None) -> None:
+    from seaweedfs_tpu import native
+    k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
+    max_step = min(batch_size, max(large_block, small_block))
+    pbuf = np.empty((m, max_step), dtype=np.uint8)
+    tailbuf = np.zeros(max_step, dtype=np.uint8)
+    done = 0
+    for row_start, block, col, step, shard_off in _iter_units(
+            dat_size, large_block, small_block, batch_size):
+        if cancel is not None and cancel():
+            raise EncodeCancelled("ec encode cancelled")
+        nz, tail = _unit_coverage(dat_size, row_start, block, col, step)
+        if nz == 0:
+            continue
+        # data shards: in-kernel copy, no user-space transit
+        with _Timer(stats, "write_data_s"):
+            for j in range(nz):
+                off = row_start + j * block + col
+                n = step if j < nz - 1 else tail
+                _copy_range(dat_fd, out_fds[j], off, shard_off, n,
+                            src_view=dat_view)
+                highwater[j] = max(highwater[j], shard_off + n)
+        # parity: ptr-matmul straight off the mmap (partial tail row is
+        # staged into a pooled zeroed buffer first)
+        with _Timer(stats, "encode_s"):
+            rows = [dat_view[row_start + j * block + col:
+                             row_start + j * block + col + step]
+                    for j in range(nz)]
+            if tail < step:
+                tailbuf[:tail] = rows[nz - 1][:tail]
+                tailbuf[tail:step] = 0
+                rows[nz - 1] = tailbuf
+            mat = codec.code.parity_matrix if nz == k else \
+                np.ascontiguousarray(codec.code.parity_matrix[:, :nz])
+            native.gf_matmul_ptrs(mat, rows, list(pbuf), step)
+        with _Timer(stats, "write_parity_s"):
+            for i in range(m):
+                _pwrite_all(out_fds[k + i], pbuf[i, :step], shard_off)
+                highwater[k + i] = max(highwater[k + i], shard_off + step)
+        done += (nz - 1) * step + tail
+        if progress is not None:
+            progress(done)
+
+
+def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
+                      dat_size: int, large_block: int, small_block: int,
+                      batch_size: int, out_fds, highwater,
+                      progress=None, cancel=None, stats=None) -> None:
+    """Reader -> dispatch -> writer pipeline for async device codecs.
+
+    A batch buffer is only returned to the pool after the writer has
+    materialised its parity — until then the device may still be reading
+    the (possibly zero-copy-aliased on CPU backends) host memory."""
+    k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
     max_step = min(batch_size, max(large_block, small_block))
     pool: queue.Queue = queue.Queue()
     for _ in range(PIPELINE_DEPTH):
-        pool.put(np.empty((layout.DATA_SHARDS, max_step), dtype=np.uint8))
+        pool.put(np.empty((k, max_step), dtype=np.uint8))
     q_read: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
     q_write: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
     errors: list[BaseException] = []
-
     done = 0
 
     def reader() -> None:
         nonlocal done
         try:
-            with open(dat_path, "rb") as dat:
-                for row_start, block, col, step in _iter_units(
-                        dat_size, large_block, small_block, batch_size):
-                    if errors:  # writer failed: stop reading the volume
-                        break
-                    if cancel is not None and cancel():
-                        raise EncodeCancelled("ec encode cancelled")
+            for row_start, block, col, step, shard_off in _iter_units(
+                    dat_size, large_block, small_block, batch_size):
+                if errors:  # writer failed: stop reading the volume
+                    break
+                if cancel is not None and cancel():
+                    raise EncodeCancelled("ec encode cancelled")
+                nz, tail = _unit_coverage(dat_size, row_start, block, col,
+                                          step)
+                if nz == 0:
+                    continue
+                # data shards never round-trip the device: in-kernel copy
+                with _Timer(stats, "write_data_s"):
+                    for j in range(nz):
+                        off = row_start + j * block + col
+                        n = step if j < nz - 1 else tail
+                        _copy_range(dat_fd, out_fds[j], off, shard_off, n,
+                                    src_view=dat_view)
+                        highwater[j] = max(highwater[j], shard_off + n)
+                with _Timer(stats, "read_s"):
                     buf = pool.get()
                     batch = buf[:, :step]
-                    for j in range(layout.DATA_SHARDS):
+                    for j in range(k):
                         off = row_start + j * block + col
                         n = max(0, min(step, dat_size - off))
                         if n > 0:
-                            dat.seek(off)
-                            raw = dat.read(n)
-                            batch[j, : len(raw)] = np.frombuffer(
-                                raw, dtype=np.uint8)
-                        if n < step:  # only the file's tail needs zero-fill
+                            np.copyto(batch[j, :n],
+                                      dat_view[off:off + n])
+                        if n < step:
                             batch[j, max(n, 0):] = 0
-                    q_read.put((buf, step))
-                    done = min(dat_size,
-                               done + step * layout.DATA_SHARDS)
-                    if progress is not None:
-                        progress(done)
+                q_read.put((buf, step, shard_off))
+                done += (nz - 1) * step + tail
+                if progress is not None:
+                    progress(done)
         except BaseException as e:  # surfaced by the main thread
             errors.append(e)
         finally:
@@ -244,14 +462,17 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
             item = q_write.get()
             if item is None:
                 return
-            buf, step, parity = item
+            buf, step, shard_off, parity = item
             if not failed:
                 try:
-                    pnp = np.asarray(parity)  # sync point for device encode
-                    for j in range(layout.DATA_SHARDS):
-                        outputs[j].write(buf[j, :step].tobytes())
-                    for i in range(pnp.shape[0]):
-                        outputs[layout.DATA_SHARDS + i].write(pnp[i].tobytes())
+                    with _Timer(stats, "write_parity_s"):
+                        pnp = np.asarray(parity)  # sync for device encode
+                        for i in range(pnp.shape[0]):
+                            _pwrite_all(out_fds[k + i],
+                                        np.ascontiguousarray(pnp[i, :step]),
+                                        shard_off)
+                            highwater[k + i] = max(highwater[k + i],
+                                                   shard_off + step)
                 except BaseException as e:
                     errors.append(e)
                     failed = True  # keep draining so nothing deadlocks
@@ -266,12 +487,13 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
             item = q_read.get()
             if item is None:
                 break
-            buf, step = item
+            buf, step, shard_off = item
             if errors:  # writer failed: stop dispatching, surface below
                 pool.put(buf)
                 continue
-            parity = _dispatch_parity(codec, buf[:, :step])
-            q_write.put((buf, step, parity))
+            with _Timer(stats, "encode_s"):
+                parity = _dispatch_parity(codec, buf[:, :step])
+            q_write.put((buf, step, shard_off, parity))
     finally:
         q_write.put(None)
         t_w.join()
@@ -287,9 +509,17 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
         raise errors[0]
 
 
-def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH) -> list[int]:
+def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
+                     progress=None, cancel=None, stats=None) -> list[int]:
     """Regenerate whichever `.ecXX` files are missing from the >=10 present
-    ones. Returns the rebuilt shard ids."""
+    ones. Returns the rebuilt shard ids.
+
+    Same zero-copy discipline as the encode path (and the same observability:
+    `progress(bytes_done)` per batch over survivor bytes, `cancel()` aborts,
+    `stats` gets per-stage seconds): survivor shards are mmap'd and fed to
+    the native decode matmul by row pointer, rebuilt shards land in a pooled
+    buffer and are pwritten into recycled `.tmp` inodes, committed by rename
+    only on success (reference: RebuildEcFiles, ec_encoder.go:237-291)."""
     present = [i for i in range(layout.TOTAL_SHARDS)
                if os.path.exists(base + layout.to_ext(i))]
     missing = [i for i in range(layout.TOTAL_SHARDS) if i not in present]
@@ -301,25 +531,95 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH) -> list[int]:
     codec = _get_codec()
     use = present[: layout.DATA_SHARDS]
     shard_size = os.path.getsize(base + layout.to_ext(use[0]))
+    if stats is not None:
+        stats["bytes"] = shard_size * layout.DATA_SHARDS
 
+    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
+    native_host = isinstance(codec, NativeRSCodec)
+    if stats is not None:
+        stats["mode"] = "host-serial" if native_host else "staged"
+    if native_host:
+        from seaweedfs_tpu import native
+        dec_mat = codec.code.decode_matrix(list(use), list(missing))
+
+    import mmap as mmap_mod
     ins = {i: open(base + layout.to_ext(i), "rb") for i in use}
-    outs = {i: open(base + layout.to_ext(i), "wb") for i in missing}
+    maps = {}
+    views = {}
+    tmp_paths = {i: base + layout.to_ext(i) + ".tmp" for i in missing}
+    out_fds = {i: os.open(p_, os.O_RDWR | os.O_CREAT, 0o644)
+               for i, p_ in tmp_paths.items()}
+    obuf = None
+    stage = None
+    ok = False
     try:
+        if native_host:
+            obuf = np.empty(
+                (len(missing), min(batch_size, max(shard_size, 1))),
+                dtype=np.uint8)
+        for i, f in ins.items():
+            if shard_size:
+                mm = mmap_mod.mmap(f.fileno(), 0, prot=mmap_mod.PROT_READ)
+                try:
+                    mm.madvise(mmap_mod.MADV_SEQUENTIAL)
+                except (AttributeError, OSError):
+                    pass
+                maps[i] = mm
+                views[i] = np.frombuffer(mm, dtype=np.uint8)
+        done = 0
         for off in range(0, shard_size, batch_size):
+            if cancel is not None and cancel():
+                raise EncodeCancelled("ec rebuild cancelled")
             n = min(batch_size, shard_size - off)
-            stack = np.zeros((layout.DATA_SHARDS, n), dtype=np.uint8)
-            for row, i in enumerate(use):
-                ins[i].seek(off)
-                stack[row] = np.frombuffer(ins[i].read(n), dtype=np.uint8)
-            rebuilt = _reconstruct_batch(
-                codec, {i: stack[row] for row, i in enumerate(use)}, missing)
-            for i in missing:
-                outs[i].write(np.asarray(rebuilt[i]).tobytes())
+            with _Timer(stats, "reconstruct_s"):
+                if native_host:
+                    rows = [views[i][off:off + n] for i in use]
+                    outs = [obuf[r, :n] for r in range(len(missing))]
+                    native.gf_matmul_ptrs(dec_mat, rows, outs, n)
+                    rebuilt = {i: obuf[r, :n]
+                               for r, i in enumerate(missing)}
+                else:
+                    if stage is None:
+                        stage = np.empty((layout.DATA_SHARDS,
+                                          min(batch_size, shard_size)),
+                                         dtype=np.uint8)
+                    for row, i in enumerate(use):
+                        np.copyto(stage[row, :n], views[i][off:off + n])
+                    rebuilt = _reconstruct_batch(
+                        codec,
+                        {i: stage[row, :n] for row, i in enumerate(use)},
+                        missing)
+            with _Timer(stats, "write_s"):
+                for i in missing:
+                    _pwrite_all(out_fds[i],
+                                np.ascontiguousarray(rebuilt[i]), off)
+            done += n * layout.DATA_SHARDS
+            if progress is not None:
+                progress(done)
+        for fd in out_fds.values():
+            os.ftruncate(fd, shard_size)
+        ok = True
     finally:
         for f in ins.values():
             f.close()
-        for f in outs.values():
-            f.close()
+        for i in list(views):
+            del views[i]
+        for mm in maps.values():
+            try:
+                mm.close()
+            except BufferError:
+                pass
+        for fd in out_fds.values():
+            os.close(fd)
+        if ok:
+            for i, p_ in tmp_paths.items():
+                os.replace(p_, base + layout.to_ext(i))
+        else:
+            for p_ in tmp_paths.values():
+                try:
+                    os.remove(p_)
+                except OSError:
+                    pass
     return missing
 
 
