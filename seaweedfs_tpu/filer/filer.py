@@ -170,6 +170,8 @@ class Filer:
         self.store = FilerStoreWrapper(store)
         self.meta_log = MetaLog(meta_log_path)
         self.on_delete_chunks = on_delete_chunks or (lambda chunks: None)
+        self.store.on_orphan_chunks = lambda chunks: \
+            self.on_delete_chunks(chunks)
         self._lock = threading.RLock()
 
     # -- events --------------------------------------------------------
@@ -208,8 +210,14 @@ class Filer:
             if not entry.attr.mtime:
                 entry.attr.mtime = time.time()
             self.store.insert_entry(entry)
-            # garbage-collect chunks replaced by the new version
-            if old is not None and old.chunks:
+            # garbage-collect chunks replaced by the new version — UNLESS
+            # the old row belonged to a hardlink group this write leaves:
+            # siblings still reference those chunks (the wrapper already
+            # decremented the group and orphaned them if this was the last
+            # name)
+            left_group = old is not None and old.hard_link_id and \
+                old.hard_link_id != entry.hard_link_id
+            if old is not None and old.chunks and not left_group:
                 garbage = filechunks.minus_chunks(old.chunks, entry.chunks)
                 if garbage:
                     self.on_delete_chunks(garbage)
@@ -251,14 +259,15 @@ class Filer:
         except NotFound:
             return False
 
-    def update_entry(self, entry: Entry) -> Entry:
+    def update_entry(self, entry: Entry, touch: bool = True) -> Entry:
         with self._lock:
             old = None
             try:
                 old = self.store.find_entry(entry.full_path)
             except NotFound:
                 pass
-            entry.attr.mtime = time.time()
+            if touch:
+                entry.attr.mtime = time.time()
             self.store.update_entry(entry)
             self._notify(old, entry)
             return entry
@@ -298,9 +307,15 @@ class Filer:
                     raise OSError(f"directory {full_path} not empty")
                 self._collect_subtree(full_path, chunks)
                 self.store.delete_folder_children(full_path)
+                self.store.delete_entry(full_path)
+            elif entry.hard_link_id:
+                # removing one NAME of a hardlinked file: its chunks become
+                # garbage only when the last name goes (the wrapper hands
+                # them back at counter zero)
+                chunks.extend(self.store.delete_entry(full_path))
             else:
                 chunks.extend(entry.chunks)
-            self.store.delete_entry(full_path)
+                self.store.delete_entry(full_path)
             if delete_chunks and chunks:
                 self.on_delete_chunks(chunks)
             self._notify(entry, None, signatures=signatures)
@@ -312,8 +327,49 @@ class Filer:
                 self._collect_subtree(e.full_path, chunks)
                 self._notify(e, None)
             else:
-                chunks.extend(e.chunks)
+                if e.hard_link_id:
+                    # bulk folder wipe skips per-row deletes, so decrement
+                    # each linked child here; chunks orphan at zero
+                    _, garbage = self.store.delete_hard_link(e.hard_link_id)
+                    chunks.extend(garbage)
+                else:
+                    chunks.extend(e.chunks)
                 self._notify(e, None)
+
+    # -- hardlinks (filer_hardlink.go + weedfs_link.go semantics) -------
+
+    def link_entry(self, old_path: str, new_path: str,
+                   signatures: list[int] | None = None) -> Entry:
+        """Create `new_path` as an additional name for the file at
+        `old_path`.  First link converts the file to hardlink mode: its
+        attrs+chunks move into a store-KV blob keyed by a fresh random
+        hard_link_id and every name's row just points there."""
+        import secrets
+        old_path = old_path.rstrip("/") or "/"
+        new_path = new_path.rstrip("/") or "/"
+        with self._lock:
+            entry = self.store.find_entry(old_path)
+            if entry.is_directory:
+                raise IsADirectoryError(old_path)
+            if self.exists(new_path):
+                raise FileExistsError(new_path)
+            # parents first: a NotADirectoryError here must not leave the
+            # group over-counted
+            for d in parent_directories(new_path):
+                self._ensure_directory(d, signatures=signatures)
+            before = Entry.from_dict(entry.to_dict())
+            if not entry.hard_link_id:
+                entry.hard_link_id = secrets.token_hex(16)
+                entry.hard_link_counter = 1
+            entry.hard_link_counter += 1
+            self.store.update_entry(entry)  # rewrites row + shared blob
+            self._notify(before, entry, signatures=signatures)
+            link = Entry.from_dict(entry.to_dict())
+            link.full_path = new_path
+            link.attr.mtime = time.time()
+            self.store.insert_entry(link)
+            self._notify(None, link, signatures=signatures)
+            return link
 
     # -- rename (atomic within this filer) -----------------------------
 
@@ -347,6 +403,8 @@ class Filer:
         if entry.is_directory:
             for child in list(self.iter_entries(entry.full_path)):
                 self._move_subtree(child, join_path(new_path, child.name))
-        self.store.delete_entry(entry.full_path)
+        # rename moves a name, it does not remove one: the hardlink
+        # counter must not decrement
+        self.store.delete_entry(entry.full_path, keep_hard_link=True)
         self._notify(entry, new_entry, new_parent=split_path(new_path)[0])
         return new_entry

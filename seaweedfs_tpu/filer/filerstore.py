@@ -83,9 +83,19 @@ class FilerStore(abc.ABC):
         pass
 
 
+HARDLINK_KV_PREFIX = b"hardlink/"
+
+
 class FilerStoreWrapper(FilerStore):
-    """Pass-through wrapper adding op counters (the reference wrapper also
-    adds per-store metrics + path translation, filerstore_wrapper.go)."""
+    """Pass-through wrapper adding op counters and hardlink indirection
+    (reference: filerstore_wrapper.go + filerstore_hardlink.go).
+
+    Hardlinked entries keep only (path, hard_link_id, counter) in their
+    directory row; the canonical attrs + chunks live in one store-KV blob
+    keyed by the hard_link_id.  Every find/list overlays that blob, every
+    insert/update of a linked entry rewrites it, and deletes decrement the
+    shared counter — dropping the blob (and releasing the chunks to the
+    caller for deletion) when the last name goes away."""
 
     name = "wrapper"
 
@@ -93,26 +103,114 @@ class FilerStoreWrapper(FilerStore):
         self.actual = actual
         self.counters: dict[str, int] = defaultdict(int)
         self._lock = threading.Lock()
+        # chunks orphaned by an implicit hardlink release (a row re-pointed
+        # away from its group by insert/update) flow here; the Filer wires
+        # it to its deletion pipeline
+        self.on_orphan_chunks = None
 
     def _count(self, op: str) -> None:
         with self._lock:
             self.counters[op] += 1
 
+    # -- hardlink indirection (filerstore_hardlink.go) -----------------
+
+    @staticmethod
+    def _hl_key(hard_link_id: str) -> bytes:
+        return HARDLINK_KV_PREFIX + hard_link_id.encode()
+
+    def _set_hard_link(self, entry: Entry) -> None:
+        import json
+        self.actual.kv_put(self._hl_key(entry.hard_link_id),
+                           json.dumps(entry.to_dict()).encode())
+
+    def maybe_read_hard_link(self, entry: Entry) -> Entry:
+        """Overlay the canonical attrs/chunks/counter from the hardlink
+        blob; the row's own copies may be stale siblings' views."""
+        if not entry.hard_link_id:
+            return entry
+        import json
+        try:
+            blob = self.actual.kv_get(self._hl_key(entry.hard_link_id))
+        except NotFound:
+            return entry  # orphaned id: serve the row as-is
+        src = Entry.from_dict(json.loads(blob))
+        entry.attr = src.attr
+        entry.chunks = src.chunks
+        entry.extended = src.extended
+        entry.hard_link_counter = src.hard_link_counter
+        return entry
+
+    def _handle_update_to_hardlinks(self, entry: Entry) -> None:
+        """Before writing a row: persist the shared blob, and if the row
+        previously pointed at a DIFFERENT hardlink id, release that one
+        (reference: handleUpdateToHardLinks)."""
+        if entry.is_directory:
+            return
+        if entry.hard_link_id:
+            self._set_hard_link(entry)
+        try:
+            existing = self.actual.find_entry(entry.full_path)
+        except NotFound:
+            return
+        if existing.hard_link_id and \
+                existing.hard_link_id != entry.hard_link_id:
+            _, garbage = self.delete_hard_link(existing.hard_link_id)
+            if garbage and self.on_orphan_chunks is not None:
+                self.on_orphan_chunks(garbage)
+
+    def delete_hard_link(self, hard_link_id: str
+                         ) -> tuple[int, list]:
+        """Decrement the shared counter; -> (remaining, orphaned_chunks).
+        orphaned_chunks is non-empty only when the count hit zero — unlike
+        the reference (which leaks them, filerstore_hardlink.go:80-107)
+        the chunks of the last name are handed back for deletion."""
+        import json
+        key = self._hl_key(hard_link_id)
+        try:
+            blob = self.actual.kv_get(key)
+        except NotFound:
+            return 0, []
+        entry = Entry.from_dict(json.loads(blob))
+        entry.hard_link_counter -= 1
+        if entry.hard_link_counter <= 0:
+            self.actual.kv_delete(key)
+            return 0, entry.chunks
+        self.actual.kv_put(key, json.dumps(entry.to_dict()).encode())
+        return entry.hard_link_counter, []
+
+    # -- CRUD ----------------------------------------------------------
+
     def insert_entry(self, entry: Entry) -> None:
         self._count("insert")
+        self._handle_update_to_hardlinks(entry)
         self.actual.insert_entry(entry)
 
     def update_entry(self, entry: Entry) -> None:
         self._count("update")
+        self._handle_update_to_hardlinks(entry)
         self.actual.update_entry(entry)
 
     def find_entry(self, full_path: str) -> Entry:
         self._count("find")
-        return self.actual.find_entry(full_path)
+        return self.maybe_read_hard_link(self.actual.find_entry(full_path))
 
-    def delete_entry(self, full_path: str) -> None:
+    def delete_entry(self, full_path: str,
+                     keep_hard_link: bool = False) -> list:
+        """Delete a row; -> chunks orphaned by a last-name hardlink removal
+        (empty otherwise).  keep_hard_link skips the decrement — rename
+        moves a name, it does not remove one."""
         self._count("delete")
+        garbage: list = []
+        if not keep_hard_link:
+            try:
+                existing = self.actual.find_entry(full_path)
+            except NotFound:
+                existing = None
+            if existing is not None and existing.hard_link_id and \
+                    not existing.is_directory:
+                _, garbage = self.delete_hard_link(existing.hard_link_id)
         self.actual.delete_entry(full_path)
+        return garbage
 
     def delete_folder_children(self, full_path: str) -> None:
         self._count("delete_folder_children")
@@ -123,8 +221,12 @@ class FilerStoreWrapper(FilerStore):
                                limit: int = 1024,
                                prefix: str = "") -> list[Entry]:
         self._count("list")
-        return self.actual.list_directory_entries(
+        out = self.actual.list_directory_entries(
             dir_path, start_from, include_start, limit, prefix)
+        for e in out:
+            if e.hard_link_id:
+                self.maybe_read_hard_link(e)
+        return out
 
     def kv_put(self, key: bytes, value: bytes) -> None:
         self.actual.kv_put(key, value)

@@ -238,7 +238,13 @@ class WFS:
             meta = None if e.code == 404 else None
         except (urllib.error.URLError, OSError):
             raise FsError(5, "filer unreachable")  # EIO
-        self.meta_cache.put(path, meta)
+        if meta is not None and meta.get("hard_link_id"):
+            # hardlink siblings share one blob but events only name the
+            # changed path — a cached sibling would serve stale nlink /
+            # content, so linked entries are always read through
+            self.meta_cache.invalidate(path)
+        else:
+            self.meta_cache.put(path, meta)
         return meta
 
     def _read_range(self, path: str, offset: int, size: int) -> bytes:
@@ -341,10 +347,12 @@ class WFS:
         size = a.get("file_size", 0)
         for c in meta.get("chunks") or []:
             size = max(size, c.get("offset", 0) + c.get("size", 0))
+        if a.get("symlink_target"):
+            size = len(a["symlink_target"])
         return {"st_mode": a.get("mode", 0o660), "st_size": size,
                 "st_mtime": a.get("mtime", 0), "st_ctime": a.get("crtime", 0),
                 "st_uid": a.get("uid", 0), "st_gid": a.get("gid", 0),
-                "st_nlink": 1}
+                "st_nlink": max(1, meta.get("hard_link_counter", 1))}
 
     def getattr(self, path: str) -> dict:
         if path == "/":
@@ -448,6 +456,119 @@ class WFS:
         self.meta_cache.invalidate(old)
         self.meta_cache.invalidate(new)
 
+    # -- links (weedfs_link.go / weedfs_symlink.go) ---------------------
+
+    def link(self, old: str, new: str) -> None:
+        url = self._url(new, "link.from="
+                        + urllib.parse.quote(self._fp(old), safe=""))
+        req = urllib.request.Request(url, data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FsError(2, old)
+            if e.code == 409:
+                raise FsError(17, new)  # EEXIST
+            if e.code == 403:
+                raise FsError(1, old)  # EPERM: link(2) on a directory
+            raise FsError(5, f"link: {e.code}")
+        self.meta_cache.invalidate(old)
+        self.meta_cache.invalidate(new)
+
+    def symlink(self, target: str, path: str) -> None:
+        url = self._url(path, "symlink.to="
+                        + urllib.parse.quote(target, safe=""))
+        req = urllib.request.Request(url, data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                raise FsError(17, path)  # EEXIST
+            raise FsError(5, f"symlink: {e.code}")
+        self.meta_cache.invalidate(path)
+
+    def readlink(self, path: str) -> str:
+        meta = self._meta(path)
+        if meta is None:
+            raise FsError(2, path)
+        target = (meta.get("attr") or {}).get("symlink_target", "")
+        if not target:
+            raise FsError(22, path)  # EINVAL: not a symlink
+        return target
+
+    # -- attrs (weedfs_attr.go SetAttr) ---------------------------------
+
+    def _set_attr(self, path: str, body: dict) -> None:
+        req = urllib.request.Request(
+            self._url(path, "op=attr"), data=json.dumps(body).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FsError(2, path)
+            raise FsError(5, f"setattr: {e.code}")
+        self.meta_cache.invalidate(path)
+
+    def chmod(self, path: str, mode: int) -> None:
+        self._set_attr(path, {"mode": mode & 0o7777})
+
+    def chown(self, path: str, uid: int, gid: int) -> None:
+        body: dict = {}
+        if uid != -1:
+            body["uid"] = uid
+        if gid != -1:
+            body["gid"] = gid
+        if body:
+            self._set_attr(path, body)
+
+    def utimens(self, path: str, times=None) -> None:
+        mtime = times[1] if times else time.time()
+        self._set_attr(path, {"mtime": mtime})
+
+    # -- xattrs (weedfs_xattr.go; stored under the same "xattr-" extended
+    #    prefix as the reference, values base64 so binary survives JSON) --
+
+    XATTR_PREFIX = "xattr-"
+
+    def _xattrs(self, path: str) -> dict[str, bytes]:
+        import base64
+        meta = self._meta(path)
+        if meta is None:
+            raise FsError(2, path)
+        out: dict[str, bytes] = {}
+        for k, v in (meta.get("extended") or {}).items():
+            if k.startswith(self.XATTR_PREFIX):
+                try:
+                    out[k[len(self.XATTR_PREFIX):]] = \
+                        base64.b64decode(v.encode())
+                except ValueError:
+                    out[k[len(self.XATTR_PREFIX):]] = v.encode()
+        return out
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        xs = self._xattrs(path)
+        if name not in xs:
+            raise FsError(61, name)  # ENODATA
+        return xs[name]
+
+    def listxattr(self, path: str) -> list[str]:
+        return sorted(self._xattrs(path))
+
+    def setxattr(self, path: str, name: str, value: bytes) -> None:
+        import base64
+        self._set_attr(path, {"extended_set": {
+            self.XATTR_PREFIX + name:
+                base64.b64encode(bytes(value)).decode()}})
+
+    def removexattr(self, path: str, name: str) -> None:
+        if name not in self._xattrs(path):
+            raise FsError(61, name)  # ENODATA
+        self._set_attr(path, {"extended_del": [self.XATTR_PREFIX + name]})
+
 
 def mount(filer_url: str, mountpoint: str, root: str = "/",
           foreground: bool = True):
@@ -506,5 +627,51 @@ def mount(filer_url: str, mountpoint: str, root: str = "/",
 
         def rename(self, old, new):
             wfs.rename(old, new)
+
+        def link(self, target, source):
+            # fusepy argument order: link(new, existing)
+            try:
+                wfs.link(source, target)
+            except FsError as e:
+                raise FuseOSError(e.errno)
+
+        def symlink(self, target, source):
+            try:
+                wfs.symlink(source, target)
+            except FsError as e:
+                raise FuseOSError(e.errno)
+
+        def readlink(self, path):
+            try:
+                return wfs.readlink(path)
+            except FsError as e:
+                raise FuseOSError(e.errno)
+
+        def chmod(self, path, mode):
+            wfs.chmod(path, mode)
+
+        def chown(self, path, uid, gid):
+            wfs.chown(path, uid, gid)
+
+        def utimens(self, path, times=None):
+            wfs.utimens(path, times)
+
+        def getxattr(self, path, name, position=0):
+            try:
+                return wfs.getxattr(path, name)
+            except FsError as e:
+                raise FuseOSError(e.errno)
+
+        def listxattr(self, path):
+            return wfs.listxattr(path)
+
+        def setxattr(self, path, name, value, options, position=0):
+            wfs.setxattr(path, name, value)
+
+        def removexattr(self, path, name):
+            try:
+                wfs.removexattr(path, name)
+            except FsError as e:
+                raise FuseOSError(e.errno)
 
     return FUSE(_Ops(), mountpoint, foreground=foreground, nothreads=False)

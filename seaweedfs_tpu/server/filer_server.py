@@ -16,6 +16,11 @@ filer_server_handlers_read.go + weed/filer/stream.go):
   DELETE                ?recursive=true for dirs; chunks enqueued for
                         background blob deletion
   POST /new?mv.from=/x  rename/move (subtree-safe)
+  POST /new?link.from=/x  hardlink: second name for the same chunks
+  POST /p?symlink.to=t  symlink entry (readlink = ?metadata=true)
+  POST /p?op=attr       JSON attr deltas: mode/uid/gid/mtime/crtime +
+                        extended_set/extended_del (chmod/chown/utimens/
+                        xattr seam for the mount)
 
 Plus the meta-event feed the reference serves over gRPC
 (SubscribeMetadata): GET /__meta__/subscribe?since=<ts_ns> streams JSONL
@@ -494,6 +499,12 @@ class FilerServer:
             if req.method in ("POST", "PUT"):
                 if "mv.from" in req.query:
                     return await self._handle_move(req, path)
+                if "link.from" in req.query:
+                    return await self._handle_link(req, path)
+                if "symlink.to" in req.query:
+                    return await self._handle_symlink(req, path)
+                if req.query.get("op") == "attr":
+                    return await self._handle_set_attr(req, path)
                 return await self._handle_upload(req, path, is_dir_request)
             if req.method in ("GET", "HEAD"):
                 return await self._handle_read(req, path, is_dir_request)
@@ -515,6 +526,70 @@ class FilerServer:
         except (FileExistsError, NotADirectoryError, OSError) as e:
             return web.json_response({"error": str(e)}, status=409)
         return web.json_response({"path": moved.full_path})
+
+    async def _handle_link(self, req: web.Request, path: str) -> web.Response:
+        """`POST /new?link.from=/old`: hardlink — a second name for the same
+        chunks (reference: weedfs_link.go over filer_hardlink.go)."""
+        src = self._norm(req.query["link.from"])
+        try:
+            link = self.filer.link_entry(src, path,
+                                         signatures=_req_signatures(req))
+        except FileExistsError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        except (IsADirectoryError, NotADirectoryError) as e:
+            # POSIX link(2): hardlinking a directory is EPERM, not EEXIST
+            return web.json_response({"error": str(e)}, status=403)
+        return web.json_response({"path": link.full_path,
+                                  "nlink": link.hard_link_counter})
+
+    async def _handle_symlink(self, req: web.Request,
+                              path: str) -> web.Response:
+        """`POST /path?symlink.to=<target>` (reference:
+        weedfs_symlink.go:15-60 — a chunkless entry whose attr carries the
+        target; resolution is the client's job, like FUSE readlink)."""
+        import stat as stat_mod
+        now = time.time()
+        entry = Entry(full_path=path,
+                      attr=Attr(mtime=now, crtime=now,
+                                mode=stat_mod.S_IFLNK | 0o777,
+                                symlink_target=req.query["symlink.to"]))
+        self._apply_headers(entry, req)
+        try:
+            self.filer.create_entry(entry, o_excl=True,
+                                    signatures=_req_signatures(req))
+        except FileExistsError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"name": entry.name}, status=201)
+
+    async def _handle_set_attr(self, req: web.Request,
+                               path: str) -> web.Response:
+        """`POST /path?op=attr` with a JSON body of attribute deltas:
+        {mode, uid, gid, mtime, crtime, extended_set: {k: v},
+        extended_del: [k]} — the SetAttr/xattr seam of the FUSE mount
+        (reference: weedfs_attr.go SetAttr, weedfs_xattr.go)."""
+        body = await req.json()
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFound:
+            return web.json_response({"error": "not found"}, status=404)
+        a = entry.attr
+        if "mode" in body:
+            # keep the file-type bits; callers set permission bits only
+            a.mode = (a.mode & ~0o7777) | (int(body["mode"]) & 0o7777)
+        for f_ in ("uid", "gid"):
+            if f_ in body:
+                setattr(a, f_, int(body[f_]))
+        for f_ in ("mtime", "crtime"):
+            if f_ in body:
+                setattr(a, f_, float(body[f_]))
+        for k, v in (body.get("extended_set") or {}).items():
+            entry.extended[str(k)] = str(v)
+        for k in body.get("extended_del") or []:
+            entry.extended.pop(str(k), None)
+        # POSIX: chmod/chown/xattr change ctime, never mtime — and an
+        # explicit utimens mtime must stick; so attr updates never touch
+        self.filer.update_entry(entry, touch=False)
+        return web.json_response({"name": entry.name})
 
     async def _handle_upload(self, req: web.Request, path: str,
                              is_dir_request: bool) -> web.Response:

@@ -394,3 +394,114 @@ def test_logstore_persistence_and_compaction(tmp_path):
     assert s3.find_entry("/docs/f1.txt").attr.file_size == 99
     assert len(s3.list_directory_entries("/docs")) == 9
     s3.shutdown()
+
+
+# ------------------------------------------------------------- hardlinks
+
+def test_hardlink_create_and_read_via_both_names(filer):
+    e = _entry("/hl/orig.txt", size=10)
+    e.chunks = [_c("5,a", 0, 10, 1)]
+    filer.create_entry(e)
+    link = filer.link_entry("/hl/orig.txt", "/hl/link.txt")
+    assert link.hard_link_id and link.hard_link_counter == 2
+    for p in ("/hl/orig.txt", "/hl/link.txt"):
+        got = filer.find_entry(p)
+        assert [c.fid for c in got.chunks] == ["5,a"]
+        assert got.hard_link_counter == 2
+    # listing overlays the shared blob too
+    by_name = {x.name: x for x in filer.list_entries("/hl")}
+    assert [c.fid for c in by_name["link.txt"].chunks] == ["5,a"]
+
+
+def test_hardlink_unlink_one_keeps_chunks(filer):
+    e = _entry("/hl2/f", size=4)
+    e.chunks = [_c("6,b", 0, 4, 1)]
+    filer.create_entry(e)
+    filer.link_entry("/hl2/f", "/hl2/g")
+    filer.delete_entry("/hl2/f")
+    assert filer._test_deleted == []          # other name still holds them
+    got = filer.find_entry("/hl2/g")
+    assert [c.fid for c in got.chunks] == ["6,b"]
+    assert got.hard_link_counter == 1
+    filer.delete_entry("/hl2/g")              # last name: chunks orphan
+    assert [c.fid for c in filer._test_deleted] == ["6,b"]
+
+
+def test_hardlink_write_via_one_name_visible_via_other(filer):
+    e = _entry("/hl3/a", size=4)
+    e.chunks = [_c("7,c", 0, 4, 1)]
+    filer.create_entry(e)
+    filer.link_entry("/hl3/a", "/hl3/b")
+    # update content through one name: canonical blob changes for both
+    ent = filer.find_entry("/hl3/b")
+    ent.chunks = [_c("7,d", 0, 8, 2)]
+    ent.attr.file_size = 8
+    filer.update_entry(ent)
+    got = filer.find_entry("/hl3/a")
+    assert [c.fid for c in got.chunks] == ["7,d"]
+    assert got.size() == 8
+
+
+def test_hardlink_rename_does_not_decrement(filer):
+    e = _entry("/hl4/x", size=2)
+    e.chunks = [_c("8,e", 0, 2, 1)]
+    filer.create_entry(e)
+    filer.link_entry("/hl4/x", "/hl4/y")
+    filer.rename_entry("/hl4/y", "/hl4/z")
+    got = filer.find_entry("/hl4/z")
+    assert got.hard_link_counter == 2
+    assert [c.fid for c in got.chunks] == ["8,e"]
+    filer.delete_entry("/hl4/x")
+    filer.delete_entry("/hl4/z")
+    assert [c.fid for c in filer._test_deleted] == ["8,e"]
+
+
+def test_hardlink_recursive_dir_delete_decrements(filer):
+    e = _entry("/hl5/in/f", size=3)
+    e.chunks = [_c("9,f", 0, 3, 1)]
+    filer.create_entry(e)
+    filer.link_entry("/hl5/in/f", "/hl5/out")   # one name outside the dir
+    filer.delete_entry("/hl5/in", recursive=True)
+    assert filer._test_deleted == []            # /hl5/out still holds it
+    assert [c.fid for c in filer.find_entry("/hl5/out").chunks] == ["9,f"]
+    filer.delete_entry("/hl5/out")
+    assert [c.fid for c in filer._test_deleted] == ["9,f"]
+
+
+def test_hardlink_overwrite_one_name_leaves_group(filer):
+    e = _entry("/hl6/p", size=4)
+    e.chunks = [_c("10,g", 0, 4, 1)]
+    filer.create_entry(e)
+    filer.link_entry("/hl6/p", "/hl6/q")
+    # full overwrite of one name with a plain entry: that name leaves the
+    # link group (counter drops), the other keeps the old content
+    e2 = _entry("/hl6/p", size=6)
+    e2.chunks = [_c("10,h", 0, 6, 2)]
+    filer.create_entry(e2)
+    assert filer.find_entry("/hl6/p").hard_link_id == ""
+    # the group's chunks are still referenced by /hl6/q: the overwrite
+    # must NOT garbage-collect them
+    assert filer._test_deleted == []
+    q = filer.find_entry("/hl6/q")
+    assert [c.fid for c in q.chunks] == ["10,g"]
+    assert q.hard_link_counter == 1
+    # overwriting the LAST name orphans the group's chunks
+    e3 = _entry("/hl6/q", size=1)
+    e3.chunks = [_c("10,i", 0, 1, 3)]
+    filer.create_entry(e3)
+    assert [c.fid for c in filer._test_deleted] == ["10,g"]
+
+
+def test_hardlink_onto_file_parent_fails_cleanly(filer):
+    e = _entry("/hl7/f", size=2)
+    e.chunks = [_c("11,j", 0, 2, 1)]
+    filer.create_entry(e)
+    filer.create_entry(_entry("/hl7/plainfile"))
+    with pytest.raises(NotADirectoryError):
+        filer.link_entry("/hl7/f", "/hl7/plainfile/x")
+    # the failed link must not leave the group over-counted
+    got = filer.find_entry("/hl7/f")
+    assert got.hard_link_counter in (0, 1) and \
+        (not got.hard_link_id or got.hard_link_counter == 1)
+    filer.delete_entry("/hl7/f")
+    assert [c.fid for c in filer._test_deleted] == ["11,j"]

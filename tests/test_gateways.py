@@ -431,3 +431,103 @@ class TestMqBrokerCluster:
         time.sleep(0.4)
         a = join("alpha")
         assert a["partitions"] == [0, 1, 2, 3]
+
+
+class TestMountAttrSurface:
+    """Symlink / xattr / chmod-chown-utimens / hardlink through the mount
+    (reference: weedfs_symlink.go, weedfs_xattr.go, weedfs_attr.go,
+    weedfs_link.go)."""
+
+    def test_symlink_roundtrip(self, stack):
+        import stat as stat_mod
+        from seaweedfs_tpu.mount.weedfs import WFS, FsError
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=False)
+        try:
+            fh = wfs.create("/sl-target.txt")
+            wfs.write(fh, b"payload", 0)
+            wfs.release(fh)
+            wfs.symlink("/sl-target.txt", "/sl-link")
+            assert wfs.readlink("/sl-link") == "/sl-target.txt"
+            attr = wfs.getattr("/sl-link")
+            assert stat_mod.S_ISLNK(attr["st_mode"])
+            assert attr["st_size"] == len("/sl-target.txt")
+            # not a symlink -> EINVAL
+            with pytest.raises(FsError):
+                wfs.readlink("/sl-target.txt")
+            wfs.unlink("/sl-link")
+            assert wfs.getattr("/sl-target.txt")["st_size"] == 7
+        finally:
+            wfs.close()
+
+    def test_xattr_roundtrip(self, stack):
+        from seaweedfs_tpu.mount.weedfs import WFS, FsError
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=False)
+        try:
+            fh = wfs.create("/xa.txt")
+            wfs.write(fh, b"x", 0)
+            wfs.release(fh)
+            wfs.setxattr("/xa.txt", "user.color", b"blue")
+            wfs.setxattr("/xa.txt", "user.blob", bytes(range(256)))
+            assert wfs.getxattr("/xa.txt", "user.color") == b"blue"
+            assert wfs.getxattr("/xa.txt", "user.blob") == bytes(range(256))
+            assert wfs.listxattr("/xa.txt") == ["user.blob", "user.color"]
+            wfs.removexattr("/xa.txt", "user.color")
+            assert wfs.listxattr("/xa.txt") == ["user.blob"]
+            with pytest.raises(FsError):
+                wfs.getxattr("/xa.txt", "user.color")
+            with pytest.raises(FsError):
+                wfs.removexattr("/xa.txt", "user.color")
+            # content untouched by xattr churn
+            assert wfs.read(wfs.open("/xa.txt"), 1, 0) == b"x"
+        finally:
+            wfs.close()
+
+    def test_chmod_chown_utimens_persist(self, stack):
+        from seaweedfs_tpu.mount.weedfs import WFS
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=False)
+        try:
+            fh = wfs.create("/perm.txt")
+            wfs.write(fh, b"z", 0)
+            wfs.release(fh)
+            wfs.utimens("/perm.txt", (1700000000.0, 1700000001.5))
+            wfs.chmod("/perm.txt", 0o640)
+            wfs.chown("/perm.txt", 1234, 5678)
+            attr = wfs.getattr("/perm.txt")
+            assert attr["st_mode"] & 0o7777 == 0o640
+            assert attr["st_uid"] == 1234 and attr["st_gid"] == 5678
+            # POSIX: chmod/chown must not disturb an explicit mtime
+            assert abs(attr["st_mtime"] - 1700000001.5) < 1e-6
+            # a fresh WFS (no warm cache) sees the same persisted attrs
+            wfs2 = WFS(filer.url, subscribe=False)
+            try:
+                attr2 = wfs2.getattr("/perm.txt")
+                assert attr2["st_mode"] & 0o7777 == 0o640
+                assert attr2["st_uid"] == 1234
+            finally:
+                wfs2.close()
+        finally:
+            wfs.close()
+
+    def test_hardlink_through_mount(self, stack):
+        from seaweedfs_tpu.mount.weedfs import WFS, FsError
+        c, filer, _, _ = stack
+        wfs = WFS(filer.url, subscribe=False)
+        try:
+            fh = wfs.create("/hlm-a.txt")
+            wfs.write(fh, b"shared-bytes", 0)
+            wfs.release(fh)
+            wfs.link("/hlm-a.txt", "/hlm-b.txt")
+            assert wfs.getattr("/hlm-a.txt")["st_nlink"] == 2
+            assert wfs.getattr("/hlm-b.txt")["st_nlink"] == 2
+            assert wfs.read(wfs.open("/hlm-b.txt"), 12, 0) == b"shared-bytes"
+            with pytest.raises(FsError):
+                wfs.link("/hlm-a.txt", "/hlm-b.txt")  # EEXIST
+            wfs.unlink("/hlm-a.txt")
+            assert wfs.read(wfs.open("/hlm-b.txt"), 12, 0) == b"shared-bytes"
+            assert wfs.getattr("/hlm-b.txt")["st_nlink"] == 1
+            wfs.unlink("/hlm-b.txt")
+        finally:
+            wfs.close()
