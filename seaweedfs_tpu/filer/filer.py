@@ -307,15 +307,16 @@ class Filer:
                     raise OSError(f"directory {full_path} not empty")
                 self._collect_subtree(full_path, chunks)
                 self.store.delete_folder_children(full_path)
-                self.store.delete_entry(full_path)
+                self.store.delete_entry(full_path, hard_link_id="")
             elif entry.hard_link_id:
                 # removing one NAME of a hardlinked file: its chunks become
                 # garbage only when the last name goes (the wrapper hands
                 # them back at counter zero)
-                chunks.extend(self.store.delete_entry(full_path))
+                chunks.extend(self.store.delete_entry(
+                    full_path, hard_link_id=entry.hard_link_id))
             else:
                 chunks.extend(entry.chunks)
-                self.store.delete_entry(full_path)
+                self.store.delete_entry(full_path, hard_link_id="")
             if delete_chunks and chunks:
                 self.on_delete_chunks(chunks)
             self._notify(entry, None, signatures=signatures)
@@ -364,9 +365,10 @@ class Filer:
             entry.hard_link_counter += 1
             self.store.update_entry(entry)  # rewrites row + shared blob
             self._notify(before, entry, signatures=signatures)
+            # POSIX link(2): the file's mtime is untouched (only ctime
+            # changes) — the new name carries the same attrs verbatim
             link = Entry.from_dict(entry.to_dict())
             link.full_path = new_path
-            link.attr.mtime = time.time()
             self.store.insert_entry(link)
             self._notify(None, link, signatures=signatures)
             return link

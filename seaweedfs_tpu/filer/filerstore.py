@@ -194,21 +194,28 @@ class FilerStoreWrapper(FilerStore):
         self._count("find")
         return self.maybe_read_hard_link(self.actual.find_entry(full_path))
 
-    def delete_entry(self, full_path: str,
-                     keep_hard_link: bool = False) -> list:
+    _UNKNOWN = object()  # sentinel: caller didn't look the entry up
+
+    def delete_entry(self, full_path: str, keep_hard_link: bool = False,
+                     hard_link_id=_UNKNOWN) -> list:
         """Delete a row; -> chunks orphaned by a last-name hardlink removal
         (empty otherwise).  keep_hard_link skips the decrement — rename
-        moves a name, it does not remove one."""
+        moves a name, it does not remove one.  Callers that already hold
+        the entry pass its hard_link_id ("" for plain entries) to avoid a
+        second store lookup per delete."""
         self._count("delete")
         garbage: list = []
         if not keep_hard_link:
-            try:
-                existing = self.actual.find_entry(full_path)
-            except NotFound:
-                existing = None
-            if existing is not None and existing.hard_link_id and \
-                    not existing.is_directory:
-                _, garbage = self.delete_hard_link(existing.hard_link_id)
+            hl = hard_link_id
+            if hl is self._UNKNOWN:
+                try:
+                    existing = self.actual.find_entry(full_path)
+                    hl = "" if existing.is_directory else \
+                        existing.hard_link_id
+                except NotFound:
+                    hl = ""
+            if hl:
+                _, garbage = self.delete_hard_link(hl)
         self.actual.delete_entry(full_path)
         return garbage
 

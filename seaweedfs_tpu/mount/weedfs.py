@@ -235,7 +235,11 @@ class WFS:
                                         timeout=self.timeout) as r:
                 meta = json.loads(r.read())
         except urllib.error.HTTPError as e:
-            meta = None if e.code == 404 else None
+            if e.code != 404:
+                # a transient 5xx/auth error is NOT "does not exist" — it
+                # must surface as EIO, never negative-cache as ENOENT
+                raise FsError(5, f"meta: {e.code}")
+            meta = None
         except (urllib.error.URLError, OSError):
             raise FsError(5, "filer unreachable")  # EIO
         if meta is not None and meta.get("hard_link_id"):
@@ -348,7 +352,8 @@ class WFS:
         for c in meta.get("chunks") or []:
             size = max(size, c.get("offset", 0) + c.get("size", 0))
         if a.get("symlink_target"):
-            size = len(a["symlink_target"])
+            # POSIX: a symlink's size is the BYTE length of its target
+            size = len(a["symlink_target"].encode())
         return {"st_mode": a.get("mode", 0o660), "st_size": size,
                 "st_mtime": a.get("mtime", 0), "st_ctime": a.get("crtime", 0),
                 "st_uid": a.get("uid", 0), "st_gid": a.get("gid", 0),

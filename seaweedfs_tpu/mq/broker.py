@@ -33,6 +33,21 @@ probe, refreshed continuously. Killing a broker re-routes its partitions
 to survivors, which already hold the data via follower replication —
 publishes keep succeeding and subscribers lose nothing. Group offsets are
 broadcast to every live broker on commit so they also survive failover.
+
+Durability (with `filer_url`): partition logs flush into the filer as
+binary segments under /topics/<ns>/<topic>/<partition>/ (mq/segments.py;
+reference persists topic data into the filer the same way), topic confs as
+topic.json, and committed group offsets write through to
+/topics/.offsets/ — kill and restart EVERY broker and topics, messages,
+and consumer progress all recover.  Reads below the RAM window fall back
+to the segment files.
+
+Fencing: partition ownership carries an epoch issued by the master
+(/cluster/mq/epoch, monotonic per partition).  Replicas reject appends
+with an older epoch, so two brokers with divergent ring views fail loudly
+instead of silently interleaving/merging logs.  The unflushed RAM tail is
+still lost if owner AND follower die inside one flush interval — the same
+window the reference's in-memory log buffer has.
 """
 
 from __future__ import annotations
@@ -57,11 +72,14 @@ log = logging.getLogger("mq.broker")
 class BrokerServer:
     def __init__(self, master_url: str, host: str = "127.0.0.1",
                  port: int = 17777, peer_refresh: float = 2.0,
-                 member_ttl: float = 15.0):
+                 member_ttl: float = 15.0, filer_url: str | None = None,
+                 flush_interval: float = 2.0):
         self.master_url = master_url
         self.host, self.port = host, port
         self.peer_refresh = peer_refresh
         self.member_ttl = member_ttl
+        self.filer_url = filer_url
+        self.flush_interval = flush_interval
         # str(topic) -> list[LocalPartition]
         self.topics: dict[str, list[LocalPartition]] = {}
         self.peer_brokers: list[str] = [self.url]  # sorted, self included
@@ -69,6 +87,14 @@ class BrokerServer:
         self.group_members: dict[tuple[str, str], dict[str, float]] = {}
         # (group, topic, partition) -> committed offset
         self.group_offsets: dict[tuple[str, str, int], int] = {}
+        # fencing (advisor finding: divergent ring views must not silently
+        # merge): (topic, pi) -> epoch I publish under / highest seen
+        self.own_epoch: dict[tuple[str, int], int] = {}
+        self.seen_epoch: dict[tuple[str, int], int] = {}
+        # (topic, pi) -> next offset already durable in filer segments
+        self.flushed_upto: dict[tuple[str, int], int] = {}
+        self._conf_persisted: set[str] = set()
+        self.store = None  # FilerSegmentStore when filer_url is set
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes([
             web.post("/topics/configure", self.handle_configure),
@@ -82,11 +108,13 @@ class BrokerServer:
             web.post("/offsets/commit", self.handle_offsets_commit),
             web.post("/offsets/sync", self.handle_offsets_sync),
             web.get("/offsets/get", self.handle_offsets_get),
+            web.post("/flush", self.handle_flush),
             web.get("/status", self.handle_status),
         ])
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._register_task: asyncio.Task | None = None
+        self._flush_task: asyncio.Task | None = None
 
     @property
     def url(self) -> str:
@@ -96,21 +124,138 @@ class BrokerServer:
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=30))
+        if self.filer_url:
+            from seaweedfs_tpu.mq.segments import FilerSegmentStore
+            self.store = FilerSegmentStore(self._session, self.filer_url)
+            await self._recover()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
                            ssl_context=_tls.server_ssl("broker"))
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
+        if self.store is not None:
+            self._flush_task = asyncio.create_task(self._flush_loop())
         log.info("mq broker on %s", self.url)
 
     async def stop(self) -> None:
         if self._register_task:
             self._register_task.cancel()
+        if self._flush_task:
+            self._flush_task.cancel()
+        if self.store is not None:
+            try:
+                await self._flush_all()  # graceful stop drains the tail
+            except Exception:
+                log.exception("final flush failed")
         if self._session:
             await self._session.close()
         if self._runner:
             await self._runner.cleanup()
+
+    # -- durability (reference: topic data persisted into the filer under
+    #    /topics; segment serde weed/mq/segment/message_serde.go) ---------
+
+    async def _recover(self) -> None:
+        """Rebuild topics + partition tails + flush cursors from the filer:
+        a full-cluster restart loses nothing that was flushed."""
+        for topic in await self.store.list_topics():
+            n = await self.store.read_conf(topic)
+            if not n:
+                continue
+            parts = self._get_topic(topic, auto_create=True, n=n)
+            for pi, part in enumerate(parts):
+                segs = await self.store.list_segments(topic, pi)
+                if not segs:
+                    continue
+                # load the tail segments into the RAM window
+                msgs: list = []
+                for base, end, name in reversed(segs):
+                    msgs = await self.store.read_segment(topic, pi, name) \
+                        + msgs
+                    if len(msgs) >= part.max_messages:
+                        break
+                if msgs:
+                    part.load_snapshot(msgs[0].offset, msgs)
+                self.flushed_upto[(topic, pi)] = segs[-1][1]
+        if self.topics:
+            log.info("recovered %d topics from filer", len(self.topics))
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.flush_interval)
+            try:
+                await self._flush_all()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("segment flush failed")
+
+    async def _flush_all(self) -> None:
+        """Write every owned partition's unflushed tail as one new segment.
+        Only the owner flushes, so segments never duplicate; after a
+        failover the new owner derives its cursor from the filer listing."""
+        if self.store is None:
+            return
+        for topic, parts in list(self.topics.items()):
+            for pi, part in enumerate(parts):
+                if self._owner_of(pi) != self.url:
+                    continue
+                key = (topic, pi)
+                if key not in self.flushed_upto:
+                    self.flushed_upto[key] = \
+                        await self.store.flushed_upto(topic, pi)
+                upto = self.flushed_upto[key]
+                if part.next_offset <= upto:
+                    continue
+                # off-loop: read takes the partition lock and copies up to
+                # the whole RAM window (read clamps to >= upto already)
+                tail = await asyncio.to_thread(part.read, upto,
+                                               1 << 20)
+                if not tail:
+                    continue
+                if topic not in self._conf_persisted:
+                    # auto-created topics (first pub) persist their conf
+                    # with their first segment so recovery finds them
+                    await self.store.write_conf(topic, len(parts))
+                    self._conf_persisted.add(topic)
+                await self.store.write_segment(topic, pi, tail)
+                self.flushed_upto[key] = tail[-1].offset + 1
+
+    async def handle_flush(self, req: web.Request) -> web.Response:
+        """Force-drain the unflushed tails (deterministic tests; ops)."""
+        if self.store is None:
+            return web.json_response({"error": "no filer configured"},
+                                     status=400)
+        await self._flush_all()
+        return web.json_response({"ok": True})
+
+    # -- fencing epochs --------------------------------------------------
+
+    async def _ensure_epoch(self, topic: str, pi: int) -> int:
+        """Owner-side: fetch a fresh fencing epoch from the master the
+        first time this broker publishes to a partition (and again after
+        being fenced).  Monotonic per partition across the cluster."""
+        key = (topic, pi)
+        epoch = self.own_epoch.get(key)
+        if epoch is not None:
+            return epoch
+        try:
+            async with self._session.post(
+                    f"{_tls_scheme()}://{self.master_url}/cluster/mq/epoch",
+                    json={"key": f"{topic}/{pi}"},
+                    timeout=aiohttp.ClientTimeout(total=5)) as r:
+                epoch = int((await r.json())["epoch"])
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ValueError, KeyError):
+            # master unreachable: publish under the highest epoch this
+            # broker has itself replicated for (passes the follower's
+            # >= check in the common case) and do NOT cache, so the next
+            # publish retries the master — a master outage must degrade
+            # fencing, not turn into a publish outage
+            return self.seen_epoch.get(key, 0)
+        self.own_epoch[key] = epoch
+        return epoch
 
     # -- membership / balance --------------------------------------------
 
@@ -164,6 +309,9 @@ class BrokerServer:
         if alive != self.peer_brokers:
             log.info("broker ring: %s -> %s", self.peer_brokers, alive)
             self.peer_brokers = alive
+            # ownership may have moved: publish under fresh fencing epochs
+            # so a peer still on the old ring cannot silently interleave
+            self.own_epoch.clear()
         # anti-entropy every cycle (and the takeover path after a ring
         # change): a broker that accepted publishes under a stale ring view
         # holds data its settled owner lacks; comparing next_offsets and
@@ -243,6 +391,11 @@ class BrokerServer:
                 {"error": "cannot repartition a live topic"}, status=409)
         if existing is None:
             self.topics[topic] = [LocalPartition(p) for p in split_ring(n)]
+        if self.store is not None:
+            try:
+                await self.store.write_conf(topic, n)
+            except OSError:
+                log.exception("topic conf persist failed")
         if not req.query.get("propagated"):
             # every broker holds every partition object (leader for some,
             # follower for others) so configuration fans out
@@ -275,7 +428,14 @@ class BrokerServer:
         if not topic:
             return web.json_response({"error": "topic required"}, status=400)
         parts = self._get_topic(topic, auto_create=True)
-        key = req.query.get("key", "").encode()
+        if "key_b64" in req.query:  # arbitrary-bytes keys (mq/client.py)
+            try:
+                key = base64.b64decode(req.query["key_b64"])
+            except ValueError:
+                return web.json_response({"error": "bad key_b64"},
+                                         status=400)
+        else:
+            key = req.query.get("key", "").encode()
         value = await req.read()
         slot = ring_slot(key)
         part = next((p for p in parts if p.partition.holds_key(key)),
@@ -295,8 +455,20 @@ class BrokerServer:
                     {"error": f"partition {pi} owner unreachable"},
                     status=503)
 
+        epoch = await self._ensure_epoch(str(Topic.parse(topic)), pi)
         offset = await asyncio.to_thread(part.publish, key, value)
-        await self._replicate_out(topic, pi, part, offset, key, value)
+        fenced = await self._replicate_out(topic, pi, part, offset, key,
+                                           value, epoch)
+        if fenced:
+            # the follower has seen a newer owner: this broker's ring view
+            # is stale — refresh and route the NEXT publish correctly; the
+            # message is already appended locally and anti-entropy will
+            # reconcile, but tell the client the truth
+            self.own_epoch.pop((str(Topic.parse(topic)), pi), None)
+            await self._refresh_peers()
+            return web.json_response(
+                {"error": f"fenced: partition {pi} has a newer owner"},
+                status=503)
         return web.json_response({"partition": pi, "offset": offset})
 
     async def _forward_pub(self, owner: str, query, value: bytes):
@@ -313,17 +485,19 @@ class BrokerServer:
 
     async def _replicate_out(self, topic: str, pi: int,
                              part: LocalPartition, offset: int,
-                             key: bytes, value: bytes) -> None:
+                             key: bytes, value: bytes,
+                             epoch: int = 0) -> bool:
         """Synchronous replication to the partition's follower (reference:
         partition followers); a gap answer triggers a snapshot push so a
-        rejoining follower converges."""
+        rejoining follower converges.  Returns True when the follower
+        FENCED this append (it has seen a newer ownership epoch)."""
         follower = self._follower_of(pi)
         if follower is None:
-            return
+            return False
         msg = {
             "topic": topic, "partition": pi, "offset": offset,
             "partition_count": len(self.topics[str(Topic.parse(topic))]),
-            "ts_ns": time.time_ns(),
+            "ts_ns": time.time_ns(), "epoch": epoch,
             "key": base64.b64encode(key).decode(),
             "value": base64.b64encode(value).decode(),
         }
@@ -331,10 +505,13 @@ class BrokerServer:
             async with self._session.post(
                     f"{_tls_scheme()}://{follower}/replicate", json=msg,
                     timeout=aiohttp.ClientTimeout(total=10)) as r:
+                if r.status == 403:
+                    return True
                 if r.status == 409:  # follower has a gap: push everything
                     await self._push_state(follower, topic, pi, part)
         except (aiohttp.ClientError, asyncio.TimeoutError):
             pass  # follower down; the ring refresh will re-route it
+        return False
 
     async def _push_state(self, peer: str, topic: str, pi: int,
                           part: LocalPartition) -> None:
@@ -360,6 +537,17 @@ class BrokerServer:
                                 n=int(body.get("partition_count", 4)))
         if not 0 <= pi < len(parts):
             return web.json_response({"error": "bad partition"}, status=400)
+        # fencing: appends from an owner whose epoch is older than the
+        # newest we've replicated for are rejected, not merged (a stale
+        # ring view must fail loudly instead of silently discarding the
+        # settled owner's interleaved messages)
+        ekey = (str(Topic.parse(topic)), pi)
+        epoch = int(body.get("epoch", 0))
+        seen = self.seen_epoch.get(ekey, 0)
+        if epoch < seen:
+            return web.json_response(
+                {"error": f"fenced: epoch {epoch} < {seen}"}, status=403)
+        self.seen_epoch[ekey] = epoch
         ok = parts[pi].append_replica(
             int(body["offset"]), int(body["ts_ns"]),
             base64.b64decode(body["key"]), base64.b64decode(body["value"]))
@@ -416,6 +604,18 @@ class BrokerServer:
             raise web.HTTPTemporaryRedirect(
                 f"{_tls_scheme()}://{owner}/sub?{req.query_string}")
         part = parts[pi]
+        if offset < part.base_offset and self.store is not None:
+            # below the RAM window: serve from the durable filer segments
+            batch = await self._read_segments(topic, pi, offset, limit)
+            if batch:
+                lines = b"".join(
+                    json.dumps(m.to_dict(),
+                               separators=(",", ":")).encode() + b"\n"
+                    for m in batch)
+                return web.Response(
+                    body=lines, content_type="application/x-ndjson",
+                    headers={"X-Next-Offset":
+                             str(batch[-1].offset + 1)})
         batch = await asyncio.to_thread(part.read, offset, limit, wait)
         lines = b"".join(
             json.dumps(m.to_dict(), separators=(",", ":")).encode() + b"\n"
@@ -423,6 +623,20 @@ class BrokerServer:
         return web.Response(body=lines, content_type="application/x-ndjson",
                             headers={"X-Next-Offset": str(
                                 batch[-1].offset + 1 if batch else offset)})
+
+    async def _read_segments(self, topic: str, pi: int, offset: int,
+                             limit: int):
+        """Messages from `offset` out of the filer segment files (the
+        reference reads aged topic data back out of /topics the same way)."""
+        out: list = []
+        for base, end, name in await self.store.list_segments(topic, pi):
+            if end <= offset:
+                continue
+            msgs = await self.store.read_segment(topic, pi, name)
+            out.extend(m for m in msgs if m.offset >= offset)
+            if len(out) >= limit:
+                return out[:limit]
+        return out
 
     # -- consumer-group coordination (reference: sub_coordinator/) -------
 
@@ -479,6 +693,13 @@ class BrokerServer:
         key = (body["group"], str(Topic.parse(body["topic"])),
                int(body["partition"]))
         self.group_offsets[key] = int(body["offset"])
+        if self.store is not None:
+            # write-through so progress survives a full-cluster restart
+            try:
+                await self.store.write_offset(key[0], key[1], key[2],
+                                              self.group_offsets[key])
+            except OSError:
+                log.exception("offset persist failed")
 
         # fan the commit out (concurrently — a dead peer must not stall the
         # consumer) so any surviving broker can answer offsets/get later
@@ -508,7 +729,12 @@ class BrokerServer:
         key = (req.query.get("group", ""),
                str(Topic.parse(req.query.get("topic", ""))),
                int(req.query.get("partition", "0")))
-        return web.json_response({"offset": self.group_offsets.get(key, 0)})
+        offset = self.group_offsets.get(key)
+        if offset is None and self.store is not None:
+            offset = await self.store.read_offset(*key)
+            if offset is not None:
+                self.group_offsets[key] = offset
+        return web.json_response({"offset": offset or 0})
 
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response({

@@ -89,6 +89,7 @@ class MasterServer:
             web.post("/admin/unlock", self.handle_unlock),
             web.post("/admin/renew_lock", self.handle_renew_lock),
             web.post("/cluster/register", self.handle_cluster_register),
+            web.post("/cluster/mq/epoch", self.handle_mq_epoch),
             web.post("/vol/vacuum", self.handle_vacuum),
             web.post("/vol/vacuum_toggle", self.handle_vacuum_toggle),
             web.post("/raft/peers/add", self.handle_raft_peer_add),
@@ -103,6 +104,7 @@ class MasterServer:
         # non-volume-server cluster members (filers, brokers, gateways):
         # type -> {address: last_seen} (reference: weed/cluster/cluster.go)
         self.cluster_members: dict[str, dict[str, float]] = {}
+        self._mq_epochs: dict[str, int] = {}  # MQ partition fencing epochs
         self.vacuum_enabled = True
         self.garbage_threshold = 0.3
         self._runner: web.AppRunner | None = None
@@ -309,6 +311,23 @@ class MasterServer:
         if addr:
             self.cluster_members.setdefault(kind, {})[addr] = time.time()
         return web.json_response({})
+
+    async def handle_mq_epoch(self, req: web.Request) -> web.Response:
+        """Fencing-epoch authority for MQ partition ownership: each bump
+        returns a value strictly above every previously issued one, and —
+        because it is floored at the wall clock in ns — above anything an
+        earlier master incarnation issued too, so epochs need no
+        persistence.  A broker taking ownership of a partition bumps here;
+        replicas reject appends carrying an older epoch (the fencing the
+        reference gets from its balancer-leader lease)."""
+        body = await req.json()
+        key = str(body.get("key", ""))
+        if not key:
+            return web.json_response({"error": "key required"}, status=400)
+        prev = self._mq_epochs.get(key, 0)
+        epoch = max(prev + 1, time.time_ns())
+        self._mq_epochs[key] = epoch
+        return web.json_response({"epoch": epoch})
 
     # -- handlers ------------------------------------------------------
 

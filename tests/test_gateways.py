@@ -531,3 +531,123 @@ class TestMountAttrSurface:
             wfs.unlink("/hlm-b.txt")
         finally:
             wfs.close()
+
+
+class TestMQDurable:
+    """Kill-and-restart-ALL-brokers durability + client library + fencing
+    (reference: /topics persistence, mq/client/, balancer lease fencing)."""
+
+    @pytest.fixture()
+    def durable_stack(self, tmp_path):
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.mq.broker import BrokerServer
+
+        c = Cluster(tmp_path, n_volume_servers=1).start()
+        c.wait_heartbeats()
+        filer = FilerServer(c.master.url, port=free_port(),
+                            data_dir=str(tmp_path / "f"))
+        c.submit(filer.start())
+        brokers = [BrokerServer(c.master.url, port=free_port(),
+                                filer_url=filer.url, peer_refresh=0.5)
+                   for _ in range(2)]
+        for b in brokers:
+            c.submit(b.start())
+        time.sleep(1.2)  # both brokers discover each other
+        holder = {"brokers": brokers}
+        yield c, filer, holder
+        for b in holder["brokers"]:
+            c.submit(b.stop())
+        c.submit(filer.stop())
+        c.stop()
+
+    def test_full_cluster_restart_preserves_messages_and_offsets(
+            self, durable_stack):
+        from seaweedfs_tpu.mq.broker import BrokerServer
+        from seaweedfs_tpu.mq.client import MQClient
+        c, filer, holder = durable_stack
+        brokers = holder["brokers"]
+        client = MQClient([b.url for b in brokers])
+        client.configure("orders.incoming", partition_count=2)
+        sent = []
+        for i in range(20):
+            pi, off = client.publish("orders.incoming",
+                                     f"payload-{i}".encode(),
+                                     key=f"k{i}".encode())
+            sent.append((pi, off, f"payload-{i}"))
+        # consume some + commit progress
+        consumer = client.consumer("orders.incoming", group="billing",
+                                   member="m1")
+        consumer.join()
+        first = consumer.poll(max_messages=7)
+        assert len(first) == 7
+        consumer.commit()
+        committed = dict(consumer.positions)
+        # drain RAM tails to the filer, then kill EVERY broker
+        for b in brokers:
+            assert req(f"http://{b.url}/flush", method="POST",
+                       data=b"{}")[0] == 200
+        for b in brokers:
+            c.submit(b.stop())
+        # fresh broker processes on new ports, same filer
+        revived = [BrokerServer(c.master.url, port=free_port(),
+                                filer_url=filer.url, peer_refresh=0.5)
+                   for _ in range(2)]
+        for b in revived:
+            c.submit(b.start())
+        holder["brokers"] = revived
+        time.sleep(1.2)
+        client2 = MQClient([b.url for b in revived])
+        client2.refresh()
+        # every published message is still readable
+        got = []
+        for pi in range(2):
+            offset = 0
+            while True:
+                msgs, nxt = client2.fetch("orders.incoming", pi, offset)
+                if not msgs:
+                    break
+                got.extend(m["value"] for m in msgs)
+                offset = nxt
+        assert sorted(got) == sorted(v for _, _, v in sent)
+        # committed offsets recovered: a rejoining member resumes, not replays
+        consumer2 = client2.consumer("orders.incoming", group="billing",
+                                     member="m1")
+        consumer2.join()
+        for pi in consumer2.partitions:
+            assert consumer2.positions[pi] == committed.get(pi, 0)
+        rest = consumer2.poll(max_messages=100)
+        assert len(rest) == 20 - 7
+        # and publishes keep working after recovery
+        pi, off = client2.publish("orders.incoming", b"after-restart")
+        assert off >= 0
+
+    def test_epoch_fencing_rejects_stale_owner(self, durable_stack):
+        from seaweedfs_tpu.mq.client import MQClient
+        c, filer, holder = durable_stack
+        brokers = holder["brokers"]
+        client = MQClient([b.url for b in brokers])
+        client.configure("fence.t", partition_count=1)
+        client.publish("fence.t", b"one")  # establishes owner epoch
+        # follower has recorded the owner's epoch; a "stale owner" append
+        # with a lower epoch must be fenced (403), not merged
+        follower = max(brokers, key=lambda b: b.url)  # partition 0 owner is
+        owner = min(brokers, key=lambda b: b.url)     # sorted()[0]
+        seen = follower.seen_epoch.get(("fence.t", 0), 0)
+        assert seen > 0
+        body = json.dumps({
+            "topic": "fence.t", "partition": 0, "partition_count": 1,
+            "offset": 99, "ts_ns": 1, "epoch": seen - 1,
+            "key": "", "value": "c3RhbGU=",
+        }).encode()
+        st, resp, _ = req(f"http://{follower.url}/replicate", method="POST",
+                          data=body)
+        assert st == 403 and b"fenced" in resp
+        # equal/newer epochs still replicate
+        nxt = follower._get_topic("fence.t")[0].next_offset
+        body = json.dumps({
+            "topic": "fence.t", "partition": 0, "partition_count": 1,
+            "offset": nxt, "ts_ns": 1, "epoch": seen,
+            "key": "", "value": "b2s=",
+        }).encode()
+        assert req(f"http://{follower.url}/replicate", method="POST",
+                   data=body)[0] == 200
