@@ -17,11 +17,18 @@ from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
 class WeedClient:
     def __init__(self, master: str, timeout: float = 30.0, jwt_signer=None,
-                 jwt_read_signer=None):
+                 jwt_read_signer=None, stream_updates: bool = False):
         """`jwt_signer(fid) -> token` signs volume writes/deletes, and
         `jwt_read_signer(fid)` signs reads, when the cluster enforces JWTs
         (reference: operation callers hold the security.toml signing keys,
-        security/jwt.go GenJwtForVolumeServer)."""
+        security/jwt.go GenJwtForVolumeServer).
+
+        `stream_updates=True` attaches to the master's /cluster/stream
+        push feed (the reference's KeepConnected, masterclient.go:20-45):
+        volume-location deltas land in the vid cache the moment the master
+        learns them — a dead volume server stops being routed to
+        immediately instead of after the poll-TTL.  The TTL cache remains
+        as the fallback whenever the stream is down."""
         # `master` may be a comma-separated HA list; requests follow the
         # raft leader like the reference wdclient (masterclient.go:20-45)
         self.masters = [m.strip() for m in master.split(",") if m.strip()]
@@ -31,6 +38,66 @@ class WeedClient:
         self.jwt_read_signer = jwt_read_signer
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self.vid_cache_ttl = 10.0
+        self._stream_live = False
+        self._stream_stop = None
+        if stream_updates:
+            import threading
+            self._stream_stop = threading.Event()
+            t = threading.Thread(target=self._stream_loop,
+                                 name="weed-vidmap-stream", daemon=True)
+            t.start()
+
+    def close(self) -> None:
+        if self._stream_stop is not None:
+            self._stream_stop.set()
+
+    # pushed entries outlive the poll TTL but NOT forever: if the feed
+    # goes silently stale (e.g. the master was demoted but its process
+    # lives on) lookups degrade to TTL polling within this horizon
+    STREAM_ENTRY_HORIZON = 60.0
+
+    def _stream_loop(self) -> None:
+        while not self._stream_stop.is_set():
+            try:
+                # the stream must follow the raft leader: only the leader
+                # receives heartbeats, so a follower's feed would be empty
+                try:
+                    status = self._master_json("/cluster/status")
+                    leader = status.get("Leader")
+                    if leader and leader != self.master:
+                        self.master = leader
+                except (RuntimeError, OSError):
+                    pass
+                req = urllib.request.Request(
+                    f"{_tls_scheme()}://{self.master}/cluster/stream")
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    self._stream_live = True
+                    for raw in r:
+                        if self._stream_stop.is_set():
+                            return
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        if "vid" not in ev:
+                            continue  # ping / snapshot_end
+                        urls = [l["url"] for l in ev.get("locations", [])]
+                        if urls:
+                            self._vid_cache[ev["vid"]] = \
+                                (urls, time.time()
+                                 + self.STREAM_ENTRY_HORIZON
+                                 - self.vid_cache_ttl)
+                        else:
+                            self._vid_cache.pop(ev["vid"], None)
+            except (OSError, ValueError):
+                pass
+            finally:
+                self._stream_live = False
+            if not self._stream_stop.is_set():
+                # push entries go stale the moment the feed breaks: drop
+                # them so lookups fall back to TTL polling, then reconnect
+                self._vid_cache.clear()
+                self._stream_stop.wait(1.0)
 
     # -- raw http ------------------------------------------------------
 

@@ -94,6 +94,7 @@ class BrokerServer:
         # (topic, pi) -> next offset already durable in filer segments
         self.flushed_upto: dict[tuple[str, int], int] = {}
         self._conf_persisted: set[str] = set()
+        self._seg_cache: dict[tuple, list] = {}  # LRU of decoded segments
         self.store = None  # FilerSegmentStore when filer_url is set
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes([
@@ -168,16 +169,26 @@ class BrokerServer:
                 segs = await self.store.list_segments(topic, pi)
                 if not segs:
                     continue
-                # load the tail segments into the RAM window
-                msgs: list = []
+                # load the tail segments into the RAM window, newest
+                # first; dedup by offset with the newest segment winning
+                # (overlapping segments can exist after a ring-change
+                # flush race) and corrupt files skipped
+                by_off: dict[int, object] = {}
                 for base, end, name in reversed(segs):
-                    msgs = await self.store.read_segment(topic, pi, name) \
-                        + msgs
-                    if len(msgs) >= part.max_messages:
+                    for m in await self.store.read_segment(topic, pi,
+                                                           name):
+                        by_off.setdefault(m.offset, m)
+                    if len(by_off) >= part.max_messages:
                         break
+                msgs = [by_off[o] for o in sorted(by_off)]
+                msgs = msgs[-part.max_messages:]
                 if msgs:
                     part.load_snapshot(msgs[0].offset, msgs)
-                self.flushed_upto[(topic, pi)] = segs[-1][1]
+                # cursor from the last GOOD message, not the segment file
+                # names: a corrupt tail segment must not suppress
+                # re-flushing (and thus silently lose) its offset range
+                self.flushed_upto[(topic, pi)] = \
+                    (msgs[-1].offset + 1) if msgs else 0
         if self.topics:
             log.info("recovered %d topics from filer", len(self.topics))
 
@@ -193,8 +204,12 @@ class BrokerServer:
 
     async def _flush_all(self) -> None:
         """Write every owned partition's unflushed tail as one new segment.
-        Only the owner flushes, so segments never duplicate; after a
-        failover the new owner derives its cursor from the filer listing."""
+        Only the owner flushes; after a failover the new owner derives its
+        cursor from the filer listing.  During a ring-change window two
+        brokers may briefly both believe they own a partition and write
+        overlapping segments — readers (_recover, _read_segments) dedup by
+        offset, newest segment first, so the race degrades to redundant
+        bytes, not replayed duplicates."""
         if self.store is None:
             return
         for topic, parts in list(self.topics.items()):
@@ -627,16 +642,32 @@ class BrokerServer:
     async def _read_segments(self, topic: str, pi: int, offset: int,
                              limit: int):
         """Messages from `offset` out of the filer segment files (the
-        reference reads aged topic data back out of /topics the same way)."""
+        reference reads aged topic data back out of /topics the same way).
+        Only segments covering [offset, ...) are downloaded, the most
+        recently decoded ones are kept in a small LRU (a replaying
+        consumer advances through a segment across several fetches —
+        re-downloading it each time would make replay O(segments^2)), and
+        duplicate offsets from flush-race overlaps are dropped."""
         out: list = []
+        seen: set[int] = set()
         for base, end, name in await self.store.list_segments(topic, pi):
             if end <= offset:
                 continue
-            msgs = await self.store.read_segment(topic, pi, name)
-            out.extend(m for m in msgs if m.offset >= offset)
+            ckey = (topic, pi, name)
+            msgs = self._seg_cache.get(ckey)
+            if msgs is None:
+                msgs = await self.store.read_segment(topic, pi, name)
+                self._seg_cache[ckey] = msgs
+                while len(self._seg_cache) > 8:
+                    self._seg_cache.pop(next(iter(self._seg_cache)))
+            for m in msgs:
+                if m.offset >= offset and m.offset not in seen:
+                    seen.add(m.offset)
+                    out.append(m)
             if len(out) >= limit:
-                return out[:limit]
-        return out
+                break
+        out.sort(key=lambda m: m.offset)
+        return out[:limit]
 
     # -- consumer-group coordination (reference: sub_coordinator/) -------
 

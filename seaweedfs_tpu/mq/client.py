@@ -54,8 +54,13 @@ class MQClient:
         req = urllib.request.Request(
             f"{_tls_scheme()}://{broker}{path}", data=data,
             method=method or ("POST" if data is not None else "GET"))
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return r.status, r.read(), dict(r.headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            # a 4xx/5xx is an ANSWER (fenced, repartition conflict, ...),
+            # not a dead broker — hand the status to the caller
+            return e.code, e.read(), dict(e.headers)
 
     def _any_broker(self, path: str, data: bytes | None = None):
         """Try the ring then the seeds; first broker that answers wins."""

@@ -35,6 +35,7 @@ class MasterServer:
                  volume_size_limit: int = 30 * 1024 * 1024 * 1024,
                  default_replication: str = "000",
                  grow_count: int = 1, security=None,
+                 node_timeout: float = 25.0,
                  peers: list[str] | None = None,
                  raft_state_dir: str | None = None):
         self.host, self.port = host, port
@@ -56,6 +57,7 @@ class MasterServer:
                              replication=default_replication,
                              sequencer=sequencer)
         self.grow_count = grow_count
+        self.node_timeout = node_timeout
         # Raft among masters (reference: weed/server/raft_server.go):
         # replicates volume-id allocations; followers proxy to the leader
         self.raft = None
@@ -90,6 +92,7 @@ class MasterServer:
             web.post("/admin/renew_lock", self.handle_renew_lock),
             web.post("/cluster/register", self.handle_cluster_register),
             web.post("/cluster/mq/epoch", self.handle_mq_epoch),
+            web.get("/cluster/stream", self.handle_cluster_stream),
             web.post("/vol/vacuum", self.handle_vacuum),
             web.post("/vol/vacuum_toggle", self.handle_vacuum_toggle),
             web.post("/raft/peers/add", self.handle_raft_peer_add),
@@ -105,6 +108,10 @@ class MasterServer:
         # type -> {address: last_seen} (reference: weed/cluster/cluster.go)
         self.cluster_members: dict[str, dict[str, float]] = {}
         self._mq_epochs: dict[str, int] = {}  # MQ partition fencing epochs
+        # vid-map stream subscribers (reference: KeepConnected clients,
+        # master_grpc_server.go broadcastToClients)
+        self._vid_subscribers: set[asyncio.Queue] = set()
+        self.topo.on_vid_change = self._push_vid_change
         self.vacuum_enabled = True
         self.garbage_threshold = 0.3
         self._runner: web.AppRunner | None = None
@@ -138,6 +145,10 @@ class MasterServer:
             self.raft.stop()
         if self._expire_task:
             self._expire_task.cancel()
+        # wake /cluster/stream subscribers so their handlers return and
+        # runner.cleanup() doesn't wait out its shutdown timeout on them
+        for q in list(self._vid_subscribers):
+            q.put_nowait(None)
         if self._session:
             await self._session.close()
         if self._runner:
@@ -215,8 +226,8 @@ class MasterServer:
     async def _expire_loop(self) -> None:
         tick = 0
         while True:
-            await asyncio.sleep(5)
-            dead = self.topo.expire_dead_nodes()
+            await asyncio.sleep(min(5.0, self.node_timeout / 2))
+            dead = self.topo.expire_dead_nodes(self.node_timeout)
             for nid in dead:
                 log.warning("volume server %s expired from topology", nid)
             now = time.time()
@@ -439,6 +450,59 @@ class MasterServer:
                        for sid, nodes in shards.items()},
         })
 
+    def _vid_event(self, vid: int) -> dict:
+        nodes = self.topo.lookup(vid)
+        return {"vid": vid,
+                "locations": [{"url": n.url, "publicUrl": n.public_url}
+                              for n in nodes]}
+
+    def _push_vid_change(self, vid: int) -> None:
+        """Topology hook: fan a volume-location delta out to every
+        /cluster/stream subscriber (runs on the event loop — heartbeats
+        are handled there)."""
+        if not self._vid_subscribers:
+            return
+        ev = self._vid_event(vid)
+        for q in list(self._vid_subscribers):
+            if q.qsize() < 10000:  # a stuck client must not hoard memory
+                q.put_nowait(ev)
+
+    async def handle_cluster_stream(self, req: web.Request) -> web.StreamResponse:
+        """NDJSON push of volume-location deltas (the reference's
+        KeepConnected stream, wdclient/masterclient.go:20-45): a snapshot
+        of every known vid first, then live updates — an empty `locations`
+        list means the volume is gone.  Clients invalidate instantly
+        instead of serving stale routes for a poll-TTL window."""
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(req)
+        q: asyncio.Queue = asyncio.Queue()
+        self._vid_subscribers.add(q)
+        try:
+            with self.topo._lock:
+                vids = sorted({vid for n in self.topo.nodes.values()
+                               for vid in n.volumes} |
+                              {vid for n in self.topo.nodes.values()
+                               for vid, s in n.ec_shards.items() if s})
+            for vid in vids:
+                await resp.write(json.dumps(self._vid_event(vid)).encode()
+                                 + b"\n")
+            await resp.write(b'{"snapshot_end": true}\n')
+            while True:
+                try:
+                    ev = await asyncio.wait_for(q.get(), timeout=10.0)
+                except asyncio.TimeoutError:
+                    await resp.write(b'{"ping": true}\n')  # liveness probe
+                    continue
+                if ev is None:  # server shutting down
+                    break
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError, OSError):
+            pass
+        finally:
+            self._vid_subscribers.discard(q)
+        return resp
+
     async def handle_cluster_status(self, req: web.Request) -> web.Response:
         # members go stale when their register loop stops (reference:
         # cluster.go removes nodes on connection loss) — 30s covers three
@@ -544,5 +608,8 @@ class MasterServer:
                                     replica_placement=replication, ttl=ttl)
                     node.volumes[v.id] = v
                     self.topo.layout(collection, replication, ttl).register(v, node)
+                # heartbeats will see prev==new for this vid, so the
+                # stream event must fire here
+                self.topo._vids_changed({vid})
                 grown += 1
         return grown

@@ -265,15 +265,62 @@ def balanced_ec_distribution(nodes: list[str],
     return alloc
 
 
+def parse_duration(s: str) -> float:
+    """'1h' / '30m' / '45s' / plain seconds -> seconds."""
+    s = s.strip()
+    mult = {"s": 1, "m": 60, "h": 3600, "d": 86400}.get(s[-1:], None)
+    if mult is not None:
+        return float(s[:-1]) * mult
+    return float(s)
+
+
+def collect_volume_ids_for_ec_encode(topo: dict, collection: str,
+                                     full_percent: float,
+                                     quiet_seconds: float) -> list[int]:
+    """Pick quiet+full candidate volumes from the topology snapshot
+    (reference: command_ec_encode.go:290-321
+    collectVolumeIdsForEcEncode).  Pure function over the snapshot, so
+    it is testable without a cluster (SURVEY §4 topology-test pattern)."""
+    import time as _time
+    limit = topo.get("volume_size_limit", 0) or 0
+    now = _time.time()
+    vids: set[int] = set()
+    for node in topo["nodes"].values():
+        for v in node.get("volume_infos", []):
+            if v.get("collection", "") != collection:
+                continue
+            if v.get("modified_at", 0) + quiet_seconds >= now:
+                continue  # written too recently
+            if limit and v.get("size", 0) <= full_percent / 100.0 * limit:
+                continue  # not full enough
+            vids.add(v["id"])
+    return sorted(vids)
+
+
 @command("ec.encode")
 def cmd_ec_encode(env: CommandEnv, args, out):
-    """Convert a volume to EC shards and spread them
-    (reference: command_ec_encode.go:58-321)."""
+    """Convert volumes to EC shards and spread them (reference:
+    command_ec_encode.go:58-321).  With -volumeId, encodes that volume;
+    without it, scans the topology for candidates that are at least
+    -fullPercent full (default 95) and write-quiet for -quietFor
+    (default 1h) — the reference's fleet-wide operational loop."""
     env.require_lock()
     flags = parse_flags(args)
-    vid = int(flags["volumeId"])
     collection = flags.get("collection", "")
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    else:
+        full_percent = float(flags.get("fullPercent", "95"))
+        quiet = parse_duration(flags.get("quietFor", "1h"))
+        vids = collect_volume_ids_for_ec_encode(
+            env.topology(), collection, full_percent, quiet)
+        print(f"{len(vids)} volume(s) ≥{full_percent}% full and quiet "
+              f"for {quiet:.0f}s: {vids}", file=out)
+    for vid in vids:
+        _ec_encode_one(env, vid, collection, out)
 
+
+def _ec_encode_one(env: CommandEnv, vid: int, collection: str, out):
     locations = env.volume_locations(vid)
     if not locations:
         raise RuntimeError(f"volume {vid} not found")

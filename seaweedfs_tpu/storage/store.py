@@ -188,6 +188,7 @@ class Store:
                     "read_only": info.read_only,
                     "replica_placement": info.replica_placement,
                     "ttl": info.ttl, "version": info.version,
+                    "modified_at": v.last_modified,
                 })
             for vid, ev in loc.ec_volumes.items():
                 ec_shards.append({

@@ -79,6 +79,12 @@ class Volume:
         if existing:
             head = self._dat.read_at(0, SUPER_BLOCK_SIZE + 64 * 1024)
             self.super_block = SuperBlock.from_bytes(head)
+            try:
+                # a rebooted server must report when the volume last took a
+                # write (ec.encode -quietFor selection), not 0 = "forever"
+                self.last_modified = os.path.getmtime(self.dat_path)
+            except OSError:
+                pass
         else:
             self.super_block = SuperBlock(
                 version=version,

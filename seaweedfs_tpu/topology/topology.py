@@ -29,6 +29,7 @@ class VolumeState:
     replica_placement: str = "000"
     ttl: str = ""
     version: int = t.CURRENT_VERSION
+    modified_at: float = 0.0  # last write, for ec.encode quiet selection
 
 
 @dataclass
@@ -102,7 +103,22 @@ class Topology:
         self.ec_shard_locations: dict[int, dict[int, list[DataNode]]] = {}
         self.ec_collections: dict[int, str] = {}
         self.max_volume_id = 0
+        # volume-location delta hook (streamed vid-map updates, reference:
+        # master_grpc_server.go broadcastToClients): called with each vid
+        # whose location set changed; the master turns it into client
+        # push events
+        self.on_vid_change = None
         self._lock = threading.RLock()
+
+    def _vids_changed(self, vids) -> None:
+        cb = self.on_vid_change
+        if cb is None:
+            return
+        for vid in vids:
+            try:
+                cb(vid)
+            except Exception:  # a broken subscriber must not stall beats
+                pass
 
     # -- membership ----------------------------------------------------
 
@@ -128,6 +144,8 @@ class Topology:
             node.url, node.public_url = url, public_url or url
             node.last_seen = time.time()
             node.max_volume_count = beat.get("max_volume_count", node.max_volume_count)
+            prev_vids = set(node.volumes)
+            prev_ec = {vid for vid, s in node.ec_shards.items() if s}
 
             # unregister vanished volumes
             new_vids = {v["id"] for v in beat.get("volumes", [])}
@@ -145,7 +163,8 @@ class Topology:
                     deleted_bytes=vd.get("deleted_bytes", 0),
                     read_only=vd.get("read_only", False),
                     replica_placement=vd.get("replica_placement", "000"),
-                    ttl=vd.get("ttl", ""), version=vd.get("version", t.CURRENT_VERSION))
+                    ttl=vd.get("ttl", ""), version=vd.get("version", t.CURRENT_VERSION),
+                    modified_at=vd.get("modified_at", 0.0))
                 node.volumes[v.id] = v
                 self.layout(v.collection, v.replica_placement, v.ttl).register(v, node)
                 self.max_volume_id = max(self.max_volume_id, v.id)
@@ -172,6 +191,9 @@ class Topology:
                     if node not in nodes:
                         nodes.append(node)
                 self.max_volume_id = max(self.max_volume_id, vid)
+            new_ec = {vid for vid, s in node.ec_shards.items() if s}
+            self._vids_changed((prev_vids ^ new_vids)
+                               | (prev_ec ^ new_ec))
 
     def unregister_node(self, node_id: str) -> None:
         with self._lock:
@@ -185,6 +207,9 @@ class Topology:
                 for nodes in ec.values():
                     if node in nodes:
                         nodes.remove(node)
+            self._vids_changed(set(node.volumes)
+                               | {vid for vid, s in node.ec_shards.items()
+                                  if s})
 
     def expire_dead_nodes(self, timeout: float = 25.0) -> list[str]:
         now = time.time()
@@ -279,6 +304,7 @@ class Topology:
         with self._lock:
             return {
                 "max_volume_id": self.max_volume_id,
+                "volume_size_limit": self.volume_size_limit,
                 "nodes": {
                     nid: {
                         "url": n.url, "public_url": n.public_url,
@@ -290,7 +316,7 @@ class Topology:
                              "size": v.size, "file_count": v.file_count,
                              "read_only": v.read_only,
                              "replica_placement": v.replica_placement,
-                             "ttl": v.ttl}
+                             "ttl": v.ttl, "modified_at": v.modified_at}
                             for _, v in sorted(n.volumes.items())],
                         "ec_shards": {str(v): sorted(s)
                                       for v, s in n.ec_shards.items()},

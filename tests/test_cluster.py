@@ -368,3 +368,56 @@ def test_ec_generate_progress_and_cancel(tmp_path):
                                     cancel=cancel)
     finally:
         c.stop()
+
+
+def test_streamed_vid_map_invalidation(tmp_path):
+    """A client on the master's /cluster/stream push feed reroutes around
+    a dead volume server as soon as the master expires it — no stale
+    poll-TTL window (reference: wdclient KeepConnected + vid_map)."""
+    import urllib.request as _ur
+    from seaweedfs_tpu.client import WeedClient
+    c = Cluster(tmp_path, n_volume_servers=2, replication="001")
+    # fast failure detection for the test
+    c.start()
+    c.master.node_timeout = 1.5
+    c.wait_heartbeats()
+    try:
+        client = WeedClient(c.master.url, stream_updates=True)
+        poll_client = WeedClient(c.master.url)  # TTL-poll comparison
+        a = client.assign(replication="001")
+        fid = a["fid"]
+        vid = int(fid.split(",")[0])
+        client.upload_to(a["url"], fid, b"replicated-payload",
+                         jwt=a.get("auth", ""))
+        # wait for the replica heartbeat + stream snapshot to both arrive
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if len(client._vid_cache.get(vid, ([], 0))[0]) == 2:
+                break
+            time.sleep(0.1)
+        urls = client._vid_cache[vid][0]
+        assert len(urls) == 2
+        assert sorted(poll_client.lookup(vid)) == sorted(urls)
+        # kill the server the client would try first
+        dead = urls[0]
+        vs = next(v for v in c.volume_servers if v.url == dead)
+        c.submit(vs.stop())
+        c.volume_servers.remove(vs)
+        # the PUSH client's map drops the dead url once the master expires
+        # the node (~1.5s) — without any lookup from the client
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            cached = client._vid_cache.get(vid, ([], 0))[0]
+            if cached and dead not in cached:
+                break
+            time.sleep(0.1)
+        cached = client._vid_cache.get(vid, ([], 0))[0]
+        assert cached and dead not in cached, cached
+        # and the read served by the pushed map succeeds first try
+        assert client.download(fid) == b"replicated-payload"
+        # the poll client still holds the stale route inside its TTL
+        stale = poll_client._vid_cache.get(vid, ([], 0))[0]
+        assert dead in stale
+        client.close()
+    finally:
+        c.stop()

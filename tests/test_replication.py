@@ -274,7 +274,7 @@ def test_shell_help(tmp_path):
     assert "ec.encode" in out and "volume.balance" in out
     buf = io.StringIO()
     run_command(env, "help ec.encode", buf)
-    assert "Convert a volume to EC shards" in buf.getvalue()
+    assert "Convert volumes to EC shards" in buf.getvalue()
 
 
 def test_webhook_notification_queue():

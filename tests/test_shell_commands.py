@@ -585,3 +585,86 @@ def test_filer_remote_sync_loop(tmp_path):
     finally:
         c.submit(filer.stop())
         c.stop()
+
+
+def test_collect_volume_ids_for_ec_encode_snapshot():
+    """Pure topology-snapshot selection (reference:
+    collectVolumeIdsForEcEncode, command_ec_encode.go:290-321)."""
+    from seaweedfs_tpu.shell.commands import collect_volume_ids_for_ec_encode
+    now = time.time()
+    topo = {
+        "volume_size_limit": 100,
+        "nodes": {
+            "vs1": {"volume_infos": [
+                # full + quiet -> selected
+                {"id": 1, "collection": "", "size": 96,
+                 "modified_at": now - 7200},
+                # full but written recently -> skipped
+                {"id": 2, "collection": "", "size": 99,
+                 "modified_at": now - 10},
+                # quiet but not full -> skipped
+                {"id": 3, "collection": "", "size": 50,
+                 "modified_at": now - 7200},
+                # other collection -> skipped
+                {"id": 4, "collection": "pics", "size": 99,
+                 "modified_at": now - 7200},
+            ]},
+            "vs2": {"volume_infos": [
+                # replica of 1 on another node: still one candidate
+                {"id": 1, "collection": "", "size": 96,
+                 "modified_at": now - 7200},
+                {"id": 5, "collection": "", "size": 97,
+                 "modified_at": now - 7200},
+            ]},
+        },
+    }
+    got = collect_volume_ids_for_ec_encode(topo, "", 95, 3600)
+    assert got == [1, 5]
+    assert collect_volume_ids_for_ec_encode(topo, "pics", 95, 3600) == [4]
+    # zero quiet window admits the recently-written full volume too
+    assert collect_volume_ids_for_ec_encode(topo, "", 95, 0) == [1, 2, 5]
+
+
+def test_ec_encode_auto_selection(tmp_path):
+    """Without -volumeId, ec.encode scans the topology and encodes the
+    quiet+full volumes itself (2 of 3 here)."""
+    c = Cluster(tmp_path, n_volume_servers=1,
+                volume_size_limit=256 * 1024).start()
+    c.wait_heartbeats()
+    try:
+        client = WeedClient(c.master.url)
+        # grow to 3 volumes so three distinct ids exist; fill two of
+        # them past 50% of the 256KB size limit
+        import urllib.request as _ur
+        _ur.urlopen(_ur.Request(
+            f"http://{c.master.url}/vol/grow?count=3", data=b"",
+            method="POST"), timeout=15).read()
+        by_vid = {}
+        for i in range(64):
+            a = client.assign()
+            by_vid.setdefault(int(a["fid"].split(",")[0]), a)
+            if len(by_vid) >= 3:
+                break
+        assert len(by_vid) >= 3
+        vids = sorted(by_vid)[:3]
+        full, empty = vids[:2], vids[2:]
+        for vid in full:
+            a = by_vid[vid]
+            client.upload_to(a["url"], a["fid"], b"x" * 200 * 1024,
+                             jwt=a.get("auth", ""))
+        # heartbeat carries sizes + modified_at to the master
+        time.sleep(1.0)
+        env = CommandEnv(c.master.url)
+        shell(env, "lock")
+        out = shell(env, "ec.encode -quietFor 0s -fullPercent 50")
+        shell(env, "unlock")
+        for vid in full:
+            assert f"ec.encode {vid} done" in out
+        # the under-filled volume was not selected
+        for vid in empty:
+            assert f"ec.encode {vid} done" not in out
+        # encoded volumes now serve through the EC path
+        for vid in full:
+            assert client.download(by_vid[vid]["fid"]) == b"x" * 200 * 1024
+    finally:
+        c.stop()
