@@ -275,8 +275,22 @@ class Filer:
     def list_entries(self, dir_path: str, start_from: str = "",
                      include_start: bool = False, limit: int = 1024,
                      prefix: str = "") -> list[Entry]:
-        return self.store.list_directory_entries(
-            dir_path, start_from, include_start, limit, prefix)
+        """TTL'd-out entries (bucket lifecycle expiry) are invisible; the
+        rows are reaped lazily by find_entry, like the reference's filer
+        TTL handling.  Pages REFILL after filtering — a short page means
+        end-of-directory to every pagination consumer, so expired rows
+        must never shorten one."""
+        out: list[Entry] = []
+        cursor, inc = start_from, include_start
+        while len(out) < limit:
+            want = limit - len(out)
+            page = self.store.list_directory_entries(
+                dir_path, cursor, inc, want, prefix)
+            out.extend(e for e in page if not ttl_expired(e))
+            if len(page) < want:
+                break  # store exhausted
+            cursor, inc = page[-1].name, False
+        return out
 
     def iter_entries(self, dir_path: str, prefix: str = "",
                      batch: int = 1024) -> Iterator[Entry]:

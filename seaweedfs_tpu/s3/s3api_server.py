@@ -68,6 +68,16 @@ def _iso(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
 
 
+def _ttl_days(ttl: str) -> int:
+    """Filer ttl string -> whole lifecycle days (rounded up)."""
+    from seaweedfs_tpu.storage import types as _t
+    try:
+        minutes = _t.TTL.parse(ttl).minutes
+    except (KeyError, ValueError):
+        return 1
+    return max(1, -(-minutes // (24 * 60)))
+
+
 def _error_response(code: str, message: str, status: int,
                     resource: str = "") -> web.Response:
     root = ET.Element("Error")
@@ -442,9 +452,13 @@ class S3ApiServer:
         m = req.method
         if m == "PUT":
             self._require(ident, ACTION_WRITE, bucket)
+            if "lifecycle" in q:
+                return await self.put_bucket_lifecycle(bucket, body)
             return await self.put_bucket(bucket)
         if m == "DELETE":
             self._require(ident, ACTION_WRITE, bucket)
+            if "lifecycle" in q:
+                return await self.delete_bucket_lifecycle(bucket)
             return await self.delete_bucket(bucket)
         if m == "HEAD":
             self._require(ident, ACTION_LIST, bucket)
@@ -467,7 +481,9 @@ class S3ApiServer:
                 return await self.list_multipart_uploads(bucket)
             if "acl" in q:
                 return self._canned_acl(ident)
-            for sub in ("lifecycle", "policy", "cors", "website"):
+            if "lifecycle" in q:
+                return await self.get_bucket_lifecycle(bucket)
+            for sub in ("policy", "cors", "website"):
                 if sub in q:
                     return _error_response(
                         f"NoSuch{sub.capitalize()}Configuration",
@@ -499,6 +515,140 @@ class S3ApiServer:
         _el(grantee, "ID", ident.name)
         _el(grant, "Permission", "FULL_CONTROL")
         return web.Response(body=_xml(root), content_type="application/xml")
+
+    # -- bucket lifecycle (reference: s3api_bucket_handlers.go:313-400 —
+    #    expiry rules map onto per-prefix TTLs in the filer conf; the
+    #    filer's TTL machinery then ages objects out) ---------------------
+
+    async def _filer_conf(self) -> dict:
+        async with self._session.get(
+                f"{_tls_scheme()}://{self.filer_url}/__admin__/filer_conf",
+                headers=self._filer_auth(write=False)) as r:
+            return await r.json(content_type=None)
+
+    async def _filer_conf_put(self, conf: dict) -> None:
+        async with self._session.post(
+                f"{_tls_scheme()}://{self.filer_url}/__admin__/filer_conf",
+                json=conf, headers=self._filer_auth(write=True)) as r:
+            if r.status >= 300:
+                raise RuntimeError(f"filer conf update: {r.status}")
+
+    async def _bucket_missing(self, bucket: str) -> web.Response | None:
+        if await self._filer_meta(self._fp(bucket)) is None:
+            return _error_response("NoSuchBucket",
+                                   "The specified bucket does not exist",
+                                   404, bucket)
+        return None
+
+    async def put_bucket_lifecycle(self, bucket: str,
+                                   body: bytes) -> web.Response:
+        missing = await self._bucket_missing(bucket)
+        if missing is not None:
+            return missing
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            return _error_response("MalformedXML", "bad lifecycle XML", 400,
+                                   bucket)
+
+        def _find(el, tag):
+            # lifecycle docs come with or without the S3 namespace
+            found = el.find(f"{{{S3_XMLNS}}}{tag}")
+            return found if found is not None else el.find(tag)
+
+        new_rules: list[tuple[str, int]] = []  # (prefix, days)
+        for rule in list(root):
+            status = _find(rule, "Status")
+            if status is None or status.text != "Enabled":
+                continue
+            exp = _find(rule, "Expiration")
+            if exp is None:
+                continue
+            days_el = _find(exp, "Days")
+            if days_el is None:
+                continue
+            try:
+                days = int(days_el.text)
+            except (TypeError, ValueError):
+                return _error_response("MalformedXML", "bad Days", 400,
+                                       bucket)
+            if days <= 0:
+                return _error_response(
+                    "InvalidArgument", "Days must be positive", 400, bucket)
+            prefix = ""
+            filt = _find(rule, "Filter")
+            pfx_el = _find(filt, "Prefix") if filt is not None else \
+                _find(rule, "Prefix")
+            if pfx_el is not None and pfx_el.text:
+                prefix = pfx_el.text
+            new_rules.append((prefix, days))
+
+        # the put REPLACES this bucket's expiry rules via per-prefix
+        # upserts/deletes, so concurrent lifecycle updates on OTHER
+        # buckets/prefixes compose instead of clobbering each other
+        conf = await self._filer_conf()
+        bucket_root = f"{self.buckets_dir}/{bucket}/"
+        old = {r["location_prefix"]: r for r in conf.get("locations", [])
+               if r.get("location_prefix", "").startswith(bucket_root)
+               and r.get("ttl")}
+        new_prefixes = {bucket_root + p for p, _ in new_rules}
+        for stale in set(old) - new_prefixes:
+            await self._filer_conf_put({"delete_prefix": stale})
+        for prefix, days in new_rules:
+            loc_prefix = bucket_root + prefix
+            merged = dict(old.get(loc_prefix)
+                          or {"location_prefix": loc_prefix,
+                              "collection": bucket})
+            merged["ttl"] = f"{days}d"
+            await self._filer_conf_put(merged)
+        return web.Response(status=200)
+
+    async def get_bucket_lifecycle(self, bucket: str) -> web.Response:
+        missing = await self._bucket_missing(bucket)
+        if missing is not None:
+            return missing
+        conf = await self._filer_conf()
+        bucket_root = f"{self.buckets_dir}/{bucket}/"
+        rules = [(r["location_prefix"][len(bucket_root):], r["ttl"])
+                 for r in conf.get("locations", [])
+                 if r.get("location_prefix", "").startswith(bucket_root)
+                 and r.get("ttl")]
+        if not rules:
+            return _error_response(
+                "NoSuchLifecycleConfiguration",
+                "The lifecycle configuration does not exist", 404, bucket)
+        root = ET.Element("LifecycleConfiguration", xmlns=S3_XMLNS)
+        for prefix, ttl in sorted(rules):
+            rule = _el(root, "Rule")
+            _el(rule, "ID", prefix or bucket)
+            filt = _el(rule, "Filter")
+            _el(filt, "Prefix", prefix)
+            _el(rule, "Status", "Enabled")
+            exp = _el(rule, "Expiration")
+            _el(exp, "Days", str(_ttl_days(ttl)))
+        return web.Response(body=_xml(root),
+                            content_type="application/xml")
+
+    async def delete_bucket_lifecycle(self, bucket: str) -> web.Response:
+        missing = await self._bucket_missing(bucket)
+        if missing is not None:
+            return missing
+        conf = await self._filer_conf()
+        bucket_root = f"{self.buckets_dir}/{bucket}/"
+        for r in conf.get("locations", []):
+            if not (r.get("location_prefix", "").startswith(bucket_root)
+                    and r.get("ttl")):
+                continue
+            keeps_other_settings = any(
+                r.get(k) for k in ("replication", "fsync", "disk_type",
+                                   "read_only")) or \
+                r.get("collection") not in ("", bucket)
+            if keeps_other_settings:
+                await self._filer_conf_put(dict(r, ttl=""))
+            else:  # the rule only carried the ttl: drop it entirely
+                await self._filer_conf_put(
+                    {"delete_prefix": r["location_prefix"]})
+        return web.Response(status=204)
 
     async def put_bucket(self, bucket: str) -> web.Response:
         if not _valid_bucket_name(bucket):

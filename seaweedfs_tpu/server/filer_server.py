@@ -1098,6 +1098,11 @@ class FilerServer:
         body = await req.json()
         if "locations" in body:
             self.conf = FilerConf.from_json(json.dumps(body))
+        elif "delete_prefix" in body:
+            # per-prefix ops let concurrent writers (e.g. two buckets'
+            # lifecycle updates) compose instead of clobbering the
+            # whole document
+            self.conf.delete_prefix(body["delete_prefix"])
         else:
             self.conf.upsert(PathConf(**{
                 k: v for k, v in body.items()

@@ -294,9 +294,10 @@ class MasterServer:
             return web.json_response({"error": "raft disabled"}, status=400)
         body = await req.json()
         peer = body.get("peer", "")
-        if peer and peer != self.raft.cfg.node_id and \
-                peer not in self.raft.cfg.peers:
-            self.raft.cfg.peers.append(peer)
+        if peer:
+            # persists with the raft state, so a master restart keeps the
+            # operated-in membership instead of reverting to CLI -peers
+            self.raft.add_peer(peer)
         return web.json_response({"peers": self.raft.cfg.peers})
 
     async def handle_raft_peer_remove(self, req: web.Request) -> web.Response:
@@ -304,10 +305,8 @@ class MasterServer:
             return web.json_response({"error": "raft disabled"}, status=400)
         body = await req.json()
         peer = body.get("peer", "")
-        if peer in self.raft.cfg.peers:
-            self.raft.cfg.peers.remove(peer)
-            self.raft.next_index.pop(peer, None)
-            self.raft.match_index.pop(peer, None)
+        if peer:
+            self.raft.remove_peer(peer)
         return web.json_response({"peers": self.raft.cfg.peers})
 
     async def handle_vacuum(self, req: web.Request) -> web.Response:

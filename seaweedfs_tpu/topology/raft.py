@@ -11,6 +11,17 @@ The state machine here replicates the only hard state the reference
 master persists: volume-id allocations (MaxVolumeId) and admin-lock
 transitions.  Heartbeat-derived topology is soft state and rebuilt by
 volume servers re-reporting, exactly as in the reference.
+
+Robustness under CPU contention (this was a measured flake source):
+ - one long-lived replicator thread per peer batches appends and doubles
+   as the heartbeat, instead of spawning a thread per peer per 50ms tick
+ - pre-vote (raft §9.6 / hashicorp raft PreVote): a node that missed
+   heartbeats polls peers WITHOUT bumping its term first; peers that have
+   heard from a live leader recently refuse, so a starved node cannot
+   depose a healthy leader with a higher term
+ - propose() blocks on a condition, not a poll loop
+ - runtime membership changes persist with the raft state, so a restart
+   keeps the operated-in peer set rather than reverting to CLI flags
 """
 
 from __future__ import annotations
@@ -84,6 +95,14 @@ class RaftNode:
 
         self._lock = threading.RLock()
         self._apply_cv = threading.Condition(self._lock)
+        self._repl_cv = threading.Condition(self._lock)
+        self._replicators: dict[str, threading.Thread] = {}
+        self._last_sent: dict[str, float] = {}
+        # log slots awaited by in-flight propose() calls: compaction skips
+        # them so the committed-in-our-term check can always run (without
+        # this a fast compaction makes commitment unverifiable and the
+        # proposer would re-propose a possibly-applied command)
+        self._pending_proposals: set[int] = set()
         # serializes apply_command batches against snapshot restores so a
         # restored snapshot can never be followed by re-application of
         # entries it already covers (double-apply)
@@ -110,6 +129,10 @@ class RaftNode:
             self.snap_index = d.get("snap_index", -1)
             self.snap_term = d.get("snap_term", 0)
             self._snapshot_data = d.get("snapshot")
+            if "peers" in d:
+                # runtime membership changes survive a restart (the
+                # reference persists configuration through the raft log)
+                self.cfg.peers = list(d["peers"])
         except (OSError, ValueError):
             log.warning("raft state load failed; starting fresh")
             return
@@ -132,7 +155,8 @@ class RaftNode:
                        "log": [e.to_dict() for e in self.log],
                        "snap_index": self.snap_index,
                        "snap_term": self.snap_term,
-                       "snapshot": self._snapshot_data}, f)
+                       "snapshot": self._snapshot_data,
+                       "peers": self.cfg.peers}, f)
         os.replace(tmp, p)
 
     # -- index math (absolute <-> log position) --------------------------
@@ -158,11 +182,14 @@ class RaftNode:
                                   name=f"raft-{target.__name__}")
             th.start()
             self._threads.append(th)
+        with self._lock:
+            self._ensure_replicators_locked()
 
     def stop(self) -> None:
         self._stop.set()
         with self._apply_cv:
             self._apply_cv.notify_all()
+            self._repl_cv.notify_all()
 
     @property
     def is_leader(self) -> bool:
@@ -184,40 +211,66 @@ class RaftNode:
             time.sleep(0.01)
             with self._lock:
                 if self.state == LEADER:
-                    self._send_heartbeats_locked()
-                    elapsed = 0.0
-                else:
-                    elapsed = time.monotonic() - self._last_heartbeat
-            if self.state == LEADER:
-                time.sleep(self.cfg.heartbeat_ms / 1000.0)
-                continue
+                    continue  # replicator threads carry the heartbeats
+                elapsed = time.monotonic() - self._last_heartbeat
             if elapsed >= timeout:
                 self._run_election()
                 timeout = self._election_timeout()
 
-    def _run_election(self) -> None:
-        with self._lock:
-            self.state = CANDIDATE
-            self.current_term += 1
-            term = self.current_term
-            self.voted_for = self.cfg.node_id
-            self._save_state()
-            self._last_heartbeat = time.monotonic()
-            last_idx = self._last_index_locked()
-            last_term = self._term_at_locked(last_idx) if last_idx >= 0 else 0
+    def _collect_votes(self, term: int, last_idx: int, last_term: int,
+                       pre: bool) -> int | None:
+        """One voting round; -> granted count, or None if a higher term
+        was observed (we stepped down)."""
         votes = 1
         for peer in self.cfg.peers:
-            resp = self.transport(peer, "request_vote", {
-                "term": term, "candidate_id": self.cfg.node_id,
-                "last_log_index": last_idx, "last_log_term": last_term})
+            payload = {"term": term, "candidate_id": self.cfg.node_id,
+                       "last_log_index": last_idx,
+                       "last_log_term": last_term}
+            if pre:
+                payload["pre"] = True
+            resp = self.transport(peer, "request_vote", payload)
             if resp is None:
                 continue
             with self._lock:
                 if resp.get("term", 0) > self.current_term:
                     self._become_follower(resp["term"], None)
-                    return
+                    return None
             if resp.get("vote_granted"):
                 votes += 1
+        return votes
+
+    def _run_election(self) -> None:
+        with self._lock:
+            term = self.current_term + 1
+            last_idx = self._last_index_locked()
+            last_term = self._term_at_locked(last_idx) if last_idx >= 0 else 0
+            has_peers = bool(self.cfg.peers)
+        if has_peers:
+            # pre-vote round: probe electability WITHOUT bumping the term.
+            # Peers in contact with a live leader refuse, so a CPU-starved
+            # or partitioned node rejoining cannot disrupt a stable quorum.
+            votes = self._collect_votes(term, last_idx, last_term, pre=True)
+            if votes is None or votes < self.quorum():
+                with self._lock:
+                    # back off a full election timeout before re-probing,
+                    # or a partitioned node pre-vote-storms every peer
+                    self._last_heartbeat = time.monotonic()
+                return
+        with self._lock:
+            if self.current_term >= term or self.state == LEADER:
+                # a concurrent RPC moved the term (or elected us) while
+                # the lock was released for the pre-vote round; bumping
+                # current_term DOWN here would reset voted_for and allow
+                # a double vote in the newer term
+                return
+            self.state = CANDIDATE
+            self.current_term = term
+            self.voted_for = self.cfg.node_id
+            self._save_state()
+            self._last_heartbeat = time.monotonic()
+        votes = self._collect_votes(term, last_idx, last_term, pre=False)
+        if votes is None:
+            return
         with self._lock:
             if self.state != CANDIDATE or self.current_term != term:
                 return
@@ -229,7 +282,8 @@ class RaftNode:
                 self.match_index = {p: -1 for p in self.cfg.peers}
                 log.info("%s elected leader for term %d (%d votes)",
                          self.cfg.node_id, term, votes)
-                self._send_heartbeats_locked()
+                self._ensure_replicators_locked()
+                self._repl_cv.notify_all()
                 self.on_leadership_change(True)
 
     def _become_follower(self, term: int, leader: str | None) -> None:
@@ -241,16 +295,78 @@ class RaftNode:
             self.leader_id = leader
         self._save_state()
         self._last_heartbeat = time.monotonic()
+        self._apply_cv.notify_all()  # wake proposers blocked on commit
         if was_leader:
             self.on_leadership_change(False)
 
+    # -- runtime membership (persisted with the raft state) --------------
+
+    def add_peer(self, peer: str) -> None:
+        with self._lock:
+            if peer == self.cfg.node_id or peer in self.cfg.peers:
+                return
+            self.cfg.peers.append(peer)
+            self.next_index[peer] = self._last_index_locked() + 1
+            self.match_index[peer] = -1
+            self._ensure_replicators_locked()
+            self._save_state()
+
+    def remove_peer(self, peer: str) -> None:
+        with self._lock:
+            if peer not in self.cfg.peers:
+                return
+            self.cfg.peers.remove(peer)
+            self.next_index.pop(peer, None)
+            self.match_index.pop(peer, None)
+            self._save_state()
+            self._repl_cv.notify_all()  # its replicator thread exits
+
     # -- replication ----------------------------------------------------
 
-    def _send_heartbeats_locked(self) -> None:
-        term = self.current_term
+    def _ensure_replicators_locked(self) -> None:
+        """One long-lived batching replicator thread per peer: it IS the
+        heartbeat (empty batch when idle), and proposals just wake it —
+        no thread churn per tick, which matters under CPU contention."""
         for peer in self.cfg.peers:
-            threading.Thread(target=self._replicate_to, args=(peer, term),
-                             daemon=True).start()
+            th = self._replicators.get(peer)
+            if th is not None and th.is_alive():
+                continue
+            th = threading.Thread(target=self._replicator, args=(peer,),
+                                  daemon=True, name=f"raft-repl-{peer}")
+            self._replicators[peer] = th
+            th.start()
+
+    def _replicator(self, peer: str) -> None:
+        hb = self.cfg.heartbeat_ms / 1000.0
+        while not self._stop.is_set():
+            with self._lock:
+                if peer not in self.cfg.peers:
+                    self._replicators.pop(peer, None)
+                    return
+                if self.state != LEADER:
+                    self._repl_cv.wait(0.2)
+                    continue
+                term = self.current_term
+                due = self._last_sent.get(peer, 0.0) + hb - time.monotonic()
+                pending = self._last_index_locked() >= \
+                    self.next_index.get(peer, 0)
+                if due > 0 and not pending:
+                    self._repl_cv.wait(due)
+                    if self.state != LEADER or \
+                            (time.monotonic() <
+                             self._last_sent.get(peer, 0.0) + hb and
+                             self._last_index_locked() <
+                             self.next_index.get(peer, 0)):
+                        continue
+                    term = self.current_term
+                self._last_sent[peer] = time.monotonic()
+            try:
+                self._replicate_to(peer, term)
+            except Exception:
+                # the thread is this peer's ONLY replication channel — an
+                # exception (e.g. an index race during truncation) must
+                # never kill it
+                log.exception("replication to %s failed", peer)
 
     def _replicate_to(self, peer: str, term: int) -> None:
         with self._lock:
@@ -286,19 +402,26 @@ class RaftNode:
                 return
             if self.state != LEADER or self.current_term != term:
                 return
+            # monotonic guard: overlapping in-flight RPCs mean a stale
+            # response can arrive late — match_index must never regress
+            # below already-acknowledged entries
             if rpc == "install_snapshot":
                 if resp.get("success"):
-                    self.match_index[peer] = payload["last_included_index"]
-                    self.next_index[peer] = \
-                        payload["last_included_index"] + 1
+                    self.match_index[peer] = max(
+                        self.match_index.get(peer, -1),
+                        payload["last_included_index"])
+                    self.next_index[peer] = self.match_index[peer] + 1
                 return
             if resp.get("success"):
-                self.match_index[peer] = \
-                    payload["prev_log_index"] + len(payload["entries"])
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, -1),
+                    payload["prev_log_index"] + len(payload["entries"]))
                 self.next_index[peer] = self.match_index[peer] + 1
                 self._advance_commit_locked()
             else:
-                self.next_index[peer] = max(self.snap_index + 1, ni - 1)
+                self.next_index[peer] = max(self.snap_index + 1,
+                                            self.match_index.get(peer, -1)
+                                            + 1, ni - 1)
 
     def _advance_commit_locked(self) -> None:
         for n in range(self._last_index_locked(), self.commit_index, -1):
@@ -343,6 +466,12 @@ class RaftNode:
         if len(self.log) < self.cfg.snapshot_threshold:
             return
         upto = self.last_applied
+        if self._pending_proposals and min(self._pending_proposals) <= upto:
+            # a snapshot can only be cut exactly at last_applied (that is
+            # what take_snapshot() captures) — so while a proposer still
+            # needs its slot's term for the commit check, DEFER compaction
+            # entirely rather than mislabel the snapshot's coverage
+            return
         if upto <= self.snap_index:
             return
         data = self.take_snapshot()
@@ -358,26 +487,58 @@ class RaftNode:
     # -- client API -----------------------------------------------------
 
     def propose(self, command: dict, timeout: float = 5.0) -> bool:
-        """Leader-only: append + replicate + wait for commit."""
+        """Leader-only: append + replicate + wait for commit.
+
+        Survives leadership churn within the window: if this node is
+        deposed mid-flight it waits for a re-election; the entry counts
+        as committed only if the slot it was appended to still carries
+        the term it was appended in (the standard client check), and is
+        re-appended after a re-election when a competing leader's log
+        truncated it away."""
+        deadline = time.monotonic() + timeout
         with self._lock:
             if self.state != LEADER:
                 return False
-            self.log.append(LogEntry(self.current_term, command))
-            self._save_state()
-            index = self._last_index_locked()
-            if not self.cfg.peers:  # single-node cluster commits instantly
-                self.commit_index = index
-                self._apply_cv.notify_all()
-            else:
-                self._send_heartbeats_locked()
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if self.commit_index >= index:
-                    return True
-                if self.state != LEADER:
-                    return False
-            time.sleep(0.005)
+            index: int | None = None
+            append_term = 0
+            try:
+                while time.monotonic() < deadline and \
+                        not self._stop.is_set():
+                    if index is None and self.state == LEADER:
+                        self.log.append(LogEntry(self.current_term,
+                                                 command))
+                        self._save_state()
+                        index = self._last_index_locked()
+                        append_term = self.current_term
+                        self._pending_proposals.add(index)
+                        if not self.cfg.peers:  # single-node: instant
+                            self.commit_index = index
+                            self._apply_cv.notify_all()
+                        else:
+                            self._repl_cv.notify_all()
+                    if index is not None and self.commit_index >= index:
+                        if index > self.snap_index and \
+                                self._term_at_locked(index) == append_term:
+                            return True
+                        # our slot was overwritten by a competing leader
+                        # (or covered by ITS InstallSnapshot): commitment
+                        # of OUR command is unverifiable — re-propose
+                        # (at-least-once; master commands tolerate it)
+                        self._pending_proposals.discard(index)
+                        index = None
+                        continue
+                    if index is not None and self.state != LEADER and \
+                            self._last_index_locked() < index:
+                        # deposed AND our tail was truncated: re-append
+                        # once this node regains leadership
+                        self._pending_proposals.discard(index)
+                        index = None
+                    self._apply_cv.wait(
+                        min(0.1, max(0.001,
+                                     deadline - time.monotonic())))
+            finally:
+                if index is not None:
+                    self._pending_proposals.discard(index)
         return False
 
     # -- RPC handlers (called by the transport server) -------------------
@@ -385,16 +546,29 @@ class RaftNode:
     def handle_request_vote(self, req: dict) -> dict:
         with self._lock:
             term = req["term"]
+            my_last_idx = self._last_index_locked()
+            my_last_term = self._term_at_locked(my_last_idx) \
+                if my_last_idx >= 0 else 0
+            up_to_date = (req["last_log_term"], req["last_log_index"]) \
+                >= (my_last_term, my_last_idx)
+            if req.get("pre"):
+                # pre-vote (raft §9.6): no state change, no persistence —
+                # granted only if we would vote AND we are not hearing
+                # from a live leader (lease check), so a rejoining node
+                # cannot depose a healthy one
+                lease = self.cfg.election_timeout_ms[0] / 1000.0
+                leaderless = self.state == CANDIDATE or \
+                    (self.state != LEADER and
+                     time.monotonic() - self._last_heartbeat >= lease)
+                granted = term >= self.current_term and up_to_date and \
+                    leaderless
+                return {"term": self.current_term,
+                        "vote_granted": bool(granted)}
             if term > self.current_term:
                 self._become_follower(term, None)
             granted = False
             if term == self.current_term and \
                     self.voted_for in (None, req["candidate_id"]):
-                my_last_idx = self._last_index_locked()
-                my_last_term = self._term_at_locked(my_last_idx) \
-                    if my_last_idx >= 0 else 0
-                up_to_date = (req["last_log_term"], req["last_log_index"]) \
-                    >= (my_last_term, my_last_idx)
                 if up_to_date:
                     granted = True
                     self.voted_for = req["candidate_id"]

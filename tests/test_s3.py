@@ -40,7 +40,8 @@ class S3Stack:
 
         self.master = MasterServer("127.0.0.1", free_port())
         self.vs = VolumeServer([str(self.tmp / "v")], self.master.url,
-                               port=free_port(), heartbeat_interval=0.2)
+                               port=free_port(), heartbeat_interval=0.2,
+                               max_volumes=48)
         self.filer = FilerServer(self.master.url, port=free_port(),
                                  data_dir=str(self.tmp / "f"))
         iam = IdentityAccessManagement([
@@ -617,3 +618,64 @@ class TestPostPolicyAndBreaker:
             assert form("scoped-bucket", "up/z.bin") == 403
         finally:
             policy_b64, sig = policy_b64_save, sig_save
+
+
+class TestBucketLifecycle:
+    """Expiry rules mapped to filer-conf TTLs (reference:
+    s3api_bucket_handlers.go:313-400 Get/PutBucketLifecycleConfiguration)."""
+
+    LIFECYCLE = (b'<LifecycleConfiguration>'
+                 b'<Rule><ID>logs</ID><Status>Enabled</Status>'
+                 b'<Filter><Prefix>logs/</Prefix></Filter>'
+                 b'<Expiration><Days>1</Days></Expiration></Rule>'
+                 b'<Rule><ID>off</ID><Status>Disabled</Status>'
+                 b'<Filter><Prefix>keep/</Prefix></Filter>'
+                 b'<Expiration><Days>2</Days></Expiration></Rule>'
+                 b'</LifecycleConfiguration>')
+
+    def test_lifecycle_roundtrip_and_expiry(self, stack):
+        stack.req("PUT", "/lc-bucket")
+        # no config yet
+        st, body, _ = stack.req("GET", "/lc-bucket", query={"lifecycle": ""})
+        assert st == 404 and b"NoSuchLifecycleConfiguration" in body
+        # put: only the Enabled rule lands
+        st, _, _ = stack.req("PUT", "/lc-bucket", data=self.LIFECYCLE,
+                             query={"lifecycle": ""})
+        assert st == 200
+        st, body, _ = stack.req("GET", "/lc-bucket", query={"lifecycle": ""})
+        assert st == 200
+        root = _xml(body)
+        prefixes = [e.text for e in _find_all(root, "Prefix")]
+        days = [e.text for e in _find_all(root, "Days")]
+        assert prefixes == ["logs/"] and days == ["1"]
+        # new objects under the rule prefix inherit the TTL...
+        st, body, _ = stack.req("PUT", "/lc-bucket/logs/app.log",
+                                data=b"expiring")
+        assert st == 200, body
+        st, body, _ = stack.req("PUT", "/lc-bucket/other.txt",
+                                data=b"durable")
+        assert st == 200, body
+        meta = stack.filer.filer.find_entry(
+            "/buckets/lc-bucket/logs/app.log")
+        assert meta.attr.ttl_sec == 86400
+        assert stack.filer.filer.find_entry(
+            "/buckets/lc-bucket/other.txt").attr.ttl_sec == 0
+        # ...and age out: push the object's birth past its TTL and it
+        # vanishes from GET and listings
+        meta.attr.crtime -= 86401
+        stack.filer.filer.store.update_entry(meta)
+        st, _, _ = stack.req("GET", "/lc-bucket/logs/app.log")
+        assert st == 404
+        st, body, _ = stack.req("GET", "/lc-bucket")
+        keys = [e.text for e in _find_all(_xml(body), "Key")]
+        assert "logs/app.log" not in keys and "other.txt" in keys
+        # delete config
+        st, _, _ = stack.req("DELETE", "/lc-bucket",
+                             query={"lifecycle": ""})
+        assert st == 204
+        st, _, _ = stack.req("GET", "/lc-bucket", query={"lifecycle": ""})
+        assert st == 404
+        # objects written after the delete carry no TTL
+        stack.req("PUT", "/lc-bucket/logs/later.log", data=b"kept")
+        assert stack.filer.filer.find_entry(
+            "/buckets/lc-bucket/logs/later.log").attr.ttl_sec == 0
