@@ -412,3 +412,244 @@ try:  # pragma: no cover - depends on environment
     STORES["etcd"] = EtcdStore
 except ImportError:
     pass
+
+
+class CassandraStore(FilerStore):
+    """Wide-column store: one partition per directory, children as
+    clustering rows (reference: weed/filer/cassandra/cassandra_store.go —
+    table filemeta(directory, name, meta) PRIMARY KEY ((directory), name)).
+
+    The session is injectable: production wires a cassandra-driver
+    Session (registration below is gated on that SDK, like the
+    reference's build-tag-gated drivers); tests drive the identical CQL
+    through an in-memory fake, so the SPI semantics are covered even
+    where no cluster exists."""
+
+    name = "cassandra"
+
+    CREATE = (
+        "CREATE TABLE IF NOT EXISTS filemeta (directory text, name text,"
+        " meta blob, PRIMARY KEY ((directory), name))",
+        "CREATE TABLE IF NOT EXISTS kv (key blob PRIMARY KEY, value blob)",
+        # directory registry: partitions can't be range-scanned, so
+        # subtree deletes find their directories through this ordered
+        # single-partition index
+        "CREATE TABLE IF NOT EXISTS dirlist (bucket int, directory text,"
+        " PRIMARY KEY ((bucket), directory))",
+    )
+
+    def __init__(self, hosts: list[str] | None = None,
+                 keyspace: str = "seaweedfs", username: str = "",
+                 password: str = "", session=None):
+        if session is None:  # pragma: no cover - needs a live cluster
+            from cassandra.cluster import Cluster
+            from cassandra.auth import PlainTextAuthProvider
+            auth = PlainTextAuthProvider(username, password) \
+                if username else None
+            cluster = Cluster(hosts or ["127.0.0.1"], auth_provider=auth)
+            session = cluster.connect(keyspace)
+        self.s = session
+        for ddl in self.CREATE:
+            self.s.execute(ddl)
+
+    @staticmethod
+    def _dir_name(full_path: str) -> tuple[str, str]:
+        d, _, n = full_path.rpartition("/")
+        return d or "/", n
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = self._dir_name(entry.full_path)
+        self.s.execute(
+            "INSERT INTO filemeta (directory, name, meta) VALUES "
+            "(%s, %s, %s)",
+            (d, n, json.dumps(entry.to_dict()).encode()))
+        self.s.execute(
+            "INSERT INTO dirlist (bucket, directory) VALUES (0, %s)", (d,))
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = self._dir_name(full_path)
+        rows = list(self.s.execute(
+            "SELECT meta FROM filemeta WHERE directory=%s AND name=%s",
+            (d, n)))
+        if not rows:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(bytes(rows[0][0])))
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = self._dir_name(full_path)
+        self.s.execute(
+            "DELETE FROM filemeta WHERE directory=%s AND name=%s", (d, n))
+
+    def delete_folder_children(self, full_path: str) -> None:
+        """Drop the whole subtree, including children whose intermediate
+        directories have no entry row of their own: the dirlist index
+        names every directory partition under the prefix.  `base + '0'`
+        is the byte after '/', so '/topaz' never matches a '/top'
+        delete."""
+        base = full_path.rstrip("/") or "/"
+        rows = self.s.execute(
+            "SELECT directory FROM dirlist WHERE bucket=0 AND "
+            "directory>=%s AND directory<%s", (base, base + "0"))
+        for (d,) in list(rows):
+            if d != base and not d.startswith(base + "/"):
+                continue
+            self.s.execute("DELETE FROM filemeta WHERE directory=%s", (d,))
+            self.s.execute(
+                "DELETE FROM dirlist WHERE bucket=0 AND directory=%s",
+                (d,))
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        if start_from:
+            op = ">=" if include_start else ">"
+            rows = self.s.execute(
+                f"SELECT meta FROM filemeta WHERE directory=%s AND "
+                f"name{op}%s", (d, start_from))
+        else:
+            rows = self.s.execute(
+                "SELECT meta FROM filemeta WHERE directory=%s", (d,))
+        out = []
+        for row in rows:  # rows come back clustering-ordered by name
+            e = Entry.from_dict(json.loads(bytes(row[0])))
+            if prefix and not e.name.startswith(prefix):
+                continue
+            out.append(e)
+            if len(out) >= limit:
+                break
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.s.execute("INSERT INTO kv (key, value) VALUES (%s, %s)",
+                       (key, value))
+
+    def kv_get(self, key: bytes) -> bytes:
+        rows = list(self.s.execute(
+            "SELECT value FROM kv WHERE key=%s", (key,)))
+        if not rows:
+            raise NotFound(key.decode(errors="replace"))
+        return bytes(rows[0][0])
+
+    def kv_delete(self, key: bytes) -> None:
+        self.s.execute("DELETE FROM kv WHERE key=%s", (key,))
+
+
+try:  # pragma: no cover - depends on environment
+    import cassandra  # noqa: F401
+    STORES["cassandra"] = CassandraStore
+except ImportError:
+    pass
+
+
+ENTRY_SEP = b"\x00"      # sorts before every printable byte: a directory's
+KV_PREFIX = b"kv\x01"    # children scan contiguously, subdirs don't mix
+
+
+class TikvStore(FilerStore):
+    """Ordered-KV store over a TiKV RawKV-style client (reference:
+    weed/filer/tikv/tikv_store.go).  Entry key = <dir>\\x00<name>, so one
+    prefix scan lists a directory in name order.
+
+    The client is injectable (put/get/delete/scan(start, end, limit) over
+    byte keys): production wires tikv_client.RawClient (registration
+    gated on that SDK); tests run the matrix on an in-memory ordered
+    fake."""
+
+    name = "tikv"
+
+    def __init__(self, pd_addrs: list[str] | None = None, client=None):
+        if client is None:  # pragma: no cover - needs a live cluster
+            from tikv_client import RawClient
+            client = RawClient.connect(pd_addrs or ["127.0.0.1:2379"])
+        self.c = client
+
+    @staticmethod
+    def _ekey(full_path: str) -> bytes:
+        d, _, n = full_path.rpartition("/")
+        return (d or "/").encode() + ENTRY_SEP + n.encode()
+
+    def insert_entry(self, entry: Entry) -> None:
+        self.c.put(self._ekey(entry.full_path),
+                   json.dumps(entry.to_dict()).encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        raw = self.c.get(self._ekey(full_path))
+        if raw is None:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(raw))
+
+    def delete_entry(self, full_path: str) -> None:
+        self.c.delete(self._ekey(full_path))
+
+    def delete_folder_children(self, full_path: str) -> None:
+        """Two range deletes cover the whole subtree even when
+        intermediate directories have no entry row: the directory's own
+        children ('<dir>\\x00...') and every nested directory's
+        ('<dir>/...\\x00...', bounded by '<dir>0' — the byte after '/' —
+        so '/topaz' never matches a '/top' delete)."""
+        base = (full_path.rstrip("/") or "/").encode()
+        for start, end in ((base + ENTRY_SEP, base + ENTRY_SEP + b"\xff" * 8),
+                           (base + b"/", base + b"0")):
+            while True:
+                batch = self.c.scan(start, end, 1024)
+                if not batch:
+                    break
+                for k, _ in batch:
+                    self.c.delete(k)
+                start = batch[-1][0] + b"\x00"
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = (dir_path.rstrip("/") or "/").encode()
+        start = d + ENTRY_SEP + start_from.encode() if start_from \
+            else d + ENTRY_SEP
+        end = d + ENTRY_SEP + b"\xff" * 8
+        out: list[Entry] = []
+        skip_first_eq = bool(start_from) and not include_start
+        while len(out) < limit:
+            batch = self.c.scan(start, end, min(1024, limit - len(out) + 1))
+            if not batch:
+                break
+            for k, v in batch:
+                if skip_first_eq and k == d + ENTRY_SEP + start_from.encode():
+                    continue
+                e = Entry.from_dict(json.loads(v))
+                if prefix and not e.name.startswith(prefix):
+                    continue
+                out.append(e)
+                if len(out) >= limit:
+                    break
+            last_k = batch[-1][0]
+            if len(batch) < min(1024, limit - len(out) + 1) or \
+                    last_k >= end:
+                break
+            start = last_k + b"\x00"
+            skip_first_eq = False
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.c.put(KV_PREFIX + key, value)
+
+    def kv_get(self, key: bytes) -> bytes:
+        raw = self.c.get(KV_PREFIX + key)
+        if raw is None:
+            raise NotFound(key.decode(errors="replace"))
+        return raw
+
+    def kv_delete(self, key: bytes) -> None:
+        self.c.delete(KV_PREFIX + key)
+
+
+try:  # pragma: no cover - depends on environment
+    import tikv_client  # noqa: F401
+    STORES["tikv"] = TikvStore
+except ImportError:
+    pass

@@ -352,8 +352,14 @@ def _filer_walk(filer_url: str, dir_path: str, timeout: float = 60.0):
                 try:
                     with urllib.request.urlopen(murl, timeout=timeout) as r2:
                         meta = json.loads(r2.read())
-                except urllib.error.HTTPError:
-                    continue
+                except urllib.error.HTTPError as err:
+                    if err.code == 404:
+                        continue  # entry vanished between list and fetch
+                    # same contract as the directory listing above: a
+                    # transient failure must abort loudly — a skipped
+                    # entry's key would miss seen_keys and the sync would
+                    # stamp a placeholder over live content
+                    raise
                 yield p, meta
 
 
@@ -406,6 +412,21 @@ def meta_sync_remote_to_filer(remote: RemoteStorageClient, filer_url: str,
         if key in seen_keys:
             continue
         path = mount_dir + "/" + e.key
+        # never stamp a placeholder over an entry this mapping does not
+        # manage: a locally-created file whose name collides with a
+        # remote key keeps its content (the operator resolves the clash)
+        try:
+            murl = (f"{_tls_scheme()}://{filer_url}"
+                    f"{urllib.parse.quote(path)}?metadata=true")
+            with urllib.request.urlopen(murl, timeout=timeout) as r:
+                existing = json.loads(r.read())
+            ext = {k.lower(): v
+                   for k, v in (existing.get("extended") or {}).items()}
+            if "remote-key" not in ext:
+                continue
+        except urllib.error.HTTPError as err:
+            if err.code != 404:
+                raise
         headers = {
             "Seaweed-remote-size": str(e.size),
             "Seaweed-remote-mtime": str(int(e.mtime)),

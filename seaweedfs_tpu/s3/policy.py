@@ -22,6 +22,9 @@ from __future__ import annotations
 
 import fnmatch
 import json
+import logging
+
+log = logging.getLogger("s3.policy")
 
 # coarse internal actions -> the s3 action names checked against policies
 ACTION_NAMES = {
@@ -124,21 +127,39 @@ class BucketPolicyStore:
         self._filer = filer_call
         self._cache: dict[str, tuple[float, PolicyDocument | None]] = {}
 
+    #: sentinel for an unparseable stored document — its (possibly Deny)
+    #: statements are unknown, so evaluation must NOT fail open
+    BROKEN = "broken"
+
     async def refresh(self, bucket: str, now: float) -> None:
         hit = self._cache.get(bucket)
         if hit is not None and now - hit[0] < self.TTL:
             return
         st, body = await self._filer("GET", f"{self.PATH}/{bucket}.json")
+        if st not in (200, 404):
+            # a transient filer error is NOT "no policy": caching absence
+            # would silently disable Deny statements for a TTL. Keep the
+            # last known document if we have one; otherwise treat the
+            # policy as unreadable (fail closed, admin-only).
+            log.warning("bucket %s: policy refresh got HTTP %s", bucket, st)
+            self._cache[bucket] = (now, hit[1] if hit else self.BROKEN)
+            return
         doc = None
         if st == 200 and body:
             try:
                 doc = PolicyDocument.parse(body)
-            except PolicyError:
-                doc = None  # unreadable stored policy: fail closed to
-                # identity-only auth rather than 500 every request
+            except PolicyError as e:
+                # a policy written around put()'s validation (straight to
+                # the filer) may have carried Deny statements: dropping it
+                # silently would fail OPEN. Deny non-admin access until
+                # the document is fixed, and say so.
+                log.error("bucket %s: stored policy unparseable (%s); "
+                          "denying non-admin access until repaired",
+                          bucket, e)
+                doc = self.BROKEN
         self._cache[bucket] = (now, doc)
 
-    def get(self, bucket: str) -> PolicyDocument | None:
+    def get(self, bucket: str):
         hit = self._cache.get(bucket)
         return hit[1] if hit else None
 
@@ -157,9 +178,12 @@ class BucketPolicyStore:
 
     def evaluate(self, bucket: str, principal: str, action: str,
                  key: str = "") -> str | None:
+        """-> "deny" | "allow" | "broken" | None (no policy / no match)."""
         doc = self.get(bucket)
         if doc is None:
             return None
+        if doc is self.BROKEN:
+            return self.BROKEN
         names = ACTION_NAMES.get(action, [f"s3:{action}"])
         if key:
             resource = f"arn:aws:s3:::{bucket}/{key}"

@@ -32,7 +32,8 @@ import xml.etree.ElementTree as ET
 import aiohttp
 from aiohttp import web
 
-from seaweedfs_tpu.s3.auth import (ACTION_LIST, ACTION_READ, ACTION_TAGGING,
+from seaweedfs_tpu.s3.auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ,
+                                   ACTION_TAGGING,
                                    ACTION_WRITE, AuthError, Identity,
                                    IdentityAccessManagement)
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
@@ -97,6 +98,11 @@ class S3ApiServer:
         self.filer_url = filer_url
         self.host, self.port = host, port
         self.iam = iam or IdentityAccessManagement()
+        from seaweedfs_tpu.s3.policy import BucketPolicyStore, PolicyError
+        self._PolicyError = PolicyError
+        self.policies = BucketPolicyStore(
+            lambda method, path, data=None:
+                self._filer(method, path, data=data))
         from seaweedfs_tpu.s3.circuit_breaker import CircuitBreaker
         self.breaker = breaker or CircuitBreaker()
         self.buckets_dir = buckets_dir.rstrip("/")
@@ -200,10 +206,13 @@ class S3ApiServer:
         return json.loads(body)
 
     async def _filer_list(self, dir_path: str, last: str = "",
-                          limit: int = 1000, prefix: str = "") -> dict:
+                          limit: int = 1000, prefix: str = "",
+                          include_last: bool = False) -> dict:
         params = {"limit": str(limit)}
         if last:
             params["lastFileName"] = last
+            if include_last:
+                params["includeLastFile"] = "true"
         if prefix:
             params["prefix"] = prefix
         st, body = await self._filer("GET", dir_path.rstrip("/") + "/",
@@ -270,6 +279,13 @@ class S3ApiServer:
         try:
             if not bucket:
                 return await self.list_buckets(ident)
+            # bucket policies layer under the identity check (reference:
+            # the policy engine in weed/s3api/policy/); refresh failure
+            # degrades to identity-only auth, never a 500 per request
+            try:
+                await self.policies.refresh(bucket, time.time())
+            except Exception:
+                pass
             if not key:
                 return await self.bucket_op(req, ident, bucket, q, body)
             return await self.object_op(req, ident, bucket, key, q, body)
@@ -282,7 +298,31 @@ class S3ApiServer:
             body = _decode_aws_chunked(body)
         return body
 
-    def _require(self, ident: Identity, action: str, bucket: str) -> None:
+    def _require_admin(self, ident: Identity, bucket: str) -> None:
+        """Policy management is AWS's s3:PutBucketPolicy-class privilege:
+        only the Admin action grants it, and bucket policies themselves
+        cannot (a policy-granted writer must never rewrite the policy)."""
+        if not ident.can_do(ACTION_ADMIN, bucket):
+            raise AuthError("AccessDenied", "Access Denied")
+
+    def _require(self, ident: Identity, action: str, bucket: str,
+                 key: str = "") -> None:
+        """AWS evaluation order: explicit policy Deny always wins, a
+        policy Allow grants, otherwise the identity's own action list
+        decides.  An unreadable stored policy denies everyone but bucket
+        admins (its Deny statements are unknown — failing open would be
+        worse)."""
+        verdict = self.policies.evaluate(bucket, ident.name, action, key)
+        if verdict == "deny":
+            raise AuthError("AccessDenied",
+                            "Access Denied by bucket policy")
+        if verdict == "broken":
+            if ident.can_do(ACTION_ADMIN, bucket):
+                return
+            raise AuthError("AccessDenied",
+                            "bucket policy unreadable; access restricted")
+        if verdict == "allow":
+            return
         if not ident.can_do(action, bucket):
             raise AuthError("AccessDenied", "Access Denied")
 
@@ -451,11 +491,20 @@ class S3ApiServer:
     async def bucket_op(self, req, ident, bucket, q, body) -> web.Response:
         m = req.method
         if m == "PUT":
+            if "policy" in q:
+                # rewriting the policy is privilege management, not an
+                # object write: an object-writer identity must not be able
+                # to grant itself (or everyone) the bucket
+                self._require_admin(ident, bucket)
+                return await self.put_bucket_policy(ident, bucket, body)
             self._require(ident, ACTION_WRITE, bucket)
             if "lifecycle" in q:
                 return await self.put_bucket_lifecycle(bucket, body)
             return await self.put_bucket(bucket)
         if m == "DELETE":
+            if "policy" in q:
+                self._require_admin(ident, bucket)
+                return await self.delete_bucket_policy(ident, bucket)
             self._require(ident, ACTION_WRITE, bucket)
             if "lifecycle" in q:
                 return await self.delete_bucket_lifecycle(bucket)
@@ -482,8 +531,13 @@ class S3ApiServer:
             if "acl" in q:
                 return self._canned_acl(ident)
             if "lifecycle" in q:
+                self._require(ident, ACTION_LIST, bucket)
                 return await self.get_bucket_lifecycle(bucket)
-            for sub in ("policy", "cors", "website"):
+            if "policy" in q:
+                # the document discloses principals/access structure
+                self._require_admin(ident, bucket)
+                return await self.get_bucket_policy(ident, bucket)
+            for sub in ("cors", "website"):
                 if sub in q:
                     return _error_response(
                         f"NoSuch{sub.capitalize()}Configuration",
@@ -539,6 +593,41 @@ class S3ApiServer:
                                    "The specified bucket does not exist",
                                    404, bucket)
         return None
+
+    # -- bucket policy (reference: weed/s3api/policy/ + the
+    #    Get/Put/DeleteBucketPolicy handlers) ----------------------------
+
+    async def get_bucket_policy(self, ident: Identity,
+                                bucket: str) -> web.Response:
+        missing = await self._bucket_missing(bucket)
+        if missing is not None:
+            return missing
+        st, body = await self._filer(
+            "GET", f"{self.policies.PATH}/{bucket}.json")
+        if st != 200 or not body:
+            return _error_response("NoSuchBucketPolicy",
+                                   "The bucket policy does not exist",
+                                   404, bucket)
+        return web.Response(body=body, content_type="application/json")
+
+    async def put_bucket_policy(self, ident: Identity, bucket: str,
+                                body: bytes) -> web.Response:
+        missing = await self._bucket_missing(bucket)
+        if missing is not None:
+            return missing
+        try:
+            await self.policies.put(bucket, body or b"")
+        except self._PolicyError as e:
+            return _error_response("MalformedPolicy", str(e), 400, bucket)
+        return web.Response(status=204)
+
+    async def delete_bucket_policy(self, ident: Identity,
+                                   bucket: str) -> web.Response:
+        missing = await self._bucket_missing(bucket)
+        if missing is not None:
+            return missing
+        await self.policies.delete(bucket)
+        return web.Response(status=204)
 
     async def put_bucket_lifecycle(self, bucket: str,
                                    body: bytes) -> web.Response:
@@ -759,7 +848,12 @@ class S3ApiServer:
         contents: list[tuple[str, dict]] = []
         prefixes: list[str] = []
         seen_prefixes: set[str] = set()
-        state = {"count": 0, "truncated": False, "next_marker": ""}
+        state = {"count": 0, "truncated": False, "next_marker": "",
+                 "pages": 0, "scan_cursor": ""}
+        # per-request filer-page budget: a prefix that matches nothing in
+        # a huge bucket must return a truncated page the client can
+        # continue from, not scan millions of rows in one request
+        PAGE_BUDGET = 64
 
         async def emit(key: str, entry: dict) -> bool:
             """Returns False when the listing is full."""
@@ -783,10 +877,41 @@ class S3ApiServer:
             return True
 
         async def walk(dir_path: str, key_base: str) -> bool:
+            # continuation discipline (the reference's cursor model,
+            # s3api_objects_list_handlers.go): seed each directory's
+            # listing AT the marker's component instead of re-walking
+            # every already-served row from the filer — without this a
+            # many-page listing re-lists O(pages * keys) rows
             last = ""
+            include_last = False
+            if marker and marker.startswith(key_base):
+                rest = marker[len(key_base):]
+                comp = rest.split("/", 1)[0]
+                if comp:
+                    last = comp
+                    # always re-include the marker component: it may be a
+                    # DIRECTORY whose subtree sorts after the marker (e.g.
+                    # start-after=mid with mid/k0.txt present) — emit's
+                    # own `key <= marker` filter drops the already-served
+                    # file case
+                    include_last = True
+            elif marker and key_base.startswith(marker):
+                pass  # whole directory is past the marker: list it all
             while True:
+                if state["pages"] >= PAGE_BUDGET:
+                    state["truncated"] = True
+                    # the continuation must always advance: the last
+                    # SCANNED key (even an unemitted directory) beats an
+                    # empty marker that would re-walk the same pages
+                    state["next_marker"] = (key_base + last if last
+                                            else state["scan_cursor"]) \
+                        or state["next_marker"]
+                    return False
+                state["pages"] += 1
                 listing = await self._filer_list(dir_path, last=last,
-                                                 limit=1000)
+                                                 limit=1000,
+                                                 include_last=include_last)
+                include_last = False
                 entries = listing.get("Entries", [])
                 if not entries:
                     return True
@@ -796,6 +921,7 @@ class S3ApiServer:
                     if name.startswith("."):
                         continue  # .uploads and friends stay hidden
                     key = key_base + name
+                    state["scan_cursor"] = key
                     if e.get("IsDirectory"):
                         sub_key = key + "/"
                         # prune subtrees that cannot match the prefix
@@ -825,17 +951,17 @@ class S3ApiServer:
     async def object_op(self, req, ident, bucket, key, q, body):
         m = req.method
         if m == "GET" and "uploadId" in q:
-            self._require(ident, ACTION_READ, bucket)
+            self._require(ident, ACTION_READ, bucket, key)
             return await self.list_parts(bucket, key, q["uploadId"])
         if "tagging" in q:
             if m in ("PUT", "DELETE"):
-                self._require(ident, ACTION_TAGGING, bucket)
+                self._require(ident, ACTION_TAGGING, bucket, key)
                 return await self.put_tagging(
                     bucket, key, body if m == "PUT" else None)
-            self._require(ident, ACTION_READ, bucket)
+            self._require(ident, ACTION_READ, bucket, key)
             return await self.get_tagging(bucket, key)
         if m == "PUT":
-            self._require(ident, ACTION_WRITE, bucket)
+            self._require(ident, ACTION_WRITE, bucket, key)
             if "partNumber" in q:
                 return await self.put_part(req, bucket, key, q, body)
             if "x-amz-copy-source" in req.headers:
@@ -843,22 +969,22 @@ class S3ApiServer:
             return await self.put_object(req, bucket, key, body)
         if m == "POST":
             if "uploads" in q:
-                self._require(ident, ACTION_WRITE, bucket)
+                self._require(ident, ACTION_WRITE, bucket, key)
                 return await self.initiate_multipart(req, bucket, key)
             if "uploadId" in q:
-                self._require(ident, ACTION_WRITE, bucket)
+                self._require(ident, ACTION_WRITE, bucket, key)
                 return await self.complete_multipart(bucket, key,
                                                      q["uploadId"], body)
         if m == "DELETE":
             if "uploadId" in q:
-                self._require(ident, ACTION_WRITE, bucket)
+                self._require(ident, ACTION_WRITE, bucket, key)
                 return await self.abort_multipart(bucket, key, q["uploadId"])
-            self._require(ident, ACTION_WRITE, bucket)
+            self._require(ident, ACTION_WRITE, bucket, key)
             st, _ = await self._filer("DELETE", self._fp(bucket, key),
                                       params={"recursive": "true"})
             return web.Response(status=204)
         if m in ("GET", "HEAD"):
-            self._require(ident, ACTION_READ, bucket)
+            self._require(ident, ACTION_READ, bucket, key)
             return await self.get_object(req, bucket, key)
         return _error_response("MethodNotAllowed", "method not allowed", 405)
 

@@ -939,8 +939,9 @@ class FilerServer:
         limit = int(req.query.get("limit", "100"))
         last = req.query.get("lastFileName", "")
         prefix = req.query.get("prefix", "")
+        include_last = req.query.get("includeLastFile") == "true"
         entries = self.filer.list_entries(path, start_from=last,
-                                          include_start=False,
+                                          include_start=include_last,
                                           limit=limit + 1, prefix=prefix)
         more = len(entries) > limit
         entries = entries[:limit]

@@ -455,12 +455,6 @@ class SortedFileNeedleMap:
             yield nid, (off, size)
 
     @property
-    def _m(self) -> dict:
-        # compatibility view for callers that introspect the table
-        # (max_file_key/export); built lazily, sealed volumes are small sets
-        return {nid: v for nid, v in self.items()}
-
-    @property
     def content_size(self) -> int:
         return sum(v[1] for _, v in self.items())
 

@@ -118,7 +118,93 @@ class FormatDialect(abstract_sql.SqliteDialect):
         super().create_tables(conn._conn)
 
 
-@pytest.fixture(params=["memory", "sqlite", "logstore", "sql-format"])
+class FakeCqlSession:
+    """In-memory stand-in for a cassandra-driver Session understanding
+    exactly the CQL statements CassandraStore issues — runs the full
+    store matrix where no cluster exists (same philosophy as the
+    format-shim dialect above)."""
+
+    def __init__(self):
+        self.filemeta: dict[str, dict[str, bytes]] = {}
+        self.kv: dict[bytes, bytes] = {}
+        self.dirlist: set[str] = set()
+
+    def execute(self, q, params=()):
+        if q.startswith("CREATE TABLE"):
+            return []
+        if q.startswith("INSERT INTO filemeta"):
+            d, n, blob = params
+            self.filemeta.setdefault(d, {})[n] = blob
+            return []
+        if q.startswith("SELECT meta FROM filemeta WHERE directory=%s AND "
+                        "name=%s"):
+            d, n = params
+            row = self.filemeta.get(d, {}).get(n)
+            return [(row,)] if row is not None else []
+        if q.startswith("SELECT meta FROM filemeta WHERE directory=%s AND "
+                        "name>"):
+            d, n = params
+            op_ge = "name>=" in q
+            names = sorted(self.filemeta.get(d, {}))
+            return [(self.filemeta[d][x],) for x in names
+                    if (x >= n if op_ge else x > n)]
+        if q.startswith("SELECT meta FROM filemeta WHERE directory=%s"):
+            d, = params
+            return [(self.filemeta[d][x],)
+                    for x in sorted(self.filemeta.get(d, {}))]
+        if q.startswith("DELETE FROM filemeta WHERE directory=%s AND "
+                        "name=%s"):
+            d, n = params
+            self.filemeta.get(d, {}).pop(n, None)
+            return []
+        if q.startswith("DELETE FROM filemeta WHERE directory=%s"):
+            self.filemeta.pop(params[0], None)
+            return []
+        if q.startswith("INSERT INTO dirlist"):
+            self.dirlist.add(params[0])
+            return []
+        if q.startswith("SELECT directory FROM dirlist"):
+            lo, hi = params
+            return [(d,) for d in sorted(self.dirlist) if lo <= d < hi]
+        if q.startswith("DELETE FROM dirlist"):
+            self.dirlist.discard(params[0])
+            return []
+        if q.startswith("INSERT INTO kv"):
+            self.kv[bytes(params[0])] = bytes(params[1])
+            return []
+        if q.startswith("SELECT value FROM kv"):
+            row = self.kv.get(bytes(params[0]))
+            return [(row,)] if row is not None else []
+        if q.startswith("DELETE FROM kv"):
+            self.kv.pop(bytes(params[0]), None)
+            return []
+        raise AssertionError(f"unhandled CQL: {q}")
+
+
+class FakeRawKV:
+    """Ordered in-memory RawKV with the tikv_client surface TikvStore
+    uses: put/get/delete/scan(start, end, limit)."""
+
+    def __init__(self):
+        self.d: dict[bytes, bytes] = {}
+
+    def put(self, k, v):
+        self.d[bytes(k)] = bytes(v)
+
+    def get(self, k):
+        return self.d.get(bytes(k))
+
+    def delete(self, k):
+        self.d.pop(bytes(k), None)
+
+    def scan(self, start, end, limit):
+        out = [(k, self.d[k]) for k in sorted(self.d)
+               if start <= k < end]
+        return out[:limit]
+
+
+@pytest.fixture(params=["memory", "sqlite", "logstore", "sql-format",
+                        "cassandra-fake", "tikv-fake"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryStore()
@@ -131,6 +217,12 @@ def store(request, tmp_path):
         s = abstract_sql.AbstractSqlStore(FormatDialect(str(tmp_path / "f.db")))
         yield s
         s.shutdown()
+    elif request.param == "cassandra-fake":
+        from seaweedfs_tpu.filer.stores_extra import CassandraStore
+        yield CassandraStore(session=FakeCqlSession())
+    elif request.param == "tikv-fake":
+        from seaweedfs_tpu.filer.stores_extra import TikvStore
+        yield TikvStore(client=FakeRawKV())
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
         yield s

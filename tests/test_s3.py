@@ -679,3 +679,142 @@ class TestBucketLifecycle:
         stack.req("PUT", "/lc-bucket/logs/later.log", data=b"kept")
         assert stack.filer.filer.find_entry(
             "/buckets/lc-bucket/logs/later.log").attr.ttl_sec == 0
+
+
+class TestBucketPolicy:
+    """Bucket policy storage + AWS evaluation order (deny wins > allow >
+    identity actions), and the unreadable-policy fail-closed path
+    (reference: weed/s3api/policy/)."""
+
+    READER = Credential("READONLY", "rsecret")
+
+    def test_policy_crud_and_enforcement(self, stack):
+        import json as json_mod
+        stack.req("PUT", "/pol-bucket")
+        stack.req("PUT", "/pol-bucket/secret.txt", data=b"classified")
+        stack.req("PUT", "/pol-bucket/open.txt", data=b"public-ish")
+        # no policy yet
+        st, body, _ = stack.req("GET", "/pol-bucket", query={"policy": ""})
+        assert st == 404 and b"NoSuchBucketPolicy" in body
+        # malformed policy -> 400
+        st, body, _ = stack.req("PUT", "/pol-bucket", data=b"not-json",
+                                query={"policy": ""})
+        assert st == 400 and b"MalformedPolicy" in body
+        # deny reader the secret object; allow reader writes to /open*
+        doc = json_mod.dumps({
+            "Version": "2012-10-17",
+            "Statement": [
+                {"Effect": "Deny", "Principal": "*",
+                 "Action": "s3:GetObject",
+                 "Resource": "arn:aws:s3:::pol-bucket/secret.txt"},
+                {"Effect": "Allow",
+                 "Principal": {"AWS": "arn:aws:iam:::user/reader"},
+                 "Action": ["s3:PutObject"],
+                 "Resource": "arn:aws:s3:::pol-bucket/open*"},
+            ]}).encode()
+        st, body, _ = stack.req("PUT", "/pol-bucket", data=doc,
+                                query={"policy": ""})
+        assert st == 204, body
+        st, body, _ = stack.req("GET", "/pol-bucket", query={"policy": ""})
+        assert st == 200 and b"2012-10-17" in body
+        # explicit deny beats even the Admin identity
+        st, body, _ = stack.req("GET", "/pol-bucket/secret.txt")
+        assert st == 403 and b"bucket policy" in body
+        # other objects unaffected
+        assert stack.req("GET", "/pol-bucket/open.txt")[0] == 200
+        # policy Allow grants beyond the identity's own actions: reader
+        # has no Write action, but the policy allows puts under /open*
+        st, _, _ = stack.req("PUT", "/pol-bucket/open2.txt",
+                             data=b"by-reader", cred=self.READER)
+        assert st == 200
+        # ...while un-allowed writes still fail on the identity
+        st, _, _ = stack.req("PUT", "/pol-bucket/other.txt",
+                             data=b"nope", cred=self.READER)
+        assert st == 403
+        # delete policy: the deny lifts
+        assert stack.req("DELETE", "/pol-bucket",
+                         query={"policy": ""})[0] == 204
+        assert stack.req("GET", "/pol-bucket/secret.txt")[0] == 200
+
+    def test_unreadable_policy_fails_closed_except_admin(self, stack):
+        stack.req("PUT", "/brk-bucket")
+        stack.req("PUT", "/brk-bucket/x.txt", data=b"x")
+        # corrupt policy written straight to the filer (bypassing PUT
+        # validation, as the advisor scenario describes)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{stack.filer.url}/etc/s3/policies/brk-bucket.json",
+            data=b'{"Statement": "garbage"}', method="PUT"), timeout=15)
+        stack.s3.policies._cache.pop("brk-bucket", None)  # force re-read
+        # non-admin is denied outright (the lost document may have held
+        # Deny statements)
+        st, body, _ = stack.req("GET", "/brk-bucket/x.txt",
+                                cred=self.READER)
+        assert st == 403 and b"unreadable" in body
+        # the bucket admin still gets through to repair things
+        assert stack.req("GET", "/brk-bucket/x.txt")[0] == 200
+        assert stack.req("DELETE", "/brk-bucket",
+                         query={"policy": ""})[0] == 204
+        stack.s3.policies._cache.pop("brk-bucket", None)
+        st, _, _ = stack.req("GET", "/brk-bucket/x.txt", cred=self.READER)
+        assert st == 200
+
+
+class TestListPagination:
+    def test_marker_seeded_continuation(self, stack):
+        """Multi-page ListObjects via marker returns every key exactly
+        once, including nested directories straddling page boundaries."""
+        stack.req("PUT", "/page-bucket")
+        keys = []
+        for i in range(7):
+            keys.append(f"a{i:02d}.txt")
+        for d in ("mid", "zed"):
+            for i in range(4):
+                keys.append(f"{d}/k{i}.txt")
+        for k in sorted(keys):
+            st, _, _ = stack.req("PUT", f"/page-bucket/{k}", data=b"v")
+            assert st == 200
+        got = []
+        marker = ""
+        for _ in range(30):
+            q = {"max-keys": "3"}
+            if marker:
+                q["marker"] = marker
+            st, body, _ = stack.req("GET", "/page-bucket", query=q)
+            assert st == 200
+            root = _xml(body)
+            page = [e.text for e in _find_all(root, "Key")]
+            got.extend(page)
+            if _text(root, "IsTruncated") != "true":
+                break
+            marker = _text(root, "NextMarker") or (page[-1] if page else "")
+        assert got == sorted(keys)
+
+
+class TestPolicyPrivilege:
+    READER = Credential("READONLY", "rsecret")
+
+    def test_policy_management_needs_admin(self, stack):
+        import json as json_mod
+        stack.req("PUT", "/priv-bucket")
+        doc = json_mod.dumps({"Statement": [
+            {"Effect": "Allow", "Principal": "*", "Action": "s3:*",
+             "Resource": "*"}]}).encode()
+        # a Read/List identity can neither write, read, nor delete policies
+        assert stack.req("PUT", "/priv-bucket", data=doc,
+                         query={"policy": ""}, cred=self.READER)[0] == 403
+        assert stack.req("GET", "/priv-bucket",
+                         query={"policy": ""}, cred=self.READER)[0] == 403
+        assert stack.req("DELETE", "/priv-bucket",
+                         query={"policy": ""}, cred=self.READER)[0] == 403
+
+    def test_start_after_directory_name_descends(self, stack):
+        """marker == a directory name (no trailing slash) must still
+        return the directory's subtree (it sorts after the marker)."""
+        stack.req("PUT", "/sa-bucket")
+        stack.req("PUT", "/sa-bucket/mid/k0.txt", data=b"v")
+        stack.req("PUT", "/sa-bucket/aaa.txt", data=b"v")
+        st, body, _ = stack.req("GET", "/sa-bucket",
+                                query={"marker": "mid"})
+        assert st == 200
+        keys = [e.text for e in _find_all(_xml(body), "Key")]
+        assert keys == ["mid/k0.txt"]
