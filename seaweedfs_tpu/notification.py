@@ -10,8 +10,11 @@ behind the same registry seam so kafka-style backends can slot in.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from collections import deque
+
+log = logging.getLogger("notification")
 
 
 class MessageQueue:
@@ -167,6 +170,34 @@ class SqsQueue(MessageQueue):
                                    separators=(",", ":")))
 
 
+class GooglePubSubQueue(MessageQueue):
+    """GCP Pub/Sub backend (reference: weed/notification/google_pub_sub);
+    registers only when google-cloud-pubsub imports."""
+
+    name = "google_pub_sub"
+
+    def __init__(self, project_id: str, topic: str = "seaweedfs"):
+        from google.cloud import pubsub_v1
+        self._publisher = pubsub_v1.PublisherClient()
+        self._topic = self._publisher.topic_path(project_id, topic)
+
+    def send(self, key: str, message: dict) -> None:
+        future = self._publisher.publish(
+            self._topic,
+            json.dumps({"key": key, **message},
+                       separators=(",", ":")).encode(),
+            key=key)
+        # publish() batches and resolves later: surface failures instead
+        # of dropping events silently
+        future.add_done_callback(
+            lambda f: f.exception() and log.warning(
+                "pubsub event lost for %s: %s", key, f.exception()))
+
+    def close(self) -> None:
+        # flush the batched tail before shutdown (KafkaQueue parity)
+        self._publisher.stop()
+
+
 QUEUES = {"log": LogQueue, "memory": MemoryQueue, "webhook": WebhookQueue}
 
 # SDK-gated backends, mirroring the reference's build-tag registration
@@ -178,6 +209,11 @@ except ImportError:
 try:
     import boto3  # noqa: F401
     QUEUES["aws_sqs"] = SqsQueue
+except ImportError:
+    pass
+try:
+    from google.cloud import pubsub_v1  # noqa: F401
+    QUEUES["google_pub_sub"] = GooglePubSubQueue
 except ImportError:
     pass
 

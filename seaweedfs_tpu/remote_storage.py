@@ -250,7 +250,165 @@ class S3Remote(RemoteStorageClient):
                 raise
 
 
-REMOTES = {"local": LocalDirRemote, "s3": S3Remote}
+class GcsRemote(S3Remote):
+    """Google Cloud Storage via its S3-compatible XML API with HMAC
+    interoperability keys — the SDK-free wire path (reference:
+    weed/remote_storage/gcs/gcs_storage_client.go fills the same SPI with
+    the google SDK; GCS's interop endpoint speaks the identical protocol
+    S3Remote already implements, so only the endpoint and key names
+    differ)."""
+
+    name = "gcs"
+
+    def __init__(self, bucket: str, access_key: str = "",
+                 secret_key: str = "",
+                 endpoint: str = "https://storage.googleapis.com",
+                 timeout: float = 60.0):
+        super().__init__(endpoint=endpoint, bucket=bucket,
+                         access_key=access_key, secret_key=secret_key,
+                         region="auto", timeout=timeout)
+
+
+class AzureRemote(RemoteStorageClient):
+    """Azure Blob Storage over its REST API with SharedKey request
+    signing — no SDK (reference: weed/remote_storage/azure/
+    azure_storage_client.go over the azure-storage-blob-go SDK; the wire
+    protocol is List Blobs / Get Blob / Put Blob / Delete Blob with the
+    SharedKey Authorization scheme)."""
+
+    name = "azure"
+
+    API_VERSION = "2020-10-02"
+
+    def __init__(self, account: str, container: str, account_key: str,
+                 endpoint: str = "", timeout: float = 60.0):
+        import base64
+        self.account = account
+        self.container = container
+        self.key = base64.b64decode(account_key)
+        self.endpoint = (endpoint or
+                         f"https://{account}.blob.core.windows.net"
+                         ).rstrip("/")
+        self.timeout = timeout
+
+    # -- SharedKey signing (docs: "Authorize with Shared Key") -----------
+
+    def _sign(self, method: str, path: str, query: dict[str, str],
+              headers: dict[str, str], content_length: int) -> dict:
+        import base64
+        import hmac
+        import hashlib
+        headers = dict(headers)
+        headers["x-ms-date"] = time.strftime(
+            "%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+        headers["x-ms-version"] = self.API_VERSION
+        canon_headers = "".join(
+            f"{k.lower()}:{headers[k]}\n"
+            for k in sorted(headers, key=str.lower)
+            if k.lower().startswith("x-ms-"))
+        canon_resource = f"/{self.account}{path}"
+        for k in sorted(query, key=str.lower):
+            canon_resource += f"\n{k.lower()}:{query[k]}"
+        sts = "\n".join([
+            method,
+            "",                               # Content-Encoding
+            "",                               # Content-Language
+            str(content_length) if content_length else "",
+            "",                               # Content-MD5
+            headers.get("Content-Type", ""),
+            "",                               # Date (x-ms-date wins)
+            "", "", "", "", "",               # If-* / Range header slots
+        ]) + "\n" + canon_headers + canon_resource
+        sig = base64.b64encode(hmac.new(
+            self.key, sts.encode(), hashlib.sha256).digest()).decode()
+        headers["Authorization"] = f"SharedKey {self.account}:{sig}"
+        return headers
+
+    def _request(self, method: str, key: str = "",
+                 query: dict[str, str] | None = None, data: bytes = b"",
+                 headers: dict[str, str] | None = None):
+        import urllib.parse as up
+        import urllib.request
+        query = dict(query or {})
+        path = f"/{self.container}" + \
+            (f"/{key.lstrip('/')}" if key else "")
+        headers = dict(headers or {})
+        if data or method == "PUT":
+            # urllib adds its own Content-Type to any request with a body
+            # (even b"") — set it BEFORE signing or the wire disagrees
+            # with the signature
+            headers.setdefault("Content-Type", "application/octet-stream")
+        headers = self._sign(method, path, query, headers, len(data))
+        qs = up.urlencode(query)
+        url = f"{self.endpoint}{up.quote(path)}" + (f"?{qs}" if qs else "")
+        # PUTs must carry a body even when empty: Azure's Put Blob
+        # requires Content-Length (411 otherwise), and urllib only sends
+        # one when data is not None — a zero-byte blob is data=b""
+        body = data if (data or method == "PUT") else None
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    # -- SPI -------------------------------------------------------------
+
+    def traverse(self, prefix: str = ""):
+        import calendar
+        import xml.etree.ElementTree as ET
+        marker = ""
+        while True:
+            q = {"restype": "container", "comp": "list",
+                 "maxresults": "1000"}
+            if prefix:
+                q["prefix"] = prefix.lstrip("/")
+            if marker:
+                q["marker"] = marker
+            with self._request("GET", "", q) as r:
+                root = ET.fromstring(r.read())
+            for b in root.iter("Blob"):
+                key = b.findtext("Name", "")
+                props = b.find("Properties")
+                size = int(props.findtext("Content-Length", "0")) \
+                    if props is not None else 0
+                lm = props.findtext("Last-Modified", "") \
+                    if props is not None else ""
+                try:
+                    mtime = calendar.timegm(time.strptime(
+                        lm, "%a, %d %b %Y %H:%M:%S GMT"))
+                except ValueError:
+                    mtime = 0.0
+                yield RemoteEntry(key, size, mtime)
+            marker = root.findtext("NextMarker", "") or ""
+            if not marker:
+                return
+
+    def read_file(self, key: str) -> bytes:
+        with self._request("GET", key) as r:
+            return r.read()
+
+    def read_range(self, key: str, offset: int, size: int) -> bytes:
+        with self._request(
+                "GET", key,
+                headers={"x-ms-range":
+                         f"bytes={offset}-{offset + size - 1}"}) as r:
+            return r.read()
+
+    def write_file(self, key: str, data: bytes) -> None:
+        with self._request("PUT", key, data=data,
+                           headers={"x-ms-blob-type": "BlockBlob"}):
+            pass
+
+    def delete_file(self, key: str) -> None:
+        import urllib.error
+        try:
+            with self._request("DELETE", key):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+REMOTES = {"local": LocalDirRemote, "s3": S3Remote, "gcs": GcsRemote,
+           "azure": AzureRemote}
 
 
 def parse_remote_spec(spec: str) -> tuple[str, dict]:
@@ -276,9 +434,7 @@ def make_remote(kind: str, **options) -> RemoteStorageClient:
     try:
         return REMOTES[kind](**options)
     except KeyError:
-        raise ValueError(
-            f"unknown remote {kind!r} (have {sorted(REMOTES)}; gcs/azure "
-            f"register here when their SDKs are installed)")
+        raise ValueError(f"unknown remote {kind!r} (have {sorted(REMOTES)})")
 
 
 def sync_remote_to_filer(remote: RemoteStorageClient, filer_url: str,
@@ -378,11 +534,15 @@ def meta_sync_remote_to_filer(remote: RemoteStorageClient, filer_url: str,
                       if not e.is_directory}
     changed = deleted = unchanged = 0
     seen_keys = set()
+    unmanaged_paths = set()
     for path, meta in _filer_walk(filer_url, mount_dir, timeout):
         ext = {k.lower(): v for k, v in (meta.get("extended") or {}).items()}
         key = ext.get("remote-key")
         if key is None:
-            continue  # locally-created file, not ours to manage
+            # locally-created file, not ours to manage — remembered so a
+            # colliding remote key below never overwrites it
+            unmanaged_paths.add(path)
+            continue
         seen_keys.add(key)
         re_ = remote_entries.get(key)
         if re_ is None:
@@ -414,19 +574,10 @@ def meta_sync_remote_to_filer(remote: RemoteStorageClient, filer_url: str,
         path = mount_dir + "/" + e.key
         # never stamp a placeholder over an entry this mapping does not
         # manage: a locally-created file whose name collides with a
-        # remote key keeps its content (the operator resolves the clash)
-        try:
-            murl = (f"{_tls_scheme()}://{filer_url}"
-                    f"{urllib.parse.quote(path)}?metadata=true")
-            with urllib.request.urlopen(murl, timeout=timeout) as r:
-                existing = json.loads(r.read())
-            ext = {k.lower(): v
-                   for k, v in (existing.get("extended") or {}).items()}
-            if "remote-key" not in ext:
-                continue
-        except urllib.error.HTTPError as err:
-            if err.code != 404:
-                raise
+        # remote key keeps its content (the walk above already fetched
+        # every existing entry's metadata — no extra round-trips)
+        if path in unmanaged_paths:
+            continue
         headers = {
             "Seaweed-remote-size": str(e.size),
             "Seaweed-remote-mtime": str(int(e.mtime)),

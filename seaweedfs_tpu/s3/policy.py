@@ -135,7 +135,16 @@ class BucketPolicyStore:
         hit = self._cache.get(bucket)
         if hit is not None and now - hit[0] < self.TTL:
             return
-        st, body = await self._filer("GET", f"{self.PATH}/{bucket}.json")
+        try:
+            st, body = await self._filer("GET",
+                                         f"{self.PATH}/{bucket}.json")
+        except Exception as e:
+            # a transport error (unreachable filer) must behave exactly
+            # like an HTTP 5xx: keep the last known document, else fail
+            # closed — never fail open by looking like "no policy"
+            log.warning("bucket %s: policy refresh failed (%s)", bucket, e)
+            self._cache[bucket] = (now, hit[1] if hit else self.BROKEN)
+            return
         if st not in (200, 404):
             # a transient filer error is NOT "no policy": caching absence
             # would silently disable Deny statements for a TTL. Keep the

@@ -865,6 +865,8 @@ class S3ApiServer:
                 di = rest.find(delimiter)
                 if di >= 0:
                     common = prefix + rest[: di + len(delimiter)]
+                    if marker and common <= marker:
+                        return True  # served as a CommonPrefix last page
                     if common not in seen_prefixes:
                         seen_prefixes.add(common)
                         prefixes.append(common)
@@ -900,12 +902,15 @@ class S3ApiServer:
             while True:
                 if state["pages"] >= PAGE_BUDGET:
                     state["truncated"] = True
-                    # the continuation must always advance: the last
-                    # SCANNED key (even an unemitted directory) beats an
-                    # empty marker that would re-walk the same pages
-                    state["next_marker"] = (key_base + last if last
-                                            else state["scan_cursor"]) \
+                    # the continuation should advance to the last SCANNED
+                    # key — but never lexically BEHIND the client's
+                    # marker, which would re-emit already-served keys
+                    # (a stalled-but-duplicate-free page is the lesser
+                    # failure in that pathological ordering)
+                    cursor = (key_base + last if last
+                              else state["scan_cursor"]) \
                         or state["next_marker"]
+                    state["next_marker"] = max(cursor, marker or "")
                     return False
                 state["pages"] += 1
                 listing = await self._filer_list(dir_path, last=last,
@@ -931,6 +936,17 @@ class S3ApiServer:
                         if marker and marker >= sub_key and \
                                 not marker.startswith(sub_key):
                             continue
+                        if delimiter and sub_key.startswith(prefix):
+                            rest_d = sub_key[len(prefix):]
+                            di = rest_d.find(delimiter)
+                            if di >= 0:
+                                common = prefix + rest_d[:di + len(delimiter)]
+                                if marker and common <= marker:
+                                    # the whole subtree folds into a
+                                    # CommonPrefix already served — a
+                                    # continuation from NextMarker=
+                                    # "photos/" must not re-walk photos/
+                                    continue
                         if not await walk(dir_path + "/" + name, sub_key):
                             return False
                     else:

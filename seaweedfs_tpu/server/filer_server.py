@@ -130,6 +130,10 @@ class FilerServer:
         # per-chunk AES-GCM (reference: filer -encryptVolumeData)
         self.encrypt_data = encrypt_data
         # tiered chunk cache on the read path (reference: util/chunk_cache)
+        # sectioned chunk resolution + read-pattern detection for huge
+        # files (reference: filechunk_group.go / reader_pattern.go)
+        self._chunk_groups: dict = {}
+        self._read_patterns: dict = {}
         from seaweedfs_tpu.utils.chunk_cache import ChunkCache
         cache_dir = None
         if chunk_cache_disk and data_dir:
@@ -362,7 +366,7 @@ class FilerServer:
                          mtime=time.time_ns(), etag=etag,
                          cipher_key=cipher_key, is_compressed=is_compressed)
 
-    async def _fetch_chunk(self, fid: str) -> bytes:
+    async def _fetch_chunk(self, fid: str, cache: bool = True) -> bytes:
         # disk tiers do blocking IO; mem-only lookups stay inline
         if self.chunk_cache.tiers:
             cached = await asyncio.to_thread(self.chunk_cache.get, fid)
@@ -387,10 +391,10 @@ class FilerServer:
                                              headers=headers) as r:
                     if r.status == 200:
                         blob = await r.read()
-                        if self.chunk_cache.tiers:
+                        if cache and self.chunk_cache.tiers:
                             await asyncio.to_thread(self.chunk_cache.put,
                                                     fid, blob)
-                        else:
+                        elif cache:
                             self.chunk_cache.put(fid, blob)
                         return blob
                     last = f"HTTP {r.status}"
@@ -912,21 +916,61 @@ class FilerServer:
                 await resp.write(data)
                 pos += len(data)
         else:
-            await self._stream_range(resp, chunks, offset, length)
+            await self._stream_range(resp, chunks, offset, length,
+                                     path=path, entry=entry)
         await resp.write_eof()
         return resp
 
+    def _group_for(self, path: str, entry: Entry,
+                   chunks: list[FileChunk]):
+        """Per-entry-version ChunkGroup cache: a ranged read of a huge
+        file resolves only the 64MiB sections it touches instead of the
+        full chunk list (reference: filechunk_group.go)."""
+        from seaweedfs_tpu.filer.filechunk_section import ChunkGroup
+        key = (path, entry.attr.mtime, len(chunks))
+        group = self._chunk_groups.get(key)
+        if group is None:
+            group = ChunkGroup(chunks)
+            self._chunk_groups[key] = group
+            while len(self._chunk_groups) > 32:
+                self._chunk_groups.pop(next(iter(self._chunk_groups)))
+        return group
+
     async def _stream_range(self, resp, chunks: list[FileChunk],
-                            offset: int, length: int) -> None:
+                            offset: int, length: int,
+                            path: str = "", entry: Entry | None = None
+                            ) -> None:
         """Stream [offset, offset+length) to the client, zero-filling
         sparse gaps (reference: filer/stream.go StreamContent)."""
-        views = fc.view_from_chunks(chunks, offset, length)
+        if entry is not None:
+            views = self._group_for(path, entry, chunks).read_views(
+                offset, length)
+        else:
+            views = fc.view_from_chunks(chunks, offset, length)
+        # random readers must not churn the chunk cache with bytes nobody
+        # revisits (reference: reader_pattern.go -> reader_cache).  The
+        # pattern is tracked per PATH here (the reference tracks per file
+        # handle): only ranged reads vote — repeated whole-file GETs of a
+        # hot object are the cache's best case and must never disable it
+        cache_chunks = True
+        whole_file = entry is not None and offset == 0 and \
+            length >= entry.size()
+        if path and not whole_file:
+            from seaweedfs_tpu.filer.filechunk_section import ReaderPattern
+            rp = self._read_patterns.get(path)
+            if rp is None:
+                rp = self._read_patterns[path] = ReaderPattern()
+                while len(self._read_patterns) > 256:
+                    self._read_patterns.pop(
+                        next(iter(self._read_patterns)))
+            rp.monitor_read(offset, length)
+            cache_chunks = not rp.is_random
         pos = offset
         for v in views:
             if v.logic_offset > pos:
                 await _write_zeros(resp, v.logic_offset - pos)
                 pos = v.logic_offset
-            blob = await self._fetch_chunk(v.fid)
+            blob = await self._fetch_chunk(v.fid, cache=cache_chunks)
             blob = await self._decode_chunk_blob(blob, v.cipher_key,
                                                  v.is_compressed)
             await resp.write(blob[v.offset_in_chunk:v.offset_in_chunk + v.size])

@@ -293,3 +293,185 @@ def test_remote_mount_read_through(tmp_path):
     finally:
         c.submit(filer.stop())
         c.stop()
+
+
+def test_gcs_remote_speaks_s3_interop(tmp_path):
+    """GcsRemote = the GCS XML-interop wire path: identical protocol to
+    S3Remote with the GCS endpoint/HMAC keys (reference:
+    weed/remote_storage/gcs/).  Proven against our own gateway standing in
+    for storage.googleapis.com."""
+    import urllib.request
+    from seaweedfs_tpu.remote_storage import GcsRemote, make_remote
+    c, filer, s3 = _s3_stack(tmp_path)
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{s3.url}/gcs-bucket", method="PUT"), timeout=10)
+        r = make_remote("gcs", bucket="gcs-bucket",
+                        endpoint=f"http://{s3.url}")
+        assert isinstance(r, GcsRemote)
+        r.write_file("obj/one", b"gcs-bytes")
+        assert r.read_file("obj/one") == b"gcs-bytes"
+        assert r.read_range("obj/one", 4, 5) == b"bytes"
+        assert [e.key for e in r.traverse()] == ["obj/one"]
+        r.delete_file("obj/one")
+        assert list(r.traverse()) == []
+    finally:
+        c.submit(s3.stop())
+        c.submit(filer.stop())
+        c.stop()
+
+
+class _FakeAzure:
+    """In-memory Azure Blob endpoint that VERIFIES SharedKey signatures
+    from the spec (independently of the client's signer) and serves
+    List/Get/Put/Delete Blob."""
+
+    def __init__(self, account, key_b64):
+        import base64
+        self.account = account
+        self.key = base64.b64decode(key_b64)
+        self.blobs = {}
+        self.seen_versions = set()
+
+    def start(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def _verify(self):
+                import base64
+                import hashlib
+                import hmac
+                import urllib.parse as up
+                u = up.urlparse(self.path)
+                q = dict(up.parse_qsl(u.query, keep_blank_values=True))
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                fake.seen_versions.add(headers.get("x-ms-version"))
+                canon_headers = "".join(
+                    f"{k}:{headers[k]}\n" for k in sorted(headers)
+                    if k.startswith("x-ms-"))
+                canon_resource = f"/{fake.account}{up.unquote(u.path)}"
+                for k in sorted(q, key=str.lower):
+                    canon_resource += f"\n{k.lower()}:{q[k]}"
+                cl = headers.get("content-length", "")
+                if cl == "0":
+                    cl = ""
+                sts = "\n".join([
+                    self.command, "", "", cl, "",
+                    headers.get("content-type", ""), "",
+                    "", "", "", "", "",
+                ]) + "\n" + canon_headers + canon_resource
+                want = base64.b64encode(hmac.new(
+                    fake.key, sts.encode(), hashlib.sha256).digest()).decode()
+                got = headers.get("authorization", "")
+                return got == f"SharedKey {fake.account}:{want}", u, q
+
+            def _respond(self, status, body=b"", headers=None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                ok, u, q = self._verify()
+                if not ok:
+                    return self._respond(403)
+                if q.get("comp") == "list":
+                    prefix = q.get("prefix", "")
+                    items = "".join(
+                        f"<Blob><Name>{k}</Name><Properties>"
+                        f"<Content-Length>{len(v)}</Content-Length>"
+                        f"<Last-Modified>Thu, 01 Jan 2026 00:00:00 GMT"
+                        f"</Last-Modified></Properties></Blob>"
+                        for k, v in sorted(fake.blobs.items())
+                        if k.startswith(prefix))
+                    xml = (f"<EnumerationResults><Blobs>{items}</Blobs>"
+                           f"<NextMarker/></EnumerationResults>")
+                    return self._respond(200, xml.encode())
+                key = u.path.split("/", 2)[-1]
+                if key not in fake.blobs:
+                    return self._respond(404)
+                data = fake.blobs[key]
+                rng = self.headers.get("x-ms-range", "")
+                if rng.startswith("bytes="):
+                    lo, hi = rng[6:].split("-")
+                    data = data[int(lo):int(hi) + 1]
+                    return self._respond(206, data)
+                return self._respond(200, data)
+
+            def do_PUT(self):
+                ok, u, q = self._verify()
+                if not ok:
+                    return self._respond(403)
+                if self.headers.get("Content-Length") is None:
+                    # real Azure: Put Blob requires Content-Length
+                    return self._respond(411)
+                n = int(self.headers["Content-Length"])
+                fake.blobs[u.path.split("/", 2)[-1]] = self.rfile.read(n)
+                self._respond(201)
+
+            def do_DELETE(self):
+                ok, u, q = self._verify()
+                if not ok:
+                    return self._respond(403)
+                if fake.blobs.pop(u.path.split("/", 2)[-1], None) is None:
+                    return self._respond(404)
+                self._respond(202)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        return f"http://127.0.0.1:{self.httpd.server_port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def test_azure_remote_sharedkey_wire_protocol():
+    """AzureRemote's SharedKey signing + REST verbs, checked by a fake
+    Azure endpoint that re-derives the signature from the spec (so the
+    signer is validated against an independent implementation, reference:
+    weed/remote_storage/azure/)."""
+    import base64
+    from seaweedfs_tpu.remote_storage import make_remote
+    key = base64.b64encode(b"0123456789abcdef0123456789abcdef").decode()
+    fake = _FakeAzure("acct", key)
+    endpoint = fake.start()
+    try:
+        r = make_remote("azure", account="acct", container="cont",
+                        account_key=key, endpoint=endpoint)
+        r.write_file("empty.bin", b"")  # zero-byte blobs must carry
+        assert r.read_file("empty.bin") == b""          # Content-Length
+        r.delete_file("empty.bin")
+        r.write_file("dir/a.bin", b"azure-payload")
+        r.write_file("dir/b.bin", b"B" * 64)
+        r.write_file("top.bin", b"t")
+        assert r.read_file("dir/a.bin") == b"azure-payload"
+        assert r.read_range("dir/a.bin", 6, 7) == b"payload"
+        assert {e.key: e.size for e in r.traverse()} == {
+            "dir/a.bin": 13, "dir/b.bin": 64, "top.bin": 1}
+        assert [e.key for e in r.traverse(prefix="dir/")] == \
+            ["dir/a.bin", "dir/b.bin"]
+        assert all(e.mtime > 0 for e in r.traverse())
+        r.delete_file("top.bin")
+        assert "top.bin" not in {e.key for e in r.traverse()}
+        r.delete_file("top.bin")  # 404 is idempotent
+        # a wrong key is refused by the endpoint's own verifier
+        import urllib.error
+        bad = make_remote("azure", account="acct", container="cont",
+                          account_key=base64.b64encode(b"x" * 32).decode(),
+                          endpoint=endpoint)
+        try:
+            bad.read_file("dir/a.bin")
+            assert False, "bad key accepted"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        assert fake.seen_versions == {"2020-10-02"}
+    finally:
+        fake.stop()

@@ -597,3 +597,72 @@ def test_hardlink_onto_file_parent_fails_cleanly(filer):
         (not got.hard_link_id or got.hard_link_counter == 1)
     filer.delete_entry("/hl7/f")
     assert [c.fid for c in filer._test_deleted] == ["11,j"]
+
+
+# ----------------------------------------------- sections / read pattern
+
+def test_chunk_group_sections_resolve_lazily():
+    from seaweedfs_tpu.filer.filechunk_section import ChunkGroup
+    sec = 1000  # tiny sections for the test
+    # 10 sections of data, one chunk per 500 bytes, plus one spanning a
+    # section boundary with a newer overwrite
+    chunks = [_c(f"1,{i}", i * 500, 500, 1) for i in range(20)]
+    chunks.append(_c("1,x", 950, 100, 2))     # spans sections 0-1, newer
+    g = ChunkGroup(chunks, section_size=sec)
+    assert g.file_size == 10000
+    # a read inside section 3 resolves ONLY that section
+    views = g.read_views(3200, 100)
+    assert [v.fid for v in views] == ["1,6"]
+    assert g.resolved_sections == 1
+    # boundary-spanning read resolves two sections; the newer chunk wins
+    views = g.read_views(900, 200)
+    assert g.resolved_sections == 3
+    # the spanning chunk splits at the section boundary (two views of the
+    # same blob; the chunk cache absorbs the second fetch) but coverage
+    # and the winning chunk are exact
+    got = [(v.fid, v.logic_offset, v.size) for v in views]
+    assert got == [("1,1", 900, 50), ("1,x", 950, 50),
+                   ("1,x", 1000, 50), ("1,2", 1050, 50)]
+
+    def coverage(views):
+        m = {}
+        for v in views:
+            for i in range(v.size):
+                m[v.logic_offset + i] = (v.fid, v.offset_in_chunk + i)
+        return m
+
+    # bytes served match a full non-sectioned resolution exactly
+    assert coverage(fc.view_from_chunks(chunks, 0, 10000)) == \
+        coverage(g.read_views(0, 10000))
+
+
+def test_chunk_group_sparse_and_bounds():
+    from seaweedfs_tpu.filer.filechunk_section import ChunkGroup
+    g = ChunkGroup([_c("1,a", 100, 50, 1), _c("1,b", 5000, 50, 1)],
+                   section_size=1000)
+    # gap between chunks: views absent (streamer zero-fills)
+    vs = g.read_views(0, 6000)
+    assert [(v.fid, v.logic_offset) for v in vs] == [("1,a", 100),
+                                                     ("1,b", 5000)]
+    assert g.read_views(200, 0) == []
+    assert g.read_views(10000, 50) == []      # past EOF
+    assert ChunkGroup([]).read_views(0, 100) == []
+
+
+def test_reader_pattern_mode_switching():
+    from seaweedfs_tpu.filer.filechunk_section import ReaderPattern
+    rp = ReaderPattern()
+    assert not rp.is_random  # neutral start serves caches
+    rp.monitor_read(0, 100)      # first read from 0 counts sequential
+    for i in range(1, 5):
+        rp.monitor_read(i * 100, 100)
+    assert not rp.is_random
+    # a burst of scattered reads flips to random (saturating at -3)
+    for off in (9000, 42, 7777, 123, 8080):
+        rp.monitor_read(off, 10)
+    assert rp.is_random
+    # sustained sequential reading flips back
+    rp.monitor_read(8090, 10)
+    for i in range(5):
+        rp.monitor_read(8100 + i * 10, 10)
+    assert not rp.is_random

@@ -818,3 +818,34 @@ class TestPolicyPrivilege:
         assert st == 200
         keys = [e.text for e in _find_all(_xml(body), "Key")]
         assert keys == ["mid/k0.txt"]
+
+
+class TestDelimiterPagination:
+    def test_common_prefix_continuation_no_duplicates(self, stack):
+        """Delimiter listings paginating by NextMarker: each CommonPrefix
+        appears exactly once and the walk never re-descends a served
+        prefix's subtree."""
+        stack.req("PUT", "/delim-bucket")
+        for d in ("alpha", "beta", "gamma"):
+            for i in range(3):
+                stack.req("PUT", f"/delim-bucket/{d}/f{i}.txt", data=b"v")
+        stack.req("PUT", "/delim-bucket/zz-root.txt", data=b"v")
+        seen_prefixes, seen_keys = [], []
+        marker = ""
+        for _ in range(20):
+            q = {"delimiter": "/", "max-keys": "1"}
+            if marker:
+                q["marker"] = marker
+            st, body, _ = stack.req("GET", "/delim-bucket", query=q)
+            assert st == 200
+            root = _xml(body)
+            seen_prefixes.extend(
+                e.text for p in _find_all(root, "CommonPrefixes")
+                for e in p if e.tag.endswith("Prefix"))
+            seen_keys.extend(e.text for e in _find_all(root, "Key"))
+            if _text(root, "IsTruncated") != "true":
+                break
+            marker = _text(root, "NextMarker")
+            assert marker
+        assert seen_prefixes == ["alpha/", "beta/", "gamma/"]
+        assert seen_keys == ["zz-root.txt"]
