@@ -7,8 +7,13 @@ import and chosen by the enabled block in filer.toml.  This module adds:
   - LogStore: an embedded log-structured store (LevelDB-class role:
     single-writer local persistence with an in-memory index, JSONL WAL +
     snapshot compaction) — no external dependency.
-  - RedisStore: registered only when the `redis` client package is
-    importable (like the reference's build-tag-gated drivers).
+  - RedisStore / MongoStore / EtcdStore: registered only when their client
+    packages import (like the reference's build-tag-gated drivers).
+  - CassandraStore (wide-column, directory partitions + dirlist index) and
+    TikvStore (ordered KV, <dir>\x00<name> keys): injectable clients —
+    SDK-gated in production, fully matrix-tested through in-memory fakes.
+  - ElasticStore: pure-REST Elasticsearch driver (no SDK), injectable
+    transport.
 
 Every driver implements the same 8-method FilerStore SPI
 (weed/filer/filerstore.go:21-45)."""
@@ -653,3 +658,146 @@ try:  # pragma: no cover - depends on environment
     STORES["tikv"] = TikvStore
 except ImportError:
     pass
+
+
+class ElasticStore(FilerStore):
+    """Document store over the Elasticsearch REST API (reference:
+    weed/filer/elastic/v7/elastic_store.go — entries as docs id'd by the
+    url-safe full path, kv in a dedicated index).  Pure HTTP JSON: no SDK.
+
+    `transport(method, path, body_dict|None) -> (status, json_dict)` is
+    injectable; the default speaks urllib to the server.  Search-after
+    pagination orders listings by the `name` keyword field."""
+
+    name = "elastic"
+    INDEX = "seaweedfs_filemeta"
+    KV_INDEX = "seaweedfs_kv"
+    MAX_PAGE = 10000  # ES index.max_result_window default
+
+    def __init__(self, url: str = "http://127.0.0.1:9200", transport=None):
+        self.url = url.rstrip("/")
+        self._t = transport or self._http
+        for index in (self.INDEX, self.KV_INDEX):
+            self._t("PUT", f"/{index}", {"mappings": {"properties": {
+                "directory": {"type": "keyword"},
+                "name": {"type": "keyword"}}}})
+
+    def _http(self, method: str, path: str, body=None):
+        import urllib.error
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except ValueError:
+                return e.code, {}
+
+    @staticmethod
+    def _id(full_path: str) -> str:
+        import base64
+        return base64.urlsafe_b64encode(full_path.encode()).decode()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, _, n = entry.full_path.rpartition("/")
+        st, _ = self._t(
+            "PUT", f"/{self.INDEX}/_doc/{self._id(entry.full_path)}"
+            "?refresh=true",
+            {"directory": d or "/", "name": n,
+             "meta": json.dumps(entry.to_dict())})
+        if st >= 300:
+            raise OSError(f"elastic insert: HTTP {st}")
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        st, doc = self._t(
+            "GET", f"/{self.INDEX}/_doc/{self._id(full_path)}", None)
+        if st == 404 or (st < 300 and not doc.get("found")):
+            raise NotFound(full_path)
+        if st >= 300:
+            # a 5xx/429 is a store outage, NOT data absence — NotFound
+            # here would let writers recreate/overwrite live entries
+            raise OSError(f"elastic get: HTTP {st}")
+        return Entry.from_dict(json.loads(doc["_source"]["meta"]))
+
+    def delete_entry(self, full_path: str) -> None:
+        self._t("DELETE",
+                f"/{self.INDEX}/_doc/{self._id(full_path)}?refresh=true",
+                None)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/") or "/"
+        # root: every directory string starts with "/" — a "//" prefix
+        # would miss all nested descendants
+        pref = base if base.endswith("/") else base + "/"
+        self._t("POST", f"/{self.INDEX}/_delete_by_query?refresh=true", {
+            "query": {"bool": {"should": [
+                {"term": {"directory": base}},
+                {"prefix": {"directory": pref}},
+            ]}}})
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        """Pages with name-range cursors in MAX_PAGE steps — a single
+        _search above index.max_result_window (10k) is a 400 from ES."""
+        d = dir_path.rstrip("/") or "/"
+        out: list[Entry] = []
+        cursor, inclusive = start_from, include_start
+        while len(out) < limit:
+            want = min(limit - len(out), self.MAX_PAGE)
+            query: dict = {"bool": {"filter": [
+                {"term": {"directory": d}}]}}
+            if prefix:
+                query["bool"]["filter"].append(
+                    {"prefix": {"name": prefix}})
+            if cursor:
+                op = "gte" if inclusive else "gt"
+                query["bool"]["filter"].append(
+                    {"range": {"name": {op: cursor}}})
+            st, res = self._t("POST", f"/{self.INDEX}/_search", {
+                "query": query, "size": want,
+                "sort": [{"name": "asc"}]})
+            if st >= 300:
+                raise OSError(f"elastic search: HTTP {st}")
+            hits = res.get("hits", {}).get("hits", [])
+            out.extend(Entry.from_dict(json.loads(h["_source"]["meta"]))
+                       for h in hits)
+            if len(hits) < want:
+                break
+            cursor, inclusive = out[-1].name, False
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        import base64
+        self._t("PUT",
+                f"/{self.KV_INDEX}/_doc/{self._id(key.decode('latin-1'))}"
+                "?refresh=true",
+                {"value": base64.b64encode(value).decode()})
+
+    def kv_get(self, key: bytes) -> bytes:
+        import base64
+        st, doc = self._t(
+            "GET",
+            f"/{self.KV_INDEX}/_doc/{self._id(key.decode('latin-1'))}",
+            None)
+        if st == 404 or (st < 300 and not doc.get("found")):
+            raise NotFound(key.decode(errors="replace"))
+        if st >= 300:
+            raise OSError(f"elastic kv get: HTTP {st}")
+        return base64.b64decode(doc["_source"]["value"])
+
+    def kv_delete(self, key: bytes) -> None:
+        self._t("DELETE",
+                f"/{self.KV_INDEX}/_doc/{self._id(key.decode('latin-1'))}"
+                "?refresh=true", None)
+
+
+STORES["elastic"] = ElasticStore  # REST-only: no SDK gate needed

@@ -66,6 +66,7 @@ class VolumeServer:
             web.get("/metrics", self.handle_metrics),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/volume/delete", self.handle_volume_delete),
+            web.post("/admin/leave", self.handle_leave),
             web.post("/admin/volume/readonly", self.handle_volume_readonly),
             web.post("/admin/volume/configure_replication",
                      self.handle_configure_replication),
@@ -154,6 +155,10 @@ class VolumeServer:
                         (i + 1) % len(self.master_urls)]
 
     async def _heartbeat_once(self) -> None:
+        if getattr(self, "_left", False):
+            # decommissioned via /admin/leave: stray admin calls that
+            # trigger delta beats must not silently re-register us
+            return
         beat = self.store.collect_heartbeat()
         metrics.VOLUME_COUNT_GAUGE.labels("", "normal").set(
             len(beat.get("volumes", [])))
@@ -499,6 +504,16 @@ class VolumeServer:
         self.store.delete_volume(body["volume"])
         await self._heartbeat_once()
         return web.json_response({})
+
+    async def handle_leave(self, req: web.Request) -> web.Response:
+        """Stop heartbeating so the master expires this server from the
+        topology (reference: volume_grpc_admin.go VolumeServerLeave) —
+        the clean-decommission step after volume.server.evacuate."""
+        self._left = True  # sticky: delta beats from admin calls stay off
+        if self._hb_task:
+            self._hb_task.cancel()
+            self._hb_task = None
+        return web.json_response({"ok": True})
 
     async def handle_configure_replication(self, req: web.Request
                                            ) -> web.Response:

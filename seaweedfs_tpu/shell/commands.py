@@ -1646,3 +1646,126 @@ def cmd_ec_progress(env: CommandEnv, args, out):
         return
     if flags.get("cancel") != "true" or not cancelled:
         print(f"no encode job found for volume {vid}", file=out)
+
+
+@command("volume.delete.empty")
+def cmd_volume_delete_empty(env: CommandEnv, args, out):
+    """Delete volumes holding zero live files, fleet-wide (reference:
+    command_volume_delete_empty.go).  Dry-run unless -force; -quietFor
+    (default 24h) keeps freshly-created volumes safe."""
+    env.require_lock()
+    flags = parse_flags(args)
+    force = "force" in flags
+    quiet = parse_duration(flags.get("quietFor", "24h"))
+    import time as _time
+    now = _time.time()
+    topo = env.topology()
+    victims: dict[int, list[str]] = {}
+    for nid, node in topo["nodes"].items():
+        for v in node.get("volume_infos", []):
+            if v.get("file_count", 0) - v.get("delete_count", 0) > 0:
+                continue
+            if v.get("modified_at", 0) + quiet >= now:
+                continue
+            victims.setdefault(v["id"], []).append(nid)
+    for vid in sorted(victims):
+        if force:
+            for nid in victims[vid]:
+                env.vs_post(nid, "/admin/volume/delete", {"volume": vid})
+            print(f"deleted empty volume {vid} from "
+                  f"{len(victims[vid])} node(s)", file=out)
+        else:
+            print(f"would delete empty volume {vid} on "
+                  f"{victims[vid]} (use -force)", file=out)
+    if not victims:
+        print("no empty volumes", file=out)
+
+
+@command("volume.server.evacuate")
+def cmd_volume_server_evacuate(env: CommandEnv, args, out):
+    """Move every volume and EC shard off -node onto the least-loaded
+    other servers (reference: command_volume_server_evacuate.go) — drain
+    before maintenance/decommission."""
+    env.require_lock()
+    flags = parse_flags(args)
+    node = flags["node"]
+    topo = env.topology()
+    if node not in topo["nodes"]:
+        raise RuntimeError(f"unknown volume server {node}")
+    others = {nid: nd for nid, nd in topo["nodes"].items() if nid != node}
+    if not others:
+        raise RuntimeError("no other servers to evacuate onto")
+    load = {nid: len(nd.get("volumes", [])) for nid, nd in others.items()}
+    free = {nid: nd.get("free_slots", 0) for nid, nd in others.items()}
+    moved = 0
+    for v in topo["nodes"][node].get("volume_infos", []):
+        vid = v["id"]
+        # a target must have a slot and must not already hold a replica
+        candidates = sorted(
+            (nid for nid in others
+             if free.get(nid, 0) > 0
+             and vid not in others[nid].get("volumes", [])),
+            key=lambda nid: load[nid])
+        if not candidates:
+            print(f"  volume {vid}: no target with free slots", file=out)
+            continue
+        target = candidates[0]
+        move_volume(env, vid, node, target,
+                    v.get("collection", ""))
+        load[target] += 1
+        free[target] -= 1
+        moved += 1
+        print(f"  volume {vid} -> {target}", file=out)
+    # EC shards: copy to the least-loaded target, mount there, drop here
+    ec = topo["nodes"][node].get("ec_shards", {})
+    ec_cols = topo.get("ec_collections", {})
+    for vid_s, shards in sorted(ec.items()):
+        vid = int(vid_s)
+        if not shards:
+            continue
+        col = ec_cols.get(vid_s, "")
+        target = min(sorted(others), key=lambda nid: load[nid])
+        env.vs_post(target, "/admin/ec/copy",
+                    {"volume": vid, "collection": col, "source": node,
+                     "shards": shards, "copy_ecx": True})
+        env.vs_post(target, "/admin/ec/mount",
+                    {"volume": vid, "collection": col})
+        env.vs_post(node, "/admin/ec/delete_shards",
+                    {"volume": vid, "shards": shards})
+        # ALL shards left the node: unmount clears the empty EcVolume (a
+        # re-mount would 404 on the missing files and abort the drain)
+        env.vs_post(node, "/admin/ec/unmount", {"volume": vid})
+        load[target] += 1
+        moved += 1
+        print(f"  ec shards {shards} of {vid} -> {target}", file=out)
+    print(f"evacuated {moved} volume(s)/shard set(s) off {node}", file=out)
+
+
+@command("volume.server.leave")
+def cmd_volume_server_leave(env: CommandEnv, args, out):
+    """Ask a volume server to stop heartbeating so the master drops it
+    from placement (reference: command_volume_server_leave.go); pair with
+    volume.server.evacuate for a clean decommission."""
+    env.require_lock()
+    flags = parse_flags(args)
+    node = flags["node"]
+    env.vs_post(node, "/admin/leave", {})
+    print(f"{node} is leaving the cluster (heartbeats stopped)", file=out)
+
+
+@command("remote.unmount")
+def cmd_remote_unmount(env: CommandEnv, args, out):
+    """Detach a remote mapping from a directory (reference:
+    command_remote_unmount.go).  Cached/placeholder entries under the
+    directory stay unless -deleteEntries."""
+    flags = parse_flags(args)
+    mount_dir = flags.get("dir", "/remote")
+    filer = env.find_filer()
+    env._call(f"{filer}/__admin__/remote_mounts",
+              {"remove": [mount_dir]})
+    if flags.get("deleteEntries", "false") == "true":
+        try:
+            env.filer_delete(filer, mount_dir, recursive=True)
+        except Exception as e:
+            print(f"  entry cleanup failed: {e}", file=out)
+    print(f"remote.unmount: {mount_dir} detached", file=out)

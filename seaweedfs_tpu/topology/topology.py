@@ -305,6 +305,8 @@ class Topology:
             return {
                 "max_volume_id": self.max_volume_id,
                 "volume_size_limit": self.volume_size_limit,
+                "ec_collections": {str(v): c for v, c
+                                   in self.ec_collections.items() if c},
                 "nodes": {
                     nid: {
                         "url": n.url, "public_url": n.public_url,

@@ -203,8 +203,75 @@ class FakeRawKV:
         return out[:limit]
 
 
+class FakeElasticTransport:
+    """Minimal Elasticsearch REST emulation for the statements ElasticStore
+    issues (PUT/GET/DELETE _doc, _search with bool filters, _delete_by_query)."""
+
+    def __init__(self):
+        self.indices: dict[str, dict[str, dict]] = {}
+
+    def __call__(self, method, path, body=None):
+        import urllib.parse as up
+        path = path.split("?", 1)[0]
+        parts = [p for p in path.split("/") if p]
+        index = self.indices.setdefault(up.unquote(parts[0]), {})
+        if len(parts) == 1 and method == "PUT":
+            return 200, {"acknowledged": True}
+        if len(parts) >= 2 and parts[1] == "_doc":
+            doc_id = up.unquote(parts[2])
+            if method == "PUT":
+                index[doc_id] = body
+                return 200, {"result": "updated"}
+            if method == "GET":
+                if doc_id not in index:
+                    return 404, {"found": False}
+                return 200, {"found": True, "_source": index[doc_id]}
+            if method == "DELETE":
+                index.pop(doc_id, None)
+                return 200, {"result": "deleted"}
+        if len(parts) == 2 and parts[1] == "_delete_by_query":
+            should = body["query"]["bool"]["should"]
+            def hit(src):
+                for cl in should:
+                    if "term" in cl and \
+                            src.get("directory") == cl["term"]["directory"]:
+                        return True
+                    if "prefix" in cl and src.get("directory", "").startswith(
+                            cl["prefix"]["directory"]):
+                        return True
+                return False
+            for k in [k for k, v in index.items() if hit(v)]:
+                del index[k]
+            return 200, {"deleted": 1}
+        if len(parts) == 2 and parts[1] == "_search":
+            filters = body["query"]["bool"]["filter"]
+            def match(src):
+                for cl in filters:
+                    if "term" in cl:
+                        ((f, v),) = cl["term"].items()
+                        if src.get(f) != v:
+                            return False
+                    elif "prefix" in cl:
+                        ((f, v),) = cl["prefix"].items()
+                        if not src.get(f, "").startswith(v):
+                            return False
+                    elif "range" in cl:
+                        ((f, cond),) = cl["range"].items()
+                        if "gt" in cond and not src.get(f, "") > cond["gt"]:
+                            return False
+                        if "gte" in cond and \
+                                not src.get(f, "") >= cond["gte"]:
+                            return False
+                return True
+            hits = sorted((v for v in index.values() if match(v)),
+                          key=lambda v: v.get("name", ""))
+            hits = hits[: body.get("size", 10)]
+            return 200, {"hits": {"hits": [{"_source": h} for h in hits]}}
+        raise AssertionError(f"unhandled ES call: {method} {path}")
+
+
 @pytest.fixture(params=["memory", "sqlite", "logstore", "sql-format",
-                        "cassandra-fake", "tikv-fake"])
+                        "cassandra-fake", "tikv-fake", "elastic-fake"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryStore()
@@ -223,6 +290,9 @@ def store(request, tmp_path):
     elif request.param == "tikv-fake":
         from seaweedfs_tpu.filer.stores_extra import TikvStore
         yield TikvStore(client=FakeRawKV())
+    elif request.param == "elastic-fake":
+        from seaweedfs_tpu.filer.stores_extra import ElasticStore
+        yield ElasticStore(transport=FakeElasticTransport())
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
         yield s

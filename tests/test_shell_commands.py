@@ -525,6 +525,10 @@ class TestBreadthCommands:
         assert "cold" in out
         out = shell(env, "remote.configure -delete true -name cold")
         assert "no remotes" in out
+        # unmount detaches the mapping; entries remain by default
+        out = shell(env, "remote.unmount -dir /rm")
+        assert "detached" in out
+        assert "two.txt" in shell(env, "fs.ls /rm")
 
     def test_mq_commands(self, stack):
         c, filer, broker, env = stack
@@ -668,3 +672,65 @@ def test_ec_encode_auto_selection(tmp_path):
             assert client.download(by_vid[vid]["fid"]) == b"x" * 200 * 1024
     finally:
         c.stop()
+
+
+def test_volume_delete_empty(cluster3):
+    c = cluster3
+    client = WeedClient(c.master.url)
+    fid = client.upload(b"live-data", name="keep.bin")
+    live_vid = int(fid.split(",")[0])
+    import urllib.request
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{c.master.url}/vol/grow?count=2", data=b"",
+        method="POST"), timeout=15).read()
+    time.sleep(1.0)
+    env = CommandEnv(c.master.url)
+    shell(env, "lock")
+    # dry run: reports but deletes nothing
+    out = shell(env, "volume.delete.empty -quietFor 0s")
+    assert "would delete" in out
+    before = {v["id"] for n in env.topology()["nodes"].values()
+              for v in n["volume_infos"]}
+    out = shell(env, "volume.delete.empty -quietFor 0s -force")
+    shell(env, "unlock")
+    assert wait_for(lambda: {
+        v["id"] for n in env.topology()["nodes"].values()
+        for v in n["volume_infos"]} == {live_vid})
+    assert live_vid in before and len(before) > 1
+    assert client.download(fid) == b"live-data"
+
+
+def test_volume_server_evacuate_and_leave(cluster3):
+    c = cluster3
+    client = WeedClient(c.master.url)
+    fids = [client.upload(f"payload-{i}".encode()) for i in range(4)]
+    env = CommandEnv(c.master.url)
+    # EC-encode one volume (its own collection, so the plain-data volume
+    # survives the encode's delete) so the drain must move shard sets too
+    ec_fid = client.upload(b"ec-payload", collection="ecdata")
+    ec_vid = int(ec_fid.split(",")[0])
+    shell(env, "lock")
+    shell(env, f"ec.encode -volumeId {ec_vid} -collection ecdata")
+    shell(env, "unlock")
+    topo = env.topology()
+    # pick the node holding the most volumes
+    victim = max(topo["nodes"],
+                 key=lambda nid: len(topo["nodes"][nid]["volumes"]))
+    held = set(topo["nodes"][victim]["volumes"])
+    assert held
+    shell(env, "lock")
+    out = shell(env, f"volume.server.evacuate -node {victim}")
+    assert "evacuated" in out
+    # the victim holds nothing (volumes OR shards); everything still reads
+    topo = env.topology()
+    assert topo["nodes"][victim]["volumes"] == []
+    assert not any(topo["nodes"][victim].get("ec_shards", {}).values())
+    assert client.download(ec_fid) == b"ec-payload"
+    for i, fid in enumerate(fids):
+        assert client.download(fid) == f"payload-{i}".encode()
+    # leave: the master expires the server from the topology
+    shell(env, f"volume.server.leave -node {victim}")
+    shell(env, "unlock")
+    c.master.node_timeout = 1.5
+    assert wait_for(lambda: victim not in env.topology()["nodes"],
+                    timeout=15)
