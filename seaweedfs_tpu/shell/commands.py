@@ -1769,3 +1769,121 @@ def cmd_remote_unmount(env: CommandEnv, args, out):
         except Exception as e:
             print(f"  entry cleanup failed: {e}", file=out)
     print(f"remote.unmount: {mount_dir} detached", file=out)
+
+
+@command("s3.bucket.quota")
+def cmd_s3_bucket_quota(env: CommandEnv, args, out):
+    """Set/clear a bucket's byte quota, stored on the bucket entry
+    (reference: command_s3_bucket_quota.go).  -name b -quotaMB 100 |
+    -name b -delete; s3.bucket.quota.check enforces."""
+    flags = parse_flags(args)
+    name = flags.get("name", "")
+    if not name:
+        raise RuntimeError("-name required")
+    filer = env.find_filer()
+    entry = env.master_get_raw(filer, f"/buckets/{name}",
+                               metadata="true")
+    if "delete" in flags:
+        entry["quota"] = 0
+    elif "quotaMB" not in flags:
+        raise RuntimeError("-quotaMB <megabytes> or -delete required")
+    else:
+        entry["quota"] = int(float(flags["quotaMB"]) * 1024 * 1024)
+    env._call(f"{filer}/__admin__/entry", {"entry": entry})
+    q = entry["quota"]
+    print(f"bucket {name}: quota "
+          + (f"{q} bytes" if q else "removed"), file=out)
+
+
+@command("s3.bucket.quota.check")
+def cmd_s3_bucket_quota_check(env: CommandEnv, args, out):
+    """Walk each bucket's usage and enforce its quota by toggling a
+    read-only filer rule on the bucket prefix (reference:
+    command_s3_bucket_quota_check.go; the reference emails/flips
+    read-only the same way).  Dry-run unless -apply."""
+    flags = parse_flags(args)
+    apply = "apply" in flags
+    filer = env.find_filer()
+
+    def usage(d: str) -> int:
+        total = 0
+        for e in env.filer_list(filer, d):
+            if e.get("IsDirectory"):
+                total += usage(e["FullPath"])
+            else:
+                total += e.get("FileSize", 0)
+        return total
+
+    conf = env.master_get_raw(filer, "/__admin__/filer_conf")
+    rules = conf.get("locations", [])
+    changed = 0
+    for b in env.filer_list(filer, "/buckets"):
+        if not b.get("IsDirectory"):
+            continue
+        name = b["FullPath"].rsplit("/", 1)[-1]
+        entry = env.master_get_raw(filer, f"/buckets/{name}",
+                                   metadata="true")
+        quota = int(entry.get("quota", 0) or 0)
+        if quota <= 0:
+            continue
+        used = usage(f"/buckets/{name}")
+        prefix = f"/buckets/{name}/"
+        rule = next((r for r in rules
+                     if r.get("location_prefix") == prefix), None)
+        over = used > quota
+        state = "OVER" if over else "ok"
+        print(f"bucket {name}: {used}/{quota} bytes [{state}]", file=out)
+        # merge into any existing rule at this prefix — a lifecycle TTL
+        # (or other settings) at /buckets/<b>/ must survive the toggle
+        if over and not (rule and rule.get("read_only")):
+            if apply:
+                merged = dict(rule or {"location_prefix": prefix,
+                                       "collection": name})
+                merged["read_only"] = True
+                env._call(f"{filer}/__admin__/filer_conf", merged)
+                changed += 1
+            else:
+                print(f"  would mark {prefix} read-only (-apply)",
+                      file=out)
+        elif not over and rule and rule.get("read_only"):
+            if apply:
+                keeps_other = any(rule.get(k) for k in
+                                  ("ttl", "replication", "fsync",
+                                   "disk_type"))
+                if keeps_other:
+                    env._call(f"{filer}/__admin__/filer_conf",
+                              dict(rule, read_only=False))
+                else:
+                    env._call(f"{filer}/__admin__/filer_conf",
+                              {"delete_prefix": prefix})
+                changed += 1
+            else:
+                print(f"  would clear read-only on {prefix} (-apply)",
+                      file=out)
+    if apply:
+        print(f"{changed} rule change(s) applied", file=out)
+
+
+@command("mq.balance")
+def cmd_mq_balance(env: CommandEnv, args, out):
+    """Show the deterministic partition->broker assignment for every topic
+    (reference: command_mq_balance.go triggers the balancer; this ring
+    balances continuously, so the command reports the settled layout)."""
+    brokers = env.master_get_raw(env.master, "/cluster/status") \
+        .get("Members", {}).get("broker", [])
+    if not brokers:
+        print("no brokers registered", file=out)
+        return
+    listing = env.master_get_raw(sorted(brokers)[0], "/topics/list")
+    # the queried broker's ring can momentarily be [] during a master
+    # heartbeat lapse; fall back to the registry view
+    ring = listing.get("brokers") or sorted(brokers)
+    print(f"broker ring: {ring}", file=out)
+    for t in listing.get("topics", []):
+        n = t["partition_count"]
+        print(f"{t['name']}: {n} partition(s)", file=out)
+        for pi in range(n):
+            follower = ring[(pi + 1) % len(ring)] if len(ring) > 1 else "-"
+            print(f"  p{pi}: owner {ring[pi % len(ring)]} "
+                  f"follower {follower} "
+                  f"next_offset {t['next_offsets'][pi]}", file=out)

@@ -539,6 +539,8 @@ class TestBreadthCommands:
         assert "shell.t" in out
         out = shell(env, "mq.topic.desc -topic shell.t")
         assert "partition 0" in out and "partition 1" in out
+        out = shell(env, "mq.balance")
+        assert "broker ring" in out and "shell.t" in out and "p1:" in out
 
     def test_ec_cleanup_dry_run(self, stack):
         c, filer, broker, env = stack
@@ -734,3 +736,61 @@ def test_volume_server_evacuate_and_leave(cluster3):
     c.master.node_timeout = 1.5
     assert wait_for(lambda: victim not in env.topology()["nodes"],
                     timeout=15)
+
+
+def test_s3_bucket_quota_lifecycle(tmp_path):
+    """Quota set -> check flips the bucket read-only when over; deletes
+    under quota clear it (reference: command_s3_bucket_quota*.go)."""
+    import urllib.request
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port(),
+                        data_dir=str(tmp_path / "f"))
+    c.submit(filer.start())
+    try:
+        env = CommandEnv(c.master.url)
+        assert wait_for(lambda: c.master.cluster_members.get("filer"))
+        shell(env, "s3.bucket.create -name qb")
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{filer.url}/buckets/qb/a.bin", data=b"x" * 4096,
+            method="PUT"), timeout=15).read()
+        out = shell(env, "s3.bucket.quota -name qb -quotaMB 0.001")  # 1048B
+        assert "quota 1048 bytes" in out
+        # a lifecycle-style TTL rule at the bucket prefix must survive the
+        # quota toggles below
+        shell(env, "fs.configure -locationPrefix /buckets/qb/ -ttl 7d "
+                   "-collection qb -apply")
+        out = shell(env, "s3.bucket.quota.check")
+        assert "OVER" in out and "would mark" in out
+        out = shell(env, "s3.bucket.quota.check -apply")
+        assert "1 rule change(s) applied" in out
+        # bucket writes now refuse (the filer's read-only rule)
+        st = 0
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://{filer.url}/buckets/qb/b.bin", data=b"y",
+                method="PUT"), timeout=15)
+            st = 200
+        except urllib.error.HTTPError as e:
+            st = e.code
+        assert st == 403
+        # free space; check clears the rule; writes flow again
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{filer.url}/buckets/qb/a.bin", method="DELETE"),
+            timeout=15).read()
+        out = shell(env, "s3.bucket.quota.check -apply")
+        assert "[ok]" in out
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{filer.url}/buckets/qb/c.bin", data=b"z",
+            method="PUT"), timeout=15).read()
+        conf = env.master_get_raw(filer.url, "/__admin__/filer_conf")
+        rule = next(r for r in conf["locations"]
+                    if r["location_prefix"] == "/buckets/qb/")
+        assert rule["ttl"] == "7d" and not rule.get("read_only")
+        # quota removal
+        out = shell(env, "s3.bucket.quota -name qb -delete true")
+        assert "removed" in out
+    finally:
+        c.submit(filer.stop())
+        c.stop()
