@@ -121,6 +121,7 @@ class FilerServer:
             web.get("/__admin__/remote_mounts", self.handle_get_mounts),
             web.post("/__admin__/remote_mounts", self.handle_put_mounts),
             web.post("/__admin__/filer_conf", self.handle_put_conf),
+            web.post("/__admin__/notify", self.handle_notify_subtree),
             web.get("/__admin__/status", self.handle_status),
             web.get("/__ui__", self.handle_ui),
             web.get("/metrics", self.handle_metrics),
@@ -1131,6 +1132,32 @@ class FilerServer:
             kind, options = parse_remote_spec(spec)
             client = cache[spec] = make_remote(kind, **options)
         return client, best
+
+    async def handle_notify_subtree(self, req: web.Request) -> web.Response:
+        """Re-send every entry under a prefix to the notification queue as
+        a create event (reference: command_fs_meta_notify.go) — primes a
+        fresh replication consumer with the existing tree."""
+        if self.notification is None:
+            return web.json_response(
+                {"error": "no notification queue configured"}, status=400)
+        body = await req.json()
+        prefix = (body.get("prefix") or "/").rstrip("/") or "/"
+        sent = 0
+
+        def walk(d: str) -> None:
+            nonlocal sent
+            for e in self.filer.iter_entries(d):
+                self.notification.send(e.directory, {
+                    "directory": e.directory,
+                    "old_entry": None,
+                    "new_entry": e.to_dict(),
+                })
+                sent += 1
+                if e.is_directory:
+                    walk(e.full_path)
+
+        await asyncio.to_thread(walk, prefix)
+        return web.json_response({"sent": sent})
 
     async def handle_get_conf(self, req: web.Request) -> web.Response:
         return web.Response(text=self.conf.to_json(),

@@ -1887,3 +1887,79 @@ def cmd_mq_balance(env: CommandEnv, args, out):
             print(f"  p{pi}: owner {ring[pi % len(ring)]} "
                   f"follower {follower} "
                   f"next_offset {t['next_offsets'][pi]}", file=out)
+
+
+@command("fs.meta.notify")
+def cmd_fs_meta_notify(env: CommandEnv, args, out):
+    """Recursively re-send a directory's metadata to the filer's
+    notification queue (reference: command_fs_meta_notify.go) — primes a
+    replication consumer with the existing tree."""
+    path = env.resolve(
+        (args and not args[-1].startswith("-") and args[-1]) or ".")
+    filer = env.find_filer()
+    r = env._call(f"{filer}/__admin__/notify", {"prefix": path})
+    print(f"notified {r.get('sent', 0)} entr(ies) under {path}", file=out)
+
+
+@command("fs.meta.change.volume.id")
+def cmd_fs_meta_change_volume_id(env: CommandEnv, args, out):
+    """Rewrite chunk fids from one volume id to another across a subtree
+    (reference: command_fs_meta_change_volume_id.go) — the metadata half
+    of renumbering a volume.  -dir / -fromVolumeId X -toVolumeId Y
+    [-mapping file-with-x=>y-lines] [-force to apply]."""
+    flags = parse_flags(args)
+    mapping: dict[int, int] = {}
+    if flags.get("mapping"):
+        with open(flags["mapping"]) as f:
+            for line in f:
+                line = line.strip()
+                if not line or "=>" not in line:
+                    continue
+                a, b = line.split("=>", 1)
+                mapping[int(a)] = int(b)
+    else:
+        src, dst = int(flags.get("fromVolumeId", "0")), \
+            int(flags.get("toVolumeId", "0"))
+        if not src or not dst or src == dst:
+            raise RuntimeError("-fromVolumeId and -toVolumeId must be "
+                               "distinct and non-zero (or use -mapping)")
+        mapping[src] = dst
+    force = "force" in flags
+    root = flags.get("dir", "/")
+    filer = env.find_filer()
+    changed = 0
+
+    def walk(d: str) -> None:
+        nonlocal changed
+        for e in env.filer_list(filer, d):
+            if e.get("IsDirectory"):
+                walk(e["FullPath"])
+                continue
+            entry = env.master_get_raw(
+                filer, urllib.parse.quote(e["FullPath"]), metadata="true")
+            dirty = False
+            for c in entry.get("chunks", []):
+                if c.get("is_chunk_manifest"):
+                    print(f"  skip manifest file {e['FullPath']} "
+                          "(not implemented)", file=out)
+                    break
+                vid_s, _, rest = c.get("fid", "").partition(",")
+                try:
+                    vid = int(vid_s)
+                except ValueError:
+                    continue
+                if vid in mapping:
+                    c["fid"] = f"{mapping[vid]},{rest}"
+                    dirty = True
+            else:
+                if dirty:
+                    changed += 1
+                    print(f"  {'updating' if force else 'would update'} "
+                          f"{e['FullPath']}", file=out)
+                    if force:
+                        env._call(f"{filer}/__admin__/entry",
+                                  {"entry": entry})
+
+    walk(root.rstrip("/") or "/")
+    print(f"{changed} file(s) {'updated' if force else 'need updating'}"
+          + ("" if force else " (dry run; add -force)"), file=out)

@@ -794,3 +794,54 @@ def test_s3_bucket_quota_lifecycle(tmp_path):
     finally:
         c.submit(filer.stop())
         c.stop()
+
+
+def test_fs_meta_notify_and_change_volume_id(tmp_path):
+    import json
+    import urllib.request
+    from seaweedfs_tpu.notification import MemoryQueue
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    q = MemoryQueue()
+    filer = FilerServer(c.master.url, port=free_port(),
+                        data_dir=str(tmp_path / "f"), notification=q)
+    c.submit(filer.start())
+    try:
+        env = CommandEnv(c.master.url)
+        assert wait_for(lambda: c.master.cluster_members.get("filer"))
+        for p in ("/nt/a.txt", "/nt/sub/b.txt"):
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://{filer.url}{p}", data=b"x", method="PUT"),
+                timeout=15).read()
+        q.messages.clear()  # drop the live events; notify replays
+        out = shell(env, "fs.meta.notify /nt")
+        # a.txt + sub + sub/b.txt
+        assert "notified 3 entr(ies)" in out
+        paths = {(m.get("new_entry") or {}).get("full_path")
+                 for _, m in q.messages}
+        assert {"/nt/a.txt", "/nt/sub", "/nt/sub/b.txt"} <= paths
+
+        # change volume id metadata: dry run then forced rewrite
+        meta = json.loads(urllib.request.urlopen(
+            f"http://{filer.url}/nt/a.txt?metadata=true",
+            timeout=15).read())
+        vid = int(meta["chunks"][0]["fid"].split(",")[0])
+        out = shell(env, f"fs.meta.change.volume.id -dir /nt "
+                         f"-fromVolumeId {vid} -toVolumeId {vid + 90}")
+        assert "need updating" in out and "dry run" in out
+        meta2 = json.loads(urllib.request.urlopen(
+            f"http://{filer.url}/nt/a.txt?metadata=true",
+            timeout=15).read())
+        assert meta2["chunks"][0]["fid"].startswith(f"{vid},")
+        out = shell(env, f"fs.meta.change.volume.id -dir /nt "
+                         f"-fromVolumeId {vid} -toVolumeId {vid + 90} "
+                         f"-force")
+        assert "updated" in out
+        meta3 = json.loads(urllib.request.urlopen(
+            f"http://{filer.url}/nt/a.txt?metadata=true",
+            timeout=15).read())
+        assert meta3["chunks"][0]["fid"].startswith(f"{vid + 90},")
+    finally:
+        c.submit(filer.stop())
+        c.stop()
