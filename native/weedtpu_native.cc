@@ -38,6 +38,11 @@ static uint8_t GF_MUL[256][256];
 // (low nibble), MUL_HI[c][x] = c*(x<<4).  c*b = MUL_LO[c][b&15] ^ MUL_HI[c][b>>4].
 static uint8_t MUL_LO[256][16];
 static uint8_t MUL_HI[256][16];
+// GFNI affine matrices: multiply-by-c over GF(2^8) is GF(2)-linear, so it is
+// one 8x8 bit-matrix — GF2P8AFFINEQB applies it to 64 bytes per instruction.
+// Layout per the ISA: result bit b of each byte = parity(A.byte[7-b] & x),
+// so A.byte[7-b] bit t = bit b of (c * 2^t).
+static uint64_t GF_AFFINE[256];
 static int gf_initialized = 0;
 
 static uint8_t gf_mul_slow(uint8_t a, uint8_t b) {
@@ -62,6 +67,16 @@ void wn_gf_init(void) {
       MUL_LO[c][x] = GF_MUL[c][x];
       MUL_HI[c][x] = GF_MUL[c][x << 4];
     }
+  }
+  for (int c = 0; c < 256; c++) {
+    uint64_t A = 0;
+    for (int b = 0; b < 8; b++) {
+      uint8_t row = 0;
+      for (int t = 0; t < 8; t++)
+        if ((GF_MUL[c][1 << t] >> b) & 1) row = (uint8_t)(row | (1u << t));
+      A |= (uint64_t)row << (8 * (7 - b));
+    }
+    GF_AFFINE[c] = A;
   }
   gf_initialized = 1;
 }
@@ -205,18 +220,120 @@ static void gf_matmul_avx2_group(const uint8_t* mat, int r0, int nrows, int k,
 }
 #endif
 
+#if defined(__x86_64__)
+// GFNI + AVX512: one gf2p8affineqb per (coefficient, 64-byte lane) replaces
+// the whole pshufb split-table dance — the encode becomes memory-bound on
+// any GFNI host.  Guarded by runtime CPUID (compiled via target attribute,
+// so the .so still loads and runs on plain-AVX2 machines).
+__attribute__((target("gfni,avx512f,avx512bw,avx512vl")))
+static void gf_matmul_gfni_group(const uint8_t* mat, int r0, int nrows, int k,
+                                 const uint8_t* const* in_rows,
+                                 uint8_t* const* out_rows, size_t n) {
+  size_t col = 0;
+  for (; col + 128 <= n; col += 128) {
+    __m512i acc[4][2];
+    for (int r = 0; r < nrows; r++)
+      acc[r][0] = acc[r][1] = _mm512_setzero_si512();
+    for (int j = 0; j < k; j++) {
+      const uint8_t* src = in_rows[j] + col;
+      __m512i v0 = _mm512_loadu_si512((const void*)src);
+      __m512i v1 = _mm512_loadu_si512((const void*)(src + 64));
+      for (int r = 0; r < nrows; r++) {
+        uint8_t c = mat[(size_t)(r0 + r) * k + j];
+        if (c == 0) continue;
+        __m512i A = _mm512_set1_epi64((long long)GF_AFFINE[c]);
+        acc[r][0] = _mm512_xor_si512(
+            acc[r][0], _mm512_gf2p8affine_epi64_epi8(v0, A, 0));
+        acc[r][1] = _mm512_xor_si512(
+            acc[r][1], _mm512_gf2p8affine_epi64_epi8(v1, A, 0));
+      }
+    }
+    for (int r = 0; r < nrows; r++) {
+      uint8_t* dst = out_rows[r0 + r] + col;
+      _mm512_storeu_si512((void*)dst, acc[r][0]);
+      _mm512_storeu_si512((void*)(dst + 64), acc[r][1]);
+    }
+  }
+  // scalar tail (< 128 bytes)
+  for (; col < n; col++) {
+    for (int r = 0; r < nrows; r++) {
+      uint8_t a = 0;
+      for (int j = 0; j < k; j++) {
+        uint8_t c = mat[(size_t)(r0 + r) * k + j];
+        if (c) a ^= GF_MUL[c][in_rows[j][col]];
+      }
+      out_rows[r0 + r][col] = a;
+    }
+  }
+}
+
+__attribute__((target("xsave")))
+static int detect_gfni(void) {
+  // GFNI (leaf 7 ECX bit 8) + AVX512F (EBX bit 16) + AVX512BW (EBX bit 30)
+  unsigned a, b, c, d;
+  if (!__get_cpuid_count(7, 0, &a, &b, &c, &d)) return 0;
+  if (!((c >> 8) & 1)) return 0;
+  if (!((b >> 16) & 1) || !((b >> 30) & 1)) return 0;
+  // OS must enable ZMM state (XCR0 bits 5:7 via OSXSAVE)
+  if (!__get_cpuid(1, &a, &b, &c, &d) || !((c >> 27) & 1)) return 0;
+  uint64_t xcr0 = _xgetbv(0);
+  return (xcr0 & 0xE6) == 0xE6;
+}
+#endif
+
+// 0 = auto (best available), 1 = force AVX2 split-table, 2 = force scalar,
+// 3 = force GFNI (falls back to auto-best when the host lacks it).  The AVX2
+// force keeps the klauspost-equivalent baseline measurable on GFNI hosts
+// (bench.py benchmarks both and reports the ratio).
+static int gf_impl_force = 0;
+
+void wn_gf_set_impl(int impl) { gf_impl_force = impl; }
+
+int wn_gf_impl(void) {
+#if defined(__x86_64__)
+  static int has_gfni = -1;
+  if (has_gfni < 0) has_gfni = detect_gfni();
+#if defined(__AVX2__)
+  int best = has_gfni ? 3 : 1;  // 3 = gfni+avx512
+#else
+  int best = has_gfni ? 3 : 2;
+#endif
+  switch (gf_impl_force) {
+    case 1: return 1;
+    case 2: return 2;
+    case 3: return has_gfni ? 3 : best;
+    default: return best;
+  }
+#else
+  (void)gf_impl_force;
+  return 2;
+#endif
+}
+
 // Shared ptr-based core used by both entry points.
 static void gf_matmul_rows(const uint8_t* mat, int rows, int k,
                            const uint8_t* const* in_rows,
                            uint8_t* const* out_rows, size_t n) {
-#if defined(__AVX2__)
-  for (int r0 = 0; r0 < rows; r0 += 4) {
-    int nrows = rows - r0 < 4 ? rows - r0 : 4;
-    gf_matmul_avx2_group(mat, r0, nrows, k, in_rows, out_rows, n);
+#if defined(__x86_64__)
+  if (wn_gf_impl() == 3) {
+    for (int r0 = 0; r0 < rows; r0 += 4) {
+      int nrows = rows - r0 < 4 ? rows - r0 : 4;
+      gf_matmul_gfni_group(mat, r0, nrows, k, in_rows, out_rows, n);
+    }
+    return;
   }
-#else
-  // Cache-blocked fallback: 16KB column panels keep the k input sub-blocks
-  // resident in L2 across all output rows.
+#endif
+#if defined(__AVX2__)
+  if (wn_gf_impl() != 2) {
+    for (int r0 = 0; r0 < rows; r0 += 4) {
+      int nrows = rows - r0 < 4 ? rows - r0 : 4;
+      gf_matmul_avx2_group(mat, r0, nrows, k, in_rows, out_rows, n);
+    }
+    return;
+  }
+#endif
+  // Cache-blocked scalar fallback: 16KB column panels keep the k input
+  // sub-blocks resident in L2 across all output rows.
   const size_t BLK = 16 * 1024;
   for (size_t col = 0; col < n; col += BLK) {
     size_t w = n - col < BLK ? n - col : BLK;
@@ -226,13 +343,12 @@ static void gf_matmul_rows(const uint8_t* mat, int rows, int k,
       for (int j = 0; j < k; j++) {
         uint8_t c = mat[(size_t)r * k + j];
         if (c == 0) continue;
-        wn_gf_mul_slice(c, in_rows[j] + col, dst, w, !first);
+        gf_mul_slice_scalar(c, in_rows[j] + col, dst, w, !first);
         first = 0;
       }
       if (first) memset(dst, 0, w);
     }
   }
-#endif
 }
 
 void wn_gf_matmul(const uint8_t* mat, int rows, int k, const uint8_t* in,

@@ -80,6 +80,8 @@ def _load():
         lib.wn_gf_matmul_ptrs.argtypes = [
             _u8p, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(_u8p), ctypes.POINTER(_u8p), ctypes.c_size_t]
+        lib.wn_gf_set_impl.argtypes = [ctypes.c_int]
+        lib.wn_gf_impl.restype = ctypes.c_int
         lib.wn_crc32c.restype = ctypes.c_uint32
         lib.wn_crc32c.argtypes = [_u8p, ctypes.c_size_t, ctypes.c_uint32]
         lib.wn_aes256_ctr.argtypes = [_u8p, _u8p, _u8p, _u8p, ctypes.c_size_t]
@@ -112,6 +114,21 @@ def _require():
 
 def _as_u8p(a) -> _u8p:
     return a.ctypes.data_as(_u8p)
+
+
+GF_IMPL_AUTO, GF_IMPL_AVX2, GF_IMPL_SCALAR, GF_IMPL_GFNI = 0, 1, 2, 3
+
+
+def gf_impl() -> int:
+    """Active GF matmul kernel: 1=AVX2 split-table, 2=scalar, 3=GFNI+AVX512."""
+    return int(_require().wn_gf_impl())
+
+
+def set_gf_impl(impl: int) -> None:
+    """Force a kernel (GF_IMPL_*): lets bench.py measure the AVX2 path (the
+    klauspost-equivalent baseline) on GFNI hosts. GF_IMPL_AUTO restores
+    best-available dispatch."""
+    _require().wn_gf_set_impl(int(impl))
 
 
 def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:

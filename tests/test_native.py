@@ -32,6 +32,27 @@ def test_gf_matmul_matches_reference():
     assert (got == want).all()
 
 
+def test_gf_matmul_impls_agree():
+    """Every compiled kernel (scalar / AVX2 / GFNI where the host has it)
+    produces identical output — the GFNI affine-matrix construction is
+    cross-checked against the split-table path, not just the field axioms."""
+    rng = np.random.default_rng(7)
+    mat = rng.integers(0, 256, (5, 12), dtype=np.uint8)
+    data = rng.integers(0, 256, (12, 8192 + 77), dtype=np.uint8)
+    auto_impl = native.gf_impl()
+    results = {}
+    try:
+        for impl in (native.GF_IMPL_SCALAR, native.GF_IMPL_AVX2,
+                     native.GF_IMPL_AUTO):
+            native.set_gf_impl(impl)
+            results[impl] = native.gf_matmul(mat, data)
+    finally:
+        native.set_gf_impl(native.GF_IMPL_AUTO)
+    want = gf.gf_matmul(mat, data)
+    for impl, got in results.items():
+        assert (got == want).all(), (impl, auto_impl)
+
+
 def test_gf_mul_slice_accumulate():
     rng = np.random.default_rng(3)
     src = rng.integers(0, 256, 1000, dtype=np.uint8)
