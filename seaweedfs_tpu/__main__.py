@@ -197,6 +197,24 @@ def main(argv=None) -> int:
     psy.add_argument("-offsetFile", default=".filer_sync_offsets.json")
     psy.add_argument("-oneway", action="store_true")
 
+    prp = sub.add_parser(
+        "filer.replicate",
+        help="consume filer meta events from a notification queue and "
+             "apply them to a replication sink "
+             "(command/filer_replicate.go)")
+    prp.add_argument("-filer", default="127.0.0.1:8888",
+                     help="filer to read file content from")
+    prp.add_argument("-notificationLog", required=True,
+                     help="JSONL file written by the filer's `log` "
+                          "notification queue")
+    prp.add_argument("-sink", required=True,
+                     help="kind:spec, e.g. local:/mirror or "
+                          "s3:endpoint=..,bucket=..,access_key=..,"
+                          "secret_key=.. or filer:host:port")
+    prp.add_argument("-filerPath", default="/",
+                     help="only replicate events under this prefix")
+    prp.add_argument("-offsetFile", default=".filer_replicate_offsets.json")
+
     pwd = sub.add_parser("webdav",
                          help="WebDAV gateway over a filer (webdav_server.go)")
     pwd.add_argument("-ip", default="127.0.0.1")
@@ -244,7 +262,7 @@ def main(argv=None) -> int:
                       help="comma-separated SAN hosts/IPs")
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs):
+              psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs, prp):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -298,6 +316,25 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "filer.backup":
         return _run_filer_backup(args)
+    if args.cmd == "filer.replicate":
+        from seaweedfs_tpu.replication.replicate_daemon import (
+            LogFileSource, ReplicateDaemon, read_file_via_filer)
+        from seaweedfs_tpu.replication.sink import make_sink
+        if args.sink.startswith("filer:"):
+            sink = make_sink("filer", filer_url=args.sink[len("filer:"):])
+        else:
+            from seaweedfs_tpu.remote_storage import parse_remote_spec
+            kind, options = parse_remote_spec(args.sink)
+            sink = make_sink(kind, **options)
+        daemon = ReplicateDaemon(
+            LogFileSource(args.notificationLog), sink,
+            read_file_via_filer(args.filer), prefix=args.filerPath,
+            offset_path=args.offsetFile)
+        try:
+            daemon.run()
+        except KeyboardInterrupt:
+            pass
+        return 0
     if args.cmd == "certs":
         from seaweedfs_tpu.security import tls as tls_mod
         table = tls_mod.generate_certs(

@@ -54,9 +54,11 @@ class MemoryQueue(MessageQueue):
 
     def __init__(self, maxlen: int = 65536):
         self.messages: deque = deque(maxlen=maxlen)
+        self.sent = 0  # total ever sent: lets consumers detect eviction
 
     def send(self, key: str, message: dict) -> None:
         self.messages.append((key, message))
+        self.sent += 1
 
 
 class WebhookQueue(MessageQueue):

@@ -141,7 +141,9 @@ class IdentityAccessManagement:
     def verify_payload_hash(headers, body: bytes) -> None:
         """Compare the signed x-amz-content-sha256 against the actual body.
         Called by the gateway after it has read the body (kept separate from
-        authenticate() so auth happens before buffering the payload)."""
+        authenticate() so auth happens before buffering the payload).
+        STREAMING-* bodies are NOT skipped silently: their integrity is
+        enforced per chunk by decode_aws_chunked + chunked_context."""
         sha_hdr = headers.get("x-amz-content-sha256", "")
         if not sha_hdr or sha_hdr == "UNSIGNED-PAYLOAD" or \
                 sha_hdr.startswith("STREAMING-"):
@@ -150,6 +152,40 @@ class IdentityAccessManagement:
             raise AuthError("XAmzContentSHA256Mismatch",
                             "The provided 'x-amz-content-sha256' header does "
                             "not match what was computed.", 400)
+
+    def chunked_context(self, headers) -> "StreamingContext | None":
+        """Per-chunk signature context for a STREAMING-AWS4-HMAC-SHA256
+        upload (reference: chunked_reader_v4.go:38-60).  The seed signature
+        is the (already verified) Authorization header signature; each chunk
+        then chains off it.  Returns None for unsigned streaming variants
+        (STREAMING-UNSIGNED-PAYLOAD-TRAILER — integrity there is the
+        trailing checksum, not a signature chain)."""
+        sha_hdr = headers.get("x-amz-content-sha256", "")
+        if not sha_hdr.startswith("STREAMING-AWS4-HMAC-SHA256"):
+            return None
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256"):
+            raise AuthError("AccessDenied",
+                            "streaming upload requires V4 header auth")
+        try:
+            parts = dict(
+                p.strip().split("=", 1)
+                for p in auth[len("AWS4-HMAC-SHA256"):].strip().split(","))
+            cred_scope = parts["Credential"].split("/")
+            access_key, datestamp, region, service = (
+                cred_scope[0], cred_scope[1], cred_scope[2], cred_scope[3])
+            seed_sig = parts["Signature"]
+        except (KeyError, IndexError, ValueError):
+            raise AuthError("AuthorizationHeaderMalformed",
+                            "cannot parse Authorization header", 400)
+        _, cred = self.lookup(access_key)
+        amz_date = headers.get("x-amz-date", headers.get("X-Amz-Date", ""))
+        return StreamingContext(
+            sig_key=self._sig_key(cred.secret_key, datestamp, region,
+                                  service),
+            seed_sig=seed_sig,
+            amz_date=amz_date,
+            scope=f"{datestamp}/{region}/{service}/aws4_request")
 
     @staticmethod
     def _check_skew(amz_date: str) -> None:
@@ -328,17 +364,129 @@ class IdentityAccessManagement:
         return ident
 
 
+@dataclass
+class StreamingContext:
+    """Everything decode_aws_chunked needs to verify a signed chunk chain."""
+    sig_key: bytes
+    seed_sig: str
+    amz_date: str
+    scope: str
+
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _chunk_signature(ctx: StreamingContext, prev_sig: str,
+                     data: bytes) -> str:
+    sts = "\n".join([
+        "AWS4-HMAC-SHA256-PAYLOAD", ctx.amz_date, ctx.scope, prev_sig,
+        _EMPTY_SHA256, hashlib.sha256(data).hexdigest()])
+    return hmac.new(ctx.sig_key, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def decode_aws_chunked(body: bytes, ctx: StreamingContext | None,
+                       decoded_length: int | None = None) -> bytes:
+    """Decode an aws-chunked streaming payload
+    (`hex-size;chunk-signature=...\\r\\n<data>\\r\\n ... 0;...\\r\\n`),
+    cryptographically verifying every chunk-signature against the chain
+    seeded by the header signature when `ctx` is given (reference:
+    chunked_reader_v4.go:170-214 — a forged or reordered chunk is a 403,
+    and truncated/malformed framing is a 400, never a silently shortened
+    object).  With ctx=None (unsigned streaming / auth disabled) the
+    framing is stripped and only well-formedness + decoded length are
+    enforced.  Trailing `x-amz-trailer-signature` is verified when the
+    stream is signed; other trailers (checksums) are accepted."""
+    out = bytearray()
+    prev_sig = ctx.seed_sig if ctx else ""
+    i = 0
+    final_seen = False
+    while i < len(body):
+        nl = body.find(b"\r\n", i)
+        if nl < 0:
+            raise AuthError("IncompleteBody", "truncated chunk header", 400)
+        header = body[i:nl]
+        fields = header.split(b";")
+        try:
+            size = int(fields[0], 16)
+        except ValueError:
+            raise AuthError("IncompleteBody", "malformed chunk size", 400)
+        chunk_sig = None
+        for f in fields[1:]:
+            name, _, val = f.partition(b"=")
+            if name.strip() == b"chunk-signature":
+                chunk_sig = val.strip().decode("ascii", "replace")
+        start = nl + 2
+        data = body[start:start + size]
+        if len(data) != size:
+            raise AuthError("IncompleteBody", "truncated chunk data", 400)
+        if ctx is not None:
+            if chunk_sig is None:
+                raise AuthError("AccessDenied",
+                                "missing chunk-signature in signed stream")
+            want = _chunk_signature(ctx, prev_sig, data)
+            if not hmac.compare_digest(want, chunk_sig):
+                raise AuthError("SignatureDoesNotMatch",
+                                "chunk signature mismatch")
+            prev_sig = want
+        out += data
+        i = start + size
+        if body[i:i + 2] == b"\r\n":
+            i += 2
+        if size == 0:
+            final_seen = True
+            break
+    if not final_seen:
+        raise AuthError("IncompleteBody", "missing final chunk", 400)
+    # trailing headers (checksum trailers and/or x-amz-trailer-signature).
+    # The trailer signature chains off the final chunk signature and covers
+    # sha256 of the canonicalized trailer lines ("name:value\n" each).
+    trailer_canon = bytearray()
+    while i < len(body):
+        nl = body.find(b"\r\n", i)
+        line = body[i:nl] if nl >= 0 else body[i:]
+        i = nl + 2 if nl >= 0 else len(body)
+        if not line:
+            continue
+        name, _, val = line.partition(b":")
+        if name.strip() == b"x-amz-trailer-signature":
+            if ctx is not None:
+                sts = "\n".join([
+                    "AWS4-HMAC-SHA256-TRAILER", ctx.amz_date, ctx.scope,
+                    prev_sig,
+                    hashlib.sha256(bytes(trailer_canon)).hexdigest()])
+                want = hmac.new(ctx.sig_key, sts.encode(),
+                                hashlib.sha256).hexdigest()
+                got = val.strip().decode("ascii", "replace")
+                if not hmac.compare_digest(want, got):
+                    raise AuthError("SignatureDoesNotMatch",
+                                    "trailer signature mismatch")
+        else:
+            trailer_canon += name.strip().lower() + b":" + val.strip() + b"\n"
+    if decoded_length is not None and len(out) != decoded_length:
+        raise AuthError(
+            "IncompleteBody",
+            "You did not provide the number of bytes specified by the "
+            "x-amz-decoded-content-length header", 400)
+    return bytes(out)
+
+
 def sign_v4(cred: Credential, method: str, host: str, path: str,
             query: dict[str, str], region: str = "us-east-1",
-            payload: bytes = b"", amz_date: str | None = None) -> dict:
+            payload: bytes = b"", amz_date: str | None = None,
+            payload_hash: str | None = None,
+            extra_headers: dict | None = None) -> dict:
     """Client-side V4 signer (for tests and the replication sink client).
-    Returns headers to attach."""
+    Returns headers to attach.  `payload_hash` overrides the computed sha256
+    (for STREAMING-* uploads); `extra_headers` are signed along."""
     if amz_date is None:
         amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     datestamp = amz_date[:8]
-    payload_hash = hashlib.sha256(payload).hexdigest()
+    if payload_hash is None:
+        payload_hash = hashlib.sha256(payload).hexdigest()
     headers = {"Host": host, "x-amz-date": amz_date,
                "x-amz-content-sha256": payload_hash}
+    if extra_headers:
+        headers.update(extra_headers)
     signed = sorted(h.lower() for h in headers)
     iam = IdentityAccessManagement
     creq = "\n".join([
@@ -355,3 +503,37 @@ def sign_v4(cred: Credential, method: str, host: str, path: str,
         f"AWS4-HMAC-SHA256 Credential={cred.access_key}/{datestamp}/{region}"
         f"/s3/aws4_request, SignedHeaders={';'.join(signed)}, Signature={sig}")
     return headers
+
+
+def sign_v4_chunked(cred: Credential, method: str, host: str, path: str,
+                    query: dict[str, str], payload: bytes,
+                    region: str = "us-east-1",
+                    chunk_size: int = 64 * 1024,
+                    amz_date: str | None = None) -> tuple[dict, bytes]:
+    """Client-side STREAMING-AWS4-HMAC-SHA256-PAYLOAD signer: returns
+    (headers, aws-chunked body with a verified chunk-signature chain) — the
+    wire format aws-cli/SDKs produce for streaming PUTs."""
+    if amz_date is None:
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    datestamp = amz_date[:8]
+    headers = sign_v4(
+        cred, method, host, path, query, region=region, amz_date=amz_date,
+        payload_hash="STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+        extra_headers={"Content-Encoding": "aws-chunked",
+                       "x-amz-decoded-content-length": str(len(payload))})
+    seed_sig = headers["Authorization"].rsplit("Signature=", 1)[1]
+    ctx = StreamingContext(
+        sig_key=IdentityAccessManagement._sig_key(
+            cred.secret_key, datestamp, region, "s3"),
+        seed_sig=seed_sig, amz_date=amz_date,
+        scope=f"{datestamp}/{region}/s3/aws4_request")
+    body = bytearray()
+    prev = seed_sig
+    chunks = [payload[i:i + chunk_size]
+              for i in range(0, len(payload), chunk_size)] + [b""]
+    for data in chunks:
+        sig = _chunk_signature(ctx, prev, data)
+        body += f"{len(data):x};chunk-signature={sig}\r\n".encode()
+        body += data + b"\r\n"
+        prev = sig
+    return headers, bytes(body)

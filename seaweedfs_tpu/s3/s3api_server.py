@@ -35,7 +35,8 @@ from aiohttp import web
 from seaweedfs_tpu.s3.auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ,
                                    ACTION_TAGGING,
                                    ACTION_WRITE, AuthError, Identity,
-                                   IdentityAccessManagement)
+                                   IdentityAccessManagement,
+                                   decode_aws_chunked)
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
 
@@ -271,7 +272,9 @@ class S3ApiServer:
                 body = await self._read_body(req)
                 # the signature covered x-amz-content-sha256; now that the
                 # body is read, check the body actually matches it
-                if self.iam.enabled and not _is_aws_chunked(req):
+                # (STREAMING-* uploads were verified chunk-by-chunk inside
+                # _read_body; verify_payload_hash no-ops for those)
+                if self.iam.enabled:
                     self.iam.verify_payload_hash(req.headers, body)
         except AuthError as e:
             return _error_response(e.code, str(e), e.status, path)
@@ -295,7 +298,17 @@ class S3ApiServer:
     async def _read_body(self, req: web.Request) -> bytes:
         body = await req.read()
         if _is_aws_chunked(req):
-            body = _decode_aws_chunked(body)
+            # signed streams get the full chunk-signature chain verified
+            # (seed = the already-authenticated header signature); forged
+            # or truncated chunks are rejected, not silently accepted
+            # (reference: chunked_reader_v4.go:38-60,170-214)
+            ctx = self.iam.chunked_context(req.headers) \
+                if self.iam.enabled else None
+            decoded_len = None
+            dl_hdr = req.headers.get("x-amz-decoded-content-length")
+            if dl_hdr and dl_hdr.isdigit():
+                decoded_len = int(dl_hdr)
+            body = decode_aws_chunked(body, ctx, decoded_len)
         return body
 
     def _require_admin(self, ident: Identity, bucket: str) -> None:
@@ -1297,25 +1310,3 @@ def _valid_bucket_name(name: str) -> bool:
     return name[0] not in ".-" and name[-1] not in ".-"
 
 
-def _decode_aws_chunked(body: bytes) -> bytes:
-    """Decode aws-chunked streaming payload: hex-size;chunk-signature=...\r\n
-    <data>\r\n ... 0;...\r\n\r\n (sig per chunk not re-verified here; the
-    reference validates them in chunked_reader_v4.go)."""
-    out = bytearray()
-    i = 0
-    while i < len(body):
-        nl = body.find(b"\r\n", i)
-        if nl < 0:
-            break
-        header = body[i:nl]
-        size_hex = header.split(b";", 1)[0]
-        try:
-            size = int(size_hex, 16)
-        except ValueError:
-            break
-        if size == 0:
-            break
-        start = nl + 2
-        out += body[start:start + size]
-        i = start + size + 2  # skip trailing \r\n
-    return bytes(out)

@@ -128,6 +128,134 @@ def test_local_sink_replicator(tmp_path):
     assert not (tmp_path / "mirror/x/g.txt").exists()
 
 
+def test_cloud_sink_s3_mirror_with_resume(tmp_path):
+    """filer tree -> S3 bucket through the queue-driven replicate daemon
+    (reference: filer_replicate.go + sink/s3sink/s3_sink.go:30-70), against
+    this repo's own SigV4-verifying S3 gateway, with offset resume across a
+    daemon restart."""
+    from tests.test_s3 import S3Stack, CRED
+    from seaweedfs_tpu.notification import LogQueue
+    from seaweedfs_tpu.replication.replicate_daemon import (
+        LogFileSource, ReplicateDaemon, read_file_via_filer)
+    from seaweedfs_tpu.replication.sink import make_sink
+
+    events_path = str(tmp_path / "events.jsonl")
+    stack = S3Stack(tmp_path).start()
+    try:
+        # wire the notification queue into the running filer (the CLI does
+        # this at construction; the seam is the same attribute)
+        stack.filer.notification = LogQueue(events_path)
+        stack.filer.filer.meta_log.subscribe(stack.filer._notify_queue)
+        st, _, _ = stack.req("PUT", "/mirror-bucket")
+        assert st == 200
+
+        put(stack.filer.url, "/src/a.txt", b"alpha")
+        put(stack.filer.url, "/src/sub/b.txt", b"beta")
+        put(stack.filer.url, "/other/ignored.txt", b"out of scope")
+
+        def make_daemon():
+            sink = make_sink("s3", endpoint=stack.s3.url,
+                             bucket="mirror-bucket",
+                             access_key=CRED.access_key,
+                             secret_key=CRED.secret_key)
+            return ReplicateDaemon(
+                LogFileSource(events_path, poll_interval=0.05), sink,
+                read_file_via_filer(stack.filer.url), prefix="/src",
+                offset_path=str(tmp_path / "rep_offsets.json"),
+                offset_key="test")
+
+        d1 = make_daemon()
+        d1.run_in_thread()
+        assert wait_for(lambda: stack.req(
+            "GET", "/mirror-bucket/src/a.txt")[1] == b"alpha")
+        assert wait_for(lambda: stack.req(
+            "GET", "/mirror-bucket/src/sub/b.txt")[1] == b"beta")
+        # out-of-scope file is not mirrored
+        st, _, _ = stack.req("GET", "/mirror-bucket/other/ignored.txt")
+        assert st == 404
+        d1.stop()
+        time.sleep(0.2)
+
+        # events while the daemon is down; a fresh daemon resumes from the
+        # stored offset and applies only the new ones
+        put(stack.filer.url, "/src/c.txt", b"gamma")
+        d2 = make_daemon()
+        d2.run_in_thread()
+        assert wait_for(lambda: stack.req(
+            "GET", "/mirror-bucket/src/c.txt")[1] == b"gamma")
+        assert d2.applied <= 2, "resume must not replay applied events"
+
+        # deletion propagates to the bucket
+        req = urllib.request.Request(f"http://{stack.filer.url}/src/a.txt",
+                                     method="DELETE")
+        urllib.request.urlopen(req, timeout=30)
+        assert wait_for(lambda: stack.req(
+            "GET", "/mirror-bucket/src/a.txt")[0] == 404)
+        d2.stop()
+    finally:
+        stack.stop()
+
+
+def test_cloud_sink_incremental_and_dir_delete(tmp_path):
+    """CloudSink over the local-dir remote: incremental mode date-prefixes
+    keys and never deletes; normal mode deletes recursively via traverse
+    (object stores have no rmdir)."""
+    from seaweedfs_tpu.remote_storage import LocalDirRemote
+    from seaweedfs_tpu.replication.sink import CloudSink, Replicator
+
+    store = str(tmp_path / "store")
+    sink = CloudSink(LocalDirRemote(store))
+    rep = Replicator(sink, lambda p: b"data", "/")
+    rep.replicate({"new_entry": {"full_path": "/d/x.txt",
+                                 "is_directory": False}, "old_entry": None})
+    rep.replicate({"new_entry": {"full_path": "/d/y.txt",
+                                 "is_directory": False}, "old_entry": None})
+    assert (tmp_path / "store/d/x.txt").exists()
+    # directory delete fans out over traverse
+    rep.replicate({"old_entry": {"full_path": "/d", "is_directory": True},
+                   "new_entry": None})
+    assert not (tmp_path / "store/d/x.txt").exists()
+    assert not (tmp_path / "store/d/y.txt").exists()
+
+    inc = CloudSink(LocalDirRemote(store), incremental=True)
+    rep2 = Replicator(inc, lambda p: b"data", "/")
+    rep2.replicate({"new_entry": {"full_path": "/d/z.txt",
+                                  "is_directory": False},
+                    "old_entry": None})
+    dated = time.strftime("%Y-%m-%d")
+    assert (tmp_path / f"store/{dated}/d/z.txt").exists()
+    # incremental never deletes (Replicator guards on is_incremental)
+    rep2.replicate({"old_entry": {"full_path": "/d/z.txt",
+                                  "is_directory": False}, "new_entry": None})
+    assert (tmp_path / f"store/{dated}/d/z.txt").exists()
+
+
+def test_azure_sink_wire(tmp_path):
+    """AzureSink = CloudSink over AzureRemote, against the SharedKey-
+    verifying fake endpoint (reference: sink/azuresink/azure_sink.go)."""
+    import base64
+    from tests.test_backend_tier import _FakeAzure
+    from seaweedfs_tpu.replication.sink import make_sink, Replicator
+
+    key = base64.b64encode(b"0123456789abcdef0123456789abcdef").decode()
+    fake = _FakeAzure("acct", key)
+    endpoint = fake.start()
+    try:
+        sink = make_sink("azure", account="acct", container="backup",
+                         account_key=key, endpoint=endpoint)
+        rep = Replicator(sink, lambda p: b"azure-bytes", "/")
+        rep.replicate({"new_entry": {"full_path": "/docs/f.bin",
+                                     "is_directory": False},
+                       "old_entry": None})
+        assert fake.blobs.get("docs/f.bin") == b"azure-bytes"
+        rep.replicate({"old_entry": {"full_path": "/docs/f.bin",
+                                     "is_directory": False},
+                       "new_entry": None})
+        assert "docs/f.bin" not in fake.blobs
+    finally:
+        fake.stop()
+
+
 def test_notification_queue(tmp_path):
     from seaweedfs_tpu.notification import make_queue
     q = make_queue("log", path=str(tmp_path / "events.jsonl"))
