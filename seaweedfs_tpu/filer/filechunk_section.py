@@ -94,12 +94,17 @@ class ReaderPattern:
 
     def __init__(self):
         self._counter = 0
-        self._last_stop = 0
+        # None until the first read: the first observation is a BASELINE,
+        # not a randomness vote — a reader resuming mid-file (or a fresh
+        # per-connection pattern key) must not disable caching with its
+        # very first read
+        self._last_stop: int | None = None
         self._lock = threading.Lock()
 
     def monitor_read(self, offset: int, size: int) -> None:
         with self._lock:
-            sequential = offset == self._last_stop
+            sequential = self._last_stop is None or \
+                offset == self._last_stop
             self._last_stop = offset + size
             if sequential:
                 if self._counter < MODE_CHANGE_LIMIT:

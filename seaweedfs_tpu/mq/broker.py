@@ -648,11 +648,20 @@ class BrokerServer:
         consumer advances through a segment across several fetches —
         re-downloading it each time would make replay O(segments^2)), and
         duplicate offsets from flush-race overlaps are dropped."""
-        out: list = []
-        seen: set[int] = set()
+        # A LATER segment's copy of an offset wins — the same newest-wins
+        # rule _recover applies, so live subscribers and a restarted
+        # cluster resolve flush-race overlaps identically.  Early exit only
+        # once `limit` offsets are collected AND the next segment starts
+        # beyond the limit-th one (no density assumption: torn-tail drops
+        # and corrupt-segment skips can leave gaps a fixed offset+limit
+        # window would silently jump over).
+        by_off: dict[int, Message] = {}
         for base, end, name in await self.store.list_segments(topic, pi):
             if end <= offset:
                 continue
+            if len(by_off) >= limit and \
+                    base > sorted(by_off)[limit - 1]:
+                break
             ckey = (topic, pi, name)
             msgs = self._seg_cache.get(ckey)
             if msgs is None:
@@ -661,13 +670,9 @@ class BrokerServer:
                 while len(self._seg_cache) > 8:
                     self._seg_cache.pop(next(iter(self._seg_cache)))
             for m in msgs:
-                if m.offset >= offset and m.offset not in seen:
-                    seen.add(m.offset)
-                    out.append(m)
-            if len(out) >= limit:
-                break
-        out.sort(key=lambda m: m.offset)
-        return out[:limit]
+                if m.offset >= offset:
+                    by_off[m.offset] = m
+        return [by_off[o] for o in sorted(by_off)][:limit]
 
     # -- consumer-group coordination (reference: sub_coordinator/) -------
 

@@ -48,12 +48,18 @@ def decode_segment(data: bytes) -> list[Message]:
     pos = 4
     n = len(data)
     while pos < n:
+        if pos + SEG_HEADER.size > n:
+            break  # segment cut inside a record header: same torn-tail drop
         off, ts, klen, vlen = SEG_HEADER.unpack_from(data, pos)
         pos += SEG_HEADER.size
         key = data[pos:pos + klen]
         pos += klen
         value = data[pos:pos + vlen]
         pos += vlen
+        if len(key) != klen or len(value) != vlen:
+            # segment cut mid-record (torn write): a silently shortened
+            # message must not replay — drop the partial trailing record
+            break
         msgs.append(Message(off, ts, key, value))
     return msgs
 

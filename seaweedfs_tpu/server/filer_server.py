@@ -917,8 +917,11 @@ class FilerServer:
                 await resp.write(data)
                 pos += len(data)
         else:
+            peer = req.transport.get_extra_info("peername") \
+                if req.transport else None
             await self._stream_range(resp, chunks, offset, length,
-                                     path=path, entry=entry)
+                                     path=path, entry=entry,
+                                     client=str(peer) if peer else "")
         await resp.write_eof()
         return resp
 
@@ -939,8 +942,8 @@ class FilerServer:
 
     async def _stream_range(self, resp, chunks: list[FileChunk],
                             offset: int, length: int,
-                            path: str = "", entry: Entry | None = None
-                            ) -> None:
+                            path: str = "", entry: Entry | None = None,
+                            client: str = "") -> None:
         """Stream [offset, offset+length) to the client, zero-filling
         sparse gaps (reference: filer/stream.go StreamContent)."""
         if entry is not None:
@@ -950,17 +953,22 @@ class FilerServer:
             views = fc.view_from_chunks(chunks, offset, length)
         # random readers must not churn the chunk cache with bytes nobody
         # revisits (reference: reader_pattern.go -> reader_cache).  The
-        # pattern is tracked per PATH here (the reference tracks per file
-        # handle): only ranged reads vote — repeated whole-file GETs of a
-        # hot object are the cache's best case and must never disable it
+        # pattern is tracked per (path, client connection) — the closest
+        # HTTP analogue of the reference's per-file-handle tracking: two
+        # concurrent sequential readers of one hot file must not interleave
+        # offsets into a false "random" verdict that disables caching for
+        # exactly the object that benefits most.  Only ranged reads vote —
+        # repeated whole-file GETs of a hot object are the cache's best
+        # case and must never disable it
         cache_chunks = True
         whole_file = entry is not None and offset == 0 and \
             length >= entry.size()
         if path and not whole_file:
             from seaweedfs_tpu.filer.filechunk_section import ReaderPattern
-            rp = self._read_patterns.get(path)
+            pkey = (path, client)
+            rp = self._read_patterns.get(pkey)
             if rp is None:
-                rp = self._read_patterns[path] = ReaderPattern()
+                rp = self._read_patterns[pkey] = ReaderPattern()
                 while len(self._read_patterns) > 256:
                     self._read_patterns.pop(
                         next(iter(self._read_patterns)))

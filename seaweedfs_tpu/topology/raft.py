@@ -196,8 +196,13 @@ class RaftNode:
         with self._lock:
             return self.state == LEADER
 
+    @staticmethod
+    def quorum_of(n_peers: int) -> int:
+        """Majority of (n_peers + self)."""
+        return (n_peers + 1) // 2 + 1
+
     def quorum(self) -> int:
-        return (len(self.cfg.peers) + 1) // 2 + 1
+        return self.quorum_of(len(self.cfg.peers))
 
     # -- election -------------------------------------------------------
 
@@ -218,11 +223,14 @@ class RaftNode:
                 timeout = self._election_timeout()
 
     def _collect_votes(self, term: int, last_idx: int, last_term: int,
-                       pre: bool) -> int | None:
-        """One voting round; -> granted count, or None if a higher term
-        was observed (we stepped down)."""
+                       pre: bool, peers: list[str]) -> int | None:
+        """One voting round over a membership SNAPSHOT taken under the lock
+        by the caller (add_peer/remove_peer mutate cfg.peers in place — an
+        unlocked iteration could skip a peer or tally against a different
+        quorum denominator than it polled); -> granted count, or None if a
+        higher term was observed (we stepped down)."""
         votes = 1
-        for peer in self.cfg.peers:
+        for peer in peers:
             payload = {"term": term, "candidate_id": self.cfg.node_id,
                        "last_log_index": last_idx,
                        "last_log_term": last_term}
@@ -244,13 +252,17 @@ class RaftNode:
             term = self.current_term + 1
             last_idx = self._last_index_locked()
             last_term = self._term_at_locked(last_idx) if last_idx >= 0 else 0
-            has_peers = bool(self.cfg.peers)
-        if has_peers:
+            # snapshot membership + quorum size for the whole election: the
+            # fan-out and the majority check must see the same peer set
+            peers = list(self.cfg.peers)
+            quorum = self.quorum_of(len(peers))
+        if peers:
             # pre-vote round: probe electability WITHOUT bumping the term.
             # Peers in contact with a live leader refuse, so a CPU-starved
             # or partitioned node rejoining cannot disrupt a stable quorum.
-            votes = self._collect_votes(term, last_idx, last_term, pre=True)
-            if votes is None or votes < self.quorum():
+            votes = self._collect_votes(term, last_idx, last_term, pre=True,
+                                        peers=peers)
+            if votes is None or votes < quorum:
                 with self._lock:
                     # back off a full election timeout before re-probing,
                     # or a partitioned node pre-vote-storms every peer
@@ -268,13 +280,14 @@ class RaftNode:
             self.voted_for = self.cfg.node_id
             self._save_state()
             self._last_heartbeat = time.monotonic()
-        votes = self._collect_votes(term, last_idx, last_term, pre=False)
+        votes = self._collect_votes(term, last_idx, last_term, pre=False,
+                                    peers=peers)
         if votes is None:
             return
         with self._lock:
             if self.state != CANDIDATE or self.current_term != term:
                 return
-            if votes >= self.quorum():
+            if votes >= quorum:
                 self.state = LEADER
                 self.leader_id = self.cfg.node_id
                 n = self._last_index_locked() + 1
