@@ -656,6 +656,8 @@ class BrokerServer:
         # and corrupt-segment skips can leave gaps a fixed offset+limit
         # window would silently jump over).
         by_off: dict[int, Message] = {}
+        if limit <= 0:
+            return []
         for base, end, name in await self.store.list_segments(topic, pi):
             if end <= offset:
                 continue
