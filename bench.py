@@ -480,6 +480,23 @@ def main() -> None:
          _bench_encode_kernel, 10, 4, _n_for(10), on_tpu, 60,
          _mesh_codec_factory)
 
+    # xprof trace of one warm encode batch (WEEDTPU_JAX_PROFILE=dir):
+    # proves the kernel timeline the way the reference's pprof profiles do
+    trace_dir = os.environ.get("WEEDTPU_JAX_PROFILE")
+    if trace_dir:
+        try:
+            import jax.numpy as jnp
+            from seaweedfs_tpu.utils import grace as _grace
+            codec = _device_codec(10, 4, on_tpu)
+            data = jnp.asarray(np.random.default_rng(0).integers(
+                0, 256, (10, 4 * 1024 * 1024), dtype=np.uint8))
+            np.asarray(codec.encode_parity(data))  # warm/compile first
+            with _grace.jax_profile(trace_dir):
+                np.asarray(codec.encode_parity(data))
+            extra["jax_profile_trace"] = trace_dir
+        except Exception as e:
+            print(f"bench: jax profile failed: {e}", file=sys.stderr)
+
     # e2e through write_ec_files: on this harness the TPU number is tunnel-
     # bound (see module docstring) — kept small so it finishes, and tagged
     # so nobody reads the tunnel's ~MB/s d2h as a system property; the host

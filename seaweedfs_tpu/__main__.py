@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import sys
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 
@@ -19,6 +20,9 @@ from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 def _add_common_flags(p):
     p.add_argument("-v", type=int, default=0, help="log verbosity")
     p.add_argument("-logFile", default=None)
+    p.add_argument("--jax-profile", dest="jaxProfile", default=None,
+                   help="capture a JAX/xprof trace into this directory "
+                        "(utils/grace.py; view with tensorboard)")
     p.add_argument("-securityConfig", default=None,
                    help="security.toml path (default: standard search paths)")
     p.add_argument("-cpuprofile", default=None,
@@ -197,6 +201,48 @@ def main(argv=None) -> int:
     psy.add_argument("-offsetFile", default=".filer_sync_offsets.json")
     psy.add_argument("-oneway", action="store_true")
 
+    pmt2 = sub.add_parser(
+        "filer.meta.tail",
+        help="stream continuous meta changes on a filer as JSON lines "
+             "(command/filer_meta_tail.go)")
+    pmt2.add_argument("-filer", default="127.0.0.1:8888")
+    pmt2.add_argument("-pathPrefix", default="/")
+    pmt2.add_argument("-timeAgo", type=float, default=0.0,
+                      help="start this many seconds before now")
+    pmt2.add_argument("-untilTimeAgo", type=float, default=0.0,
+                      help="stop after reaching this many seconds ago")
+    pmt2.add_argument("-pattern", default="",
+                      help="fnmatch on the file name (or full path when "
+                           "it contains '/')")
+
+    pct = sub.add_parser(
+        "filer.cat",
+        help="stream one filer file to stdout or -o FILE "
+             "(command/filer_cat.go)")
+    pct.add_argument("-filer", default="127.0.0.1:8888")
+    pct.add_argument("-o", dest="output", default="",
+                     help="write to file instead of stdout")
+    pct.add_argument("path", help="file path on the filer")
+
+    pcpy = sub.add_parser(
+        "filer.copy",
+        help="upload local files/directories to a filer path "
+             "(command/filer_copy.go)")
+    pcpy.add_argument("-filer", default="127.0.0.1:8888")
+    pcpy.add_argument("sources", nargs="+",
+                      help="local files/dirs, last arg = target filer dir")
+
+    prg = sub.add_parser(
+        "filer.remote.gateway",
+        help="mirror bucket creation/deletion under -buckets.dir to the "
+             "remote storage (command/filer_remote_gateway.go)")
+    prg.add_argument("-filer", default="127.0.0.1:8888")
+    prg.add_argument("-remote", required=True,
+                     help="kind:spec of the remote (bucket field ignored; "
+                          "buckets are created per filer bucket)")
+    prg.add_argument("-buckets.dir", dest="bucketsDir", default="/buckets")
+    prg.add_argument("-offsetFile", default=None)
+
     prp = sub.add_parser(
         "filer.replicate",
         help="consume filer meta events from a notification queue and "
@@ -262,7 +308,8 @@ def main(argv=None) -> int:
                       help="comma-separated SAN hosts/IPs")
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
-              psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs, prp):
+              psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs, prp,
+              pmt2, pct, pcpy, prg):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -270,6 +317,7 @@ def main(argv=None) -> int:
     from seaweedfs_tpu.utils import grace, weedlog
     weedlog.setup(args.v, args.logFile)
     grace.setup_stack_dumps()
+    grace.setup_jax_profile(getattr(args, "jaxProfile", None))
     # every subcommand — servers AND client-side tools (backup, upload,
     # shell, mount, filer.sync, mq.broker ...) — loads security.toml here so
     # JWT keys and process-wide TLS (security/tls.py) are live before any
@@ -316,6 +364,14 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "filer.backup":
         return _run_filer_backup(args)
+    if args.cmd == "filer.meta.tail":
+        return _run_filer_meta_tail(args)
+    if args.cmd == "filer.cat":
+        return _run_filer_cat(args)
+    if args.cmd == "filer.copy":
+        return _run_filer_copy(args)
+    if args.cmd == "filer.remote.gateway":
+        return _run_filer_remote_gateway(args)
     if args.cmd == "filer.replicate":
         from seaweedfs_tpu.replication.replicate_daemon import (
             LogFileSource, ReplicateDaemon, read_file_via_filer)
@@ -832,6 +888,202 @@ topic = "seaweedfs_filer"
 default = "localhost:9333"
 """,
 }
+
+
+def _run_filer_meta_tail(args) -> int:
+    """Stream filer meta events as JSON lines (reference:
+    weed/command/filer_meta_tail.go — same event shape, same fnmatch
+    -pattern semantics: full-path match when the pattern contains '/')."""
+    import fnmatch
+    import time as _time
+    import urllib.parse
+    import urllib.request
+
+    since_ns = 0
+    if args.timeAgo > 0:
+        since_ns = int((_time.time() - args.timeAgo) * 1e9)
+    until_ns = None
+    if args.untilTimeAgo > 0:
+        until_ns = int((_time.time() - args.untilTimeAgo) * 1e9)
+        live = "false"
+    else:
+        live = "true"
+    url = (f"{_tls_scheme()}://{args.filer}/__meta__/subscribe?"
+           + urllib.parse.urlencode({"since": str(since_ns),
+                                     "prefix": args.pathPrefix,
+                                     "live": live}))
+
+    def matches(ev: dict) -> bool:
+        if not args.pattern:
+            return True
+        ent = ev.get("new_entry") or ev.get("old_entry") or {}
+        full = ent.get("full_path", "")
+        name = full.rsplit("/", 1)[-1]
+        target = full if "/" in args.pattern else name
+        return fnmatch.fnmatch(target, args.pattern)
+
+    try:
+        with urllib.request.urlopen(url, timeout=3600) as r:
+            for raw in r:
+                line = raw.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                if until_ns is not None and ev.get("ts_ns", 0) > until_ns:
+                    break
+                if matches(ev):
+                    print(line.decode())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _run_filer_cat(args) -> int:
+    """Stream one filer file to stdout / -o FILE (reference:
+    weed/command/filer_cat.go)."""
+    import shutil
+    import urllib.parse
+    import urllib.request
+
+    path = args.path if args.path.startswith("/") else "/" + args.path
+    url = f"{_tls_scheme()}://{args.filer}{urllib.parse.quote(path)}"
+    try:
+        with urllib.request.urlopen(url, timeout=3600) as r:
+            if args.output:
+                with open(args.output, "wb") as f:
+                    shutil.copyfileobj(r, f)
+            else:
+                shutil.copyfileobj(r, sys.stdout.buffer)
+    except urllib.error.HTTPError as e:
+        print(f"filer.cat: {path}: HTTP {e.code}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _run_filer_copy(args) -> int:
+    """Upload local files/directories into a filer directory (reference:
+    weed/command/filer_copy.go — `weed filer.copy local... /target/dir/`)."""
+    import os
+    import urllib.parse
+    import urllib.request
+
+    if len(args.sources) < 2:
+        print("filer.copy: need SOURCE... TARGET_DIR", file=sys.stderr)
+        return 1
+    *sources, target = args.sources
+    # accept both /dir and http://filer:port/dir target forms
+    if "://" in target:
+        parsed = urllib.parse.urlparse(target)
+        filer, target = parsed.netloc, parsed.path or "/"
+    else:
+        filer = args.filer
+    target = target.rstrip("/") + "/"
+
+    def put(local: str, remote: str) -> None:
+        size = os.path.getsize(local)
+        with open(local, "rb") as f:
+            # stream the file object: a multi-GB source must not be
+            # buffered whole in this process (the filer chunks it anyway)
+            req = urllib.request.Request(
+                f"{_tls_scheme()}://{filer}{urllib.parse.quote(remote)}",
+                data=f, method="POST",
+                headers={"Content-Length": str(size)})
+            with urllib.request.urlopen(req, timeout=600):
+                pass
+        print(f"copied {local} -> {remote} ({size} bytes)")
+
+    n = 0
+    for src in sources:
+        if os.path.isdir(src):
+            base = os.path.basename(src.rstrip("/"))
+            for root, _, files in os.walk(src):
+                rel = os.path.relpath(root, src)
+                for fn in files:
+                    dst = target + base + "/" + \
+                        (fn if rel == "." else f"{rel}/{fn}")
+                    put(os.path.join(root, fn), dst)
+                    n += 1
+        else:
+            put(src, target + os.path.basename(src))
+            n += 1
+    print(f"filer.copy: {n} file(s) uploaded")
+    return 0
+
+
+def _run_filer_remote_gateway(args) -> int:
+    """Mirror bucket-level events under -buckets.dir to the remote:
+    creating a bucket in the filer creates it on the remote, deleting
+    removes it, and object writes inside a bucket sync through the same
+    event-applier filer.remote.sync uses (reference:
+    weed/command/filer_remote_gateway.go)."""
+    import urllib.parse
+    import urllib.request
+
+    from seaweedfs_tpu.remote_storage import make_remote, parse_remote_spec
+    from seaweedfs_tpu.replication.filer_sync import SyncOffsetStore
+
+    kind, options = parse_remote_spec(args.remote)
+    offsets = SyncOffsetStore(args.offsetFile)
+    okey = f"remote-gateway:{args.remote}"
+    buckets_dir = args.bucketsDir.rstrip("/")
+
+    def bucket_remote(bucket: str):
+        opt = dict(options)
+        opt["bucket"] = bucket
+        return make_remote(kind, **opt)
+
+    while True:
+        since = offsets.get(okey)
+        url = (f"{_tls_scheme()}://{args.filer}/__meta__/subscribe?"
+               + urllib.parse.urlencode({"since": str(since),
+                                         "prefix": buckets_dir,
+                                         "live": "true"}))
+        try:
+            with urllib.request.urlopen(url, timeout=3600) as r:
+                for raw in r:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    _apply_gateway_event(ev, buckets_dir, bucket_remote,
+                                         args.filer)
+                    offsets.put(okey, ev.get("ts_ns", since))
+        except KeyboardInterrupt:
+            offsets.flush()
+            return 0
+        except OSError:
+            import time as _time
+            _time.sleep(2)
+
+
+def _apply_gateway_event(ev: dict, buckets_dir: str, bucket_remote,
+                         filer: str) -> None:
+    """One meta event -> remote bucket/object action."""
+    from seaweedfs_tpu.remote_storage import _apply_local_event_to_remote
+    ent = ev.get("new_entry") or ev.get("old_entry") or {}
+    full = ent.get("full_path", "")
+    if not full.startswith(buckets_dir + "/"):
+        return
+    rel = full[len(buckets_dir) + 1:]
+    bucket, _, inner = rel.partition("/")
+    if not bucket:
+        return
+    remote = bucket_remote(bucket)
+    if not inner:
+        # bucket-level create/delete
+        import stat as _stat
+        is_dir = bool(ent.get("is_directory")) or _stat.S_ISDIR(
+            (ent.get("attr") or {}).get("mode", 0))
+        if not is_dir:
+            return
+        if ev.get("new_entry") is None and hasattr(remote, "delete_bucket"):
+            remote.delete_bucket()
+        elif ev.get("old_entry") is None and hasattr(remote, "create_bucket"):
+            remote.create_bucket()
+        return
+    # object-level event inside the bucket: reuse the remote.sync applier
+    _apply_local_event_to_remote(remote, filer, f"{buckets_dir}/{bucket}",
+                                 ev, 60.0)
 
 
 def _run_filer_backup(args) -> int:

@@ -801,3 +801,496 @@ class ElasticStore(FilerStore):
 
 
 STORES["elastic"] = ElasticStore  # REST-only: no SDK gate needed
+
+
+class HbaseStore(FilerStore):
+    """Wide-column store over the HBase REST gateway ("Stargate") wire
+    protocol (reference: weed/filer/hbase/hbase_store.go over the Thrift
+    client — same row model: ordered row key `<dir>\\x00<name>`, one
+    column family).  Cells travel base64-coded in JSON; range listings use
+    the stateful scanner resource (POST .../scanner -> Location, GET for
+    batches, DELETE to close).
+
+    `transport(method, path, body_dict|None) -> (status, body_dict,
+    headers_dict)` is injectable; the default speaks urllib to the REST
+    gateway, so the driver tests offline against a protocol-faithful
+    fake."""
+
+    name = "hbase"
+    TABLE = "seaweedfs"
+    COL = "f:m"  # family:qualifier for the meta blob
+
+    def __init__(self, url: str = "http://127.0.0.1:8080", transport=None):
+        self.url = url.rstrip("/")
+        self._t = transport or self._http
+
+    def _http(self, method: str, path: str, body=None):
+        import urllib.error
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json",
+                     "Accept": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                raw = r.read()
+                return (r.status, json.loads(raw) if raw else {},
+                        dict(r.headers))
+        except urllib.error.HTTPError as e:
+            return e.code, {}, dict(e.headers)
+
+    @staticmethod
+    def _b64(b: bytes) -> str:
+        import base64
+        return base64.b64encode(b).decode()
+
+    @staticmethod
+    def _unb64(s: str) -> bytes:
+        import base64
+        return base64.b64decode(s)
+
+    @staticmethod
+    def _ekey(full_path: str) -> bytes:
+        d, _, n = full_path.rpartition("/")
+        return (d or "/").encode() + ENTRY_SEP + n.encode()
+
+    @staticmethod
+    def _row_url(row: bytes) -> str:
+        # the REST gateway takes the LITERAL row key in the URL path
+        # (binary bytes percent-encoded); base64 belongs only in the JSON
+        # cell bodies — a base64 URL row would write one key and read
+        # another on a real Stargate
+        import urllib.parse
+        return urllib.parse.quote_from_bytes(row, safe="")
+
+    def _put(self, row: bytes, value: bytes) -> None:
+        st, _, _ = self._t(
+            "PUT", f"/{self.TABLE}/{self._row_url(row)}",
+            {"Row": [{"key": self._b64(row), "Cell": [
+                {"column": self._b64(self.COL.encode()),
+                 "$": self._b64(value)}]}]})
+        if st >= 300:
+            raise OSError(f"hbase put: HTTP {st}")
+
+    def _get(self, row: bytes) -> bytes | None:
+        st, doc, _ = self._t(
+            "GET", f"/{self.TABLE}/{self._row_url(row)}/{self.COL}", None)
+        if st == 404:
+            return None
+        if st >= 300:
+            raise OSError(f"hbase get: HTTP {st}")
+        cells = doc.get("Row", [{}])[0].get("Cell", [])
+        return self._unb64(cells[0]["$"]) if cells else None
+
+    def _delete(self, row: bytes) -> None:
+        st, _, _ = self._t(
+            "DELETE", f"/{self.TABLE}/{self._row_url(row)}", None)
+        if st >= 300 and st != 404:
+            raise OSError(f"hbase delete: HTTP {st}")
+
+    def _scan(self, start: bytes, end: bytes, limit: int):
+        """-> ordered [(row_key, value)] via the scanner resource."""
+        st, _, hdrs = self._t(
+            "POST", f"/{self.TABLE}/scanner",
+            {"startRow": self._b64(start), "endRow": self._b64(end),
+             "batch": min(limit, 1024)})
+        loc = next((v for k, v in hdrs.items()
+                    if k.lower() == "location"), None)
+        if st >= 300 or not loc:
+            raise OSError(f"hbase scanner: HTTP {st}")
+        scanner = loc[len(self.url):] if loc.startswith(self.url) else loc
+        out: list[tuple[bytes, bytes]] = []
+        try:
+            while len(out) < limit:
+                st, doc, _ = self._t("GET", scanner, None)
+                if st == 204 or st == 404:
+                    break
+                if st >= 300:
+                    raise OSError(f"hbase scan: HTTP {st}")
+                for rowdoc in doc.get("Row", []):
+                    cells = rowdoc.get("Cell", [])
+                    if cells:
+                        out.append((self._unb64(rowdoc["key"]),
+                                    self._unb64(cells[0]["$"])))
+                    if len(out) >= limit:
+                        break
+        finally:
+            self._t("DELETE", scanner, None)
+        return out
+
+    def insert_entry(self, entry: Entry) -> None:
+        self._put(self._ekey(entry.full_path),
+                  json.dumps(entry.to_dict()).encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        raw = self._get(self._ekey(full_path))
+        if raw is None:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(raw))
+
+    def delete_entry(self, full_path: str) -> None:
+        self._delete(self._ekey(full_path))
+
+    def delete_folder_children(self, full_path: str) -> None:
+        # same two-range subtree cover as TikvStore (see its docstring)
+        base = (full_path.rstrip("/") or "/").encode()
+        for start, end in ((base + ENTRY_SEP, base + ENTRY_SEP + b"\xff" * 8),
+                           (base + b"/", base + b"0")):
+            while True:
+                batch = self._scan(start, end, 1024)
+                if not batch:
+                    break
+                for k, _ in batch:
+                    self._delete(k)
+                start = batch[-1][0] + b"\x00"
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = (dir_path.rstrip("/") or "/").encode()
+        start = d + ENTRY_SEP + start_from.encode() if start_from \
+            else d + ENTRY_SEP
+        end = d + ENTRY_SEP + b"\xff" * 8
+        out: list[Entry] = []
+        skip_eq = bool(start_from) and not include_start
+        while len(out) < limit:
+            batch = self._scan(start, end, limit - len(out) + 1)
+            if not batch:
+                break
+            for k, v in batch:
+                if skip_eq and k == d + ENTRY_SEP + start_from.encode():
+                    continue
+                e = Entry.from_dict(json.loads(v))
+                if prefix and not e.name.startswith(prefix):
+                    continue
+                out.append(e)
+                if len(out) >= limit:
+                    break
+            start = batch[-1][0] + b"\x00"
+            skip_eq = False
+            if len(batch) < limit - len(out) + 1 and len(out) < limit:
+                break
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._put(KV_PREFIX + key, value)
+
+    def kv_get(self, key: bytes) -> bytes:
+        raw = self._get(KV_PREFIX + key)
+        if raw is None:
+            raise NotFound(key.decode(errors="replace"))
+        return raw
+
+    def kv_delete(self, key: bytes) -> None:
+        self._delete(KV_PREFIX + key)
+
+
+STORES["hbase"] = HbaseStore  # REST gateway: no SDK gate needed
+
+
+class ArangodbStore(FilerStore):
+    """Document store over the ArangoDB HTTP API (reference:
+    weed/filer/arangodb/arangodb_store.go — entries as documents keyed by
+    the url-safe full path, listings/subtree deletes via AQL cursors).
+
+    `transport(method, path, body_dict|None) -> (status, body_dict)` is
+    injectable like ElasticStore's."""
+
+    name = "arangodb"
+    COLL = "seaweedfs_filemeta"
+    KV_COLL = "seaweedfs_kv"
+
+    def __init__(self, url: str = "http://127.0.0.1:8529",
+                 database: str = "_system", transport=None):
+        self.url = url.rstrip("/")
+        self.db = f"/_db/{database}"
+        self._t = transport or self._http
+        for coll in (self.COLL, self.KV_COLL):
+            self._t("POST", f"{self.db}/_api/collection", {"name": coll})
+
+    def _http(self, method: str, path: str, body=None):
+        import urllib.error
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except ValueError:
+                return e.code, {}
+
+    @staticmethod
+    def _key(s: str) -> str:
+        import base64
+        return base64.urlsafe_b64encode(s.encode()).decode().rstrip("=")
+
+    def _aql(self, query: str, bind: dict) -> list:
+        st, res = self._t("POST", f"{self.db}/_api/cursor",
+                          {"query": query, "bindVars": bind,
+                           "batchSize": 1000})
+        if st >= 300:
+            raise OSError(f"arangodb aql: HTTP {st} {res.get('errorMessage')}")
+        out = list(res.get("result", []))
+        while res.get("hasMore"):
+            st, res = self._t("PUT",
+                              f"{self.db}/_api/cursor/{res['id']}", None)
+            if st >= 300:
+                raise OSError(f"arangodb cursor: HTTP {st}")
+            out.extend(res.get("result", []))
+        return out
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, _, n = entry.full_path.rpartition("/")
+        st, res = self._t(
+            "POST", f"{self.db}/_api/document/{self.COLL}?overwrite=true",
+            {"_key": self._key(entry.full_path), "directory": d or "/",
+             "name": n, "meta": json.dumps(entry.to_dict())})
+        if st >= 300:
+            raise OSError(f"arangodb insert: HTTP {st}")
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        st, doc = self._t(
+            "GET",
+            f"{self.db}/_api/document/{self.COLL}/{self._key(full_path)}",
+            None)
+        if st == 404:
+            raise NotFound(full_path)
+        if st >= 300:
+            raise OSError(f"arangodb get: HTTP {st}")
+        return Entry.from_dict(json.loads(doc["meta"]))
+
+    def delete_entry(self, full_path: str) -> None:
+        self._t("DELETE",
+                f"{self.db}/_api/document/{self.COLL}/{self._key(full_path)}",
+                None)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/") or "/"
+        pref = base if base.endswith("/") else base + "/"
+        self._aql(
+            f"FOR doc IN {self.COLL} "
+            "FILTER doc.directory == @base OR "
+            "STARTS_WITH(doc.directory, @pref) "
+            f"REMOVE doc IN {self.COLL}",
+            {"base": base, "pref": pref})
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        filters = ["doc.directory == @dir"]
+        bind: dict = {"dir": d, "limit": limit}
+        if start_from:
+            filters.append("doc.name >= @start" if include_start
+                           else "doc.name > @start")
+            bind["start"] = start_from
+        if prefix:
+            filters.append("STARTS_WITH(doc.name, @prefix)")
+            bind["prefix"] = prefix
+        rows = self._aql(
+            f"FOR doc IN {self.COLL} FILTER {' AND '.join(filters)} "
+            "SORT doc.name ASC LIMIT @limit RETURN doc.meta", bind)
+        return [Entry.from_dict(json.loads(m)) for m in rows]
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        import base64
+        st, _ = self._t(
+            "POST", f"{self.db}/_api/document/{self.KV_COLL}?overwrite=true",
+            {"_key": self._key(key.decode("latin-1")),
+             "value": base64.b64encode(value).decode()})
+        if st >= 300:
+            raise OSError(f"arangodb kv put: HTTP {st}")
+
+    def kv_get(self, key: bytes) -> bytes:
+        import base64
+        st, doc = self._t(
+            "GET", f"{self.db}/_api/document/{self.KV_COLL}/"
+            f"{self._key(key.decode('latin-1'))}", None)
+        if st == 404:
+            raise NotFound(key.decode(errors="replace"))
+        if st >= 300:
+            raise OSError(f"arangodb kv get: HTTP {st}")
+        return base64.b64decode(doc["value"])
+
+    def kv_delete(self, key: bytes) -> None:
+        self._t("DELETE", f"{self.db}/_api/document/{self.KV_COLL}/"
+                f"{self._key(key.decode('latin-1'))}", None)
+
+
+STORES["arangodb"] = ArangodbStore  # REST-only: no SDK gate needed
+
+
+class YdbStore(FilerStore):
+    """Row store over YDB's table service (reference:
+    weed/filer/ydb/ydb_store.go — YQL with DECLAREd parameters, PK
+    (directory, name); YDB primary keys are globally ordered, so subtree
+    deletes are plain PK range scans — no side index like Cassandra's
+    dirlist is needed).
+
+    `session.execute(yql, params) -> rows` is injectable: production
+    wires a ydb-sdk session (registration gated on that SDK); tests run
+    the matrix on a statement-faithful fake."""
+
+    name = "ydb"
+
+    CREATE = (
+        "CREATE TABLE IF NOT EXISTS filemeta (directory Utf8, name Utf8,"
+        " meta String, PRIMARY KEY (directory, name))",
+        "CREATE TABLE IF NOT EXISTS kv (k String, v String,"
+        " PRIMARY KEY (k))",
+    )
+
+    def __init__(self, endpoint: str = "grpc://127.0.0.1:2136",
+                 database: str = "/local", session=None):
+        if session is None:  # pragma: no cover - needs a live cluster
+            session = _YdbPoolSession(endpoint, database)
+        self.s = session
+        for ddl in self.CREATE:
+            self.s.execute(ddl, {})
+
+    @staticmethod
+    def _dir_name(full_path: str) -> tuple[str, str]:
+        d, _, n = full_path.rpartition("/")
+        return d or "/", n
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = self._dir_name(entry.full_path)
+        self.s.execute(
+            "DECLARE $dir AS Utf8; DECLARE $name AS Utf8; "
+            "DECLARE $meta AS String; "
+            "UPSERT INTO filemeta (directory, name, meta) "
+            "VALUES ($dir, $name, $meta)",
+            {"$dir": d, "$name": n,
+             "$meta": json.dumps(entry.to_dict()).encode()})
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = self._dir_name(full_path)
+        rows = self.s.execute(
+            "DECLARE $dir AS Utf8; DECLARE $name AS Utf8; "
+            "SELECT meta FROM filemeta "
+            "WHERE directory = $dir AND name = $name",
+            {"$dir": d, "$name": n})
+        if not rows:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(bytes(rows[0][0])))
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = self._dir_name(full_path)
+        self.s.execute(
+            "DECLARE $dir AS Utf8; DECLARE $name AS Utf8; "
+            "DELETE FROM filemeta WHERE directory = $dir AND name = $name",
+            {"$dir": d, "$name": n})
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/") or "/"
+        # '0' is the byte after '/': bounds the subtree without matching
+        # sibling prefixes ('/topaz' for a '/top' delete)
+        self.s.execute(
+            "DECLARE $base AS Utf8; DECLARE $lo AS Utf8; "
+            "DECLARE $hi AS Utf8; "
+            "DELETE FROM filemeta WHERE directory = $base OR "
+            "(directory >= $lo AND directory < $hi)",
+            {"$base": base, "$lo": base + "/", "$hi": base + "0"})
+
+    def list_directory_entries(self, dir_path: str, start_from: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        """Pages with name cursors until `limit` PREFIX MATCHES are
+        collected or the directory is exhausted — filtering a single
+        limit+1 page client-side would return bogus empty results for a
+        sparse prefix in a large directory."""
+        d = dir_path.rstrip("/") or "/"
+        out: list[Entry] = []
+        cursor, inclusive = start_from, include_start
+        page = max(limit + 1, 256)
+        while len(out) < limit:
+            if cursor:
+                op = ">=" if inclusive else ">"
+                rows = self.s.execute(
+                    "DECLARE $dir AS Utf8; DECLARE $start AS Utf8; "
+                    "DECLARE $limit AS Uint64; "
+                    f"SELECT meta FROM filemeta WHERE directory = $dir AND "
+                    f"name {op} $start ORDER BY name LIMIT $limit",
+                    {"$dir": d, "$start": cursor, "$limit": page})
+            else:
+                rows = self.s.execute(
+                    "DECLARE $dir AS Utf8; DECLARE $limit AS Uint64; "
+                    "SELECT meta FROM filemeta WHERE directory = $dir "
+                    "ORDER BY name LIMIT $limit",
+                    {"$dir": d, "$limit": page})
+            rows = list(rows)
+            for row in rows:
+                e = Entry.from_dict(json.loads(bytes(row[0])))
+                if not prefix or e.name.startswith(prefix):
+                    out.append(e)
+                    if len(out) >= limit:
+                        break
+                cursor, inclusive = e.name, False
+            else:
+                if len(rows) < page:
+                    break
+                continue
+            break
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.s.execute(
+            "DECLARE $k AS String; DECLARE $v AS String; "
+            "UPSERT INTO kv (k, v) VALUES ($k, $v)",
+            {"$k": key, "$v": value})
+
+    def kv_get(self, key: bytes) -> bytes:
+        rows = self.s.execute(
+            "DECLARE $k AS String; SELECT v FROM kv WHERE k = $k",
+            {"$k": key})
+        if not rows:
+            raise NotFound(key.decode(errors="replace"))
+        return bytes(rows[0][0])
+
+    def kv_delete(self, key: bytes) -> None:
+        self.s.execute(
+            "DECLARE $k AS String; DELETE FROM kv WHERE k = $k",
+            {"$k": key})
+
+
+class _YdbPoolSession:  # pragma: no cover - needs a live cluster
+    """Adapter giving a ydb SessionPool the two-method execute() surface
+    YdbStore drives (the injectable-session seam stays SDK-free)."""
+
+    def __init__(self, endpoint: str, database: str):
+        import ydb
+        driver = ydb.Driver(endpoint=endpoint, database=database)
+        driver.wait(timeout=15)
+        self.pool = ydb.SessionPool(driver)
+
+    def execute(self, q: str, params: dict):
+        def run(session):
+            prepared = session.prepare(q)
+            result = session.transaction().execute(
+                prepared, params, commit_tx=True)
+            if not result:
+                return []
+            return [tuple(row[c] for c in row) for row in result[0].rows]
+        return self.pool.retry_operation_sync(run)
+
+
+try:  # pragma: no cover - depends on environment
+    import ydb  # noqa: F401
+    STORES["ydb"] = YdbStore
+except ImportError:
+    pass

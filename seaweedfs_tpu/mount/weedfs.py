@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.error
@@ -207,6 +208,11 @@ class WFS:
         self._next_fh = 2
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # mount-wide byte quota (0 = unlimited), set live via the admin
+        # socket (shell mount.configure); enforced on writes with a
+        # cached usage walk
+        self.quota_bytes = 0
+        self._du_cache: tuple[float, int] | None = None
         self._sub_thread: threading.Thread | None = None
         if subscribe:
             self._sub_thread = threading.Thread(
@@ -421,7 +427,40 @@ class WFS:
     def read(self, fh: int, size: int, offset: int) -> bytes:
         return self.handle(fh).read(size, offset)
 
+    def _used_bytes(self) -> int:
+        """Approximate mount usage for quota checks: a recursive listing
+        walk, cached 10s (quota is an operator guard-rail, not an exact
+        accountant — the reference enforces collection quotas with the
+        same lag via the master's periodic stats)."""
+        now = time.monotonic()
+        if self._du_cache and now - self._du_cache[0] < 10.0:
+            return self._du_cache[1]
+        total = 0
+        stack = ["/"]
+        while stack:
+            d = stack.pop()
+            try:
+                for name in self.readdir(d):
+                    if name in (".", ".."):
+                        continue
+                    p = (d.rstrip("/") + "/" + name)
+                    try:
+                        st = self.getattr(p)
+                    except FsError:
+                        continue
+                    if st["st_mode"] & 0o040000:
+                        stack.append(p)
+                    else:
+                        total += st["st_size"]
+            except FsError:
+                continue
+        self._du_cache = (now, total)
+        return total
+
     def write(self, fh: int, data: bytes, offset: int) -> int:
+        if self.quota_bytes and \
+                self._used_bytes() + len(data) > self.quota_bytes:
+            raise FsError(122, "mount quota exceeded")  # EDQUOT
         return self.handle(fh).write(data, offset)
 
     def truncate(self, path: str, length: int, fh: int | None = None) -> None:
@@ -586,6 +625,62 @@ class WFS:
         self._set_attr(path, {"extended_del": [self.XATTR_PREFIX + name]})
 
 
+def admin_socket_path(mountpoint: str) -> str:
+    """Per-mountpoint admin socket (reference: the mount's local socket
+    command_mount_configure.go talks to)."""
+    import hashlib
+    import tempfile
+    h = hashlib.md5(os.path.abspath(mountpoint).encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"weedtpu-mount-{h}.sock")
+
+
+def start_admin_socket(wfs: "WFS", mountpoint: str) -> None:
+    """One-JSON-exchange admin protocol: client sends {} (query) or
+    {"quota": bytes}; server replies {"ok", "root", "quota"}.  Drives
+    shell `mount.configure` against a live mount."""
+    import socket as socket_mod
+
+    path = admin_socket_path(mountpoint)
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    srv = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(4)
+
+    def loop() -> None:
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    # a client that connects and never closes must not
+                    # wedge the single accept loop for the mount's life
+                    conn.settimeout(10)
+                    chunks = []
+                    while True:
+                        b = conn.recv(65536)
+                        if not b:
+                            break
+                        chunks.append(b)
+                    cmd = json.loads(b"".join(chunks) or b"{}")
+                    if "quota" in cmd:
+                        wfs.quota_bytes = max(0, int(cmd["quota"]))
+                    resp = {"ok": True, "root": wfs.root,
+                            "quota": wfs.quota_bytes}
+                except Exception as e:
+                    resp = {"ok": False, "error": str(e)}
+                try:
+                    conn.sendall(json.dumps(resp).encode())
+                except OSError:
+                    pass
+
+    threading.Thread(target=loop, name="mount-admin", daemon=True).start()
+
+
 def make_fuse_ops(wfs: "WFS", Operations, FuseOSError):
     """Build the fusepy-facing Operations adapter for a WFS instance.
 
@@ -698,6 +793,7 @@ def mount(filer_url: str, mountpoint: str, root: str = "/",
         from seaweedfs_tpu.mount.fuse_ll import FUSE, FuseOSError, Operations
 
     wfs = WFS(filer_url, root=root)
+    start_admin_socket(wfs, mountpoint)  # shell mount.configure endpoint
     ops = make_fuse_ops(wfs, Operations, FuseOSError)
     # fusepy gets threaded dispatch (WFS ops are blocking HTTP; one hung
     # filer call must not freeze the whole mountpoint); fuse_ll is

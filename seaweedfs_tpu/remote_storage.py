@@ -249,6 +249,43 @@ class S3Remote(RemoteStorageClient):
             if e.code != 404:
                 raise
 
+    def list_buckets(self) -> list[str]:
+        """Account-level ListBuckets (used by shell remote.mount.buckets)."""
+        import urllib.request
+        import xml.etree.ElementTree as ET
+        headers = self._sign("GET", "/", {}, {}, b"")
+        req = urllib.request.Request(self.endpoint + "/", headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            root = ET.fromstring(r.read())
+        out = []
+        for bucket in root.iter():
+            if bucket.tag.rpartition("}")[2] != "Bucket":
+                continue
+            for child in bucket:
+                if child.tag.rpartition("}")[2] == "Name" and child.text:
+                    out.append(child.text)
+        return out
+
+    def create_bucket(self) -> None:
+        """PUT the bucket itself (used by filer.remote.gateway when a
+        bucket appears under the filer's -buckets.dir)."""
+        import urllib.error
+        try:
+            with self._request("PUT", ""):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 409:  # BucketAlreadyExists is success here
+                raise
+
+    def delete_bucket(self) -> None:
+        import urllib.error
+        try:
+            with self._request("DELETE", ""):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
 
 class GcsRemote(S3Remote):
     """Google Cloud Storage via its S3-compatible XML API with HMAC
@@ -671,11 +708,18 @@ def _apply_local_event_to_remote(remote, filer_url: str, mount: str,
         key = key_of(new)
         if key is None or is_dir(new):
             return False
-        with urllib.request.urlopen(
-                f"{_tls_scheme()}://{filer_url}"
-                f"{urllib.parse.quote(new['full_path'])}",
-                timeout=timeout) as r:
-            data = r.read()
+        try:
+            with urllib.request.urlopen(
+                    f"{_tls_scheme()}://{filer_url}"
+                    f"{urllib.parse.quote(new['full_path'])}",
+                    timeout=timeout) as r:
+                data = r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                # deleted/renamed after this event was logged; a later
+                # event supersedes it — skip, don't stall the stream
+                return False
+            raise
         remote.write_file(key, data)
         if old is not None and key_of(old) not in (None, key):
             remote.delete_file(key_of(old))

@@ -45,6 +45,7 @@ log = logging.getLogger("s3")
 BUCKETS_DIR = "/buckets"
 UPLOADS_SUBDIR = ".uploads"
 TAG_PREFIX = "x-amz-tag-"
+CIRCUIT_BREAKER_PATH = "/etc/s3/circuit_breaker.json"
 S3_XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
 
@@ -134,7 +135,10 @@ class S3ApiServer:
         meta events (reference: s3api/auth_credentials_subscribe.go).  A
         static -config file still wins if the filer has no identity.json."""
         from seaweedfs_tpu.s3.iamapi_server import IDENTITY_PATH
-        prefix = IDENTITY_PATH.rsplit("/", 1)[0]
+        # watch /etc: covers both /etc/iam/identity.json and
+        # /etc/s3/circuit_breaker.json (shell s3.circuitbreaker writes the
+        # latter; reference stores its config at the same filer path)
+        prefix = "/etc"
 
         async def load_once() -> None:
             st, body = await self._filer("GET", IDENTITY_PATH)
@@ -148,6 +152,20 @@ class S3ApiServer:
                 self.iam.mark_configured()
                 log.info("loaded %d identities from filer",
                          len(loaded.identities))
+            st, body = await self._filer("GET", CIRCUIT_BREAKER_PATH)
+            if st == 200 and body:
+                try:
+                    cfg = json.loads(body)
+                except ValueError:
+                    log.warning("malformed circuit breaker config ignored")
+                else:
+                    self.breaker.global_max_requests = int(
+                        cfg.get("global_max_requests", 0))
+                    self.breaker.global_max_upload_bytes = int(
+                        cfg.get("global_max_upload_bytes", 0))
+                    self.breaker.bucket_max_requests = int(
+                        cfg.get("bucket_max_requests", 0))
+                    log.info("loaded circuit breaker config: %s", cfg)
 
         while True:
             try:

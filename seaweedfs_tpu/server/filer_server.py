@@ -1191,16 +1191,56 @@ class FilerServer:
         return web.json_response({"ok": True})
 
     async def handle_ui(self, req: web.Request) -> web.Response:
-        """Status page (reference: weed/server/filer_ui/)."""
+        """Operator status page with a directory browser
+        (reference: weed/server/filer_ui/ — the filer UI's core feature
+        is browsing the tree).  /__ui__?path=/some/dir lists entries."""
+        import stat as stat_mod
+        import urllib.parse as up
         from seaweedfs_tpu.server import ui
-        return web.Response(text=ui.render(
+        path = req.query.get("path", "/")
+        if not path.startswith("/"):
+            path = "/" + path
+        rows = []
+        try:
+            entries = await asyncio.to_thread(
+                self.filer.list_entries, path.rstrip("/") or "/", "",
+                False, 200, "")
+        except Exception:
+            entries = []
+        for e in entries:
+            is_dir = stat_mod.S_ISDIR(e.attr.mode)
+            href = f"/__ui__?path={up.quote(e.full_path)}" if is_dir \
+                else up.quote(e.full_path)
+            name = e.name + ("/" if is_dir else "")
+            rows.append([f"<a href='{href}'>", name,
+                         ui.fmt_bytes(e.size()) if not is_dir else "-",
+                         len(e.chunks)])
+        # render links without double-escaping: build the browse table by
+        # hand as a preformatted HTML section
+        import html as html_mod
+        browse = "<table><tr><th>name</th><th>size</th><th>chunks</th></tr>"
+        for href_open, name, size, nchunks in rows:
+            browse += (f"<tr><td>{href_open}{html_mod.escape(name)}</a>"
+                       f"</td><td class='num'>{html_mod.escape(str(size))}"
+                       f"</td><td class='num'>{nchunks}</td></tr>")
+        browse += "</table>" + ("" if rows else "<p><em>empty</em></p>")
+        page = ui.render(
             f"weedtpu filer {self.url}",
-            {"master": self.master_url,
-             "store": self.filer.store.actual.name,
-             "counters": dict(self.filer.store.counters),
-             "chunk_cache": {"hits": self.chunk_cache.hits,
-                             "misses": self.chunk_cache.misses}}),
-            content_type="text/html")
+            {"server": ui.Table(
+                ["master", "store", "deletion queue", "chunk cache hits",
+                 "chunk cache misses"],
+                [[self.master_url, self.filer.store.actual.name,
+                  self.deletion.pending_count(), self.chunk_cache.hits,
+                  self.chunk_cache.misses]]),
+             "store ops": ui.Table(
+                ["operation", "count"],
+                [[k, v] for k, v in
+                 sorted(self.filer.store.counters.items())])},
+            links={"metrics": "/metrics", "status json": "/__admin__/status"})
+        page = page.replace(
+            "</body></html>",
+            f"<h2>browse {html_mod.escape(path)}</h2>{browse}</body></html>")
+        return web.Response(text=page, content_type="text/html")
 
     async def handle_status(self, req: web.Request) -> web.Response:
         return web.json_response({

@@ -355,14 +355,60 @@ class MasterServer:
         return await handler(req)
 
     async def handle_ui(self, req: web.Request) -> web.Response:
-        """Status page (reference: weed/server/master_ui/)."""
+        """Operator status page with live topology, volume and EC shard
+        tables (reference: weed/server/master_ui/templates.go)."""
         from seaweedfs_tpu.server import ui
+        topo = self.topo.to_dict()
+        node_rows = []
+        vol_rows = []
+        ec_map: dict[str, dict[int, list[str]]] = {}
+        for nid, n in sorted(topo.get("nodes", {}).items()):
+            node_rows.append([nid, n.get("dc", ""), n.get("rack", ""),
+                              len(n.get("volume_infos", [])),
+                              n.get("free_slots", 0),
+                              sum(len(s) for s in
+                                  n.get("ec_shards", {}).values())])
+            for v in n.get("volume_infos", []):
+                vol_rows.append([
+                    v["id"], v.get("collection", "") or "-", nid,
+                    ui.fmt_bytes(v.get("size", 0)),
+                    v.get("file_count", 0),
+                    v.get("replica_placement", "000"),
+                    v.get("ttl", "") or "-", v.get("read_only", False)])
+            for vid, shards in n.get("ec_shards", {}).items():
+                for s in shards:
+                    ec_map.setdefault(vid, {}).setdefault(s, []).append(nid)
+        vol_rows.sort(key=lambda r: (r[0], r[2]))
+        ec_rows = [[vid,
+                    " ".join(f"{s}:{','.join(nodes)}"
+                             for s, nodes in sorted(shards.items())),
+                    len(shards)]
+                   for vid, shards in sorted(ec_map.items(),
+                                             key=lambda kv: int(kv[0]))]
         return web.Response(text=ui.render(
             f"weedtpu master {self.url}",
-            {"leader": self.leader_url, "is_leader": self.is_leader,
-             "topology": self.topo.to_dict(),
-             "cluster_members": {k: sorted(v) for k, v in
-                                 self.cluster_members.items()}}),
+            {"cluster": ui.Table(
+                ["leader", "this node is leader", "max volume id",
+                 "volume size limit"],
+                [[self.leader_url or "-", self.is_leader,
+                  topo.get("max_volume_id", 0),
+                  ui.fmt_bytes(topo.get("volume_size_limit", 0))]]),
+             "members": ui.Table(
+                ["role", "nodes"],
+                [[k, ", ".join(sorted(v))]
+                 for k, v in sorted(self.cluster_members.items())]),
+             "topology": ui.Table(
+                ["node", "dc", "rack", "volumes", "free slots",
+                 "ec shards"], node_rows),
+             "volumes": ui.Table(
+                ["id", "collection", "node", "size", "files",
+                 "replication", "ttl", "read-only"], vol_rows),
+             "ec shard map": ui.Table(
+                ["volume", "shard -> nodes", "present shards"], ec_rows),
+             "writables": {k: v for k, v in
+                           topo.get("writables", {}).items()}},
+            links={"metrics": "/metrics", "topology json": "/dir/status",
+                   "cluster json": "/cluster/status"}),
             content_type="text/html")
 
     async def handle_metrics(self, req: web.Request) -> web.Response:

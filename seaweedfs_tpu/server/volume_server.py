@@ -472,12 +472,36 @@ class VolumeServer:
     # -- admin: volumes --------------------------------------------------
 
     async def handle_ui(self, req: web.Request) -> web.Response:
-        """Status page (reference: weed/server/volume_server_ui/)."""
+        """Operator status page with volume and EC shard tables
+        (reference: weed/server/volume_server_ui/templates.go)."""
         from seaweedfs_tpu.server import ui
+        hb = self.store.collect_heartbeat()
+        vol_rows = [[v["id"], v.get("collection", "") or "-",
+                     ui.fmt_bytes(v.get("size", 0)), v.get("file_count", 0),
+                     v.get("delete_count", 0),
+                     ui.fmt_bytes(v.get("deleted_bytes", 0)),
+                     v.get("replica_placement", "000"),
+                     v.get("ttl", "") or "-", v.get("read_only", False)]
+                    for v in sorted(hb.get("volumes", []),
+                                    key=lambda v: v["id"])]
+        ec_rows = [[e["id"], e.get("collection", "") or "-",
+                    " ".join(str(s) for s in sorted(e.get("shards", []))),
+                    len(e.get("shards", []))]
+                   for e in sorted(hb.get("ec_shards", []),
+                                   key=lambda e: e["id"])]
         return web.Response(text=ui.render(
             f"weedtpu volume server {self.url}",
-            {"master": self.master_url,
-             "heartbeat": self.store.collect_heartbeat()}),
+            {"server": ui.Table(
+                ["master", "max slots", "volumes", "ec volumes"],
+                [[self.master_url, hb.get("max_volume_count", 0),
+                  len(vol_rows), len(ec_rows)]]),
+             "volumes": ui.Table(
+                ["id", "collection", "size", "files", "deleted",
+                 "deleted bytes", "replication", "ttl", "read-only"],
+                vol_rows),
+             "ec shards": ui.Table(
+                ["volume", "collection", "shards here", "count"], ec_rows)},
+            links={"metrics": "/metrics", "status json": "/status"}),
             content_type="text/html")
 
     async def handle_status(self, req: web.Request) -> web.Response:

@@ -1963,3 +1963,193 @@ def cmd_fs_meta_change_volume_id(env: CommandEnv, args, out):
     walk(root.rstrip("/") or "/")
     print(f"{changed} file(s) {'updated' if force else 'need updating'}"
           + ("" if force else " (dry run; add -force)"), file=out)
+
+
+@command("fs.merge.volumes")
+def cmd_fs_merge_volumes(env: CommandEnv, args, out):
+    """Re-upload the chunks of files under -dir that live on
+    -fromVolumeId into freshly assigned volumes, consolidating data off
+    small/fragmented volumes so they can be deleted (reference:
+    command_fs_merge_volumes.go).  Dry-run by default; -apply commits.
+    -dir /path -fromVolumeId N [-collection c] [-apply]"""
+    flags = parse_flags(args)
+    root = env.resolve(flags.get("dir", "/"))
+    src_vid = int(flags.get("fromVolumeId", "0"))
+    if not src_vid:
+        raise RuntimeError("-fromVolumeId is required")
+    apply = "apply" in flags
+    filer = env.find_filer()
+    from seaweedfs_tpu.client import WeedClient
+    client = WeedClient(env.master) if apply else None
+    files = chunks = 0
+    try:
+        def walk(d: str) -> None:
+            nonlocal files, chunks
+            for e in env.filer_list(filer, d):
+                if e.get("IsDirectory"):
+                    walk(e["FullPath"])
+                    continue
+                entry = env.master_get_raw(
+                    filer, urllib.parse.quote(e["FullPath"]),
+                    metadata="true")
+                dirty = False
+                for c in entry.get("chunks", []):
+                    fid = c.get("fid", "")
+                    vid_s = fid.split(",")[0]
+                    if not vid_s.isdigit() or int(vid_s) != src_vid:
+                        continue
+                    chunks += 1
+                    if not apply:
+                        dirty = True
+                        continue
+                    data = None
+                    for u in env.volume_locations(src_vid):
+                        try:
+                            with urllib.request.urlopen(
+                                    f"{_tls_scheme()}://{u}/{fid}",
+                                    timeout=120) as r:
+                                data = r.read()
+                            break
+                        except Exception:
+                            continue
+                    if data is None:
+                        raise RuntimeError(f"chunk {fid} unreadable on "
+                                           f"volume {src_vid}")
+                    # the point is moving OFF the source volume: retry
+                    # assign past it, growing fresh volumes if the source
+                    # is the only writable one
+                    a = None
+                    for attempt in range(8):
+                        cand = client.assign(
+                            collection=flags.get("collection", ""))
+                        if int(cand["fid"].split(",")[0]) != src_vid:
+                            a = cand
+                            break
+                        if attempt == 3:
+                            env.master_post(
+                                "/vol/grow", count="1",
+                                collection=flags.get("collection", ""))
+                    if a is None:
+                        raise RuntimeError(
+                            f"could not assign a target volume != "
+                            f"{src_vid}")
+                    client.upload_to(a["url"], a["fid"], data)
+                    c["fid"] = a["fid"]
+                    dirty = True
+                if dirty:
+                    files += 1
+                    print(f"  {'moved' if apply else 'would move'} "
+                          f"{e['FullPath']}", file=out)
+                    if apply:
+                        env._call(f"{filer}/__admin__/entry",
+                                  {"entry": entry})
+
+        walk(root.rstrip("/") or "/")
+    finally:
+        if client is not None:
+            client.close()
+    print(f"fs.merge.volumes: {chunks} chunk(s) in {files} file(s) "
+          f"{'moved off' if apply else 'on'} volume {src_vid}"
+          + ("" if apply else " (dry run; add -apply)"), file=out)
+
+
+@command("remote.mount.buckets")
+def cmd_remote_mount_buckets(env: CommandEnv, args, out):
+    """Mount every bucket of an S3-class remote under -dir (reference:
+    command_remote_mount_buckets.go): one subdirectory per bucket, each
+    with placeholder entries + a recorded read-through mapping.
+    -remote s3:endpoint=..,access_key=..,secret_key=.. [-dir /buckets]
+    [-bucketPattern glob]"""
+    import fnmatch
+    flags = parse_flags(args)
+    from seaweedfs_tpu.remote_storage import (make_remote,
+                                              parse_remote_spec,
+                                              sync_remote_to_filer)
+    kind, options = parse_remote_spec(flags.get("remote", ""))
+    options.pop("bucket", None)
+    base_dir = flags.get("dir", "/buckets").rstrip("/")
+    pattern = flags.get("bucketPattern", "")
+    probe = make_remote(kind, bucket="", **options)
+    if not hasattr(probe, "list_buckets"):
+        raise RuntimeError(f"remote kind {kind!r} cannot list buckets")
+    filer = env.find_filer()
+    mounted = 0
+    for bucket in probe.list_buckets():
+        if pattern and not fnmatch.fnmatch(bucket, pattern):
+            continue
+        remote = make_remote(kind, bucket=bucket, **options)
+        mount_dir = f"{base_dir}/{bucket}"
+        n = sync_remote_to_filer(remote, filer, mount_dir, cache=False)
+        spec = f"{kind}:bucket={bucket}," + ",".join(
+            f"{k}={v}" for k, v in options.items())
+        env._call(f"{filer}/__admin__/remote_mounts",
+                  {"set": {mount_dir: spec}})
+        print(f"  {bucket}: {n} object(s) -> {mount_dir}", file=out)
+        mounted += 1
+    print(f"remote.mount.buckets: {mounted} bucket(s) mounted", file=out)
+
+
+@command("mount.configure")
+def cmd_mount_configure(env: CommandEnv, args, out):
+    """Configure a RUNNING weedtpu mount through its admin unix socket
+    (reference: command_mount_configure.go over the mount's local socket).
+    -dir /mountpoint [-quotaMB N]  (0 clears the quota; no -quotaMB just
+    prints the mount's current state)"""
+    import socket as _socket
+    flags = parse_flags(args)
+    mountpoint = flags.get("dir")
+    if not mountpoint:
+        raise RuntimeError("-dir (the mountpoint) is required")
+    from seaweedfs_tpu.mount.weedfs import admin_socket_path
+    payload: dict = {}
+    if "quotaMB" in flags:
+        payload["quota"] = int(float(flags["quotaMB"]) * 1024 * 1024)
+    sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    try:
+        sock.settimeout(10)
+        sock.connect(admin_socket_path(mountpoint))
+        sock.sendall(json.dumps(payload).encode())
+        sock.shutdown(_socket.SHUT_WR)
+        resp = json.loads(sock.recv(65536))
+    except (OSError, ValueError) as e:
+        raise RuntimeError(
+            f"no responding mount at {mountpoint} ({e})") from None
+    finally:
+        sock.close()
+    if not resp.get("ok"):
+        raise RuntimeError(f"mount.configure: {resp.get('error')}")
+    quota = resp.get("quota", 0)
+    print(f"mount at {mountpoint}: root={resp.get('root')} quota="
+          + (f"{quota / (1024 * 1024):.0f}MB" if quota else "unlimited"),
+          file=out)
+
+
+@command("s3.circuitbreaker")
+def cmd_s3_circuitbreaker(env: CommandEnv, args, out):
+    """Show or set the S3 gateway circuit-breaker limits, stored in the
+    filer at /etc/s3/circuit_breaker.json and hot-reloaded by every
+    gateway (reference: command_s3_circuitbreaker.go).
+    [-global.requests N] [-global.uploadBytes N] [-bucket.requests N]
+    [-apply]   (without -apply: print the stored config)"""
+    flags = parse_flags(args)
+    from seaweedfs_tpu.s3.s3api_server import CIRCUIT_BREAKER_PATH
+    filer = env.find_filer()
+    if "apply" not in flags:
+        try:
+            raw = env.filer_read(filer, CIRCUIT_BREAKER_PATH)
+            print(raw.decode(), file=out)
+        except Exception:
+            print("no circuit breaker configured", file=out)
+        return
+    cfg = {
+        "global_max_requests": int(flags.get("global.requests", "0")),
+        "global_max_upload_bytes": int(flags.get("global.uploadBytes", "0")),
+        "bucket_max_requests": int(flags.get("bucket.requests", "0")),
+    }
+    req = urllib.request.Request(
+        f"{_tls_scheme()}://{filer}"
+        + urllib.parse.quote(CIRCUIT_BREAKER_PATH),
+        data=json.dumps(cfg).encode(), method="PUT")
+    with urllib.request.urlopen(req, timeout=60):
+        pass
+    print(f"s3.circuitbreaker applied: {json.dumps(cfg)}", file=out)

@@ -83,3 +83,34 @@ def setup_profiling(cpu_profile_path: str | None) -> None:
             log.info("cpu profile written to %s", cpu_profile_path)
             _profiler = None
     on_interrupt(dump)
+
+
+def jax_profile(trace_dir: str | None):
+    """Context manager capturing a JAX profiler (xprof) trace into
+    trace_dir — the TPU build's answer to the reference's pprof CPU
+    profiles (SURVEY §5: 'JAX profiler + xprof traces fill this role').
+    No-op when trace_dir is falsy, so call sites can pass the flag
+    straight through.  View with tensorboard or xprof."""
+    import contextlib
+    if not trace_dir:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.trace(trace_dir)
+
+
+def setup_jax_profile(trace_dir: str | None) -> None:
+    """Program-level variant (the --jax-profile CLI flag): start a trace
+    now, stop it at exit/interrupt."""
+    if not trace_dir:
+        return
+    import jax
+    jax.profiler.start_trace(trace_dir)
+    log.info("jax profiler trace started -> %s", trace_dir)
+
+    def stop():
+        try:
+            jax.profiler.stop_trace()
+            log.info("jax profiler trace written to %s", trace_dir)
+        except RuntimeError:
+            pass  # already stopped
+    on_interrupt(stop)

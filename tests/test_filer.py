@@ -270,8 +270,196 @@ class FakeElasticTransport:
         raise AssertionError(f"unhandled ES call: {method} {path}")
 
 
+class FakeHbaseRest:
+    """In-memory HBase REST ("Stargate") endpoint understanding exactly
+    the wire calls HbaseStore issues — row CRUD with base64 JSON cells and
+    the stateful scanner resource (POST -> Location, GET batches until
+    204, DELETE closes)."""
+
+    def __init__(self):
+        self.rows: dict[bytes, bytes] = {}  # row key -> f:m cell
+        self.scanners: dict[str, dict] = {}
+        self._next = 0
+
+    @staticmethod
+    def _b64(b: bytes) -> str:
+        import base64
+        return base64.b64encode(b).decode()
+
+    @staticmethod
+    def _unb64(s: str) -> bytes:
+        import base64
+        return base64.b64decode(s)
+
+    def __call__(self, method, path, body=None):
+        import urllib.parse
+        parts = [p for p in path.split("/") if p]
+        if len(parts) >= 2 and parts[1] == "scanner":
+            if method == "POST":
+                self._next += 1
+                sid = f"s{self._next}"
+                self.scanners[sid] = {
+                    "start": self._unb64(body["startRow"]),
+                    "end": self._unb64(body["endRow"]),
+                    "batch": body.get("batch", 1024)}
+                return 201, {}, {"Location": f"/{parts[0]}/scanner/{sid}"}
+            sid = parts[2]
+            sc = self.scanners.get(sid)
+            if method == "DELETE":
+                self.scanners.pop(sid, None)
+                return 200, {}, {}
+            if sc is None:
+                return 404, {}, {}
+            keys = sorted(k for k in self.rows
+                          if sc["start"] <= k < sc["end"])[: sc["batch"]]
+            if not keys:
+                return 204, {}, {}
+            sc["start"] = keys[-1] + b"\x00"
+            return 200, {"Row": [
+                {"key": self._b64(k), "Cell": [
+                    {"column": self._b64(b"f:m"),
+                     "$": self._b64(self.rows[k])}]}
+                for k in keys]}, {}
+        # real Stargate: the URL row segment is the LITERAL (percent-
+        # encoded) row key; base64 only appears in JSON cell bodies
+        row = urllib.parse.unquote_to_bytes(parts[1])
+        if method == "PUT":
+            cell = body["Row"][0]["Cell"][0]
+            self.rows[self._unb64(body["Row"][0]["key"])] = \
+                self._unb64(cell["$"])
+            return 200, {}, {}
+        if method == "DELETE":
+            self.rows.pop(row, None)
+            return 200, {}, {}
+        if method == "GET":
+            if row not in self.rows:
+                return 404, {}, {}
+            return 200, {"Row": [{"key": parts[1], "Cell": [
+                {"column": self._b64(b"f:m"),
+                 "$": self._b64(self.rows[row])}]}]}, {}
+        raise AssertionError(f"unhandled hbase call: {method} {path}")
+
+
+class FakeArangoTransport:
+    """In-memory ArangoDB HTTP endpoint covering document CRUD with
+    ?overwrite and the cursor API for the AQL shapes ArangodbStore
+    issues (directory listings, subtree REMOVE)."""
+
+    def __init__(self):
+        self.colls: dict[str, dict[str, dict]] = {}
+
+    def __call__(self, method, path, body=None):
+        if "/_api/collection" in path:
+            self.colls.setdefault(body["name"], {})
+            return 200, {}
+        if "/_api/cursor" in path:
+            if method == "PUT":  # cursor continuation
+                return self._cursor_put()
+            return self._aql(body)
+        # /_db/<db>/_api/document/<coll>[/<key>]
+        seg = path.split("/_api/document/", 1)[1].split("?")[0]
+        coll, _, key = seg.partition("/")
+        docs = self.colls.setdefault(coll, {})
+        if method == "POST":
+            docs[body["_key"]] = dict(body)
+            return 201, {}
+        if method == "GET":
+            if key not in docs:
+                return 404, {}
+            return 200, docs[key]
+        if method == "DELETE":
+            return (200, {}) if docs.pop(key, None) else (404, {})
+        raise AssertionError(f"unhandled arango call: {method} {path}")
+
+    def _aql(self, body):
+        q, bind = body["query"], body["bindVars"]
+        coll = q.split("FOR doc IN ", 1)[1].split()[0]
+        docs = self.colls.setdefault(coll, {})
+        if "REMOVE doc" in q:
+            keep = {k: d for k, d in docs.items()
+                    if not (d["directory"] == bind["base"] or
+                            d["directory"].startswith(bind["pref"]))}
+            self.colls[coll] = keep
+            return 200, {"result": [], "hasMore": False}
+        hits = [d for d in docs.values() if d["directory"] == bind["dir"]]
+        if "start" in bind:
+            if "name >= @start" in q:
+                hits = [d for d in hits if d["name"] >= bind["start"]]
+            else:
+                hits = [d for d in hits if d["name"] > bind["start"]]
+        if "prefix" in bind:
+            hits = [d for d in hits if d["name"].startswith(bind["prefix"])]
+        hits.sort(key=lambda d: d["name"])
+        hits = hits[: bind["limit"]]
+        # exercise the cursor-continuation path with a tiny first batch
+        if len(hits) > 2:
+            cid = "c1"
+            self._pending = [d["meta"] for d in hits[2:]]
+            return 200, {"result": [d["meta"] for d in hits[:2]],
+                         "hasMore": True, "id": cid}
+        return 200, {"result": [d["meta"] for d in hits], "hasMore": False}
+
+    def _cursor_put(self):
+        out, self._pending = self._pending, []
+        return 200, {"result": out, "hasMore": False}
+
+
+class FakeYdbSession:
+    """Statement-faithful stand-in for a ydb session: interprets exactly
+    the DECLAREd YQL statements YdbStore issues over ordered dicts."""
+
+    def __init__(self):
+        self.filemeta: dict[tuple[str, str], bytes] = {}
+        self.kv: dict[bytes, bytes] = {}
+
+    def execute(self, q, params):
+        if q.startswith("CREATE TABLE"):
+            return []
+        if "UPSERT INTO filemeta" in q:
+            self.filemeta[(params["$dir"], params["$name"])] = \
+                params["$meta"]
+            return []
+        if "SELECT meta FROM filemeta WHERE directory = $dir AND " \
+                "name = $name" in q:
+            row = self.filemeta.get((params["$dir"], params["$name"]))
+            return [(row,)] if row is not None else []
+        if "SELECT meta FROM filemeta WHERE directory = $dir AND " \
+                "name " in q:
+            ge = "name >= $start" in q
+            d, s = params["$dir"], params["$start"]
+            keys = sorted(k for k in self.filemeta
+                          if k[0] == d and (k[1] >= s if ge else k[1] > s))
+            return [(self.filemeta[k],)
+                    for k in keys][: params["$limit"]]
+        if "SELECT meta FROM filemeta WHERE directory = $dir" in q:
+            d = params["$dir"]
+            keys = sorted(k for k in self.filemeta if k[0] == d)
+            return [(self.filemeta[k],) for k in keys][: params["$limit"]]
+        if "DELETE FROM filemeta WHERE directory = $base" in q:
+            base, lo, hi = params["$base"], params["$lo"], params["$hi"]
+            self.filemeta = {
+                k: v for k, v in self.filemeta.items()
+                if not (k[0] == base or lo <= k[0] < hi)}
+            return []
+        if "DELETE FROM filemeta WHERE directory = $dir AND " \
+                "name = $name" in q:
+            self.filemeta.pop((params["$dir"], params["$name"]), None)
+            return []
+        if "UPSERT INTO kv" in q:
+            self.kv[bytes(params["$k"])] = bytes(params["$v"])
+            return []
+        if "SELECT v FROM kv" in q:
+            row = self.kv.get(bytes(params["$k"]))
+            return [(row,)] if row is not None else []
+        if "DELETE FROM kv" in q:
+            self.kv.pop(bytes(params["$k"]), None)
+            return []
+        raise AssertionError(f"unhandled YQL: {q}")
+
+
 @pytest.fixture(params=["memory", "sqlite", "logstore", "sql-format",
-                        "cassandra-fake", "tikv-fake", "elastic-fake"])
+                        "cassandra-fake", "tikv-fake", "elastic-fake",
+                        "hbase-fake", "arangodb-fake", "ydb-fake"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryStore()
@@ -293,6 +481,15 @@ def store(request, tmp_path):
     elif request.param == "elastic-fake":
         from seaweedfs_tpu.filer.stores_extra import ElasticStore
         yield ElasticStore(transport=FakeElasticTransport())
+    elif request.param == "hbase-fake":
+        from seaweedfs_tpu.filer.stores_extra import HbaseStore
+        yield HbaseStore(transport=FakeHbaseRest())
+    elif request.param == "arangodb-fake":
+        from seaweedfs_tpu.filer.stores_extra import ArangodbStore
+        yield ArangodbStore(transport=FakeArangoTransport())
+    elif request.param == "ydb-fake":
+        from seaweedfs_tpu.filer.stores_extra import YdbStore
+        yield YdbStore(session=FakeYdbSession())
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
         yield s

@@ -845,3 +845,175 @@ def test_fs_meta_notify_and_change_volume_id(tmp_path):
     finally:
         c.submit(filer.stop())
         c.stop()
+
+
+class TestRound5Commands:
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        c = Cluster(tmp_path, n_volume_servers=2).start()
+        c.wait_heartbeats()
+        filer = FilerServer(c.master.url, port=free_port(),
+                            data_dir=str(tmp_path / "filer"))
+        c.submit(filer.start())
+        env = CommandEnv(c.master.url)
+        assert wait_for(lambda: bool(
+            env.master_get("/cluster/status").get("Members", {}).get("filer")))
+        yield c, filer, env
+        c.submit(filer.stop())
+        c.stop()
+
+    def _put(self, filer, path, data: bytes):
+        import urllib.request
+        req = urllib.request.Request(f"http://{filer.url}{path}", data=data,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status in (200, 201)
+
+    def test_fs_merge_volumes(self, stack):
+        """Chunks move off the source volume and content survives
+        (reference: command_fs_merge_volumes.go)."""
+        import json
+        import urllib.request
+        c, filer, env = stack
+        self._put(filer, "/merge/f1.bin", b"m" * 5000)
+        self._put(filer, "/merge/f2.bin", b"n" * 5000)
+        with urllib.request.urlopen(
+                f"http://{filer.url}/merge/f1.bin?metadata=true",
+                timeout=30) as r:
+            meta = json.loads(r.read())
+        src_vid = int(meta["chunks"][0]["fid"].split(",")[0])
+        env.acquire_lock()
+        out = shell(env, f"fs.merge.volumes -dir /merge "
+                         f"-fromVolumeId {src_vid}")
+        assert "would move" in out and "dry run" in out
+        out = shell(env, f"fs.merge.volumes -dir /merge "
+                         f"-fromVolumeId {src_vid} -apply")
+        assert "moved off" in out
+        # entries no longer reference the source volume; bytes intact
+        with urllib.request.urlopen(
+                f"http://{filer.url}/merge/f1.bin?metadata=true",
+                timeout=30) as r:
+            meta2 = json.loads(r.read())
+        vids = {int(ch["fid"].split(",")[0]) for ch in meta2["chunks"]}
+        assert src_vid not in vids
+        with urllib.request.urlopen(f"http://{filer.url}/merge/f1.bin",
+                                    timeout=30) as r:
+            assert r.read() == b"m" * 5000
+
+    def test_mount_configure(self, stack, tmp_path):
+        """mount.configure drives a live WFS through its admin socket;
+        the quota rejects writes with EDQUOT
+        (reference: command_mount_configure.go)."""
+        from seaweedfs_tpu.mount.weedfs import (WFS, FsError,
+                                                start_admin_socket)
+        c, filer, env = stack
+        mnt = str(tmp_path / "fakemount")
+        wfs = WFS(filer.url, subscribe=False)
+        start_admin_socket(wfs, mnt)
+        out = shell(env, f"mount.configure -dir {mnt}")
+        assert "quota=unlimited" in out
+        out = shell(env, f"mount.configure -dir {mnt} -quotaMB 0.001")
+        assert "quota=0MB" in out or "quota" in out
+        assert wfs.quota_bytes == 1048  # 0.001 MB
+        fh = wfs.create("/q.bin")
+        with pytest.raises(FsError) as ei:
+            wfs.write(fh, b"z" * 4096, 0)
+        assert ei.value.errno == 122  # EDQUOT
+        # clearing the quota unblocks writes
+        shell(env, f"mount.configure -dir {mnt} -quotaMB 0")
+        assert wfs.write(fh, b"z" * 4096, 0) == 4096
+        wfs.release(fh)
+        wfs.close()
+        # errno contract for a dead socket
+        with pytest.raises(RuntimeError):
+            shell(env, f"mount.configure -dir {tmp_path}/nonexistent")
+
+    def test_s3_circuitbreaker(self, stack):
+        """s3.circuitbreaker stores config in the filer and a live S3
+        gateway hot-reloads it (reference: command_s3_circuitbreaker.go)."""
+        from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+        from seaweedfs_tpu.s3.auth import (Credential, Identity,
+                                           IdentityAccessManagement)
+        c, filer, env = stack
+        iam = IdentityAccessManagement([
+            Identity("admin", [Credential("AK", "SK")], ["Admin"])])
+        s3 = S3ApiServer(filer.url, port=free_port(), iam=iam)
+        c.submit(s3.start())
+        try:
+            out = shell(env, "s3.circuitbreaker")
+            assert "no circuit breaker" in out
+            out = shell(env, "s3.circuitbreaker -global.requests 7 "
+                             "-bucket.requests 3 -apply")
+            assert "applied" in out
+            out = shell(env, "s3.circuitbreaker")
+            assert '"global_max_requests": 7' in out
+            assert wait_for(
+                lambda: s3.breaker.global_max_requests == 7, timeout=15)
+            assert s3.breaker.bucket_max_requests == 3
+        finally:
+            c.submit(s3.stop())
+
+    def test_remote_mount_buckets(self, stack, tmp_path):
+        """remote.mount.buckets lists an S3 remote's buckets and mounts
+        each (reference: command_remote_mount_buckets.go) — against this
+        repo's own gateway as the remote."""
+        from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+        from seaweedfs_tpu.s3.auth import (Credential, Identity,
+                                           IdentityAccessManagement,
+                                           sign_v4)
+        import urllib.request
+        c, filer, env = stack
+        cred = Credential("AK2", "SK2")
+        iam = IdentityAccessManagement([
+            Identity("admin", [cred], ["Admin"])])
+        s3 = S3ApiServer(filer.url, port=free_port(), iam=iam)
+        c.submit(s3.start())
+        try:
+            def s3req(method, path, data=None):
+                headers = sign_v4(cred, method, s3.url, path, {},
+                                  payload=data or b"")
+                req = urllib.request.Request(
+                    f"http://{s3.url}{path}", data=data, method=method,
+                    headers=headers)
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status
+            assert s3req("PUT", "/books") == 200
+            assert s3req("PUT", "/music") == 200
+            assert s3req("PUT", "/books/novel.txt", b"pages") == 200
+            env.acquire_lock()
+            out = shell(env, "remote.mount.buckets -dir /mirror "
+                             f"-remote s3:endpoint={s3.url},"
+                             f"access_key=AK2,secret_key=SK2 "
+                             f"-bucketPattern book*")
+            assert "books: 1 object(s) -> /mirror/books" in out
+            assert "music" not in out
+            out = shell(env, "fs.ls /mirror/books")
+            assert "novel.txt" in out
+        finally:
+            c.submit(s3.stop())
+
+    def test_status_uis(self, stack):
+        """Each server's UI renders live volume/shard/browse tables
+        (reference: master_ui/volume_server_ui/filer_ui templates)."""
+        import urllib.request
+        c, filer, env = stack
+        self._put(filer, "/uidir/file.bin", b"u" * 2048)
+
+        def page(url):
+            with urllib.request.urlopen(url, timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/html")
+                return r.read().decode()
+
+        mp = page(f"http://{c.master.url}/")
+        assert "<table>" in mp and "ec shard map" in mp
+        assert "volume size limit" in mp and "/metrics" in mp
+        # the volume holding the upload shows up in the master table
+        vs_url = f"127.0.0.1:{c.volume_servers[0].port}"
+        vp = page(f"http://{vs_url}/")
+        assert "<table>" in vp and "ec shards" in vp
+        assert "read-only" in vp
+        fp = page(f"http://{filer.url}/__ui__?path=/uidir")
+        assert "file.bin" in fp and "2.0 KiB" in fp
+        root = page(f"http://{filer.url}/__ui__")
+        assert "uidir/" in root and "path=/uidir" in root
