@@ -419,7 +419,15 @@ class MasterServer:
         if not self.is_leader:
             return self._not_leader_response()
         metrics.MASTER_RECEIVED_HEARTBEATS.labels().inc()
-        beat = await req.json()
+        if req.content_type == "application/x-protobuf":
+            # binary framing (reference: master.proto Heartbeat); 415 when
+            # this master cannot decode it, so senders fall back to JSON
+            from seaweedfs_tpu import pb
+            if not pb.available():
+                return web.Response(status=415)
+            beat = pb.heartbeat_from_bytes(await req.read())
+        else:
+            beat = await req.json()
         if beat.get("max_file_key"):
             self.topo.sequencer.set_max(int(beat["max_file_key"]))
         self.topo.register_heartbeat(

@@ -102,6 +102,7 @@ class VolumeServer:
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._hb_task: asyncio.Task | None = None
+        self._wire_pb: bool | None = None  # protobuf heartbeat framing
 
     # -- lifecycle -----------------------------------------------------
 
@@ -167,8 +168,29 @@ class VolumeServer:
         beat.update({"id": self.url, "url": self.url,
                      "public_url": self.public_url,
                      "data_center": self.data_center, "rack": self.rack})
-        async with self._session.post(
-                f"{_tls_scheme()}://{self.master_url}/heartbeat", json=beat) as r:
+        # binary protobuf framing when the wire layer is built (reference:
+        # master.proto Heartbeat); JSON otherwise or when forced.  A 415
+        # from a JSON-only master latches the fallback.
+        from seaweedfs_tpu import pb
+        use_pb = self._wire_pb
+        if use_pb is None:
+            use_pb = self._wire_pb = (
+                os.environ.get("WEEDTPU_WIRE", "pb") != "json"
+                and pb.available())
+        url = f"{_tls_scheme()}://{self.master_url}/heartbeat"
+        if use_pb:
+            async with self._session.post(
+                    url, data=pb.heartbeat_to_bytes(beat),
+                    headers={"Content-Type": pb.CONTENT_TYPE}) as r:
+                if r.status == 415:
+                    self._wire_pb = False
+                    return await self._heartbeat_once()
+                if r.status == 200:
+                    data = await r.json()
+                    self.volume_size_limit = data.get(
+                        "volume_size_limit", self.volume_size_limit)
+            return
+        async with self._session.post(url, json=beat) as r:
             if r.status == 200:
                 data = await r.json()
                 self.volume_size_limit = data.get(
