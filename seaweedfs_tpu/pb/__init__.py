@@ -78,7 +78,8 @@ def heartbeat_to_bytes(beat: dict) -> bytes:
             read_only=bool(v.get("read_only", False)),
             replica_placement=str(v.get("replica_placement", "000")),
             ttl=str(v.get("ttl", "") or ""),
-            modified_at_second=int(v.get("modified_at", 0)))
+            modified_at_second=int(v.get("modified_at", 0)),
+            version=int(v.get("version", 0)))
     for e in beat.get("ec_shards", []):
         hb.ec_shards.add(id=int(e.get("id", 0)),
                          collection=e.get("collection", "") or "",
@@ -96,6 +97,10 @@ def heartbeat_from_bytes(raw: bytes) -> dict:
         "max_volume_count": hb.max_volume_count,
         "max_file_key": hb.max_file_key,
         "volumes": [{
+            # proto3 zero-default: a 0 version means "unset" — omit it so
+            # the consumer's CURRENT_VERSION default applies, matching a
+            # JSON beat that never carried the key
+            **({"version": v.version} if v.version else {}),
             "id": v.id, "size": v.size, "collection": v.collection,
             "file_count": v.file_count, "delete_count": v.delete_count,
             "deleted_bytes": v.deleted_byte_count,

@@ -86,6 +86,7 @@ class MasterServer:
             web.get("/dir/ec/lookup", self.handle_ec_lookup),
             web.post("/heartbeat", self.handle_heartbeat),
             web.get("/cluster/status", self.handle_cluster_status),
+            web.get("/dir/status", self.handle_dir_status),
             web.post("/vol/grow", self.handle_grow),
             web.post("/admin/lock", self.handle_lock),
             web.post("/admin/unlock", self.handle_unlock),
@@ -127,6 +128,10 @@ class MasterServer:
         return f"{self.host}:{self.port}"
 
     async def start(self) -> None:
+        # build/load the protobuf wire module off the event loop (first
+        # use may run protoc; see pb/__init__.py)
+        from seaweedfs_tpu import pb
+        await asyncio.to_thread(pb.available)
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=30))
@@ -555,6 +560,11 @@ class MasterServer:
         finally:
             self._vid_subscribers.discard(q)
         return resp
+
+    async def handle_dir_status(self, req: web.Request) -> web.Response:
+        """Topology snapshot (reference: master /dir/status,
+        master_server_handlers_admin.go dirStatusHandler)."""
+        return web.json_response({"Topology": self.topo.to_dict()})
 
     async def handle_cluster_status(self, req: web.Request) -> web.Response:
         # members go stale when their register loop stops (reference:

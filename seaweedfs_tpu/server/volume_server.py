@@ -107,6 +107,10 @@ class VolumeServer:
     # -- lifecycle -----------------------------------------------------
 
     async def start(self) -> None:
+        # build/load the protobuf wire module off the event loop: first
+        # use can shell out to protoc, which must not stall live requests
+        from seaweedfs_tpu import pb
+        await asyncio.to_thread(pb.available)
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=300))
@@ -170,7 +174,10 @@ class VolumeServer:
                      "data_center": self.data_center, "rack": self.rack})
         # binary protobuf framing when the wire layer is built (reference:
         # master.proto Heartbeat); JSON otherwise or when forced.  A 415
-        # from a JSON-only master latches the fallback.
+        # from a JSON-only master latches the fallback.  Only the REQUEST
+        # framing differs — response handling (size limit, 409
+        # leader-follow, rotation) is shared so the two wires cannot
+        # diverge.
         from seaweedfs_tpu import pb
         use_pb = self._wire_pb
         if use_pb is None:
@@ -179,18 +186,15 @@ class VolumeServer:
                 and pb.available())
         url = f"{_tls_scheme()}://{self.master_url}/heartbeat"
         if use_pb:
-            async with self._session.post(
-                    url, data=pb.heartbeat_to_bytes(beat),
-                    headers={"Content-Type": pb.CONTENT_TYPE}) as r:
-                if r.status == 415:
-                    self._wire_pb = False
-                    return await self._heartbeat_once()
-                if r.status == 200:
-                    data = await r.json()
-                    self.volume_size_limit = data.get(
-                        "volume_size_limit", self.volume_size_limit)
-            return
-        async with self._session.post(url, json=beat) as r:
+            req = self._session.post(
+                url, data=pb.heartbeat_to_bytes(beat),
+                headers={"Content-Type": pb.CONTENT_TYPE})
+        else:
+            req = self._session.post(url, json=beat)
+        async with req as r:
+            if r.status == 415 and use_pb:
+                self._wire_pb = False
+                return await self._heartbeat_once()
             if r.status == 200:
                 data = await r.json()
                 self.volume_size_limit = data.get(

@@ -20,7 +20,7 @@ def test_heartbeat_roundtrip_preserves_fields():
             {"id": 3, "size": 1 << 30, "collection": "hot",
              "file_count": 42, "delete_count": 2, "deleted_bytes": 999,
              "read_only": True, "replica_placement": "010", "ttl": "3d",
-             "modified_at": 1700000000},
+             "modified_at": 1700000000, "version": 3},
         ],
         "ec_shards": [
             {"id": 7, "collection": "", "shard_ids": [0, 3, 13]},
