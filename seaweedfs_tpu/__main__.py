@@ -300,6 +300,8 @@ def main(argv=None) -> int:
                      choices=["filer", "security", "master", "replication",
                               "notification", "shell"])
 
+    pver = sub.add_parser("version", help="print version and build info")
+
     pcrt = sub.add_parser(
         "certs", help="generate a cluster CA + node cert/key and print the "
                       "[tls] table for security.toml (security/tls.py)")
@@ -309,7 +311,7 @@ def main(argv=None) -> int:
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
               psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs, prp,
-              pmt2, pct, pcpy, prg):
+              pmt2, pct, pcpy, prg, pver):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -364,6 +366,16 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "filer.backup":
         return _run_filer_backup(args)
+    if args.cmd == "version":
+        import platform
+        import seaweedfs_tpu
+        from seaweedfs_tpu import native, pb
+        print(f"weedtpu {seaweedfs_tpu.__version__} "
+              f"(python {platform.python_version()}, "
+              f"native={'yes' if native.available() else 'no'}"
+              f"{', gfni' if native.available() and native.gf_impl() == 3 else ''}, "
+              f"pb={'yes' if pb.available() else 'no'})")
+        return 0
     if args.cmd == "filer.meta.tail":
         return _run_filer_meta_tail(args)
     if args.cmd == "filer.cat":

@@ -7,6 +7,24 @@ import sys
 from seaweedfs_tpu.shell.commands import CommandEnv, run_command
 
 
+def _setup_completion() -> None:
+    """Tab-completes command names (reference: the shell's
+    prompt autocompletion, weed/shell/shell.go + weed autocomplete)."""
+    try:
+        import readline
+    except ImportError:  # no libreadline: plain input() still works
+        return
+    from seaweedfs_tpu.shell.commands import COMMANDS
+
+    def complete(text: str, state: int):
+        matches = [c for c in sorted(COMMANDS) if c.startswith(text)]
+        return matches[state] if state < len(matches) else None
+
+    readline.set_completer(complete)
+    readline.set_completer_delims(" \t")
+    readline.parse_and_bind("tab: complete")
+
+
 def repl(master: str, script: str | None = None) -> int:
     env = CommandEnv(master)
     rc = 0
@@ -17,6 +35,7 @@ def repl(master: str, script: str | None = None) -> int:
                 if line:
                     run_command(env, line, sys.stdout)
             return 0
+        _setup_completion()
         while True:
             try:
                 line = input("> ").strip()
