@@ -324,8 +324,9 @@ def main(argv=None) -> int:
     # shell, mount, filer.sync, mq.broker ...) — loads security.toml here so
     # JWT keys and process-wide TLS (security/tls.py) are live before any
     # cluster URL is built. `certs` and `scaffold` are the bootstrap tools
-    # that must run even when the configured cert files are missing.
-    if args.cmd not in ("certs", "scaffold"):
+    # (and `version` the diagnostic) that must run even when the
+    # configured cert files are missing.
+    if args.cmd not in ("certs", "scaffold", "version"):
         _security(args)
     grace.setup_profiling(getattr(args, "cpuprofile", None))
 

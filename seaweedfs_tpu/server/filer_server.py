@@ -667,31 +667,69 @@ class FilerServer:
         """Stream a request body into blob-store chunks at logical offsets
         base_offset.. — shared by whole-file uploads and ranged patches.
         Appends into the caller's `chunks` list so a failure mid-stream
-        leaves the partial refs visible for cleanup. Returns byte count."""
+        leaves the partial refs visible for cleanup. Returns byte count.
+
+        Chunk uploads run CONCURRENTLY behind a bounded window while the
+        body keeps streaming in (reference: the limited upload pool in
+        filer_server_handlers_write_upload.go) — serial awaiting would
+        make every large upload latency-bound on one volume round-trip
+        per chunk.  Offsets are assigned at emit time, so completion
+        order doesn't matter; the list is offset-sorted at the end."""
         total = 0
         pending = bytearray()
+        inflight: set[asyncio.Task] = set()
+        window = 4
+
+        async def upload(blob: bytes, offset: int) -> None:
+            ck = await self._upload_chunk(blob, collection, replication,
+                                          ttl, mime)
+            ck.offset = offset
+            chunks.append(ck)
 
         async def emit(blob: bytes) -> None:
             nonlocal total
-            ck = await self._upload_chunk(blob, collection, replication,
-                                          ttl, mime)
-            ck.offset = base_offset + total
+            off = base_offset + total
             total += len(blob)
-            chunks.append(ck)
+            inflight.add(asyncio.create_task(upload(blob, off)))
+            if len(inflight) >= window:
+                done, rest = await asyncio.wait(
+                    inflight, return_when=asyncio.FIRST_COMPLETED)
+                inflight.clear()
+                inflight.update(rest)
+                # retrieve EVERY done task's result before raising: a
+                # multi-failure window must not leak unretrieved-exception
+                # warnings for the tasks behind the first one
+                errs = [t.exception() for t in done]
+                first = next((e for e in errs if e is not None), None)
+                if first is not None:
+                    raise first
 
-        while True:
-            piece = await content.read(min(chunk_size, 1 << 20))
-            if not piece:
-                break
-            if md5 is not None:
-                md5.update(piece)
-            pending.extend(piece)
-            while len(pending) >= chunk_size:
-                blob = bytes(pending[:chunk_size])
-                del pending[:chunk_size]
-                await emit(blob)
-        if pending:  # empty files carry no chunks, like the reference
-            await emit(bytes(pending))
+        try:
+            while True:
+                piece = await content.read(min(chunk_size, 1 << 20))
+                if not piece:
+                    break
+                if md5 is not None:
+                    md5.update(piece)
+                pending.extend(piece)
+                while len(pending) >= chunk_size:
+                    blob = bytes(pending[:chunk_size])
+                    del pending[:chunk_size]
+                    await emit(blob)
+            if pending:  # empty files carry no chunks, like the reference
+                await emit(bytes(pending))
+        finally:
+            # drain in-flight uploads on BOTH paths: late completions must
+            # land in `chunks` before the caller cleans up or commits
+            if inflight:
+                import sys as _sys
+                results = await asyncio.gather(*inflight,
+                                               return_exceptions=True)
+                err = next((r for r in results
+                            if isinstance(r, BaseException)), None)
+                if err is not None and _sys.exc_info()[0] is None:
+                    raise err  # never mask the original in-flight error
+        chunks.sort(key=lambda c: c.offset)
         return total
 
     def _path_lock(self, path: str) -> asyncio.Lock:
