@@ -103,6 +103,11 @@ class VolumeServer:
         self._session: aiohttp.ClientSession | None = None
         self._hb_task: asyncio.Task | None = None
         self._wire_pb: bool | None = None  # protobuf heartbeat framing
+        # vid -> (expiry, shard location map) for degraded-read fan-out;
+        # accessed from shard_reader worker threads, hence the lock
+        self._ec_loc_cache: dict[int, tuple[float, dict]] = {}
+        import threading as _threading
+        self._ec_loc_lock = _threading.Lock()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -464,6 +469,32 @@ class VolumeServer:
                 return web.json_response({"error": err}, status=500)
         return web.json_response({"size": size})
 
+    def _ec_shard_locations(self, vid: int) -> dict:
+        """Master shard-location lookup with a short TTL cache (reference:
+        store_ec.go cachedLookupEcShardLocations and its TTL tiers) — a
+        degraded read fans out to many shards and must not re-query the
+        master once per shard.  The lock covers the fetch too, so a cold
+        parallel fan-out issues ONE lookup, not one per worker thread;
+        empty results get a much shorter TTL (the reference's empty-list
+        tier) so a transient bad answer can't blank a volume for 10s."""
+        import urllib.request
+        import json as _json
+        with self._ec_loc_lock:
+            now = time.monotonic()
+            cached = self._ec_loc_cache.get(vid)
+            if cached and cached[0] > now:
+                return cached[1]
+            with urllib.request.urlopen(
+                    f"{_tls_scheme()}://{self.master_url}"
+                    f"/dir/ec/lookup?volumeId={vid}",
+                    timeout=10) as r:
+                shards = _json.load(r).get("shards", {})
+            ttl = 10.0 if shards else 1.0
+            self._ec_loc_cache[vid] = (now + ttl, shards)
+            while len(self._ec_loc_cache) > 256:
+                self._ec_loc_cache.pop(next(iter(self._ec_loc_cache)))
+            return shards
+
     def _shard_reader(self, vid: int):
         """Remote-shard fetch for EC degraded reads: ask the master where
         each shard lives, pull the byte range from a peer
@@ -471,12 +502,8 @@ class VolumeServer:
         def read(shard_id: int, offset: int, size: int) -> bytes | None:
             # runs inside a worker thread: use a blocking http client
             import urllib.request
-            import json as _json
             try:
-                with urllib.request.urlopen(
-                        f"{_tls_scheme()}://{self.master_url}/dir/ec/lookup?volumeId={vid}",
-                        timeout=10) as r:
-                    shards = _json.load(r).get("shards", {})
+                shards = self._ec_shard_locations(vid)
                 for loc in shards.get(str(shard_id), []):
                     if loc["url"] == self.url:
                         continue

@@ -120,17 +120,44 @@ class EcVolume:
 
     def _reconstruct_interval(self, shard_id: int, offset: int, size: int,
                               shard_reader: ShardReader | None) -> bytes:
-        """Online repair: rebuild this shard's byte range from any k others."""
+        """Online repair: rebuild this shard's byte range from any k
+        others.  Local shards are gathered first (cheap); the remaining
+        remote reads fan out in PARALLEL like the reference's
+        recoverOneRemoteEcShardInterval (store_ec.go:349-382) — a serial
+        walk would stack per-peer timeouts onto one degraded GET."""
         codec = ec_files._get_codec()
         got: dict[int, np.ndarray] = {}
+        missing_remote: list[int] = []
         for i in range(layout.TOTAL_SHARDS):
-            if i == shard_id or len(got) >= layout.DATA_SHARDS:
+            if i == shard_id:
                 continue
+            if len(got) >= layout.DATA_SHARDS:
+                break  # enough local shards: no wasted disk reads
             data = self._read_local(i, offset, size)
-            if (data is None or len(data) != size) and shard_reader is not None:
-                data = shard_reader(i, offset, size)
             if data is not None and len(data) == size:
                 got[i] = np.frombuffer(data, dtype=np.uint8)
+            else:
+                missing_remote.append(i)
+        if len(got) < layout.DATA_SHARDS and shard_reader is not None:
+            need = layout.DATA_SHARDS - len(got)
+            from concurrent.futures import (ThreadPoolExecutor,
+                                            as_completed)
+            pool = ThreadPoolExecutor(
+                max_workers=min(8, len(missing_remote) or 1))
+            try:
+                futs = {pool.submit(shard_reader, i, offset, size): i
+                        for i in missing_remote}
+                for fut in as_completed(futs):
+                    data = None if fut.exception() else fut.result()
+                    if data is not None and len(data) == size:
+                        got[futs[fut]] = np.frombuffer(data, dtype=np.uint8)
+                        need -= 1
+                        if need <= 0:
+                            break
+            finally:
+                # do NOT wait for stragglers: one blackholed peer must not
+                # stall the degraded GET past the k fast responders
+                pool.shutdown(wait=False, cancel_futures=True)
         if len(got) < layout.DATA_SHARDS:
             raise IOError(
                 f"ec volume {self.base}: only {len(got)} shards readable, "
