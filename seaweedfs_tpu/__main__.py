@@ -302,6 +302,11 @@ def main(argv=None) -> int:
 
     pver = sub.add_parser("version", help="print version and build info")
 
+    pac = sub.add_parser(
+        "autocomplete",
+        help="print a bash completion script (source it or install to "
+             "/etc/bash_completion.d)")
+
     pcrt = sub.add_parser(
         "certs", help="generate a cluster CA + node cert/key and print the "
                       "[tls] table for security.toml (security/tls.py)")
@@ -311,7 +316,7 @@ def main(argv=None) -> int:
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
               psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs, prp,
-              pmt2, pct, pcpy, prg, pver):
+              pmt2, pct, pcpy, prg, pver, pac):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -320,13 +325,24 @@ def main(argv=None) -> int:
     weedlog.setup(args.v, args.logFile)
     grace.setup_stack_dumps()
     grace.setup_jax_profile(getattr(args, "jaxProfile", None))
+    # client-side PRINT commands behave like unix tools when piped into
+    # head/grep: die on SIGPIPE instead of tracebacking mid-print.  Never
+    # for servers — with SIG_DFL a peer closing a socket mid-write would
+    # kill the whole process instead of raising a per-connection error.
+    if args.cmd in ("version", "autocomplete", "scaffold", "filer.cat",
+                    "filer.meta.tail", "export", "download"):
+        try:
+            import signal as _signal
+            _signal.signal(_signal.SIGPIPE, _signal.SIG_DFL)
+        except (ImportError, ValueError, OSError, AttributeError):
+            pass
     # every subcommand — servers AND client-side tools (backup, upload,
     # shell, mount, filer.sync, mq.broker ...) — loads security.toml here so
     # JWT keys and process-wide TLS (security/tls.py) are live before any
     # cluster URL is built. `certs` and `scaffold` are the bootstrap tools
     # (and `version` the diagnostic) that must run even when the
     # configured cert files are missing.
-    if args.cmd not in ("certs", "scaffold", "version"):
+    if args.cmd not in ("certs", "scaffold", "version", "autocomplete"):
         _security(args)
     grace.setup_profiling(getattr(args, "cpuprofile", None))
 
@@ -367,6 +383,18 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "filer.backup":
         return _run_filer_backup(args)
+    if args.cmd == "autocomplete":
+        # reference: weed autocomplete (fish/zsh/bash); bash here — the
+        # subcommand list is generated from the live parser registry
+        cmds = " ".join(sorted(sub.choices))
+        print(f"""_weedtpu_complete() {{
+  local cur="${{COMP_WORDS[COMP_CWORD]}}"
+  if [ "$COMP_CWORD" -eq 1 ]; then
+    COMPREPLY=( $(compgen -W "{cmds}" -- "$cur") )
+  fi
+}}
+complete -F _weedtpu_complete weedtpu""")
+        return 0
     if args.cmd == "version":
         import platform
         import seaweedfs_tpu

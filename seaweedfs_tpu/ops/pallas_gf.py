@@ -38,7 +38,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from seaweedfs_tpu.ops import codec_base, gf
 
-DEFAULT_TILE = 32768  # 16K-128K measure within noise of each other; 32K never worse
+DEFAULT_TILE = 32768  # interpreter/CPU default: small pads for small inputs
+TPU_TILE = 131072  # measured best on v5e (round-5 sweep: ~+25% over 32K;
+#                    256K regresses — xbits VMEM block passes 16MB)
 PLANE_PAD = 16  # sublane alignment for each bit-plane block
 
 
@@ -140,7 +142,17 @@ class PallasRSCodec(codec_base.RSCodecBase):
 
 
 @functools.lru_cache(maxsize=16)
-def get_codec(k: int, m: int, construction: str = "vandermonde",
-              tile: int = DEFAULT_TILE) -> PallasRSCodec:
+def _get_codec_cached(k: int, m: int, construction: str,
+                      tile: int) -> PallasRSCodec:
     from seaweedfs_tpu.models import rs
     return PallasRSCodec(rs.get_code(k, m, construction), tile)
+
+
+def get_codec(k: int, m: int, construction: str = "vandermonde",
+              tile: int | None = None) -> PallasRSCodec:
+    """tile=None resolves per backend: the big TPU tile for real chips,
+    the small default under the (CPU) interpreter where column padding
+    to the tile width is pure waste."""
+    if tile is None:
+        tile = TPU_TILE if jax.default_backend() == "tpu" else DEFAULT_TILE
+    return _get_codec_cached(k, m, construction, tile)
