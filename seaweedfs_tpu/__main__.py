@@ -300,6 +300,40 @@ def main(argv=None) -> int:
                      choices=["filer", "security", "master", "replication",
                               "notification", "shell"])
 
+    pmf = sub.add_parser(
+        "master.follower",
+        help="read-only lookup-serving master follower "
+             "(command/master_follower.go)")
+    pmf.add_argument("-ip", default="127.0.0.1")
+    pmf.add_argument("-port", type=int, default=9334)
+    pmf.add_argument("-masters", default="127.0.0.1:9333",
+                     help="comma-separated master list to track")
+
+    pmb = sub.add_parser(
+        "filer.meta.backup",
+        help="continuously back up filer METADATA (entries + chunk refs) "
+             "into a local store (command/filer_meta_backup.go)")
+    pmb.add_argument("-filer", default="127.0.0.1:8888")
+    pmb.add_argument("-filerPath", default="/")
+    pmb.add_argument("-store", required=True,
+                     help="target store spec: sqlite:/path/meta.db or "
+                          "logstore:/dir")
+    pmb.add_argument("-restart", action="store_true",
+                     help="resync from scratch instead of resuming")
+
+    # fstab-style alias for mount (reference: command/fuse.go lets
+    # /etc/fstab say `weed fuse /mnt -o "filer=..."`)
+    pfu = sub.add_parser(
+        "fuse",
+        help="fstab-style mount: `weedtpu fuse SOURCE MOUNTPOINT -o "
+             "filer=host:port,filer.path=/x` (command/fuse.go)")
+    pfu.add_argument("source", nargs="?", default="",
+                     help="fstab device field (informational)")
+    pfu.add_argument("mountpoint", nargs="?", default="")
+    pfu.add_argument("-o", dest="options", default="",
+                     help="comma-separated mount options: "
+                          "filer=host:port, filer.path=/subdir")
+
     pver = sub.add_parser("version", help="print version and build info")
 
     pac = sub.add_parser(
@@ -316,7 +350,7 @@ def main(argv=None) -> int:
 
     for p in (pm, pv, ps, pf, p3, pi, psh, pb, pup, pdl, pfx, pex, pbk,
               psy, psc, pwd, pmq, pmt, pft, pcp, pfb, pcrt, prs, prp,
-              pmt2, pct, pcpy, prg, pver, pac):
+              pmt2, pct, pcpy, prg, pver, pac, pmf, pmb, pfu):
         _add_common_flags(p)
 
     args = ap.parse_args(argv)
@@ -405,6 +439,22 @@ complete -F _weedtpu_complete weedtpu""")
               f"{', gfni' if native.available() and native.gf_impl() == 3 else ''}, "
               f"pb={'yes' if pb.available() else 'no'})")
         return 0
+    if args.cmd == "master.follower":
+        async def _run_follower():
+            from seaweedfs_tpu.server.master_follower import MasterFollower
+            mf = MasterFollower(args.masters, host=args.ip, port=args.port)
+            await mf.start()
+            try:
+                await asyncio.Event().wait()
+            finally:
+                await mf.stop()
+        try:
+            asyncio.run(_run_follower())
+        except KeyboardInterrupt:
+            pass
+        return 0
+    if args.cmd == "filer.meta.backup":
+        return _run_filer_meta_backup(args)
     if args.cmd == "filer.meta.tail":
         return _run_filer_meta_tail(args)
     if args.cmd == "filer.cat":
@@ -467,10 +517,22 @@ complete -F _weedtpu_complete weedtpu""")
             print(str(e), file=sys.stderr)
             return 1
         return 0
-    if args.cmd == "mount":
+    if args.cmd in ("mount", "fuse"):
         from seaweedfs_tpu.mount.weedfs import mount
+        if args.cmd == "fuse":
+            # mount(8) passes the mountpoint positionally and config via -o
+            opts = dict(p.partition("=")[::2]
+                        for p in args.options.split(",") if p)
+            filer = opts.get("filer", "127.0.0.1:8888")
+            root = opts.get("filer.path", "/")
+            target = args.mountpoint or args.source
+            if not target:
+                print("fuse: mountpoint required", file=sys.stderr)
+                return 2
+        else:
+            filer, root, target = args.filer, args.filerPath, args.dir
         try:
-            mount(args.filer, args.dir, root=args.filerPath)
+            mount(filer, target, root=root)
         except RuntimeError as e:
             print(str(e), file=sys.stderr)
             return 1
@@ -929,6 +991,143 @@ topic = "seaweedfs_filer"
 default = "localhost:9333"
 """,
 }
+
+
+def _run_filer_meta_backup(args) -> int:
+    """Continuously replicate filer METADATA (entries incl. chunk refs,
+    no blob content) into a local FilerStore, resumable via an offset kept
+    in the store's own KV (reference: weed/command/filer_meta_backup.go —
+    same restore story: point a filer at the backup store)."""
+    import urllib.parse
+    import urllib.request
+
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.filerstore import NotFound, make_store
+
+    kind, _, opt = args.store.partition(":")
+    if kind == "sqlite":
+        from seaweedfs_tpu.filer.abstract_sql import SqliteStore
+        store = SqliteStore(opt)
+    elif kind == "logstore":
+        from seaweedfs_tpu.filer.stores_extra import LogStore
+        store = LogStore(opt)
+    elif "=" in opt or not opt:
+        # other store kinds take key=value options like remote specs; an
+        # unparsed option string must never be silently dropped (it would
+        # back up into the store's DEFAULT target)
+        options = dict(p.partition("=")[::2]
+                       for p in opt.split(",") if p)
+        store = make_store(kind, **options)
+    else:
+        print(f"filer.meta.backup: cannot parse store spec "
+              f"{args.store!r} (use kind:key=value,... or sqlite:/path "
+              f"or logstore:/dir)", file=sys.stderr)
+        return 2
+
+    OFFSET_KEY = b"__meta_backup_offset__"
+    CHECKPOINT_EVERY = 100  # events between offset commits
+    since = 0
+    if not args.restart:
+        try:
+            since = int(store.kv_get(OFFSET_KEY))
+        except (NotFound, ValueError):
+            since = 0
+    if since == 0:
+        # initial FULL traversal (reference: filer_meta_backup.go syncs
+        # existing metadata first): the filer's event ring is bounded, so
+        # subscribing from 0 alone would silently miss older entries
+        import time as _time
+        t0 = _time.time_ns()
+        n = _meta_backup_traverse(args.filer, args.filerPath, store)
+        since = t0 - 1
+        store.kv_put(OFFSET_KEY, str(since).encode())
+        print(f"filer.meta.backup: full sync copied {n} entr(ies); "
+              f"tailing from there")
+    applied = 0
+    dirty = 0
+    try:
+        while True:
+            url = (f"{_tls_scheme()}://{args.filer}/__meta__/subscribe?"
+                   + urllib.parse.urlencode({"since": str(since),
+                                             "prefix": args.filerPath,
+                                             "live": "true"}))
+            try:
+                with urllib.request.urlopen(url, timeout=3600) as r:
+                    for raw in r:
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        old, new = ev.get("old_entry"), ev.get("new_entry")
+                        if new is not None:
+                            store.insert_entry(Entry.from_dict(new))
+                            if old is not None and \
+                                    old.get("full_path") != \
+                                    new.get("full_path"):
+                                try:
+                                    store.delete_entry(old["full_path"])
+                                except NotFound:
+                                    pass
+                        elif old is not None:
+                            try:
+                                store.delete_entry(old["full_path"])
+                            except NotFound:
+                                pass
+                        applied += 1
+                        dirty += 1
+                        since = max(since, ev.get("ts_ns", since))
+                        if dirty >= CHECKPOINT_EVERY:
+                            store.kv_put(OFFSET_KEY, str(since).encode())
+                            dirty = 0
+            except OSError as e:
+                import time as _time
+                print(f"filer.meta.backup: subscribe to {args.filer} "
+                      f"failed ({e}), retrying in 2s", file=sys.stderr)
+                _time.sleep(2)
+    except KeyboardInterrupt:
+        print(f"filer.meta.backup: {applied} event(s) applied, "
+              f"offset {since}")
+    finally:
+        store.kv_put(OFFSET_KEY, str(since).encode())
+        if hasattr(store, "shutdown"):
+            store.shutdown()
+    return 0
+
+
+def _meta_backup_traverse(filer: str, prefix: str, store) -> int:
+    """Recursive listing walk copying every entry's metadata (incl. chunk
+    refs) into the backup store."""
+    import urllib.parse
+    import urllib.request
+
+    from seaweedfs_tpu.filer.entry import Entry
+
+    n = 0
+    stack = [prefix.rstrip("/") or "/"]
+    while stack:
+        d = stack.pop()
+        url = (f"{_tls_scheme()}://{filer}"
+               f"{urllib.parse.quote(d.rstrip('/') + '/')}?limit=100000")
+        try:
+            with urllib.request.urlopen(url, timeout=120) as r:
+                listing = json.loads(r.read())
+        except OSError:
+            continue
+        for e in listing.get("Entries") or []:
+            full = e["FullPath"]
+            try:
+                with urllib.request.urlopen(
+                        f"{_tls_scheme()}://{filer}"
+                        f"{urllib.parse.quote(full)}?metadata=true",
+                        timeout=120) as r:
+                    meta = json.loads(r.read())
+            except OSError:
+                continue
+            store.insert_entry(Entry.from_dict(meta))
+            n += 1
+            if e.get("IsDirectory"):
+                stack.append(full)
+    return n
 
 
 def _run_filer_meta_tail(args) -> int:
