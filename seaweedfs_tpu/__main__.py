@@ -359,17 +359,6 @@ def main(argv=None) -> int:
     weedlog.setup(args.v, args.logFile)
     grace.setup_stack_dumps()
     grace.setup_jax_profile(getattr(args, "jaxProfile", None))
-    # client-side PRINT commands behave like unix tools when piped into
-    # head/grep: die on SIGPIPE instead of tracebacking mid-print.  Never
-    # for servers — with SIG_DFL a peer closing a socket mid-write would
-    # kill the whole process instead of raising a per-connection error.
-    if args.cmd in ("version", "autocomplete", "scaffold", "filer.cat",
-                    "filer.meta.tail", "export", "download"):
-        try:
-            import signal as _signal
-            _signal.signal(_signal.SIGPIPE, _signal.SIG_DFL)
-        except (ImportError, ValueError, OSError, AttributeError):
-            pass
     # every subcommand — servers AND client-side tools (backup, upload,
     # shell, mount, filer.sync, mq.broker ...) — loads security.toml here so
     # JWT keys and process-wide TLS (security/tls.py) are live before any
@@ -1350,5 +1339,22 @@ def _run_scaffold(args) -> int:
     return 0
 
 
+def cli() -> int:
+    """Process entry point (console script + python -m): exits quietly
+    when piped into head/grep that closed early — handled HERE, not by
+    flipping the process-global SIGPIPE disposition, which would leak
+    into in-process library callers (and kill servers on client
+    disconnects)."""
+    try:
+        return main()
+    except BrokenPipeError:
+        import os
+        try:  # silence the interpreter-shutdown flush of the dead pipe
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        except OSError:
+            pass
+        return 141  # what the shell reports for SIGPIPE deaths
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(cli())

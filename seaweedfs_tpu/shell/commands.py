@@ -2017,9 +2017,12 @@ def cmd_fs_merge_volumes(env: CommandEnv, args, out):
                                            f"volume {src_vid}")
                     # the point is moving OFF the source volume: retry
                     # assign past it, growing fresh volumes if the source
-                    # is the only writable one
+                    # is the only writable one (a grown volume becomes
+                    # assignable only after it registers — wait that
+                    # window out instead of burning the retries)
+                    import time as _time
                     a = None
-                    for attempt in range(8):
+                    for attempt in range(20):
                         cand = client.assign(
                             collection=flags.get("collection", ""))
                         if int(cand["fid"].split(",")[0]) != src_vid:
@@ -2029,6 +2032,8 @@ def cmd_fs_merge_volumes(env: CommandEnv, args, out):
                             env.master_post(
                                 "/vol/grow", count="1",
                                 collection=flags.get("collection", ""))
+                        if attempt >= 3:
+                            _time.sleep(0.2)
                     if a is None:
                         raise RuntimeError(
                             f"could not assign a target volume != "
