@@ -22,6 +22,11 @@ Extra metrics (all in the `extra` field of the one JSON line):
                                 (the degraded-read hot loop, store_ec.go:339-393)
   ec_encode_rs10_4_mesh         the column-parallel mesh codec on a 1-chip
                                 mesh: shard_map overhead vs the plain kernel
+  ec_encode_batch4_place        4 volumes batched through encode_batch_place
+                                (BASELINE's multi-volume + all-to-all shard
+                                placement config) — DEGENERATE single-chip
+                                placement here; the 8-way sharded shape runs
+                                in dryrun_multichip
   ec_encode_e2e_host_1g         file -> 14 shard files through write_ec_files
                                 on the host codec at 1GiB (the primary e2e
                                 number; GFNI+AVX512 when the host has it,
@@ -184,16 +189,19 @@ def _chained(body_fn):
     return loop
 
 
-def _bench_chained(body_fn, data, on_tpu: bool, noop_rows: int,
-                   iters: int = 20) -> float:
-    """GB/s of `data` processed per body_fn application, net of a same-shape
-    data-movement-only loop. `iters` must put the differenced loop time well
-    above the ~70ms tunnel sync noise."""
+def _bench_chained(body_fn, data, on_tpu: bool, noop_rows: int = 0,
+                   iters: int = 20, baseline_fn=None) -> float:
+    """GB/s of `data` (all elements) processed per body_fn application,
+    net of a same-shape data-movement-only loop (default: roll+xor on the
+    leading axis; pass `baseline_fn` for other shapes). `iters` must put
+    the differenced loop time well above the ~70ms tunnel sync noise."""
     import jax.numpy as jnp
     enc_loop = _chained(body_fn)
-    base_loop = _chained(
-        lambda x: jnp.concatenate(
-            [x[noop_rows:], x[:noop_rows] ^ jnp.uint8(1)], axis=0))
+    if baseline_fn is None:
+        def baseline_fn(x):
+            return jnp.concatenate(
+                [x[noop_rows:], x[:noop_rows] ^ jnp.uint8(1)], axis=0)
+    base_loop = _chained(baseline_fn)
     lo, hi = (2, 2 + iters) if on_tpu else (1, 5)
     best = float("inf")
     for _ in range(3):
@@ -204,7 +212,7 @@ def _bench_chained(body_fn, data, on_tpu: bool, noop_rows: int,
             best = min(best, net)
     if not np.isfinite(best):
         return 0.0
-    return data.shape[0] * data.shape[1] / 1e9 / best
+    return data.size / 1e9 / best
 
 
 def _device_codec(k: int, m: int, on_tpu: bool):
@@ -234,6 +242,32 @@ def _mesh_codec_factory(k: int, m: int, on_tpu: bool):
     from seaweedfs_tpu.models import rs
     from seaweedfs_tpu.parallel import mesh as pmesh
     return pmesh.ShardedRSEncoder(rs.get_code(k, m), pmesh.make_mesh())
+
+
+def _bench_batch_place(k: int, m: int, vols: int, n: int, on_tpu: bool,
+                       iters: int = 20) -> float:
+    """Multi-volume batched encode + all-to-all shard placement
+    (BASELINE.json's batched config; parallel/mesh.py encode_batch_place).
+    Degenerate single-chip placement on this harness — the 8-way sharded
+    shape runs in __graft_entry__.dryrun_multichip — so the number is the
+    batched-volumes kernel path's throughput in volume bytes."""
+    import jax.numpy as jnp
+    from seaweedfs_tpu.models import rs
+    from seaweedfs_tpu.parallel import mesh as pmesh
+    mesh = pmesh.make_mesh(axis_names=("vol", "data"), shape=(1, 1))
+    enc = pmesh.ShardedRSEncoder(rs.get_code(k, m), mesh,
+                                 col_axis="data", vol_axis="vol")
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (vols, k, n), dtype=np.uint8))
+
+    def body(x):
+        placed = enc.encode_batch_place(x)
+        return jnp.concatenate([x[:, m:, :], placed[:, k:k + m, :]], axis=1)
+
+    return _bench_chained(
+        body, data, on_tpu, iters=iters,
+        baseline_fn=lambda x: jnp.concatenate(
+            [x[:, m:, :], x[:, :m, :] ^ jnp.uint8(1)], axis=1))
 
 
 def _bench_rebuild_kernel(k: int, m: int, lost: int, n: int,
@@ -479,6 +513,8 @@ def main() -> None:
     _try(extra, "ec_encode_rs10_4_mesh",
          _bench_encode_kernel, 10, 4, _n_for(10), on_tpu, 60,
          _mesh_codec_factory)
+    _try(extra, "ec_encode_batch4_place",
+         _bench_batch_place, 10, 4, 4, _n_for(10) // 4, on_tpu, 60)
 
     # xprof trace of one warm encode batch (WEEDTPU_JAX_PROFILE=dir):
     # proves the kernel timeline the way the reference's pprof profiles do
