@@ -42,6 +42,11 @@ Extra metrics (all in the `extra` field of the one JSON line):
                                 dominated by the tunnel's ~MB/s d2h, tagged
                                 ec_encode_e2e_tunnel_bound; not a system
                                 property
+  blob_write_rps/blob_read_rps  the reference's own headline benchmark shape
+                                (1KB files, c=16, weed benchmark README
+                                numbers) on an in-process cluster — this
+                                harness has ONE shared core vs the published
+                                MacBook i7 figures
   baseline_avx2_refshape        the measured baseline itself (forced to the
                                 AVX2 path: the baseline is klauspost AVX2)
   baseline_avx2_kernel          pure-buffer AVX2 kernel GB/s
@@ -588,6 +593,83 @@ def _bench_e2e_host(extra: dict) -> None:
          320 * 1024 * 1024, detail)
     if detail:
         extra["ec_rebuild_e2e_host_detail"] = detail
+    try:
+        _bench_blob_rps(extra)
+    except Exception as e:  # cluster spin-up is best-effort in a bench
+        print(f"bench: blob rps failed: {e}", file=sys.stderr)
+
+
+def _bench_blob_rps(extra: dict, n: int = 2000, size: int = 1024,
+                    concurrency: int = 16) -> None:
+    """The reference's own headline benchmark shape (weed benchmark /
+    README.md:539-583: concurrent 1KB writes then random reads) against an
+    in-process master+volume cluster — blob_write_rps / blob_read_rps land
+    in `extra` for comparison with BASELINE.md's published req/s."""
+    import asyncio
+    import concurrent.futures
+    import threading
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    with tempfile.TemporaryDirectory(prefix="weedtpu-rps-") as d:
+        master = MasterServer("127.0.0.1", free_port())
+        vs = VolumeServer([d], master.url, port=free_port(),
+                          heartbeat_interval=0.2)
+        started = []
+        try:
+            run(master.start())
+            started.append(master)
+            run(vs.start())  # sends its first heartbeat synchronously
+            started.append(vs)
+            deadline = time.time() + 10
+            while time.time() < deadline and not master.topo.nodes:
+                time.sleep(0.05)
+            client = WeedClient(master.url)
+            payload = bytes(range(256)) * (size // 256 + 1)
+            payload = payload[:size]
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(concurrency) as ex:
+                fids = list(ex.map(
+                    lambda i: client.upload(payload, name=f"b{i}"),
+                    range(n)))
+            extra["blob_write_rps"] = round(
+                n / (time.perf_counter() - t0), 1)
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(concurrency) as ex:
+                for data in ex.map(client.download, fids):
+                    assert len(data) == size
+            extra["blob_read_rps"] = round(
+                n / (time.perf_counter() - t0), 1)
+            client.close()
+        finally:
+            # each cleanup step isolated: a stop failure must not leak
+            # the other server or the loop thread
+            if vs in started:
+                run_quiet(vs.stop())
+            if master in started:
+                run_quiet(master.stop())
+            loop.call_soon_threadsafe(loop.stop)
 
 
 def _bench_e2e_ceiling(size: int, batch: int, reps: int = 4) -> float:
