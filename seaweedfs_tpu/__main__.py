@@ -1031,7 +1031,10 @@ def _run_filer_meta_backup(args) -> int:
         since = t0 - 1
         store.kv_put(OFFSET_KEY, str(since).encode())
         print(f"filer.meta.backup: full sync copied {n} entr(ies); "
-              f"tailing from there")
+              f"tailing from there", flush=True)
+    else:
+        print(f"filer.meta.backup: resuming at offset {since}; tailing",
+              flush=True)
     applied = 0
     dirty = 0
     try:

@@ -586,10 +586,18 @@ def test_filer_meta_backup_resume(two_filers, tmp_path):
         p = subprocess.Popen(
             [sys.executable, "-m", "seaweedfs_tpu", "filer.meta.backup",
              "-filer", fa.url, "-store", f"sqlite:{db}"],
-            cwd=repo, env=env)
+            cwd=repo, env=env, stdout=subprocess.PIPE)
+        # wait for the child to report its full sync BEFORE signalling:
+        # under load the interpreter+jax start can exceed any fixed sleep
+        line = p.stdout.readline()  # blocks until the child is tailing
+        assert b"tailing" in line, f"no readiness marker: {line!r}"
         time.sleep(seconds)
         p.send_signal(2)  # SIGINT: flush + exit
-        p.wait(timeout=20)
+        try:
+            p.wait(timeout=40)
+        finally:
+            if p.poll() is None:
+                p.kill()
 
     run_backup(3.0)
     from seaweedfs_tpu.filer.abstract_sql import SqliteStore
