@@ -13,6 +13,7 @@ import time
 import urllib.parse
 import urllib.request
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.utils.http import PooledHTTP
 
 
 class WeedClient:
@@ -38,6 +39,12 @@ class WeedClient:
         self.jwt_read_signer = jwt_read_signer
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self.vid_cache_ttl = 10.0
+        # keep-alive pool: every blob op reuses a warm connection to its
+        # volume server instead of paying a TCP (and TLS) handshake per
+        # request — the reference client rides Go's default Transport
+        # reuse, and `weed benchmark`-shape workloads are handshake-bound
+        # without it
+        self._http = PooledHTTP(timeout=timeout)
         self._stream_live = False
         self._stream_stop = None
         if stream_updates:
@@ -50,6 +57,7 @@ class WeedClient:
     def close(self) -> None:
         if self._stream_stop is not None:
             self._stream_stop.set()
+        self._http.close()
 
     # pushed entries outlive the poll TTL but NOT forever: if the feed
     # goes silently stale (e.g. the master was demoted but its process
@@ -102,40 +110,45 @@ class WeedClient:
     # -- raw http ------------------------------------------------------
 
     def _master_json(self, path: str) -> dict:
-        """GET a master endpoint, following 409 leader hints and rotating
-        through the HA list on dead masters."""
+        """GET a master endpoint over the keep-alive pool, following 409
+        leader hints and rotating through the HA list on dead masters."""
+        import http.client as _hc
         last: Exception | None = None
         for attempt in range(2 * max(1, len(self.masters))):
             try:
-                with urllib.request.urlopen(
-                        f"{_tls_scheme()}://{self.master}{path}",
-                        timeout=self.timeout) as r:
-                    return json.load(r)
-            except urllib.error.HTTPError as e:
-                if e.code == 409:
-                    try:
-                        body = json.loads(e.read())
-                        leader = body.get("leader") \
-                            if isinstance(body, dict) else None
-                    except ValueError:
-                        leader = None
-                    if leader and leader != self.master:
-                        self.master = leader
-                        continue
-                raise
-            except OSError as e:
+                status, _, body = self._http.request(
+                    f"{_tls_scheme()}://{self.master}{path}",
+                    timeout=self.timeout)
+            except (_hc.HTTPException, OSError) as e:
                 last = e
                 if len(self.masters) > 1:
                     i = self.masters.index(self.master) \
                         if self.master in self.masters else -1
                     self.master = self.masters[(i + 1) % len(self.masters)]
-                else:
-                    break
+                    continue
+                break
+            if status == 409:
+                try:
+                    parsed = json.loads(body)
+                    leader = parsed.get("leader") \
+                        if isinstance(parsed, dict) else None
+                except ValueError:
+                    leader = None
+                if leader and leader != self.master:
+                    self.master = leader
+                    continue
+                raise RuntimeError(f"master {path}: HTTP 409 (not leader)")
+            if status >= 300:
+                raise RuntimeError(f"master {path}: HTTP {status}")
+            return json.loads(body)
         raise RuntimeError(f"no reachable master in {self.masters}: {last}")
 
     def _get_json(self, url: str) -> dict:
-        with urllib.request.urlopen(f"{_tls_scheme()}://{url}", timeout=self.timeout) as r:
-            return json.load(r)
+        status, _, body = self._http.request(f"{_tls_scheme()}://{url}",
+                                             timeout=self.timeout)
+        if status >= 300:
+            raise RuntimeError(f"GET {url}: HTTP {status}")
+        return json.loads(body)
 
     # -- master ops ----------------------------------------------------
 
@@ -185,13 +198,14 @@ class WeedClient:
         headers.update(self._auth_headers(fid, jwt))
         if name:
             headers["X-File-Name"] = name
-        req = urllib.request.Request(
-            f"{_tls_scheme()}://{url}/{fid}", data=data, method="PUT", headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            if r.status >= 300:
-                raise RuntimeError(f"upload {fid} to {url}: HTTP {r.status}")
+        status, _, _ = self._http.request(
+            f"{_tls_scheme()}://{url}/{fid}", method="PUT", body=data,
+            headers=headers, timeout=self.timeout)
+        if status >= 300:
+            raise RuntimeError(f"upload {fid} to {url}: HTTP {status}")
 
     def download(self, fid: str) -> bytes:
+        import http.client as _hc
         vid = int(fid.partition(",")[0])
         headers = {}
         if self.jwt_read_signer:
@@ -199,22 +213,27 @@ class WeedClient:
         last_err: Exception | None = None
         for url in self.lookup(vid):
             try:
-                req = urllib.request.Request(f"{_tls_scheme()}://{url}/{fid}",
-                                             headers=headers)
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return r.read()
-            except OSError as e:
+                status, _, body = self._http.request(
+                    f"{_tls_scheme()}://{url}/{fid}", headers=headers,
+                    timeout=self.timeout)
+            except (_hc.HTTPException, OSError) as e:
                 last_err = e
+                continue
+            if status < 300:
+                return body
+            last_err = RuntimeError(f"{url}/{fid}: HTTP {status}")
         raise RuntimeError(f"download {fid} failed: {last_err or 'no locations'}")
 
     def delete(self, fid: str) -> None:
+        import http.client as _hc
         vid = int(fid.partition(",")[0])
         for url in self.lookup(vid):
-            req = urllib.request.Request(f"{_tls_scheme()}://{url}/{fid}", method="DELETE",
-                                         headers=self._auth_headers(fid))
             try:
-                urllib.request.urlopen(req, timeout=self.timeout).close()
-                return
-            except OSError:
+                status, _, _ = self._http.request(
+                    f"{_tls_scheme()}://{url}/{fid}", method="DELETE",
+                    headers=self._auth_headers(fid), timeout=self.timeout)
+            except (_hc.HTTPException, OSError):
                 continue
+            if status < 300:
+                return
         raise RuntimeError(f"delete {fid} failed")

@@ -55,10 +55,16 @@ class MemoryQueue(MessageQueue):
     def __init__(self, maxlen: int = 65536):
         self.messages: deque = deque(maxlen=maxlen)
         self.sent = 0  # total ever sent: lets consumers detect eviction
+        # keeps (messages, sent) consistent for consumers that snapshot
+        # both (replicate_daemon.MemorySource): append + increment is not
+        # atomic, and a consumer catching the gap mis-offsets every event
+        # after an eviction
+        self.lock = threading.Lock()
 
     def send(self, key: str, message: dict) -> None:
-        self.messages.append((key, message))
-        self.sent += 1
+        with self.lock:
+            self.messages.append((key, message))
+            self.sent += 1
 
 
 class WebhookQueue(MessageQueue):

@@ -1,0 +1,57 @@
+"""Backend dispatch seam between the EC data path and the codec registry.
+
+The storage layer (storage/ec/ec_files.py) needs exactly three
+capabilities from whatever codec `_get_codec` hands it: dispatch a parity
+encode, materialise the result on the host, and reconstruct missing rows.
+The backends differ in a way that matters to the I/O engine — host codecs
+(native C++ / numpy) compute eagerly and return numpy, while JAX device
+codecs dispatch asynchronously and return an un-materialised device array
+whose d2h transfer is the sync point.  Centralising the isinstance
+fan-out here keeps the storage layer free of backend imports and gives
+the overlapped pipeline one seam to time the sync point through.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _host_classes():
+    from seaweedfs_tpu.models.rs import RSCode
+    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
+    return NativeRSCodec, RSCode
+
+
+def dispatch_parity(codec, batch: np.ndarray):
+    """Dispatch [k, B] -> [m, B] parity. JAX backends return the device
+    array WITHOUT materialising it; host backends compute eagerly."""
+    NativeRSCodec, RSCode = _host_classes()
+    if isinstance(codec, NativeRSCodec):
+        return codec.encode_parity(batch)
+    if isinstance(codec, RSCode):
+        return codec.encode_numpy(batch)[codec.k:]
+    import jax.numpy as jnp
+    return codec.encode_parity(jnp.asarray(batch))
+
+
+def materialize(parity) -> np.ndarray:
+    """Sync point of an async dispatch: host backends already returned
+    numpy; device arrays transfer d2h here."""
+    if isinstance(parity, np.ndarray):
+        return parity
+    return np.asarray(parity)
+
+
+def reconstruct_batch(codec, shards: dict[int, np.ndarray],
+                      wanted: list[int]) -> dict[int, np.ndarray]:
+    """Rebuild `wanted` shard rows from >=k survivor rows (host bytes
+    in/out)."""
+    NativeRSCodec, RSCode = _host_classes()
+    if isinstance(codec, NativeRSCodec):
+        return codec.reconstruct(shards, wanted=wanted)
+    if isinstance(codec, RSCode):
+        return codec.reconstruct_numpy(shards, wanted=wanted)
+    import jax.numpy as jnp
+    out = codec.reconstruct({i: jnp.asarray(v) for i, v in shards.items()},
+                            wanted=wanted)
+    return {i: np.asarray(v) for i, v in out.items()}

@@ -94,7 +94,9 @@ def _gf_apply(bitmat: jax.Array, data: jax.Array, k: int, m: int, kpad: int,
         ],
         out_specs=pl.BlockSpec((m, tile), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.uint8),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(bitmat, data)
 

@@ -93,10 +93,23 @@ class MemorySource(NotificationSource):
 
     def receive(self, since: int, stop: threading.Event):
         seen = since
+        import contextlib
+        # snapshot (sent, messages) under the queue's lock when it has
+        # one: this used to snapshot the deque BEFORE reading sent, so a
+        # send() racing between the two reads inflated `first` and an
+        # event was skipped (or yielded under the wrong offset) without
+        # any eviction having occurred.  The sent-before-snapshot order
+        # alone is not enough either — append and the sent increment are
+        # two bytecodes, and catching the gap after an eviction
+        # mis-offsets msgs[0].
+        lock = getattr(self.queue, "lock", None) or contextlib.nullcontext()
         while not stop.is_set():
-            msgs = list(self.queue.messages)
-            total = getattr(self.queue, "sent", len(msgs))
-            first = total - len(msgs)  # absolute index of msgs[0]
+            with lock:
+                total = getattr(self.queue, "sent", None)
+                msgs = list(self.queue.messages)
+            if total is None:
+                total = len(msgs)
+            first = max(0, total - len(msgs))  # absolute index of msgs[0]
             if seen < first:
                 log.warning("memory queue evicted %d unread events",
                             first - seen)

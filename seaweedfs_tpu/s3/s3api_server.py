@@ -159,12 +159,21 @@ class S3ApiServer:
                 except ValueError:
                     log.warning("malformed circuit breaker config ignored")
                 else:
-                    self.breaker.global_max_requests = int(
-                        cfg.get("global_max_requests", 0))
-                    self.breaker.global_max_upload_bytes = int(
-                        cfg.get("global_max_upload_bytes", 0))
-                    self.breaker.bucket_max_requests = int(
-                        cfg.get("bucket_max_requests", 0))
+                    # per-field coercion: one non-numeric value (e.g.
+                    # "global_max_requests": "abc") is logged and skipped
+                    # instead of crashing the watch iteration that also
+                    # performs identity hot-reload
+                    for field in ("global_max_requests",
+                                  "global_max_upload_bytes",
+                                  "bucket_max_requests"):
+                        try:
+                            setattr(self.breaker, field,
+                                    int(cfg.get(field, 0)))
+                        except (ValueError, TypeError):
+                            log.warning(
+                                "circuit breaker config %s=%r is not a "
+                                "number; keeping previous value",
+                                field, cfg.get(field))
                     log.info("loaded circuit breaker config: %s", cfg)
 
         while True:

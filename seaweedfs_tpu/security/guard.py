@@ -84,7 +84,10 @@ class SecurityConfig:
         ]
         for cand in candidates:
             if cand and os.path.exists(cand):
-                import tomllib
+                try:
+                    import tomllib
+                except ImportError:  # Python < 3.11
+                    import tomli as tomllib
                 with open(cand, "rb") as f:
                     data = tomllib.load(f)
                 break

@@ -430,9 +430,20 @@ class MasterServer:
             from seaweedfs_tpu import pb
             if not pb.available():
                 return web.Response(status=415)
-            beat = pb.heartbeat_from_bytes(await req.read())
+            try:
+                beat = pb.heartbeat_from_bytes(await req.read())
+            except Exception as e:
+                # a corrupt frame must not 500: senders only latch the
+                # JSON fallback on 415, so a persistent DecodeError would
+                # otherwise fail every heartbeat from that sender
+                return web.json_response(
+                    {"error": f"bad protobuf heartbeat: {e}"}, status=400)
         else:
-            beat = await req.json()
+            try:
+                beat = await req.json()
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad json heartbeat"}, status=400)
         if beat.get("max_file_key"):
             self.topo.sequencer.set_max(int(beat["max_file_key"]))
         self.topo.register_heartbeat(

@@ -104,10 +104,15 @@ class VolumeServer:
         self._hb_task: asyncio.Task | None = None
         self._wire_pb: bool | None = None  # protobuf heartbeat framing
         # vid -> (expiry, shard location map) for degraded-read fan-out;
-        # accessed from shard_reader worker threads, hence the lock
+        # accessed from shard_reader worker threads, hence the locks.
+        # The master fetch itself runs under a PER-VID lock so a stalled
+        # lookup for one volume can't serialize degraded reads (or even
+        # cache hits) on every other volume behind a 10s master timeout;
+        # _ec_loc_lock only guards the cache/lock-table dicts.
         self._ec_loc_cache: dict[int, tuple[float, dict]] = {}
         import threading as _threading
         self._ec_loc_lock = _threading.Lock()
+        self._ec_loc_vid_locks: dict[int, _threading.Lock] = {}
 
     # -- lifecycle -----------------------------------------------------
 
@@ -313,7 +318,10 @@ class VolumeServer:
                          data: bytes | None, name: bytes = b"",
                          mime: bytes = b"") -> str | None:
         """Synchronous fan-out to the other replica locations
-        (reference: weed/topology/store_replicate.go:24-135)."""
+        (reference: weed/topology/store_replicate.go:24-135).  All peers
+        are written CONCURRENTLY — the caller still waits for every ack
+        (same strict semantics), but the added latency is one peer
+        round-trip, not the sum of them."""
         vol = self.store.get_volume(fid.volume_id)
         if vol is None or vol.super_block.replica_placement.copy_count <= 1:
             return None
@@ -325,6 +333,8 @@ class VolumeServer:
         except aiohttp.ClientError as e:
             return f"replica lookup failed: {e}"
         peers = [l["url"] for l in locations if l["url"] != self.url]
+        if not peers:
+            return None
         headers = {}
         if self.security is not None and self.security.volume_write:
             headers["Authorization"] = "Bearer " + sjwt.gen_jwt(
@@ -333,7 +343,8 @@ class VolumeServer:
             headers["Content-Type"] = mime.decode(errors="replace")
         if name:
             headers["X-File-Name"] = name.decode(errors="replace")
-        for peer in peers:
+
+        async def one(peer: str) -> str | None:
             url = f"{_tls_scheme()}://{peer}/{fid}?type=replicate"
             try:
                 if method == "PUT":
@@ -342,11 +353,24 @@ class VolumeServer:
                         if r.status >= 300:
                             return f"replica write to {peer}: {r.status}"
                 else:
-                    async with self._session.delete(url, headers=headers) as r:
+                    async with self._session.delete(url,
+                                                    headers=headers) as r:
                         if r.status >= 300:
                             return f"replica delete to {peer}: {r.status}"
-            except aiohttp.ClientError as e:
-                return f"replica {method} to {peer} failed: {e}"
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                return f"replica {method} to {peer} failed: {e!r}"
+            return None
+
+        # return_exceptions so one unexpected failure cannot abandon the
+        # sibling writes as detached tasks that land AFTER the error is
+        # reported — every peer's outcome is awaited and folded in
+        results = await asyncio.gather(*(one(p) for p in peers),
+                                       return_exceptions=True)
+        for err in results:
+            if isinstance(err, BaseException):
+                return f"replica {method} failed: {err!r}"
+            if err:
+                return err
         return None
 
     PAGED_READ_MIN = 256 * 1024  # Range on bigger needles skips full load
@@ -469,31 +493,64 @@ class VolumeServer:
                 return web.json_response({"error": err}, status=500)
         return web.json_response({"size": size})
 
+    def _ec_loc_vid_lock(self, vid: int):
+        """Per-vid fetch lock, created on first use.  The table is pruned
+        alongside the cache; a pruned-then-recreated lock merely allows
+        two concurrent fetches for the same vid, resolved by the
+        double-checked cache insert."""
+        with self._ec_loc_lock:
+            lk = self._ec_loc_vid_locks.get(vid)
+            if lk is None:
+                import threading as _threading
+                lk = self._ec_loc_vid_locks[vid] = _threading.Lock()
+            return lk
+
     def _ec_shard_locations(self, vid: int) -> dict:
         """Master shard-location lookup with a short TTL cache (reference:
         store_ec.go cachedLookupEcShardLocations and its TTL tiers) — a
         degraded read fans out to many shards and must not re-query the
-        master once per shard.  The lock covers the fetch too, so a cold
-        parallel fan-out issues ONE lookup, not one per worker thread;
-        empty results get a much shorter TTL (the reference's empty-list
-        tier) so a transient bad answer can't blank a volume for 10s."""
+        master once per shard.  The fetch runs under a per-vid lock, so a
+        cold parallel fan-out issues ONE lookup per volume while lookups
+        (and cache hits) for OTHER volumes proceed concurrently; empty
+        results get a much shorter TTL (the reference's empty-list tier)
+        so a transient bad answer can't blank a volume for 10s."""
         import urllib.request
         import json as _json
-        with self._ec_loc_lock:
+        with self._ec_loc_vid_lock(vid):
             now = time.monotonic()
-            cached = self._ec_loc_cache.get(vid)
+            with self._ec_loc_lock:
+                cached = self._ec_loc_cache.get(vid)
             if cached and cached[0] > now:
                 return cached[1]
-            with urllib.request.urlopen(
-                    f"{_tls_scheme()}://{self.master_url}"
-                    f"/dir/ec/lookup?volumeId={vid}",
-                    timeout=10) as r:
-                shards = _json.load(r).get("shards", {})
+            try:
+                with urllib.request.urlopen(
+                        f"{_tls_scheme()}://{self.master_url}"
+                        f"/dir/ec/lookup?volumeId={vid}",
+                        timeout=10) as r:
+                    shards = _json.load(r).get("shards", {})
+            except Exception:
+                # record a short-TTL negative entry before re-raising:
+                # without a cache entry the vid's lock-table slot is never
+                # eligible for eviction, and vid is client-controlled —
+                # probing many vids against a dead master would grow
+                # _ec_loc_vid_locks without bound
+                with self._ec_loc_lock:
+                    self._ec_loc_cache.setdefault(vid, (now + 1.0, {}))
+                    self._ec_loc_evict_locked()
+                raise
             ttl = 10.0 if shards else 1.0
-            self._ec_loc_cache[vid] = (now + ttl, shards)
-            while len(self._ec_loc_cache) > 256:
-                self._ec_loc_cache.pop(next(iter(self._ec_loc_cache)))
+            with self._ec_loc_lock:
+                self._ec_loc_cache[vid] = (now + ttl, shards)
+                self._ec_loc_evict_locked()
             return shards
+
+    def _ec_loc_evict_locked(self) -> None:
+        """Bound the location cache AND its lock table (insertion order ==
+        eviction order).  Caller holds _ec_loc_lock."""
+        while len(self._ec_loc_cache) > 256:
+            evicted = next(iter(self._ec_loc_cache))
+            self._ec_loc_cache.pop(evicted)
+            self._ec_loc_vid_locks.pop(evicted, None)
 
     def _shard_reader(self, vid: int):
         """Remote-shard fetch for EC degraded reads: ask the master where
@@ -695,9 +752,14 @@ class VolumeServer:
         if self._ec_jobs.get(vid, {}).get("state") == "running":
             return web.json_response({"error": "encode already running"},
                                      status=409)
+        # `stages` is written in-place by the encode pipeline (per-stage
+        # seconds, mode, overlap_frac), so /admin/ec/progress shows WHERE
+        # a long encode is spending its time, not just how far it is
+        stages: dict = {}
         job = {"state": "running", "kind": "encode", "bytes_done": 0,
                "total": os.path.getsize(base + ".dat"),
-               "cancel": False, "error": None, "started": time.time()}
+               "cancel": False, "error": None, "started": time.time(),
+               "stages": stages}
         self._ec_jobs[vid] = job
 
         def gen():
@@ -705,7 +767,8 @@ class VolumeServer:
             ec_files.write_ec_files(
                 base,
                 progress=lambda n: job.__setitem__("bytes_done", n),
-                cancel=lambda: job["cancel"])
+                cancel=lambda: job["cancel"],
+                stats=stages)
             ec_files.write_sorted_ecx(base + ".idx")
             metrics.EC_ENCODE_BYTES.labels("tpu").inc(job["total"])
 
@@ -732,7 +795,13 @@ class VolumeServer:
         job = self._ec_jobs.get(vid)
         if job is None:
             return web.json_response({"error": "no encode job"}, status=404)
-        return web.json_response({k: v for k, v in job.items()})
+        # dict() is a single C-level copy (atomic under the GIL); the
+        # worker thread inserts keys into job AND its nested stages dict
+        # while we serialize, and json.dumps iterating the live dict
+        # would raise "dictionary changed size during iteration"
+        snap = {k: dict(v) if isinstance(v, dict) else v
+                for k, v in dict(job).items()}
+        return web.json_response(snap)
 
     async def handle_ec_cancel(self, req: web.Request) -> web.Response:
         body = await req.json()
@@ -761,15 +830,17 @@ class VolumeServer:
                    if os.path.exists(base + layout.to_ext(i))]
         total = (os.path.getsize(base + layout.to_ext(present[0]))
                  * layout.DATA_SHARDS) if present else 0
+        stages: dict = {}
         job = {"state": "running", "kind": "rebuild", "bytes_done": 0,
                "total": total, "cancel": False, "error": None,
-               "started": time.time()}
+               "started": time.time(), "stages": stages}
         self._ec_jobs[vid] = job
         try:
             rebuilt = await asyncio.to_thread(
                 ec_files.rebuild_ec_files, base,
                 progress=lambda n: job.__setitem__("bytes_done", n),
-                cancel=lambda: job["cancel"])
+                cancel=lambda: job["cancel"],
+                stats=stages)
         except ec_files.EncodeCancelled:
             job["state"] = "cancelled"
             return web.json_response({"error": "cancelled"}, status=409)

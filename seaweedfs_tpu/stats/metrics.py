@@ -157,10 +157,12 @@ class Histogram(_Metric):
             cum = 0
             for b, c in zip(child.buckets, child.counts):
                 cum += c
+                le = f'le="{b}"'
                 out.append(f"{self.name}_bucket"
-                           f"{_fmt_labels(labels, f'le=\"{b}\"')} {cum}")
+                           f"{_fmt_labels(labels, le)} {cum}")
+            inf = 'le="+Inf"'
             out.append(f"{self.name}_bucket"
-                       f"{_fmt_labels(labels, 'le=\"+Inf\"')} {child.count}")
+                       f"{_fmt_labels(labels, inf)} {child.count}")
             out.append(f"{self.name}_sum{_fmt_labels(labels)} {child.total}")
             out.append(f"{self.name}_count{_fmt_labels(labels)} {child.count}")
         return out

@@ -78,22 +78,66 @@ def _get_codec(kind: str | None = None):
     return gfmat_jax.get_codec(k, m)
 
 
-def _reconstruct_batch(codec, shards: dict[int, np.ndarray],
-                       wanted: list[int]) -> dict[int, np.ndarray]:
-    """Rebuild `wanted` shard rows from >=k survivor rows (host bytes in/out)."""
-    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
-    from seaweedfs_tpu.models.rs import RSCode
-    if isinstance(codec, NativeRSCodec):
-        return codec.reconstruct(shards, wanted=wanted)
-    if isinstance(codec, RSCode):
-        return codec.reconstruct_numpy(shards, wanted=wanted)
-    import jax.numpy as jnp
-    out = codec.reconstruct({i: jnp.asarray(v) for i, v in shards.items()},
-                            wanted=wanted)
-    return {i: np.asarray(v) for i, v in out.items()}
+# backend seam (ops/dispatch.py): parity dispatch, the d2h sync point,
+# and reconstruction, without backend imports in this layer
+from seaweedfs_tpu.ops.dispatch import (  # noqa: E402
+    dispatch_parity as _dispatch_parity,
+    materialize as _materialize,
+    reconstruct_batch as _reconstruct_batch,
+)
+
+# batch buffers in flight: read N+1 / encode N / drain N-1
+PIPELINE_DEPTH = int(os.environ.get("WEEDTPU_EC_PIPELINE_DEPTH", "3"))
+# queued writes per shard fd before submission backpressures
+WRITER_DEPTH = int(os.environ.get("WEEDTPU_EC_WRITER_DEPTH", "4"))
 
 
-PIPELINE_DEPTH = 3  # host batch buffers in flight: read N+1 / encode N / drain N-1
+def _writer_threads(nshards: int) -> int:
+    """Writer threads for an nshards-wide writer pool.  Shard fds are
+    striped over the workers (same shard -> same worker, so per-shard
+    write order holds); WEEDTPU_EC_WRITERS pins the count.  The default
+    is CPU-aware: one worker per shard maximises overlap on a wide
+    storage host, but on a 2-core box 14 threads just thrash the
+    scheduler and the page-cache locks — there, a couple of workers
+    already saturate the copy bandwidth."""
+    env = int(os.environ.get("WEEDTPU_EC_WRITERS", "0"))
+    if env > 0:
+        return max(1, min(nshards, env))
+    return max(2, min(nshards, os.cpu_count() or 2))
+
+
+def _map_readonly(fd: int, size: int):
+    """Read-only map of a source file for the encode/rebuild producers.
+
+    When the file plausibly fits in RAM (or WEEDTPU_EC_PREFAULT=always)
+    the map is created MAP_POPULATE: one batched kernel pass sets up
+    every PTE, measurably faster than the ~256k/GiB demand faults a
+    fresh mapping otherwise takes while the writer threads are
+    saturating the cores.  A volume bigger than a quarter of RAM (or
+    WEEDTPU_EC_PREFAULT=never) streams with plain demand faulting +
+    MADV_SEQUENTIAL readahead instead — populating it upfront would
+    serialize the whole disk read ahead of the first encoded byte and
+    churn the page cache."""
+    import mmap as mmap_mod
+    flags = mmap_mod.MAP_SHARED
+    populate = getattr(mmap_mod, "MAP_POPULATE", 0)
+    mode = os.environ.get("WEEDTPU_EC_PREFAULT", "auto")
+    if populate and mode != "never":
+        if mode == "always":
+            flags |= populate
+        else:
+            try:
+                ram = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+            except (ValueError, OSError, AttributeError):
+                ram = 0
+            if ram and size <= ram // 4:
+                flags |= populate
+    mm = mmap_mod.mmap(fd, 0, flags=flags, prot=mmap_mod.PROT_READ)
+    try:
+        mm.madvise(mmap_mod.MADV_SEQUENTIAL)
+    except (AttributeError, OSError):
+        pass
+    return mm
 
 
 def write_ec_files(base: str, dat_path: str | None = None,
@@ -177,20 +221,6 @@ def _iter_units(dat_size: int, large_block: int, small_block: int,
         shard_base += small_block
 
 
-def _dispatch_parity(codec, batch: np.ndarray):
-    """Dispatch [k, B] -> [m, B] parity. JAX backends return the device
-    array WITHOUT materialising it (dispatch is async; the writer's
-    np.asarray is the sync point); host backends compute eagerly."""
-    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
-    from seaweedfs_tpu.models.rs import RSCode
-    if isinstance(codec, NativeRSCodec):
-        return codec.encode_parity(batch)
-    if isinstance(codec, RSCode):
-        return codec.encode_numpy(batch)[codec.k:]
-    import jax.numpy as jnp
-    return codec.encode_parity(jnp.asarray(batch))
-
-
 class EncodeCancelled(RuntimeError):
     pass
 
@@ -258,19 +288,23 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
                    progress=None, cancel=None, stats=None) -> None:
     """Stream the .dat through the codec into the 14 shard fds.
 
-    Two strategies behind one surface:
-      - host codecs (native AVX2 / numpy): a serial zero-copy loop — the
-        kernel reads straight from an mmap of the .dat via per-row
-        pointers, data shards move by in-kernel copy_file_range, parity
-        lands in a pooled buffer and is pwritten.  On a storage host the
-        encode is bandwidth-bound; removing every staging copy beats any
-        amount of thread pipelining (and a 1-core host has nothing to
-        overlap anyway).
-      - device codecs (Pallas/XLA/mesh): the 3-stage reader -> dispatch ->
-        writer pipeline, since JAX dispatch is async and the device round-
-        trip genuinely overlaps host I/O.  Reads stage from the mmap into
-        pooled buffers (no per-batch allocation); only parity rides the
-        device — data shards still copy_file_range straight to disk.
+    Two strategies behind one surface, both writing through the
+    per-shard writer pool (_ShardWriterPool) so all 14 shard files land
+    concurrently:
+      - host codecs (native AVX2/GFNI): the GF matmul runs on the calling
+        thread straight off an mmap of the .dat via per-row pointers (no
+        staging copy), data shards move by in-kernel copy_file_range on
+        their writers, and parity rides a small buffer ring — encode of
+        unit N overlaps the writes of units N-1.. .
+      - device codecs (Pallas/XLA/mesh/numpy): the overlapped reader ->
+        dispatch -> drain -> writers pipeline, since JAX dispatch is
+        async and the device round-trip genuinely overlaps host I/O.
+        Reads stage from the mmap into pooled buffers (no per-batch
+        allocation); only parity rides the device.
+
+    WEEDTPU_EC_PIPELINE=serial|pipelined|auto forces the strategy (the
+    pipelined machinery accepts host codecs too — bench.py uses that to
+    race the two modes on the same codec).
 
     Rows wholly beyond the .dat are never read, encoded, or written: the
     parity of an all-zero row region is zero, so those regions become
@@ -279,7 +313,6 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
     if stats is not None:
         stats["bytes"] = dat_size
     shard_size = layout.shard_file_size(dat_size, large_block, small_block)
-    k = layout.DATA_SHARDS
     highwater = [0] * layout.TOTAL_SHARDS
     if dat_size == 0:
         _finalize_shards(out_fds, highwater, shard_size)
@@ -287,20 +320,24 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
 
     from seaweedfs_tpu.ops.native_codec import NativeRSCodec
     native_host = isinstance(codec, NativeRSCodec)
+    pipe = os.environ.get("WEEDTPU_EC_PIPELINE", "auto")
+    # the serial-host strategy needs the native ptr-matmul, so it is only
+    # reachable for host codecs; `auto` prefers the pipelined machinery
+    # even then — interleaved A/B pairs (bench._bench_pipeline_ratio) show
+    # the dedicated dispatch/drain threads edge out the serial loop even
+    # on a 2-core host, and wider hosts only widen the gap
+    use_serial = native_host and pipe == "serial"
     if stats is not None:
-        stats["mode"] = "host-serial" if native_host else "pipelined"
+        stats["mode"] = "host-serial" if use_serial else "pipelined"
 
+    t_wall = time.perf_counter()
     import mmap as mmap_mod
     with open(dat_path, "rb") as datf:
         dat_fd = datf.fileno()
-        mm = mmap_mod.mmap(dat_fd, 0, prot=mmap_mod.PROT_READ)
-        try:
-            mm.madvise(mmap_mod.MADV_SEQUENTIAL)
-        except (AttributeError, OSError):
-            pass
+        mm = _map_readonly(dat_fd, dat_size)
         dat_view = np.frombuffer(mm, dtype=np.uint8)
         try:
-            if native_host:
+            if use_serial:
                 _encode_serial_host(codec, dat_fd, dat_view, dat_size,
                                     large_block, small_block, batch_size,
                                     out_fds, highwater, progress, cancel,
@@ -318,7 +355,34 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
                 # an in-flight exception's traceback frames still hold
                 # views into the map; GC reaps the mapping with them
                 pass
+    if stats is not None:
+        stats["wall_s"] = time.perf_counter() - t_wall
+        frac = overlap_fraction(stats)
+        if frac is not None:
+            stats["overlap_frac"] = frac
     _finalize_shards(out_fds, highwater, shard_size)
+
+
+def _unit_steps(dat_size: int, large_block: int, small_block: int,
+                batch_size: int) -> tuple[int, int]:
+    """(min, max) column-batch step _iter_units will actually cut for this
+    volume — min picks direct vs batched submission, max sizes the parity
+    ring buffers.  Sizing by the actual max matters: a small-block-only
+    volume (every production volume under 10x large_block) cuts 1MB units,
+    and ring buffers sized by the never-used large step would cycle an 8x
+    larger working set through the cache for nothing."""
+    k = layout.DATA_SHARDS
+    row = large_block * k
+    n_large = (dat_size - 1) // row if dat_size > row else 0
+    remaining = dat_size - n_large * row
+    steps = []
+    if n_large:
+        steps.append(min(batch_size, large_block))
+    if remaining > 0:
+        steps.append(min(batch_size, small_block))
+    if not steps:
+        steps = [batch_size]
+    return min(steps), max(steps)
 
 
 def _unit_coverage(dat_size: int, row_start: int, block: int, col: int,
@@ -349,78 +413,443 @@ def _pwrite_all(fd: int, view, off: int) -> None:
         off += n
 
 
+def _pwritev_all(fd: int, bufs: list, off: int) -> None:
+    """Vectored pwrite of buffers destined for one contiguous file range:
+    a run of per-unit parity blocks lands in a single syscall instead of
+    one pwrite per unit.  Short writes (possibly mid-iovec) resume."""
+    if not hasattr(os, "pwritev"):
+        for b in bufs:
+            _pwrite_all(fd, b, off)
+            off += memoryview(b).nbytes
+        return
+    mvs = [memoryview(b) for b in bufs]
+    while mvs:
+        n = os.pwritev(fd, mvs, off)
+        if n <= 0:
+            raise OSError("pwritev returned 0")
+        off += n
+        while mvs and n >= len(mvs[0]):
+            n -= len(mvs[0])
+            mvs.pop(0)
+        if mvs and n:
+            mvs[0] = mvs[0][n:]
+
+
+def _countdown(n: int, cb):
+    """Return a thunk that invokes cb after being called n times — the
+    release hook for a pooled buffer fanned out to n shard writers."""
+    lock = threading.Lock()
+    left = [n]
+
+    def hit() -> None:
+        with lock:
+            left[0] -= 1
+            if left[0] > 0:
+                return
+        cb()
+    return hit
+
+
+class _ShardWriterPool:
+    """pwrite/copy_file_range workers servicing the shard fds behind
+    bounded queues.
+
+    Shards are striped over _writer_threads(n) workers with a FIXED
+    shard -> worker mapping: writes to different shard files proceed
+    concurrently — a stall on one file no longer serializes the other
+    13 — while writes to the SAME shard stay in submission order on its
+    designated thread (they target disjoint offsets, but ordering keeps
+    the fd's high-water mark and the page cache walk sequential).  On a
+    wide host the default is one worker per shard; on a small host a
+    couple of workers carry all 14 fds instead of thrashing the
+    scheduler.  Bounded queues make submission apply backpressure
+    instead of buffering a whole volume in flight.
+
+    Workers never die: after the first error they drain remaining items
+    without touching the fds (still firing release hooks) so producers
+    can never deadlock on a full queue; the first error surfaces via
+    `.errors` after close().  Busy seconds accumulate per SHARD (not per
+    worker) and close() folds them into the stats dict under
+    stage_key(shard_index), preserving the write_data_s/write_parity_s
+    attribution bench.py reports."""
+
+    def __init__(self, fds, highwater=None, stats=None, stage_key=None,
+                 depth: int | None = None, workers: int | None = None):
+        self._fds = list(fds)
+        self._hw = highwater
+        self._stats = stats
+        self._stage_key = stage_key or (lambda i: "write_s")
+        n = workers if workers else _writer_threads(len(self._fds))
+        self._nworkers = max(1, min(len(self._fds), n))
+        shards_per = -(-len(self._fds) // self._nworkers)
+        self._queues: list[queue.Queue] = [
+            queue.Queue(maxsize=(depth or WRITER_DEPTH) * shards_per)
+            for _ in range(self._nworkers)]
+        self._busy = [0.0] * len(self._fds)
+        self.errors: list[BaseException] = []
+        self._threads = [
+            threading.Thread(target=self._run, args=(w,),
+                             name=f"ec-writer-{w:02d}", daemon=True)
+            for w in range(self._nworkers)]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.errors)
+
+    def _q(self, shard: int) -> queue.Queue:
+        return self._queues[shard % self._nworkers]
+
+    def put(self, shard: int, data, off: int, release=None) -> None:
+        """Queue a pwrite of a 1-D uint8 buffer at `off`; the caller must
+        keep `data` valid until `release` (or the write) completes."""
+        self._q(shard).put((shard, [(data, None, off, release)]))
+
+    def copy(self, shard: int, src_fd: int, src_off: int, dst_off: int,
+             count: int, src_view=None) -> None:
+        """Queue an in-kernel copy_file_range into the shard file."""
+        self._q(shard).put(
+            (shard, [(None, (src_fd, src_off, count, src_view), dst_off,
+                      None)]))
+
+    def put_many(self, shard: int, jobs: list) -> None:
+        """Queue a batch of jobs as ONE queue item — one worker wakeup per
+        batch, not per job (see _ShardFlusher)."""
+        self._q(shard).put((shard, jobs))
+
+    _IOV_RUN = 512  # max buffers merged into one pwritev (< IOV_MAX)
+
+    def _run(self, w: int) -> None:
+        q = self._queues[w]
+        while True:
+            batch = q.get()
+            if batch is None:
+                return
+            shard, item = batch
+            fd = self._fds[shard]
+            t0 = time.perf_counter()
+            idx = 0
+            while idx < len(item):
+                data, cfr, off, release = item[idx]
+                releases = [release]
+                idx += 1
+                try:
+                    if self.errors:
+                        continue  # drain without touching the fd
+                    if cfr is not None:
+                        src_fd, src_off, count, src_view = cfr
+                        _copy_range(src_fd, fd, src_off, off, count,
+                                    src_view=src_view)
+                        end = off + count
+                    else:
+                        # merge the run of pwrites targeting contiguous
+                        # offsets into one vectored syscall
+                        bufs = [np.ascontiguousarray(data)]
+                        end = off + bufs[0].nbytes
+                        while (idx < len(item)
+                               and len(bufs) < self._IOV_RUN
+                               and item[idx][1] is None
+                               and item[idx][2] == end):
+                            nxt = np.ascontiguousarray(item[idx][0])
+                            bufs.append(nxt)
+                            end += nxt.nbytes
+                            releases.append(item[idx][3])
+                            idx += 1
+                        _pwritev_all(fd, bufs, off)
+                    if self._hw is not None and end > self._hw[shard]:
+                        self._hw[shard] = end
+                except BaseException as e:  # surfaced after close
+                    self.errors.append(e)
+                finally:
+                    self._busy[shard] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    for rel in releases:
+                        if rel is not None:
+                            rel()
+
+    # a bare pool quacks like a _ShardFlusher so producers can submit
+    # DIRECTLY when units are big enough that per-job queue hops are
+    # cheap relative to the writes themselves (see _make_sink)
+    def account(self, nbytes: int) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        """Drain every queue, join the workers, fold busy seconds into
+        stats.  Idempotent, and does not raise — callers inspect
+        `.errors`, letting a producer-side exception win over a writer
+        one."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join()
+        if self._stats is not None:
+            for i, busy in enumerate(self._busy):
+                key = self._stage_key(i)
+                self._stats[key] = self._stats.get(key, 0.0) + busy
+
+
+FLUSH_BYTES = int(os.environ.get("WEEDTPU_EC_FLUSH_BYTES",
+                                 str(8 * 1024 * 1024)))
+# units at or above this size skip the submission batcher entirely — a
+# queue hop per ~256KB+ write is noise, and direct submission lets the
+# writers start (and release pooled buffers) the moment a job exists
+# instead of at the next flush-group boundary
+DIRECT_MIN = int(os.environ.get("WEEDTPU_EC_DIRECT_MIN",
+                                str(256 * 1024)))
+
+
+def _make_sink(writers: "_ShardWriterPool", nshards: int, min_step: int):
+    """Submission front for the writer pool: the pool itself (direct,
+    per-job) when every unit is at least DIRECT_MIN bytes, else a
+    _ShardFlusher that batches the tiny-unit churn."""
+    if min_step >= DIRECT_MIN:
+        return writers
+    return _ShardFlusher(writers, nshards)
+
+
+def _parity_ring_size(min_step: int, max_step: int) -> int:
+    """Buffers in the countdown-released parity ring.  Direct submission
+    needs only the pipeline headroom (writers release per job); the
+    batched path must cover a whole unflushed flush group of min_step
+    units or the encode stalls on its own batching.  Direct headroom is
+    kept at +1 (not more): each buffer is (m, max_step) — 64MB at the
+    production 16MB batch — so extra depth is a real RSS cost on a
+    storage host running concurrent encodes."""
+    if min_step >= DIRECT_MIN:
+        return PIPELINE_DEPTH + 1
+    return PIPELINE_DEPTH + max(1, FLUSH_BYTES // max_step)
+
+
+class _ShardFlusher:
+    """Producer-side submission batcher for a _ShardWriterPool.
+
+    With the production 16MB column batches each unit is worth a worker
+    wakeup, but a small-block-only layout cuts 1MB units — paying a queue
+    round-trip per unit per shard costs more scheduler churn than the
+    writes themselves on a small host.  The flusher accumulates each
+    shard's jobs locally and hands them over as one put_many batch per
+    ~FLUSH_BYTES of volume data; the worker then merges the contiguous
+    parity runs into single pwritev calls."""
+
+    def __init__(self, writers: _ShardWriterPool, nshards: int,
+                 flush_bytes: int = FLUSH_BYTES):
+        self._writers = writers
+        self._jobs: list[list] = [[] for _ in range(nshards)]
+        self._acc = 0
+        self._flush_bytes = flush_bytes
+
+    def put(self, shard: int, data, off: int, release=None) -> None:
+        self._jobs[shard].append((data, None, off, release))
+
+    def copy(self, shard: int, src_fd: int, src_off: int, dst_off: int,
+             count: int, src_view=None) -> None:
+        self._jobs[shard].append(
+            (None, (src_fd, src_off, count, src_view), dst_off, None))
+
+    def account(self, nbytes: int) -> None:
+        """Producers call this once per unit; crossing the flush target
+        ships every shard's pending batch."""
+        self._acc += nbytes
+        if self._acc >= self._flush_bytes:
+            self.flush()
+
+    def flush(self) -> None:
+        self._acc = 0
+        for shard, jobs in enumerate(self._jobs):
+            if jobs:
+                self._writers.put_many(shard, jobs)
+                self._jobs[shard] = []
+
+
+def overlap_fraction(stats: dict) -> float | None:
+    """Achieved stage overlap of an encode/rebuild run: 1 - wall / (sum of
+    per-stage seconds).  0.0 means fully serial (the wall clock IS the sum
+    of its stages); the upper bound for a given stage mix is
+    1 - max_stage/sum.  stall_s is producer IDLE time (waiting on a ring
+    buffer), not a productive stage, so it is excluded — a fully
+    backpressured run reads as ~0, not as overlapped.  None when the
+    stats carry no wall clock or no stage time (e.g. an empty volume)."""
+    wall = stats.get("wall_s")
+    total = sum(v for key, v in stats.items()
+                if key.endswith("_s") and key not in ("wall_s", "stall_s")
+                and isinstance(v, float))
+    if not wall or total <= 0:
+        return None
+    return round(max(0.0, 1.0 - wall / total), 3)
+
+
+def _host_parity_unit(codec, dat_view: np.ndarray, tailbuf: np.ndarray,
+                      pbuf: np.ndarray, row_start: int, block: int,
+                      col: int, step: int, nz: int, tail: int) -> None:
+    """Parity for one column unit of a stripe row: gf_matmul_ptrs straight
+    off the .dat mmap into pbuf's m rows.  A partial tail row is staged
+    into the zeroed tailbuf first; a stripe with nz < k populated rows
+    uses a column-truncated generator.  This is the ONE copy of the
+    zero-copy host encode — both the serial and pipelined strategies call
+    it, so they stay byte-identical by construction."""
+    from seaweedfs_tpu import native
+    rows = [dat_view[row_start + j * block + col:
+                     row_start + j * block + col + step]
+            for j in range(nz)]
+    if tail < step:
+        tailbuf[:tail] = rows[nz - 1][:tail]
+        tailbuf[tail:step] = 0
+        rows[nz - 1] = tailbuf
+    code = codec.code
+    mat = code.parity_matrix if nz == code.k else \
+        np.ascontiguousarray(code.parity_matrix[:, :nz])
+    native.gf_matmul_ptrs(mat, rows, list(pbuf), step)
+
+
 def _encode_serial_host(codec, dat_fd: int, dat_view: np.ndarray,
                         dat_size: int, large_block: int, small_block: int,
                         batch_size: int, out_fds, highwater,
                         progress=None, cancel=None, stats=None) -> None:
-    from seaweedfs_tpu import native
+    """Native-codec encode with overlapped shard I/O: the GF matmul runs
+    on the calling thread straight off the .dat mmap (zero staging copy),
+    while all 14 shard files are written by the per-shard writer pool —
+    the encode of unit N overlaps the data copies and parity writes of
+    units N-1.. still in flight.  Parity lands in a small ring of pooled
+    buffers so the matmul only waits (stall_s) when every buffer is still
+    queued behind the disks."""
     k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
-    max_step = min(batch_size, max(large_block, small_block))
-    pbuf = np.empty((m, max_step), dtype=np.uint8)
+    min_step, max_step = _unit_steps(dat_size, large_block, small_block,
+                                     batch_size)
+    pbuf_pool: queue.Queue = queue.Queue()
+    for _ in range(_parity_ring_size(min_step, max_step)):
+        pbuf_pool.put(np.empty((m, max_step), dtype=np.uint8))
     tailbuf = np.zeros(max_step, dtype=np.uint8)
+    writers = _ShardWriterPool(
+        out_fds, highwater, stats,
+        stage_key=lambda i: "write_data_s" if i < k else "write_parity_s")
+    sink = _make_sink(writers, layout.TOTAL_SHARDS, min_step)
     done = 0
-    for row_start, block, col, step, shard_off in _iter_units(
-            dat_size, large_block, small_block, batch_size):
-        if cancel is not None and cancel():
-            raise EncodeCancelled("ec encode cancelled")
-        nz, tail = _unit_coverage(dat_size, row_start, block, col, step)
-        if nz == 0:
-            continue
-        # data shards: in-kernel copy, no user-space transit
-        with _Timer(stats, "write_data_s"):
+    try:
+        for row_start, block, col, step, shard_off in _iter_units(
+                dat_size, large_block, small_block, batch_size):
+            if cancel is not None and cancel():
+                raise EncodeCancelled("ec encode cancelled")
+            if writers.failed:
+                break
+            nz, tail = _unit_coverage(dat_size, row_start, block, col, step)
+            if nz == 0:
+                continue
+            # data shards: in-kernel copy on the per-shard workers, no
+            # user-space transit (the mmap view outlives the pool)
             for j in range(nz):
                 off = row_start + j * block + col
                 n = step if j < nz - 1 else tail
-                _copy_range(dat_fd, out_fds[j], off, shard_off, n,
-                            src_view=dat_view)
-                highwater[j] = max(highwater[j], shard_off + n)
-        # parity: ptr-matmul straight off the mmap (partial tail row is
-        # staged into a pooled zeroed buffer first)
-        with _Timer(stats, "encode_s"):
-            rows = [dat_view[row_start + j * block + col:
-                             row_start + j * block + col + step]
-                    for j in range(nz)]
-            if tail < step:
-                tailbuf[:tail] = rows[nz - 1][:tail]
-                tailbuf[tail:step] = 0
-                rows[nz - 1] = tailbuf
-            mat = codec.code.parity_matrix if nz == k else \
-                np.ascontiguousarray(codec.code.parity_matrix[:, :nz])
-            native.gf_matmul_ptrs(mat, rows, list(pbuf), step)
-        with _Timer(stats, "write_parity_s"):
+                sink.copy(j, dat_fd, off, shard_off, n,
+                          src_view=dat_view)
+            try:
+                pbuf = pbuf_pool.get_nowait()
+            except queue.Empty:
+                # ship the pending batches first: their releases are what
+                # refill the ring (blocking before the flush would deadlock)
+                sink.flush()
+                with _Timer(stats, "stall_s"):
+                    pbuf = pbuf_pool.get()
+            with _Timer(stats, "encode_s"):
+                _host_parity_unit(codec, dat_view, tailbuf, pbuf,
+                                  row_start, block, col, step, nz, tail)
+            release = _countdown(
+                m, lambda b=pbuf: pbuf_pool.put(b))
             for i in range(m):
-                _pwrite_all(out_fds[k + i], pbuf[i, :step], shard_off)
-                highwater[k + i] = max(highwater[k + i], shard_off + step)
-        done += (nz - 1) * step + tail
-        if progress is not None:
-            progress(done)
+                sink.put(k + i, pbuf[i, :step], shard_off,
+                         release=release)
+            done += (nz - 1) * step + tail
+            sink.account(step)
+            if progress is not None:
+                progress(done)
+        sink.flush()
+    finally:
+        writers.close()
+    if writers.errors:
+        raise writers.errors[0]
 
 
 def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
                       dat_size: int, large_block: int, small_block: int,
                       batch_size: int, out_fds, highwater,
                       progress=None, cancel=None, stats=None) -> None:
-    """Reader -> dispatch -> writer pipeline for async device codecs.
+    """Overlapped reader -> dispatch -> drain -> shard-writer pipeline.
 
-    A batch buffer is only returned to the pool after the writer has
-    materialised its parity — until then the device may still be reading
-    the (possibly zero-copy-aliased on CPU backends) host memory."""
+    Stages, each on its own thread(s), all behind bounded queues so a
+    slow stage backpressures the ones before it instead of buffering the
+    volume:
+
+      reader   walks the unit iterator for stripe N+1; data shards go to
+               their shard writers by in-kernel copy_file_range on the
+               way (they never round-trip the device).  For DEVICE
+               codecs it also stages the stripe from the mmap into a
+               pooled buffer (read_s) — the device needs a stable host
+               buffer to transfer from.  HOST codecs skip the staging
+               copy entirely: the dispatch stage encodes straight off
+               the mmap, so forcing a host codec through this machinery
+               (WEEDTPU_EC_PIPELINE=pipelined) costs no extra memory
+               traffic vs the serial strategy.
+      dispatch (caller's thread) launches the parity matmul for stripe N
+               — asynchronous on JAX backends, eager (ptr-matmul off the
+               mmap into a pooled parity ring) for native host codecs
+      drain    materialises stripe N-1's parity (d2h_s: the device sync
+               point, which the old writer buried inside write_parity_s)
+               and fans its m rows out to the shard writers
+      writers  striped pwrite workers over the 14 shard fds
+               (_ShardWriterPool), so parity files land concurrently
+               instead of serially
+
+    A batch buffer returns to the pool as soon as its parity is
+    materialised — until then the device may still be reading the
+    (possibly zero-copy-aliased on CPU backends) host memory.  Parity
+    rows are views into the materialised array, kept alive by the writer
+    queue items (host-codec parity rides a countdown-released ring
+    instead)."""
+    from seaweedfs_tpu.ops.native_codec import NativeRSCodec
+    native_host = isinstance(codec, NativeRSCodec)
     k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
-    max_step = min(batch_size, max(large_block, small_block))
+    _, max_step = _unit_steps(dat_size, large_block, small_block,
+                              batch_size)
     pool: queue.Queue = queue.Queue()
-    for _ in range(PIPELINE_DEPTH):
-        pool.put(np.empty((k, max_step), dtype=np.uint8))
+    if native_host:
+        tailbuf = np.zeros(max_step, dtype=np.uint8)
+        # sized like _parity_ring_size's BATCHED branch: the pipelined
+        # drain always submits through a _ShardFlusher (its pwritev
+        # merging measures ~4% faster than direct submission even for
+        # DIRECT_MIN-sized units), so the ring must cover a full
+        # unflushed flush group
+        for _ in range(PIPELINE_DEPTH + max(1, FLUSH_BYTES // max_step)):
+            pool.put(np.empty((m, max_step), dtype=np.uint8))
+    else:
+        for _ in range(PIPELINE_DEPTH):
+            pool.put(np.empty((k, max_step), dtype=np.uint8))
     q_read: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
-    q_write: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
+    # q_disp is unbounded: it carries at most one entry per in-flight
+    # pooled buffer (the pool is the real backpressure) plus FLUSH nudges
+    q_disp: queue.Queue = queue.Queue()
+    # dispatch sends this when it runs dry on parity buffers: the drain's
+    # flusher may be sitting on the very jobs whose releases would refill
+    # the ring (blocking on pool.get() without the nudge deadlocks)
+    FLUSH = object()
     errors: list[BaseException] = []
+    writers = _ShardWriterPool(
+        out_fds, highwater, stats,
+        stage_key=lambda i: "write_data_s" if i < k else "write_parity_s")
     done = 0
 
     def reader() -> None:
         nonlocal done
+        flusher = _ShardFlusher(writers, k)  # data shards only
         try:
             for row_start, block, col, step, shard_off in _iter_units(
                     dat_size, large_block, small_block, batch_size):
-                if errors:  # writer failed: stop reading the volume
+                if errors or writers.failed:  # downstream died: stop
                     break
                 if cancel is not None and cancel():
                     raise EncodeCancelled("ec encode cancelled")
@@ -428,85 +857,125 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
                                           step)
                 if nz == 0:
                     continue
-                # data shards never round-trip the device: in-kernel copy
-                with _Timer(stats, "write_data_s"):
-                    for j in range(nz):
-                        off = row_start + j * block + col
-                        n = step if j < nz - 1 else tail
-                        _copy_range(dat_fd, out_fds[j], off, shard_off, n,
-                                    src_view=dat_view)
-                        highwater[j] = max(highwater[j], shard_off + n)
-                with _Timer(stats, "read_s"):
-                    buf = pool.get()
-                    batch = buf[:, :step]
-                    for j in range(k):
-                        off = row_start + j * block + col
-                        n = max(0, min(step, dat_size - off))
-                        if n > 0:
-                            np.copyto(batch[j, :n],
-                                      dat_view[off:off + n])
-                        if n < step:
-                            batch[j, max(n, 0):] = 0
-                q_read.put((buf, step, shard_off))
+                for j in range(nz):
+                    off = row_start + j * block + col
+                    n = step if j < nz - 1 else tail
+                    flusher.copy(j, dat_fd, off, shard_off, n,
+                                 src_view=dat_view)
+                if native_host:
+                    # zero-copy: dispatch encodes off the mmap directly
+                    q_read.put((None, step, shard_off,
+                                (row_start, block, col, nz, tail)))
+                else:
+                    with _Timer(stats, "stall_s"):
+                        buf = pool.get()
+                    with _Timer(stats, "read_s"):
+                        batch = buf[:, :step]
+                        for j in range(k):
+                            off = row_start + j * block + col
+                            n = max(0, min(step, dat_size - off))
+                            if n > 0:
+                                np.copyto(batch[j, :n],
+                                          dat_view[off:off + n])
+                            if n < step:
+                                batch[j, max(n, 0):] = 0
+                    q_read.put((buf, step, shard_off, None))
                 done += (nz - 1) * step + tail
+                flusher.account(step)
                 if progress is not None:
                     progress(done)
-        except BaseException as e:  # surfaced by the main thread
+            flusher.flush()
+        except BaseException as e:  # surfaced by the caller's thread
             errors.append(e)
         finally:
             q_read.put(None)
 
-    def writer() -> None:
+    def drain() -> None:
         failed = False
+        flusher = _ShardFlusher(writers, layout.TOTAL_SHARDS)
         while True:
-            item = q_write.get()
+            item = q_disp.get()
             if item is None:
+                flusher.flush()
                 return
-            buf, step, shard_off, parity = item
-            if not failed:
-                try:
-                    with _Timer(stats, "write_parity_s"):
-                        pnp = np.asarray(parity)  # sync for device encode
-                        for i in range(pnp.shape[0]):
-                            _pwrite_all(out_fds[k + i],
-                                        np.ascontiguousarray(pnp[i, :step]),
-                                        shard_off)
-                            highwater[k + i] = max(highwater[k + i],
-                                                   shard_off + step)
-                except BaseException as e:
-                    errors.append(e)
-                    failed = True  # keep draining so nothing deadlocks
-            pool.put(buf)
+            if item is FLUSH:
+                flusher.flush()
+                continue
+            buf, step, shard_off, parity, release = item
+            if failed or errors or writers.failed:
+                if release is not None:
+                    for _ in range(m):
+                        release()
+                elif buf is not None:
+                    pool.put(buf)
+                continue
+            if release is not None:  # host parity: already materialised
+                for i in range(m):
+                    flusher.put(k + i, parity[i, :step], shard_off,
+                                release=release)
+                flusher.account(step)
+                continue
+            try:
+                with _Timer(stats, "d2h_s"):
+                    pnp = _materialize(parity)
+            except BaseException as e:
+                errors.append(e)
+                failed = True  # keep draining so nothing deadlocks
+                pool.put(buf)
+                continue
+            pool.put(buf)  # device is done with the host memory now
+            for i in range(pnp.shape[0]):
+                flusher.put(k + i, pnp[i, :step], shard_off)
+            flusher.account(step)
 
     t_r = threading.Thread(target=reader, name="ec-reader", daemon=True)
-    t_w = threading.Thread(target=writer, name="ec-writer", daemon=True)
+    t_d = threading.Thread(target=drain, name="ec-drain", daemon=True)
     t_r.start()
-    t_w.start()
+    t_d.start()
     try:
         while True:
             item = q_read.get()
             if item is None:
                 break
-            buf, step, shard_off = item
-            if errors:  # writer failed: stop dispatching, surface below
-                pool.put(buf)
+            buf, step, shard_off, coverage = item
+            if errors or writers.failed:  # stop dispatching, surface below
+                if buf is not None:
+                    pool.put(buf)
                 continue
-            with _Timer(stats, "encode_s"):
-                parity = _dispatch_parity(codec, buf[:, :step])
-            q_write.put((buf, step, shard_off, parity))
+            if native_host:
+                row_start, block, col, nz, tail = coverage
+                try:
+                    pbuf = pool.get_nowait()
+                except queue.Empty:
+                    q_disp.put(FLUSH)  # see FLUSH above: avoid deadlock
+                    with _Timer(stats, "stall_s"):
+                        pbuf = pool.get()
+                with _Timer(stats, "encode_s"):
+                    _host_parity_unit(codec, dat_view, tailbuf, pbuf,
+                                      row_start, block, col, step, nz,
+                                      tail)
+                release = _countdown(m, lambda b=pbuf: pool.put(b))
+                q_disp.put((None, step, shard_off, pbuf, release))
+            else:
+                with _Timer(stats, "encode_s"):
+                    parity = _dispatch_parity(codec, buf[:, :step])
+                q_disp.put((buf, step, shard_off, parity, None))
     finally:
-        q_write.put(None)
-        t_w.join()
+        q_disp.put(None)
+        t_d.join()
         while t_r.is_alive():  # unblock a reader stuck on a full q_read
             try:
                 item = q_read.get(timeout=0.05)
             except queue.Empty:
                 continue
-            if item is not None:
+            if item is not None and item[0] is not None:
                 pool.put(item[0])  # keep the pool whole or the reader starves
         t_r.join()
+        writers.close()  # after the producers: no submission can block now
     if errors:
         raise errors[0]
+    if writers.errors:
+        raise writers.errors[0]
 
 
 def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
@@ -514,11 +983,13 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     """Regenerate whichever `.ecXX` files are missing from the >=10 present
     ones. Returns the rebuilt shard ids.
 
-    Same zero-copy discipline as the encode path (and the same observability:
-    `progress(bytes_done)` per batch over survivor bytes, `cancel()` aborts,
-    `stats` gets per-stage seconds): survivor shards are mmap'd and fed to
-    the native decode matmul by row pointer, rebuilt shards land in a pooled
-    buffer and are pwritten into recycled `.tmp` inodes, committed by rename
+    Same zero-copy and overlap discipline as the encode path (and the same
+    observability: `progress(bytes_done)` per batch over survivor bytes,
+    `cancel()` aborts, `stats` gets per-stage seconds + overlap_frac):
+    survivor shards are mmap'd and fed to the native decode matmul by row
+    pointer, rebuilt shards land in a countdown-released buffer ring and
+    stream to per-shard writer workers (the decode of batch N overlaps the
+    writes of batch N-1) into recycled `.tmp` inodes, committed by rename
     only on success (reference: RebuildEcFiles, ec_encoder.go:237-291)."""
     present = [i for i in range(layout.TOTAL_SHARDS)
                if os.path.exists(base + layout.to_ext(i))]
@@ -542,6 +1013,7 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
         from seaweedfs_tpu import native
         dec_mat = codec.code.decode_matrix(list(use), list(missing))
 
+    t_wall = time.perf_counter()
     import mmap as mmap_mod
     ins = {i: open(base + layout.to_ext(i), "rb") for i in use}
     maps = {}
@@ -549,35 +1021,41 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     tmp_paths = {i: base + layout.to_ext(i) + ".tmp" for i in missing}
     out_fds = {i: os.open(p_, os.O_RDWR | os.O_CREAT, 0o644)
                for i, p_ in tmp_paths.items()}
-    obuf = None
+    # reconstruction writes ride the same per-shard writer pool as the
+    # encode path: rebuilding 4 lost shards streams them to 4 concurrent
+    # workers while the next batch's decode matmul runs.  Pooled output
+    # buffers (countdown-released once every shard writer is done with
+    # its row) keep the decode from racing its own in-flight writes.
+    wpos = {i: r for r, i in enumerate(missing)}
+    writers = _ShardWriterPool([out_fds[i] for i in missing], None, stats,
+                               stage_key=lambda i: "write_s")
+    opool: queue.Queue = queue.Queue()
+    for _ in range(PIPELINE_DEPTH):
+        opool.put(np.empty(
+            (len(missing), min(batch_size, max(shard_size, 1))),
+            dtype=np.uint8))
     stage = None
     ok = False
     try:
-        if native_host:
-            obuf = np.empty(
-                (len(missing), min(batch_size, max(shard_size, 1))),
-                dtype=np.uint8)
         for i, f in ins.items():
             if shard_size:
-                mm = mmap_mod.mmap(f.fileno(), 0, prot=mmap_mod.PROT_READ)
-                try:
-                    mm.madvise(mmap_mod.MADV_SEQUENTIAL)
-                except (AttributeError, OSError):
-                    pass
+                mm = _map_readonly(f.fileno(), shard_size)
                 maps[i] = mm
                 views[i] = np.frombuffer(mm, dtype=np.uint8)
         done = 0
         for off in range(0, shard_size, batch_size):
             if cancel is not None and cancel():
                 raise EncodeCancelled("ec rebuild cancelled")
+            if writers.failed:
+                break
             n = min(batch_size, shard_size - off)
+            with _Timer(stats, "stall_s"):
+                obuf = opool.get()
             with _Timer(stats, "reconstruct_s"):
                 if native_host:
                     rows = [views[i][off:off + n] for i in use]
                     outs = [obuf[r, :n] for r in range(len(missing))]
                     native.gf_matmul_ptrs(dec_mat, rows, outs, n)
-                    rebuilt = {i: obuf[r, :n]
-                               for r, i in enumerate(missing)}
                 else:
                     if stage is None:
                         stage = np.empty((layout.DATA_SHARDS,
@@ -589,17 +1067,29 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
                         codec,
                         {i: stage[row, :n] for row, i in enumerate(use)},
                         missing)
-            with _Timer(stats, "write_s"):
-                for i in missing:
-                    _pwrite_all(out_fds[i],
-                                np.ascontiguousarray(rebuilt[i]), off)
+                    for r, i in enumerate(missing):
+                        np.copyto(obuf[r, :n], rebuilt[i])
+            release = _countdown(len(missing),
+                                 lambda b=obuf: opool.put(b))
+            for i in missing:
+                writers.put(wpos[i], obuf[wpos[i], :n], off,
+                            release=release)
             done += n * layout.DATA_SHARDS
             if progress is not None:
                 progress(done)
+        writers.close()
+        if writers.errors:
+            raise writers.errors[0]
         for fd in out_fds.values():
             os.ftruncate(fd, shard_size)
+        if stats is not None:
+            stats["wall_s"] = time.perf_counter() - t_wall
+            frac = overlap_fraction(stats)
+            if frac is not None:
+                stats["overlap_frac"] = frac
         ok = True
     finally:
+        writers.close()  # idempotent; the fds must outlive the workers
         for f in ins.values():
             f.close()
         for i in list(views):

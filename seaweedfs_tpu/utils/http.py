@@ -1,6 +1,146 @@
-"""Shared HTTP helpers for the servers."""
+"""Shared HTTP helpers for the servers and the blocking client."""
 
 from __future__ import annotations
+
+import http.client
+import threading
+import time
+import urllib.parse
+
+
+class PooledHTTP:
+    """Keep-alive HTTP/1.1 connection pool keyed by (scheme, host).
+
+    The Python analogue of the Go http.Client transport reuse the
+    reference's `weed benchmark` leans on: without it every blob
+    operation pays a fresh TCP (and TLS) handshake, so a benchmark
+    client measures connection-setup rate instead of server rate.
+    Thread-safe; connections are returned to the pool only after the
+    response body is fully read.  A request on a reused connection that
+    dies before yielding a response is retried ONCE on a fresh
+    connection (the idle peer may have closed it under us).  Idle
+    sockets older than `idle_timeout` are closed on the next pool
+    touch — Go's Transport.IdleConnTimeout — so a long-lived daemon
+    does not hold fds to every peer it ever contacted."""
+
+    def __init__(self, timeout: float = 30.0, max_idle_per_host: int = 16,
+                 idle_timeout: float = 60.0):
+        self.timeout = timeout
+        self.max_idle_per_host = max_idle_per_host
+        self.idle_timeout = idle_timeout
+        # key -> [(conn, time.monotonic() when parked), ...]
+        self._idle: dict[
+            tuple[str, str],
+            list[tuple[http.client.HTTPConnection, float]]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _connect(self, scheme: str, host: str,
+                 timeout: float) -> http.client.HTTPConnection:
+        if scheme == "https":
+            from seaweedfs_tpu.security import tls as _tls
+            return http.client.HTTPSConnection(
+                host, timeout=timeout, context=_tls.client_ssl())
+        return http.client.HTTPConnection(host, timeout=timeout)
+
+    def _prune_locked(self, now: float) -> list[http.client.HTTPConnection]:
+        """Drop expired idle connections from EVERY key (a host we stopped
+        talking to would otherwise keep its sockets forever).  Caller
+        holds the lock; the expired conns are returned so the actual
+        close() — which may block on TLS shutdown — happens outside it."""
+        expired: list[http.client.HTTPConnection] = []
+        for key in list(self._idle):
+            fresh = [(c, ts) for c, ts in self._idle[key]
+                     if now - ts < self.idle_timeout]
+            expired += [c for c, ts in self._idle[key]
+                        if now - ts >= self.idle_timeout]
+            if fresh:
+                self._idle[key] = fresh
+            else:
+                del self._idle[key]
+        return expired
+
+    def _get_conn(self, key: tuple[str, str],
+                  timeout: float) -> tuple[http.client.HTTPConnection, bool]:
+        now = time.monotonic()
+        with self._lock:
+            expired = self._prune_locked(now)
+            idle = self._idle.get(key)
+            if idle:
+                conn, _ = idle.pop()
+                # the pooled socket keeps the timeout it was created
+                # with — re-arm it so a per-request timeout override
+                # applies to reused connections too
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+            else:
+                conn = None
+        for c in expired:
+            c.close()
+        if conn is not None:
+            return conn, True
+        return self._connect(key[0], key[1], timeout), False
+
+    def _put_conn(self, key: tuple[str, str],
+                  conn: http.client.HTTPConnection) -> None:
+        now = time.monotonic()
+        parked = False
+        with self._lock:
+            expired = self._prune_locked(now)
+            if not self._closed:
+                idle = self._idle.setdefault(key, [])
+                if len(idle) < self.max_idle_per_host:
+                    idle.append((conn, now))
+                    parked = True
+        for c in expired:
+            c.close()
+        if not parked:
+            conn.close()
+
+    def request(self, url: str, method: str = "GET", body=None,
+                headers: dict | None = None,
+                timeout: float | None = None) -> tuple[int, dict, bytes]:
+        """-> (status, response headers, body bytes).  Never raises for
+        HTTP error statuses — only for transport failures."""
+        u = urllib.parse.urlsplit(url)
+        key = (u.scheme, u.netloc)
+        path = u.path or "/"
+        if u.query:
+            path += "?" + u.query
+        tmo = self.timeout if timeout is None else timeout
+        last: Exception | None = None
+        for attempt in range(2):
+            if attempt:
+                # the retry must DIAL, not pop another idle connection —
+                # a restarted peer leaves every pooled socket stale
+                conn, reused = self._connect(key[0], key[1], tmo), False
+            else:
+                conn, reused = self._get_conn(key, tmo)
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                last = e
+                if reused:  # stale idle connection: retry on a fresh one
+                    continue
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                self._put_conn(key, conn)
+            return resp.status, dict(resp.getheaders()), data
+        raise last  # type: ignore[misc]
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = [c for idle in self._idle.values() for c, _ in idle]
+            self._idle.clear()
+        for c in conns:
+            c.close()
 
 
 def parse_range(rng: str, size: int) -> tuple[int, int]:

@@ -216,6 +216,65 @@ def test_ec_decode_back_to_volume(small_volume):
     vol.close()
 
 
+# ---- encode strategies ------------------------------------------------
+
+@pytest.mark.parametrize("batch", [50, SMALL * 10])
+@pytest.mark.parametrize("dat_size", [LARGE * 10 + SMALL * 23 + 37,
+                                      SMALL * 4 + 1])
+def test_pipelined_and_serial_encode_byte_identical(tmp_path, monkeypatch,
+                                                    batch, dat_size):
+    """The serial-host and pipelined strategies (and the numpy codec
+    through the pipelined machinery) must cut byte-identical .ec00-.ec13
+    shard files from the same .dat — the overlapped writer pool reorders
+    I/O, never contents."""
+    from seaweedfs_tpu import native
+    rng = np.random.default_rng(11)
+    dat = rng.integers(0, 256, dat_size, dtype=np.uint8).tobytes()
+    runs = [("pipelined-native", "cpp", "pipelined"),
+            ("pipelined-numpy", "numpy", "pipelined")]
+    if native.available():
+        runs.append(("serial", "cpp", "serial"))
+    shards: dict[str, list[bytes]] = {}
+    for name, codec, mode in runs:
+        if codec == "cpp" and not native.available():
+            continue
+        d = tmp_path / name
+        d.mkdir()
+        base = str(d / "v")
+        with open(base + ".dat", "wb") as f:
+            f.write(dat)
+        monkeypatch.setenv("WEEDTPU_EC_CODEC", codec)
+        monkeypatch.setenv("WEEDTPU_EC_PIPELINE", mode)
+        stats: dict = {}
+        ec_files.write_ec_files(base, large_block=LARGE, small_block=SMALL,
+                                batch_size=batch, stats=stats)
+        want_mode = "host-serial" if name == "serial" else "pipelined"
+        assert stats["mode"] == want_mode, (name, stats)
+        shards[name] = [open(base + layout.to_ext(i), "rb").read()
+                        for i in range(layout.TOTAL_SHARDS)]
+    golden = shards["pipelined-numpy"]
+    for name, got in shards.items():
+        for i in range(layout.TOTAL_SHARDS):
+            assert got[i] == golden[i], (name, i)
+
+
+def test_rebuild_stats_report_overlap(small_volume):
+    """rebuild_ec_files drives the same writer-pool machinery: stats must
+    carry per-stage seconds and the rebuilt bytes."""
+    tmp_path, _ = small_volume
+    base = str(tmp_path / "7")
+    encode_small(base)
+    for sid in (0, 10, 12, 13):
+        os.remove(base + layout.to_ext(sid))
+    stats: dict = {}
+    rebuilt = ec_files.rebuild_ec_files(base, batch_size=SMALL * 10,
+                                        stats=stats)
+    assert sorted(rebuilt) == [0, 10, 12, 13]
+    assert stats["bytes"] > 0
+    assert "reconstruct_s" in stats and "write_s" in stats
+    assert "wall_s" in stats
+
+
 # ---- golden fixture ---------------------------------------------------
 
 @pytest.mark.skipif(reference_fixture("weed/storage/erasure_coding/1.dat") is None,

@@ -188,6 +188,78 @@ def test_raft_membership_change_during_elections():
         n1.stop()
 
 
+def test_ec_degraded_read_lookup_not_serialized_across_volumes(tmp_path):
+    """The per-vid shard-location lock: concurrent degraded-read lookups
+    on two EC volumes, with the master STALLING on one of them, must not
+    serialize — the stalled volume's fetch may take its full stall, but
+    lookups (and cache hits) for the other volume proceed immediately.
+    Under the old process-wide _ec_loc_lock every fast lookup waited out
+    the stall.  Also pins the cold fan-out dedup: N concurrent workers on
+    one cold vid issue exactly ONE master fetch."""
+    import http.server
+    import json
+    import time as _time
+    from collections import Counter
+
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    STALL = 1.5
+    fetches: Counter = Counter()
+    fetch_lock = threading.Lock()
+
+    class FakeMaster(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            vid = int(self.path.rpartition("=")[2])
+            with fetch_lock:
+                fetches[vid] += 1
+            if vid == 1:
+                _time.sleep(STALL)
+            body = json.dumps(
+                {"shards": {str(i): ["127.0.0.1:0"] for i in range(14)}}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeMaster)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        vs = VolumeServer([str(tmp_path)],
+                          f"127.0.0.1:{srv.server_address[1]}")
+        t0 = _time.perf_counter()
+        stalled_done = threading.Event()
+
+        def stalled(i):
+            assert len(vs._ec_shard_locations(1)) == 14
+            stalled_done.set()
+
+        fast_elapsed: list[float] = []
+
+        def fast(i):
+            # 4 cold concurrent workers on vid 2 -> one fetch, then
+            # repeated cache hits, all while vid 1 is still stalled
+            start = _time.perf_counter()
+            for _ in range(3):
+                assert len(vs._ec_shard_locations(2)) == 14
+            fast_elapsed.append(_time.perf_counter() - start)
+
+        gang(5, lambda i: stalled(i) if i == 0 else fast(i))
+        total = _time.perf_counter() - t0
+        assert stalled_done.is_set()
+        assert total >= STALL  # the stalled fetch really stalled
+        # every fast lookup finished without waiting out the stall
+        assert max(fast_elapsed) < STALL * 0.5, fast_elapsed
+        assert fetches[1] == 1
+        assert fetches[2] == 1  # cold fan-out deduped to one fetch
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_mq_partition_publish_read_concurrent():
     from seaweedfs_tpu.mq.topic import LocalPartition, Partition
     lp = LocalPartition(Partition(range_start=0, range_stop=4096))

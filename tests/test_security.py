@@ -70,7 +70,10 @@ class TestGuard:
         assert not g.is_allowed("10.0.0.2")
 
     def test_security_config_from_real_toml(self):
-        import tomllib
+        try:
+            import tomllib
+        except ImportError:  # Python < 3.11
+            import tomli as tomllib
         data = tomllib.loads(
             '[jwt.signing]\nkey = "w"\n'
             '[jwt.signing.read]\nkey = "r"\n'
@@ -279,6 +282,8 @@ def test_tls_mtls_cluster_end_to_end(tmp_path):
     import threading
     import urllib.request
 
+    pytest.importorskip("cryptography",
+                        reason="cert generation needs cryptography")
     from tests.test_cluster import free_port
     from seaweedfs_tpu.security import tls
     from seaweedfs_tpu.server.master import MasterServer
