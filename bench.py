@@ -61,6 +61,21 @@ Extra metrics (all in the `extra` field of the one JSON line):
                                 numbers) on an in-process cluster — this
                                 harness has ONE shared core vs the published
                                 MacBook i7 figures
+  blob_read_rps_degraded        degraded EC needle reads/s through the
+                                batched read engine (all intervals planned
+                                up front, coalesced per shard, survivors
+                                read in parallel, ONE reconstruction
+                                dispatch per needle) vs the per-interval
+                                serial baseline (WEEDTPU_EC_READ=serial);
+                                falling behind serial by >10% (median of
+                                interleaved pairs) FAILS the bench
+                                (blob_read_degraded_regression)
+  filer_stream_mbps             whole-file filer streaming with the bounded
+                                readahead pipeline (WEEDTPU_READAHEAD) vs
+                                the serial fetch->write loop (=0), chunk
+                                cache disabled so every GET pays real
+                                volume fetches; same regression gate
+                                (filer_stream_pipeline_regression)
   baseline_avx2_refshape        the measured baseline itself (forced to the
                                 AVX2 path: the baseline is klauspost AVX2)
   baseline_avx2_kernel          pure-buffer AVX2 kernel GB/s
@@ -492,6 +507,15 @@ def main() -> None:
         # pair ratios (~0.05 of ceiling_frac) on narrow hosts
         _bench_e2e_host(extra)
 
+    # read-path engine benches (host-codec only, no device involvement):
+    # batched degraded EC reads and pipelined filer streaming, each raced
+    # against its serial baseline with a regression gate
+    for fn in (_bench_degraded_read, _bench_filer_stream):
+        try:
+            fn(extra)
+        except Exception as e:
+            print(f"bench: {fn.__name__} failed: {e}", file=sys.stderr)
+
     if force_cpu:
         # best CPU story first: the native AVX2 codec needs no jax at all
         from seaweedfs_tpu import native
@@ -599,10 +623,18 @@ def _exit_code(extra: dict) -> int:
     """Nonzero when a hard regression gate tripped — the JSON line still
     prints so the round records WHAT regressed, but the driver sees a
     failed bench instead of a silently slower one."""
-    return 1 if extra.get("ec_encode_e2e_pipeline_regression") else 0
+    gates = ("ec_encode_e2e_pipeline_regression",
+             "blob_read_degraded_regression",
+             "filer_stream_pipeline_regression")
+    return 1 if any(extra.get(g) for g in gates) else 0
 
 
 PIPELINE_REGRESSION_TOL = 0.90  # pipelined must stay within 10% of serial
+READ_REGRESSION_TOL = 0.90  # batched degraded read vs per-interval serial
+# the filer streaming effect size on a 2-core in-process harness is small
+# (~1.05-1.1x) while host weather swings ±10%; the gate exists to catch a
+# COLLAPSE (depth-4 cache thrash measured 0.68x), not weather
+FILER_STREAM_REGRESSION_TOL = 0.80
 
 
 def _bench_e2e_host(extra: dict) -> None:
@@ -754,6 +786,218 @@ def _bench_blob_rps(extra: dict, n: int = 2000, size: int = 1024,
             if master in started:
                 run_quiet(master.stop())
             loop.call_soon_threadsafe(loop.stop)
+
+
+def _bench_degraded_read(extra: dict, n_needles: int = 40,
+                         nsize: int = 64 * 1024, concurrency: int = 8,
+                         pairs: int = 4) -> None:
+    """Degraded EC needle reads/s: the batched read engine (all intervals
+    planned up front, adjacent per-shard ranges coalesced, survivor reads
+    fanned out on the shared pool, ONE reconstruction dispatch per needle)
+    vs the per-interval serial baseline (WEEDTPU_EC_READ=serial) on the
+    same shard files with two data shards deleted.  64KB needles over 4KB
+    blocks give ~17 intervals per needle — the shape where the
+    per-interval matmul tax shows.  A fresh EcVolume per rep keeps the
+    reconstruction LRU cold so the number measures the engine, not the
+    cache; interleaved pairs + median ratio cancel machine weather (same
+    rationale as _bench_e2e_ceiling).  Below READ_REGRESSION_TOL the run
+    FAILS (blob_read_degraded_regression + nonzero exit)."""
+    import concurrent.futures
+
+    from seaweedfs_tpu import native
+    from seaweedfs_tpu.storage import needle as ndl
+    from seaweedfs_tpu.storage.ec import ec_files, ec_volume, layout
+    from seaweedfs_tpu.storage.volume import Volume
+
+    small = 4096
+    old = os.environ.get("WEEDTPU_EC_CODEC")
+    # host codec: this metric times read-path orchestration, not a device
+    # kernel (and must not touch a possibly-dead TPU tunnel)
+    os.environ["WEEDTPU_EC_CODEC"] = "cpp" if native.available() else "numpy"
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-deg-") as d:
+            vol = Volume(d, "", 9)
+            rng = np.random.default_rng(4)
+            ids = []
+            for i in range(1, n_needles + 1):
+                data = rng.integers(0, 256, nsize, dtype=np.uint8).tobytes()
+                vol.append_needle(ndl.Needle(cookie=0x77, id=i, data=data))
+                ids.append(i)
+            vol.close()
+            base = os.path.join(d, "9")
+            ec_files.write_ec_files(base, large_block=1 << 40,
+                                    small_block=small,
+                                    batch_size=small * 10)
+            ec_files.write_sorted_ecx(base + ".idx")
+            for sid in (1, 4):  # two data shards lost
+                os.remove(base + layout.to_ext(sid))
+
+            def rep(mode: str) -> float:
+                ev = ec_volume.EcVolume(base, 1 << 40, small)
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(concurrency) as ex:
+                    for n in ex.map(
+                            lambda nid: ev.read_needle(nid, mode=mode), ids):
+                        assert len(n.data) == nsize
+                el = time.perf_counter() - t0
+                ev.close()
+                return el
+
+            best_b = best_s = float("inf")
+            ratios = []
+            for i in range(pairs):
+                if i % 2 == 0:
+                    t_s = rep("serial")
+                    t_b = rep("batched")
+                else:
+                    t_b = rep("batched")
+                    t_s = rep("serial")
+                if i == 0:
+                    continue  # cold page cache / codec warmup on both sides
+                best_b = min(best_b, t_b)
+                best_s = min(best_s, t_s)
+                ratios.append(t_s / t_b)
+            # per-stage engine counters from one fresh batched pass
+            ev = ec_volume.EcVolume(base, 1 << 40, small)
+            for nid in ids[:8]:
+                ev.read_needle(nid)
+            extra["blob_read_degraded_detail"] = ev.read_stats_snapshot()
+            ev.close()
+        ratios.sort()
+        ratio = ratios[len(ratios) // 2]
+        extra["blob_read_rps_degraded"] = round(n_needles / best_b, 1)
+        extra["blob_read_rps_degraded_serial"] = round(n_needles / best_s, 1)
+        extra["blob_read_degraded_ratio"] = round(ratio, 3)
+        if ratio < READ_REGRESSION_TOL:
+            extra["blob_read_degraded_regression"] = True
+            print(f"bench: REGRESSION — batched degraded reads run at "
+                  f"{ratio:.2f}x the per-interval serial baseline (median "
+                  f"of interleaved pairs); the one-shot reconstruction "
+                  f"engine has stopped paying off. Failing the bench run.",
+                  file=sys.stderr)
+    finally:
+        if old is None:
+            os.environ.pop("WEEDTPU_EC_CODEC", None)
+        else:
+            os.environ["WEEDTPU_EC_CODEC"] = old
+
+
+def _bench_filer_stream(extra: dict, size: int = 24 * 1024 * 1024,
+                        pairs: int = 6) -> None:
+    """Whole-file filer streaming MB/s: the bounded readahead pipeline
+    (WEEDTPU_READAHEAD=4, fetch+decode of chunk N+1.. overlapping the
+    client write of N) vs the serial fetch->write loop (=0), interleaved
+    pairs over the same entry on an in-process master+volume+filer
+    cluster.  The filer's chunk cache is DISABLED so every GET pays real
+    volume-server fetches — the latency the pipeline exists to hide.
+    Below FILER_STREAM_REGRESSION_TOL the run FAILS
+    (filer_stream_pipeline_regression + nonzero exit)."""
+    import asyncio
+    import socket
+    import threading
+    import urllib.request
+
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    old = os.environ.get("WEEDTPU_READAHEAD")
+    best_p = best_s = float("inf")
+    ratios: list[float] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-fstream-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs = VolumeServer([d], master.url, port=free_port(),
+                              heartbeat_interval=0.2)
+            filer = FilerServer(master.url, port=free_port(),
+                                chunk_cache_mem=0)
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                run(filer.start())
+                started.append(filer)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                payload = np.random.default_rng(5).integers(
+                    0, 256, size, dtype=np.uint8).tobytes()
+                url = f"http://127.0.0.1:{filer.port}/bench/stream.bin"
+                req = urllib.request.Request(url, data=payload,
+                                             method="PUT")
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    r.read()
+
+                def rep(depth: str) -> float:
+                    os.environ["WEEDTPU_READAHEAD"] = depth
+                    t0 = time.perf_counter()
+                    got = 0
+                    with urllib.request.urlopen(url, timeout=120) as r:
+                        while True:
+                            b = r.read(1 << 20)
+                            if not b:
+                                break
+                            got += len(b)
+                    assert got == size, got
+                    return time.perf_counter() - t0
+
+                for i in range(pairs):
+                    if i % 2 == 0:
+                        t_s = rep("0")
+                        t_p = rep("2")
+                    else:
+                        t_p = rep("2")
+                        t_s = rep("0")
+                    if i == 0:
+                        continue  # warm connections / page cache
+                    best_p = min(best_p, t_p)
+                    best_s = min(best_s, t_s)
+                    ratios.append(t_s / t_p)
+            finally:
+                if filer in started:
+                    run_quiet(filer.stop())
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        if old is None:
+            os.environ.pop("WEEDTPU_READAHEAD", None)
+        else:
+            os.environ["WEEDTPU_READAHEAD"] = old
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["filer_stream_mbps"] = round(size / 1e6 / best_p, 1)
+    extra["filer_stream_mbps_serial"] = round(size / 1e6 / best_s, 1)
+    extra["filer_stream_pipeline_ratio"] = round(ratio, 3)
+    if ratio < FILER_STREAM_REGRESSION_TOL:
+        extra["filer_stream_pipeline_regression"] = True
+        print(f"bench: REGRESSION — readahead filer streaming runs at "
+              f"{ratio:.2f}x the serial loop (median of interleaved "
+              f"pairs); the chunk prefetch pipeline has stopped "
+              f"overlapping. Failing the bench run.", file=sys.stderr)
 
 
 def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:

@@ -34,6 +34,7 @@ import gzip
 import hashlib
 import json
 import logging
+import os
 import time
 
 import aiohttp
@@ -143,6 +144,10 @@ class FilerServer:
         self.chunk_cache = ChunkCache(mem_limit=chunk_cache_mem,
                                       disk_dir=cache_dir,
                                       disk_limit=chunk_cache_disk)
+        # singleflight table for the streaming read path: (fid, cache) ->
+        # the one in-flight fetch+decode every concurrent GET of that
+        # chunk joins
+        self._chunk_flight: dict[tuple[str, bool], asyncio.Future] = {}
         # peer meta aggregation (reference: weed/filer/meta_aggregator.go)
         self.aggregate_peers = aggregate_peers
         self._peer_tasks: dict[str, asyncio.Task] = {}
@@ -415,6 +420,49 @@ class FilerServer:
             blob = await asyncio.to_thread(gzip.decompress, blob)
         return blob
 
+    async def _load_chunk_once(self, v, cache: bool) -> bytes:
+        blob = await self._fetch_chunk(v.fid, cache=cache)
+        return await self._decode_chunk_blob(blob, v.cipher_key,
+                                             v.is_compressed)
+
+    async def _load_chunk_view(self, v, cache: bool = True) -> bytes:
+        """Fetch+decode one chunk view with singleflight: N concurrent
+        GETs of the same hot chunk share ONE in-flight upstream fetch and
+        decode instead of stampeding the volume server and the chunk
+        cache (reference: reader_cache.go's one-downloader-per-chunk
+        discipline).  Failures are never cached — the table entry dies
+        with the future — and waiters are shielded so one cancelled
+        client (disconnect mid-stream) can't kill the fetch the others
+        are waiting on.  The flight key includes the cache flag so a
+        random-pattern reader's no-cache fetch can't suppress cache
+        population for a sequential reader of the same chunk (or vice
+        versa) — worst case one extra upstream GET for a doubly-hot
+        chunk, never an inverted cache decision."""
+        key = (v.fid, cache)
+        fut = self._chunk_flight.get(key)
+        if fut is None:
+            fut = asyncio.ensure_future(self._load_chunk_once(v, cache))
+            self._chunk_flight[key] = fut
+            fut.add_done_callback(
+                lambda _f, k=key: self._chunk_flight.pop(k, None))
+        else:
+            metrics.FILER_SINGLEFLIGHT_JOINED.labels().inc()
+        return await asyncio.shield(fut)
+
+    @staticmethod
+    def _readahead_depth() -> int:
+        """Chunk views prefetched ahead of the in-order writer
+        (WEEDTPU_READAHEAD; 0 = the serial fetch->write loop).  The
+        default is a conservative 2: enough to hide one volume-server
+        round-trip behind the client write, without cycling N multi-MB
+        chunk buffers through a narrow host's cache (measured: depth 4
+        runs ~15% SLOWER than serial on a 2-core box, depth 2 wins there
+        and everywhere wider; raise it when volume servers are remote)."""
+        try:
+            return int(os.environ.get("WEEDTPU_READAHEAD", "2"))
+        except ValueError:
+            return 2
+
     async def _resolve_chunks(self, entry: Entry) -> list[FileChunk]:
         """Expand manifest refs, fetching manifest blobs level by level
         (they may nest)."""
@@ -441,6 +489,10 @@ class FilerServer:
     # -- main dispatch -------------------------------------------------
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
+        # ChunkCache keeps its own counters; mirror them into the registry
+        # at scrape time so the bench can read filer cache hit ratio
+        for stat, value in self.chunk_cache.stats().items():
+            metrics.FILER_CHUNK_CACHE.labels(stat).set(value)
         return web.Response(text=metrics.REGISTRY.render(),
                             content_type="text/plain")
 
@@ -1012,16 +1064,52 @@ class FilerServer:
                         next(iter(self._read_patterns)))
             rp.monitor_read(offset, length)
             cache_chunks = not rp.is_random
+        # bounded readahead pipeline: prefetch up to `depth` chunk views
+        # as tasks while the response is written strictly IN ORDER — the
+        # fetch+decode of view N+1.. overlaps the client write of view N
+        # (the serial loop paid full upstream latency per chunk).  Bytes
+        # on the wire are identical to the serial loop by construction:
+        # only completed head-of-line tasks are written.
         pos = offset
-        for v in views:
-            if v.logic_offset > pos:
-                await _write_zeros(resp, v.logic_offset - pos)
-                pos = v.logic_offset
-            blob = await self._fetch_chunk(v.fid, cache=cache_chunks)
-            blob = await self._decode_chunk_blob(blob, v.cipher_key,
-                                                 v.is_compressed)
-            await resp.write(blob[v.offset_in_chunk:v.offset_in_chunk + v.size])
-            pos += v.size
+        depth = self._readahead_depth()
+        if depth <= 0:
+            for v in views:
+                if v.logic_offset > pos:
+                    await _write_zeros(resp, v.logic_offset - pos)
+                    pos = v.logic_offset
+                blob = await self._load_chunk_once(v, cache_chunks)
+                await resp.write(
+                    blob[v.offset_in_chunk:v.offset_in_chunk + v.size])
+                pos += v.size
+        else:
+            from collections import deque
+            pending: deque = deque()
+            nxt = 0
+            try:
+                while nxt < len(views) and len(pending) < depth:
+                    v = views[nxt]
+                    nxt += 1
+                    pending.append((v, asyncio.ensure_future(
+                        self._load_chunk_view(v, cache_chunks))))
+                while pending:
+                    v, task = pending.popleft()
+                    blob = await task
+                    if v.logic_offset > pos:
+                        await _write_zeros(resp, v.logic_offset - pos)
+                        pos = v.logic_offset
+                    await resp.write(
+                        blob[v.offset_in_chunk:v.offset_in_chunk + v.size])
+                    pos += v.size
+                    while nxt < len(views) and len(pending) < depth:
+                        v = views[nxt]
+                        nxt += 1
+                        pending.append((v, asyncio.ensure_future(
+                            self._load_chunk_view(v, cache_chunks))))
+            finally:
+                for _, task in pending:
+                    # cancelling a waiter never kills a shared in-flight
+                    # fetch (_load_chunk_view shields the real future)
+                    task.cancel()
         if pos < offset + length:
             await _write_zeros(resp, offset + length - pos)
 

@@ -37,6 +37,67 @@ log = logging.getLogger("volume")
 EC_FILE_EXTS = [layout.to_ext(i) for i in range(layout.TOTAL_SHARDS)] + \
     [".ecx", ".ecj", ".vif"]
 
+try:
+    from aiohttp.http_writer import StreamWriter as _AioSW
+    from aiohttp.http_writer import _serialize_headers as _ser_headers
+    # write_eof leans on these writer privates too — probe them all, so a
+    # partial aiohttp internals change disables the fast path instead of
+    # 500ing the hottest GET route
+    if not all(hasattr(_AioSW, a)
+               for a in ("_writelines", "_write", "chunked")):
+        _ser_headers = None
+except ImportError:  # aiohttp internals moved: fall back to two writes
+    _ser_headers = None
+
+
+class _OneShotResponse(web.Response):
+    """web.Response that defers the header write and flushes headers+body
+    in ONE transport write.  Stock aiohttp issues two socket sends per
+    response (headers at prepare, body at write_eof); on syscall-taxed
+    hosts that second send is a measurable slice of a small-blob GET, and
+    the blob read path is exactly small responses at high rate.  Any
+    non-simple shape (chunked, compressed, payload body, empty-body
+    methods) falls back to the stock path."""
+
+    async def _write_headers(self) -> None:
+        if _ser_headers is None:
+            return await super()._write_headers()
+        version = self._req.version
+        status_line = (f"HTTP/{version[0]}.{version[1]} "
+                       f"{self._status} {self._reason}")
+        self._hdr_buf = _ser_headers(status_line, self._headers)
+
+    async def write_eof(self, data: bytes = b"") -> None:
+        buf = getattr(self, "_hdr_buf", None)
+        if buf is None:
+            return await super().write_eof(data)
+        self._hdr_buf = None
+        writer = self._payload_writer
+        try:
+            # everything read here is aiohttp-private; an internals
+            # change must degrade to the stock two-write path, not 500
+            # the hottest GET route (no bytes are on the wire yet)
+            from aiohttp.payload import Payload
+            body = (self._body if self._compressed_body is None
+                    else self._compressed_body)
+            simple = (writer is not None and not self._eof_sent
+                      and not writer.chunked and writer._compress is None
+                      and not self._must_be_empty_body
+                      and not isinstance(body, Payload) and not data)
+        except AttributeError:
+            simple = False
+        if not simple:
+            if writer is not None and not self._eof_sent:
+                writer._write(buf)
+            return await super().write_eof(data)
+        if body:
+            if writer.length is not None:
+                writer.length = max(0, writer.length - len(body))
+            writer._writelines((buf, body))
+        else:
+            writer._write(buf)
+        await web.StreamResponse.write_eof(self)
+
 
 class VolumeServer:
     def __init__(self, directories: list[str], master_url: str,
@@ -374,18 +435,34 @@ class VolumeServer:
         return None
 
     PAGED_READ_MIN = 256 * 1024  # Range on bigger needles skips full load
+    # small plain-volume needles are pread directly on the event loop:
+    # cheaper than a thread-pool round-trip per request WHEN the pages are
+    # cache-resident (the hot-blob case this server optimizes for).  The
+    # tradeoff is deliberate: a cold page stalls the loop for one disk
+    # read (~ms), so deployments whose working set exceeds RAM — where
+    # most reads fault — should set WEEDTPU_INLINE_READ_MAX=0 to force
+    # every read through the pool
+    INLINE_READ_MAX = int(os.environ.get("WEEDTPU_INLINE_READ_MAX",
+                                         str(64 * 1024)))
 
     async def _read_blob(self, req: web.Request, fid: t.FileId) -> web.StreamResponse:
+        # parsing an EMPTY query string still costs a parse_qsl pass per
+        # GET; the common blob read has no query at all
+        query = req.query if req.query_string else {}
         rng0 = req.headers.get("Range", "")
-        if rng0.startswith("bytes=") and "width" not in req.query \
-                and "height" not in req.query:
+        if rng0.startswith("bytes=") and "width" not in query \
+                and "height" not in query:
             resp = await self._read_blob_paged(req, fid, rng0)
             if resp is not None:
                 return resp
         try:
-            n = await asyncio.to_thread(
-                self.store.read_needle, fid.volume_id, fid.key,
-                fid.cookie, self._shard_reader(fid.volume_id))
+            n = self.store.read_needle_inline(
+                fid.volume_id, fid.key, fid.cookie, self.INLINE_READ_MAX) \
+                if self.INLINE_READ_MAX else None
+            if n is None:
+                n = await asyncio.to_thread(
+                    self.store.read_needle, fid.volume_id, fid.key,
+                    fid.cookie, self._shard_reader(fid.volume_id))
         except KeyError:
             return web.json_response({"error": "not found"}, status=404)
         except PermissionError:
@@ -400,17 +477,17 @@ class VolumeServer:
         # on-read image resize/crop (reference: images/resizing.go served
         # via ?width= on the volume read handler, needle.go:101-106)
         mime = n.mime.decode() if n.mime else ""
-        if ("width" in req.query or "height" in req.query):
+        if ("width" in query or "height" in query):
             from seaweedfs_tpu import images
             try:
-                w = int(req.query.get("width", "0") or 0)
-                h = int(req.query.get("height", "0") or 0)
+                w = int(query.get("width", "0") or 0)
+                h = int(query.get("height", "0") or 0)
             except ValueError:
                 w = h = 0  # malformed size params are ignored
             if (w or h) and images.is_image_mime(mime):
                 data = await asyncio.to_thread(
                     images.resized, data, mime, w, h,
-                    req.query.get("mode", ""))
+                    query.get("mode", ""))
         rng = req.headers.get("Range", "")
         if rng.startswith("bytes=") and data:
             from seaweedfs_tpu.utils.http import parse_range
@@ -424,7 +501,7 @@ class VolumeServer:
                 f"bytes {lo}-{lo + length - 1}/{len(data)}"
             data, status = data[lo:lo + length], 206
         body = b"" if req.method == "HEAD" else data
-        return web.Response(
+        return _OneShotResponse(
             body=body, status=status,
             content_type=(n.mime.decode() if n.mime else "application/octet-stream"),
             headers=headers)
@@ -618,6 +695,15 @@ class VolumeServer:
         return web.json_response(self.store.collect_heartbeat())
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
+        # per-stage degraded-read counters live on each mounted EcVolume;
+        # mirror their sums into the registry at scrape time
+        totals: dict[str, int] = {}
+        for loc in self.store.locations:
+            for ev in list(loc.ec_volumes.values()):
+                for stat, v in ev.read_stats_snapshot().items():
+                    totals[stat] = totals.get(stat, 0) + v
+        for stat, v in totals.items():
+            metrics.EC_DEGRADED_READ.labels(stat).set(v)
         return web.Response(text=metrics.REGISTRY.render(),
                             content_type="text/plain")
 

@@ -229,3 +229,17 @@ FILER_REQUEST_HISTOGRAM = REGISTRY.histogram(
     "weedtpu_filer_request_seconds", "filer request latency", ("type",))
 EC_ENCODE_BYTES = REGISTRY.counter(
     "weedtpu_ec_encode_bytes_total", "bytes EC-encoded", ("codec",))
+# read-path engine: filer chunk-cache counters (mirrored from ChunkCache at
+# scrape time), streaming singleflight joins, and the per-stage EC
+# degraded-read counters (mirrored from every mounted EcVolume.read_stats)
+FILER_CHUNK_CACHE = REGISTRY.gauge(
+    "weedtpu_filer_chunk_cache", "filer chunk cache counters "
+    "(hits/misses/mem_bytes/tierN_bytes, cumulative where applicable)",
+    ("stat",))
+FILER_SINGLEFLIGHT_JOINED = REGISTRY.counter(
+    "weedtpu_filer_chunk_singleflight_joined_total",
+    "concurrent chunk fetches collapsed into an already in-flight one")
+EC_DEGRADED_READ = REGISTRY.gauge(
+    "weedtpu_ec_degraded_read", "EC degraded-read engine counters "
+    "(shards fetched, intervals coalesced, reconstruct batches/intervals, "
+    "cache hits)", ("stat",))

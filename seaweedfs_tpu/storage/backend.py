@@ -57,10 +57,19 @@ class DiskFile(BackendStorageFile):
         self._f.seek(offset)
         return self._f.read(size)
 
+    def pread(self, offset: int, size: int) -> bytes:
+        """Positional read on the raw fd — no shared seek position, so
+        concurrent readers need no lock.  Coherent with append() because
+        append flushes the userspace buffer before returning."""
+        return os.pread(self._f.fileno(), size, offset)
+
     def append(self, data: bytes) -> int:
         self._f.seek(0, os.SEEK_END)
         offset = self._f.tell()
         self._f.write(data)
+        # flush so lock-free pread() readers see the bytes the moment the
+        # needle becomes visible in the needle map (append returns first)
+        self._f.flush()
         return offset
 
     def size(self) -> int:
@@ -69,6 +78,7 @@ class DiskFile(BackendStorageFile):
 
     def truncate(self, size: int) -> None:
         self._f.truncate(size)
+        self._f.flush()
 
     def flush(self) -> None:
         self._f.flush()
@@ -111,6 +121,21 @@ class MmapFile(BackendStorageFile):
             return bytes(self._mm[offset:offset + size])
         self._f.seek(offset)
         return self._f.read(size)
+
+    def pread(self, offset: int, size: int) -> bytes:
+        """Lock-free read out of the current mapping — a memcpy, zero
+        syscalls.  Raises OSError when the window is stale (file grew
+        past it, or a truncate/close swapped the map): the caller falls
+        back to the locked read_at, which remaps."""
+        mm = self._mm
+        if mm is None:
+            raise OSError("no mapping yet")
+        try:
+            if offset + size > len(mm):
+                raise OSError("read past mmap window")
+            return mm[offset:offset + size]
+        except ValueError as e:  # mapping closed under us (remap/close)
+            raise OSError(str(e)) from e
 
     def append(self, data: bytes) -> int:
         self._f.seek(0, os.SEEK_END)

@@ -11,11 +11,25 @@ volume-server layer can back missing local shards with remote RPCs; when a
 shard can't be read at all, the interval is reconstructed on-device from any
 k readable shards (reference: store_ec.go:339-393
 recoverOneRemoteEcShardInterval -> enc.ReconstructData).
+
+The needle read path is a batched engine rather than the reference's
+per-interval loop: all intervals are planned up front, adjacent ranges of
+the same shard file coalesce into single reads, every local+remote shard
+read fans out through one long-lived executor, and ALL missing intervals
+reconstruct in ONE codec dispatch (the survivor slices for every failed
+range stack column-wise into a single GF(2^8) matmul — RS decodes
+byte-position by byte-position, so concatenated ranges rebuild exactly as
+they would one by one).  A small LRU keeps recently reconstructed ranges so
+repeated degraded GETs of a hot needle cost no shard I/O and no matmul.
+`WEEDTPU_EC_READ=serial` restores the per-interval loop (bench baseline).
 """
 
 from __future__ import annotations
 
 import os
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Callable
 
 import numpy as np
@@ -26,6 +40,36 @@ from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import ec_files, layout
 
 ShardReader = Callable[[int, int, int], "bytes | None"]
+
+# bytes of reconstructed ranges kept per EcVolume so hot degraded needles
+# don't re-reconstruct (0 disables)
+RECONSTRUCT_CACHE_BYTES = int(os.environ.get(
+    "WEEDTPU_EC_RECONSTRUCT_CACHE", str(8 * 1024 * 1024)))
+
+# one long-lived pool for LOCAL degraded-read shard preads (the old engine
+# built a fresh ThreadPoolExecutor per interval — pool construction cost
+# per degraded GET, times one per interval).  Remote shard fetches must
+# NOT ride this pool: a blackholed peer parks its reader thread for the
+# full RPC timeout, and a handful of those would starve every degraded
+# GET's fast local preads behind them — remote fan-outs get a throwaway
+# per-call pool instead (abandoned stragglers die with it).
+_READ_POOL: ThreadPoolExecutor | None = None
+_READ_POOL_LOCK = threading.Lock()
+
+
+def _read_pool() -> ThreadPoolExecutor:
+    global _READ_POOL
+    pool = _READ_POOL
+    if pool is None:
+        with _READ_POOL_LOCK:
+            pool = _READ_POOL
+            if pool is None:
+                workers = int(os.environ.get("WEEDTPU_EC_READ_WORKERS",
+                                             "16"))
+                pool = _READ_POOL = ThreadPoolExecutor(
+                    max_workers=max(1, workers),
+                    thread_name_prefix="ec-read")
+    return pool
 
 
 class EcVolume:
@@ -58,6 +102,19 @@ class EcVolume:
         else:
             self.shard_size = 0
         self.dat_size = ec_files.find_dat_file_size(base, self.version)
+
+        # degraded-read engine state: per-stage counters for /metrics and
+        # an LRU of reconstructed (shard, offset, size) ranges
+        self.read_stats: dict[str, int] = {
+            "local_shard_reads": 0, "remote_shard_reads": 0,
+            "intervals_coalesced": 0, "reconstruct_batches": 0,
+            "reconstruct_intervals": 0, "reconstruct_cache_hits": 0,
+        }
+        self._stats_lock = threading.Lock()
+        self._recon_cache: OrderedDict[tuple[int, int, int], bytes] = \
+            OrderedDict()
+        self._recon_cache_bytes = 0
+        self._recon_lock = threading.Lock()
 
     # -- index ---------------------------------------------------------
 
@@ -98,85 +155,309 @@ class EcVolume:
         with open(self.base + ".ecj", "ab") as j:
             j.write(needle_id.to_bytes(8, "big"))
 
+    # -- stats / cache --------------------------------------------------
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.read_stats[key] = self.read_stats.get(key, 0) + n
+
+    def read_stats_snapshot(self) -> dict[str, int]:
+        with self._stats_lock:
+            return dict(self.read_stats)
+
+    def _cache_get(self, key: tuple[int, int, int]) -> bytes | None:
+        with self._recon_lock:
+            data = self._recon_cache.get(key)
+            if data is not None:
+                self._recon_cache.move_to_end(key)
+        return data
+
+    def _cache_put(self, key: tuple[int, int, int], data: bytes) -> None:
+        if RECONSTRUCT_CACHE_BYTES <= 0 or \
+                len(data) > RECONSTRUCT_CACHE_BYTES:
+            return
+        with self._recon_lock:
+            old = self._recon_cache.pop(key, None)
+            if old is not None:
+                self._recon_cache_bytes -= len(old)
+            self._recon_cache[key] = data
+            self._recon_cache_bytes += len(data)
+            while self._recon_cache_bytes > RECONSTRUCT_CACHE_BYTES and \
+                    self._recon_cache:
+                _, ev = self._recon_cache.popitem(last=False)
+                self._recon_cache_bytes -= len(ev)
+
     # -- reads ----------------------------------------------------------
 
     def _read_local(self, shard_id: int, offset: int, size: int) -> bytes | None:
+        """Positional read on the shard fd: os.pread carries its own file
+        offset, so concurrent interval reads of one EcVolume never race a
+        shared seek position."""
         f = self.shards.get(shard_id)
         if f is None:
             return None
-        f.seek(offset)
-        return f.read(size)
+        try:
+            return os.pread(f.fileno(), size, offset)
+        except OSError:
+            return None
 
     def read_interval(self, shard_id: int, offset: int, size: int,
                       shard_reader: ShardReader | None = None) -> bytes:
         data = self._read_local(shard_id, offset, size)
         if data is not None and len(data) == size:
+            self._bump("local_shard_reads")
             return data
         if shard_reader is not None:
             data = shard_reader(shard_id, offset, size)
             if data is not None and len(data) == size:
+                self._bump("remote_shard_reads")
                 return data
         return self._reconstruct_interval(shard_id, offset, size, shard_reader)
 
     def _reconstruct_interval(self, shard_id: int, offset: int, size: int,
                               shard_reader: ShardReader | None) -> bytes:
-        """Online repair: rebuild this shard's byte range from any k
-        others.  Local shards are gathered first (cheap); the remaining
-        remote reads fan out in PARALLEL like the reference's
-        recoverOneRemoteEcShardInterval (store_ec.go:349-382) — a serial
-        walk would stack per-peer timeouts onto one degraded GET."""
-        codec = ec_files._get_codec()
-        got: dict[int, np.ndarray] = {}
-        missing_remote: list[int] = []
-        for i in range(layout.TOTAL_SHARDS):
-            if i == shard_id:
-                continue
-            if len(got) >= layout.DATA_SHARDS:
-                break  # enough local shards: no wasted disk reads
-            data = self._read_local(i, offset, size)
-            if data is not None and len(data) == size:
-                got[i] = np.frombuffer(data, dtype=np.uint8)
-            else:
-                missing_remote.append(i)
-        if len(got) < layout.DATA_SHARDS and shard_reader is not None:
-            need = layout.DATA_SHARDS - len(got)
-            from concurrent.futures import (ThreadPoolExecutor,
-                                            as_completed)
-            pool = ThreadPoolExecutor(
-                max_workers=min(8, len(missing_remote) or 1))
+        """Per-interval repair (the serial baseline and the read_interval
+        fallback): a reconstruction batch of one."""
+        return self._reconstruct_ranges([(shard_id, offset, size)],
+                                        shard_reader, use_cache=False)[0]
+
+    def _read_segs_local(self, shard_id: int,
+                         segs: list[tuple[int, int]]) -> bytes | None:
+        """All (offset, size) segments of one shard, concatenated; None if
+        the shard is absent or any segment reads short."""
+        parts = []
+        for off, size in segs:
+            data = self._read_local(shard_id, off, size)
+            if data is None or len(data) != size:
+                return None
+            parts.append(data)
+        return b"".join(parts)
+
+    def _gather_survivors(self, exclude: set[int],
+                          segs: list[tuple[int, int]],
+                          shard_reader: ShardReader | None
+                          ) -> dict[int, np.ndarray]:
+        """k survivor rows covering every segment, local shards first, the
+        remainder fanned out to peers in PARALLEL on the shared pool like
+        the reference's recoverOneRemoteEcShardInterval
+        (store_ec.go:349-382) — a serial walk would stack per-peer
+        timeouts onto one degraded GET."""
+        k = layout.DATA_SHARDS
+        pool = _read_pool()
+        local = [i for i in range(layout.TOTAL_SHARDS)
+                 if i not in exclude and i in self.shards]
+        results: dict[int, bytes] = {}
+        if len(local) == 1:
+            data = self._read_segs_local(local[0], segs)
+            if data is not None:
+                results[local[0]] = data
+        elif local:
+            futs = {pool.submit(self._read_segs_local, i, segs): i
+                    for i in local}
+            for fut in as_completed(futs):
+                data = None if fut.exception() else fut.result()
+                if data is not None:
+                    results[futs[fut]] = data
+        self._bump("local_shard_reads", len(results) * len(segs))
+        if len(results) < k and shard_reader is not None:
+            need = k - len(results)
+            remote = [i for i in range(layout.TOTAL_SHARDS)
+                      if i not in exclude and i not in results]
+
+            def read_remote(sid: int) -> bytes | None:
+                parts = []
+                for off, size in segs:
+                    data = shard_reader(sid, off, size)
+                    if data is None or len(data) != size:
+                        return None
+                    parts.append(data)
+                return b"".join(parts)
+
+            # throwaway pool, like the reference's per-recover fan-out: a
+            # stuck peer must stall THIS request at worst, never the
+            # shared local-pread pool other degraded GETs ride
+            rpool = ThreadPoolExecutor(
+                max_workers=min(8, len(remote) or 1))
             try:
-                futs = {pool.submit(shard_reader, i, offset, size): i
-                        for i in missing_remote}
+                futs = {rpool.submit(read_remote, i): i for i in remote}
                 for fut in as_completed(futs):
                     data = None if fut.exception() else fut.result()
-                    if data is not None and len(data) == size:
-                        got[futs[fut]] = np.frombuffer(data, dtype=np.uint8)
+                    if data is not None:
+                        results[futs[fut]] = data
+                        self._bump("remote_shard_reads", len(segs))
                         need -= 1
                         if need <= 0:
                             break
             finally:
-                # do NOT wait for stragglers: one blackholed peer must not
-                # stall the degraded GET past the k fast responders
-                pool.shutdown(wait=False, cancel_futures=True)
-        if len(got) < layout.DATA_SHARDS:
+                # do NOT wait for stragglers: one blackholed peer must
+                # not stall the degraded GET past the k fast responders
+                rpool.shutdown(wait=False, cancel_futures=True)
+        if len(results) < k:
             raise IOError(
-                f"ec volume {self.base}: only {len(got)} shards readable, "
-                f"need {layout.DATA_SHARDS} to reconstruct shard {shard_id}")
-        out = ec_files._reconstruct_batch(codec, got, [shard_id])
-        return np.asarray(out[shard_id]).tobytes()
+                f"ec volume {self.base}: only {len(results)} shards "
+                f"readable, need {k} to reconstruct "
+                f"shard(s) {sorted(exclude)}")
+        rows = {}
+        for sid in sorted(results)[:k]:
+            rows[sid] = np.frombuffer(results[sid], dtype=np.uint8)
+        return rows
+
+    def _reconstruct_ranges(self, ranges: list[tuple[int, int, int]],
+                            shard_reader: ShardReader | None,
+                            use_cache: bool = True) -> list[bytes]:
+        """Rebuild several (shard_id, offset, size) ranges in ONE batched
+        codec dispatch: each survivor's slices concatenate into a single
+        row, the decode matmul runs once over the whole concatenation, and
+        the rebuilt rows split back per range."""
+        out: list[bytes | None] = [None] * len(ranges)
+        todo: list[int] = []
+        for idx, key in enumerate(ranges):
+            data = self._cache_get(key) if use_cache else None
+            if data is not None:
+                out[idx] = data
+                self._bump("reconstruct_cache_hits")
+            else:
+                todo.append(idx)
+        if not todo:
+            return out  # type: ignore[return-value]
+        wanted = sorted({ranges[i][0] for i in todo})
+        segs = [(ranges[i][1], ranges[i][2]) for i in todo]
+        rows = self._gather_survivors(set(wanted), segs, shard_reader)
+        codec = ec_files._get_codec()
+        # one dispatch decodes every wanted shard over the WHOLE
+        # concatenation even though each segment only consumes its own
+        # shard's slice — deliberately: with f lost shards that wastes
+        # (f-1)/f of the matmul OUTPUT (microseconds at KB batch sizes),
+        # while splitting into per-shard dispatches multiplies the
+        # per-call orchestration cost this engine exists to amortize
+        rebuilt = ec_files._reconstruct_batch(codec, rows, wanted)
+        self._bump("reconstruct_batches")
+        self._bump("reconstruct_intervals", len(todo))
+        pos = 0
+        for idx in todo:
+            sid, off, size = ranges[idx]
+            data = np.asarray(rebuilt[sid][pos:pos + size]).tobytes()
+            pos += size
+            out[idx] = data
+            if use_cache:
+                self._cache_put((sid, off, size), data)
+        return out  # type: ignore[return-value]
+
+    def _read_ranges(self, plan: list[tuple[int, int, int]],
+                     shard_reader: ShardReader | None) -> list[bytes]:
+        """The batched read engine: coalesce adjacent per-shard ranges,
+        read all coalesced ranges concurrently (local then remote), and
+        repair everything still missing in one reconstruction dispatch."""
+        # coalesce: group the plan per shard, merge contiguous shard-file
+        # ranges (a needle spanning whole stripe rows lands contiguous
+        # blocks in each shard file), remembering how each original
+        # interval slices back out of its merged read
+        per_shard: dict[int, list[tuple[int, int, int]]] = {}
+        for i, (sid, off, size) in enumerate(plan):
+            per_shard.setdefault(sid, []).append((off, size, i))
+        reads: list[list] = []  # [sid, off, size, [(idx, rel_off, sz)..]]
+        for sid, lst in per_shard.items():
+            lst.sort()
+            cur: list | None = None
+            for off, size, idx in lst:
+                if cur is not None and cur[1] + cur[2] == off:
+                    cur[3].append((idx, cur[2], size))
+                    cur[2] += size
+                else:
+                    cur = [sid, off, size, [(idx, 0, size)]]
+                    reads.append(cur)
+        if len(plan) > len(reads):
+            self._bump("intervals_coalesced", len(plan) - len(reads))
+
+        blobs: dict[int, bytes] = {}  # read index -> bytes
+        failed: list[int] = []
+        # reconstructed-range LRU first: a hot degraded needle skips shard
+        # I/O entirely
+        probe: list[int] = []
+        for ri, (sid, off, size, _) in enumerate(reads):
+            data = self._cache_get((sid, off, size))
+            if data is not None:
+                blobs[ri] = data
+                self._bump("reconstruct_cache_hits")
+            else:
+                probe.append(ri)
+        # local reads, concurrent when there is anything to overlap
+        if len(probe) == 1:
+            ri = probe[0]
+            sid, off, size, _ = reads[ri]
+            data = self._read_local(sid, off, size)
+            if data is not None and len(data) == size:
+                blobs[ri] = data
+                self._bump("local_shard_reads")
+            else:
+                failed.append(ri)
+        elif probe:
+            pool = _read_pool()
+            futs = {pool.submit(self._read_local, *reads[ri][:3]): ri
+                    for ri in probe}
+            for fut in as_completed(futs):
+                ri = futs[fut]
+                data = None if fut.exception() else fut.result()
+                if data is not None and len(data) == reads[ri][2]:
+                    blobs[ri] = data
+                    self._bump("local_shard_reads")
+                else:
+                    failed.append(ri)
+        # remote fetch of whatever the local disks couldn't serve — on a
+        # throwaway pool so a hung peer can't starve the shared pread pool
+        if failed and shard_reader is not None:
+            still: list[int] = []
+            rpool = ThreadPoolExecutor(max_workers=min(8, len(failed)))
+            try:
+                futs = {rpool.submit(shard_reader, *reads[ri][:3]): ri
+                        for ri in failed}
+                for fut in as_completed(futs):
+                    ri = futs[fut]
+                    data = None if fut.exception() else fut.result()
+                    if data is not None and len(data) == reads[ri][2]:
+                        blobs[ri] = data
+                        self._bump("remote_shard_reads")
+                    else:
+                        still.append(ri)
+            finally:
+                rpool.shutdown(wait=False, cancel_futures=True)
+            failed = still
+        # one-shot batched reconstruction of every range still missing
+        if failed:
+            failed.sort()
+            keys = [tuple(reads[ri][:3]) for ri in failed]
+            rebuilt = self._reconstruct_ranges(keys, shard_reader)
+            for ri, data in zip(failed, rebuilt):
+                blobs[ri] = data
+        parts: list[bytes | None] = [None] * len(plan)
+        for ri, (_, _, _, members) in enumerate(reads):
+            blob = blobs[ri]
+            for idx, rel, size in members:
+                parts[idx] = blob[rel:rel + size]
+        return parts  # type: ignore[return-value]
 
     def read_needle(self, needle_id: int,
-                    shard_reader: ShardReader | None = None) -> ndl.Needle:
-        """Full needle read: locate -> per-interval shard reads -> parse."""
+                    shard_reader: ShardReader | None = None,
+                    mode: str | None = None) -> ndl.Needle:
+        """Full needle read: locate -> plan all intervals -> batched shard
+        reads + one-shot reconstruction -> parse.  `mode` (or
+        WEEDTPU_EC_READ) = "serial" restores the per-interval loop."""
         dat_offset, size = self.find_needle(needle_id)
         length = t.actual_size(size, self.version)
         intervals = layout.locate_data(
             self.large_block, self.small_block, self.dat_size,
             dat_offset, length)
-        parts = []
+        plan = []
         for iv in intervals:
-            sid, off = iv.to_shard_id_and_offset(self.large_block, self.small_block)
-            parts.append(self.read_interval(sid, off, iv.size, shard_reader))
+            sid, off = iv.to_shard_id_and_offset(self.large_block,
+                                                 self.small_block)
+            plan.append((sid, off, iv.size))
+        mode = mode or os.environ.get("WEEDTPU_EC_READ", "batched")
+        if mode == "serial":
+            parts = [self.read_interval(sid, off, size, shard_reader)
+                     for sid, off, size in plan]
+        else:
+            parts = self._read_ranges(plan, shard_reader)
         record = b"".join(parts)
         n = ndl.Needle.from_record(record, self.version)
         if n.id != needle_id:

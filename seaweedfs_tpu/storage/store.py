@@ -14,12 +14,22 @@ import re
 import threading
 
 from seaweedfs_tpu.storage import needle as ndl
+from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import ec_volume as ecv
 from seaweedfs_tpu.storage.ec import layout
 from seaweedfs_tpu.storage.volume import Volume
 
 _VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
 _ECX_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ecx$")
+
+
+def _volume_backend() -> str:
+    """Backend for store-served volumes (WEEDTPU_VOLUME_BACKEND).  The
+    default is mmap: blob GETs slice the page cache directly instead of
+    paying a read syscall per request — on syscall-taxed hosts (VMs,
+    sandboxed kernels) that syscall is a measurable share of the whole
+    serve path.  Appends still go through the file descriptor."""
+    return os.environ.get("WEEDTPU_VOLUME_BACKEND", "mmap")
 
 
 class DiskLocation:
@@ -47,7 +57,8 @@ class DiskLocation:
                 # it as live data (shell re-runs the move from scratch)
                 continue
             if vid not in self.volumes:
-                self.volumes[vid] = Volume(self.directory, col, vid)
+                self.volumes[vid] = Volume(self.directory, col, vid,
+                                           backend=_volume_backend())
                 self.collections[vid] = col
         for path in glob.glob(os.path.join(self.directory, "*.ecx")):
             m = _ECX_RE.match(os.path.basename(path))
@@ -109,7 +120,8 @@ class Store:
             if len(loc.volumes) >= loc.max_volumes:
                 raise OSError("no free volume slots")
             v = Volume(loc.directory, collection, vid,
-                       replica_placement=replica_placement, ttl=ttl)
+                       replica_placement=replica_placement, ttl=ttl,
+                       backend=_volume_backend())
             loc.volumes[vid] = v
             loc.collections[vid] = collection
             return v
@@ -147,6 +159,27 @@ class Store:
                 raise PermissionError("cookie mismatch")
             return n
         raise KeyError(f"volume {vid} not found")
+
+    def read_needle_inline(self, vid: int, needle_id: int,
+                           cookie: int | None = None,
+                           max_bytes: int = 64 * 1024) -> "ndl.Needle | None":
+        """Event-loop-safe fast path for SMALL plain-volume reads: returns
+        the needle when it can be served by a bounded lock-free pread
+        (page-cache latency), or None when the caller must take the
+        thread-pool path (EC volume, missing/deleted needle, big record,
+        or a backend without pread — a remote tier would block the loop
+        on the network)."""
+        v = self.get_volume(vid)
+        if v is None:
+            return None
+        if getattr(v._dat, "pread", None) is None:
+            return None
+        loc = v.nm.get(needle_id)
+        if loc is None:
+            return None
+        if t.actual_size(loc[1], v.version) > max_bytes:
+            return None
+        return v.read_needle(needle_id, cookie)
 
     def delete_needle(self, vid: int, needle_id: int,
                       cookie: int | None = None) -> int:

@@ -222,12 +222,26 @@ class Volume:
 
     # -- read path -----------------------------------------------------
 
+    def _pread_at(self, offset: int, length: int) -> bytes:
+        """Lock-free positional read when the backend supports it
+        (DiskFile.pread): concurrent GETs of one volume stop serializing
+        on the shared file handle's seek position.  Falls back to the
+        locked path on backends without pread, and on the (vacuum-swap)
+        race where the backing fd was just replaced."""
+        pread = getattr(self._dat, "pread", None)
+        if pread is not None:
+            try:
+                return pread(offset, length)
+            except (OSError, ValueError):
+                pass  # fd swapped mid-read (compact): retry under lock
+        with self._lock:
+            return self._dat.read_at(offset, length)
+
     def _read_at(self, offset_units: int, size: int,
                  verify_checksum: bool = True) -> ndl.Needle:
         offset = t.from_offset_units(offset_units)
         length = t.actual_size(size, self.version)
-        with self._lock:
-            record = self._dat.read_at(offset, length)
+        record = self._pread_at(offset, length)
         if len(record) < length:
             raise EOFError(f"truncated needle at {offset}")
         try:
@@ -265,8 +279,7 @@ class Volume:
         if self.version == t.VERSION1:
             raise ValueError("paged meta read needs a v2/v3 volume")
         offset = t.from_offset_units(loc[0])
-        with self._lock:
-            head = self._dat.read_at(offset, t.NEEDLE_HEADER_SIZE + 4)
+        head = self._pread_at(offset, t.NEEDLE_HEADER_SIZE + 4)
         if len(head) < t.NEEDLE_HEADER_SIZE + 4:
             raise EOFError(f"truncated needle at {offset}")
         hcookie, _hid, hsize = struct.unpack(
@@ -280,15 +293,13 @@ class Volume:
         (data_size,) = struct.unpack(">I", head[t.NEEDLE_HEADER_SIZE:])
         tail_len = hsize - 4 - data_size  # flags..pairs block
         if tail_len > 0:
-            with self._lock:
-                tail = self._dat.read_at(
-                    offset + t.NEEDLE_HEADER_SIZE + 4 + data_size, tail_len)
+            tail = self._pread_at(
+                offset + t.NEEDLE_HEADER_SIZE + 4 + data_size, tail_len)
             n.parse_meta_tail(tail)
         # checksum sits right after the meta block
-        with self._lock:
-            crc_raw = self._dat.read_at(
-                offset + t.NEEDLE_HEADER_SIZE + hsize,
-                t.NEEDLE_CHECKSUM_SIZE)
+        crc_raw = self._pread_at(
+            offset + t.NEEDLE_HEADER_SIZE + hsize,
+            t.NEEDLE_CHECKSUM_SIZE)
         if len(crc_raw) == t.NEEDLE_CHECKSUM_SIZE:
             (n.checksum,) = struct.unpack(">I", crc_raw)
         n.size = data_size
@@ -313,8 +324,7 @@ class Volume:
         if self.version == t.VERSION1:
             raise ValueError("paged read needs a v2/v3 volume")
         offset = t.from_offset_units(loc[0])
-        with self._lock:
-            head = self._dat.read_at(offset, t.NEEDLE_HEADER_SIZE + 4)
+        head = self._pread_at(offset, t.NEEDLE_HEADER_SIZE + 4)
         if len(head) < t.NEEDLE_HEADER_SIZE + 4:
             raise EOFError(f"truncated needle at {offset}")
         hcookie, _hid, hsize = struct.unpack(
@@ -328,9 +338,8 @@ class Volume:
         ln = max(0, min(page_size, data_size - lo))
         if ln == 0:
             return b""
-        with self._lock:
-            return self._dat.read_at(
-                offset + t.NEEDLE_HEADER_SIZE + 4 + lo, ln)
+        return self._pread_at(
+            offset + t.NEEDLE_HEADER_SIZE + 4 + lo, ln)
 
     def has_needle(self, needle_id: int) -> bool:
         return self.nm.get(needle_id) is not None

@@ -84,6 +84,13 @@ class DiskTier:
                     f.write(data)
                 os.replace(tmp, p)
             except OSError:
+                # a failed write after open() leaves a stale .tmp that
+                # would sit in the directory (and, pre-fix, inflate the
+                # evictor's totals) forever
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
                 return
             self._used += len(data) - old
             if self._used > self.limit:
@@ -94,6 +101,8 @@ class DiskTier:
         total = 0
         try:
             for name in os.listdir(self.dir):
+                if name.endswith(".tmp"):
+                    continue  # in-flight (or stale) temp: not cached bytes
                 p = os.path.join(self.dir, name)
                 try:
                     st = os.stat(p)
@@ -160,3 +169,11 @@ class ChunkCache:
         tier = self._tier_for(len(data))
         if tier is not None:
             tier.put(key, data)
+
+    def stats(self) -> dict[str, int]:
+        """Hit/miss counters and per-tier byte usage, for /metrics."""
+        st = {"hits": self.hits, "misses": self.misses,
+              "mem_bytes": self.mem.used, "mem_limit": self.mem.limit}
+        for i, tier in enumerate(self.tiers):
+            st[f"tier{i}_bytes"] = tier._used
+        return st

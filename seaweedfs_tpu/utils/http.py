@@ -3,9 +3,150 @@
 from __future__ import annotations
 
 import http.client
+import socket
 import threading
 import time
 import urllib.parse
+
+
+class _BadResponse(http.client.HTTPException):
+    pass
+
+
+class _RawConn:
+    """One raw keep-alive socket + a minimal HTTP/1.1 client codec.
+
+    http.client parses response headers through email.feedparser — at
+    benchmark request rates that parser (plus per-request settimeout
+    syscalls and header-object churn) is a measurable share of CLIENT
+    cpu, which on a small host competes with the very server being
+    measured.  The repo's servers speak plain HTTP/1.1 with
+    content-length or chunked bodies, which this codec covers; anything
+    it cannot parse raises and the caller falls back to a fresh dial."""
+
+    __slots__ = ("sock", "buf", "timeout")
+
+    def __init__(self, sock: socket.socket, timeout: float):
+        self.sock = sock
+        self.buf = b""
+        self.timeout = timeout
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _read_until(self, marker: bytes) -> bytes:
+        """Consume through `marker`; returns everything before it."""
+        while True:
+            i = self.buf.find(marker)
+            if i >= 0:
+                out = self.buf[:i]
+                self.buf = self.buf[i + len(marker):]
+                return out
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise _BadResponse("connection closed mid-response")
+            self.buf += chunk
+
+    def _read_exact(self, n: int) -> bytes:
+        parts = []
+        if self.buf:
+            take = self.buf[:n]
+            parts.append(take)
+            self.buf = self.buf[len(take):]
+            n -= len(take)
+        while n > 0:
+            chunk = self.sock.recv(min(1 << 20, max(n, 65536)))
+            if not chunk:
+                raise _BadResponse("connection closed mid-body")
+            if len(chunk) > n:
+                parts.append(chunk[:n])
+                self.buf += chunk[n:]
+                n = 0
+            else:
+                parts.append(chunk)
+                n -= len(chunk)
+        return b"".join(parts)
+
+    def _read_head(self) -> tuple[bytes, int, dict]:
+        """One status-line + header block -> (http version, status,
+        lowercased header dict)."""
+        head = self._read_until(b"\r\n\r\n")
+        lines = head.split(b"\r\n")
+        parts = lines[0].split(None, 2)
+        if len(parts) < 2 or not parts[0].startswith(b"HTTP/1."):
+            raise _BadResponse(f"bad status line {lines[0]!r}")
+        try:
+            status = int(parts[1])
+        except ValueError:
+            raise _BadResponse(f"bad status line {lines[0]!r}") from None
+        hdrs: dict = {}
+        for line in lines[1:]:
+            k, sep, v = line.partition(b":")
+            if sep:
+                hdrs[k.strip().lower().decode("latin-1")] = \
+                    v.strip().decode("latin-1")
+        return parts[0], status, hdrs
+
+    def roundtrip(self, method: str, path: str, host: str, body,
+                  headers: dict, timeout: float
+                  ) -> tuple[int, dict, bytes, bool]:
+        """-> (status, lowercased header dict, body, keep_alive)."""
+        if timeout != self.timeout:
+            self.sock.settimeout(timeout)
+            self.timeout = timeout
+        out = [f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"]
+        has_cl = False
+        for k, v in headers.items():
+            lk = k.lower()
+            if lk == "content-length":
+                has_cl = True
+            out.append(f"{k}: {v}\r\n")
+        if body is not None and not has_cl:
+            out.append(f"Content-Length: {len(body)}\r\n")
+        elif body is None and method in ("POST", "PUT"):
+            out.append("Content-Length: 0\r\n")
+        out.append("\r\n")
+        req = "".join(out).encode("latin-1")
+        # one sendall for headers+body keeps small uploads to one syscall
+        self.sock.sendall(req + body if body is not None else req)
+        version, status, hdrs = self._read_head()
+        while status == 100:  # 100-continue: parse the real response
+            version, status, hdrs = self._read_head()
+        keep = version != b"HTTP/1.0" and \
+            "close" not in hdrs.get("connection", "").lower()
+        if method == "HEAD" or status in (204, 304):
+            return status, hdrs, b"", keep
+        if "chunked" in hdrs.get("transfer-encoding", "").lower():
+            chunks = []
+            while True:
+                size_line = self._read_until(b"\r\n")
+                size = int(size_line.split(b";")[0], 16)
+                if size == 0:
+                    # trailers (none from our servers) up to the blank line
+                    while True:
+                        line = self._read_until(b"\r\n")
+                        if not line:
+                            break
+                    break
+                chunks.append(self._read_exact(size))
+                if self._read_exact(2) != b"\r\n":
+                    raise _BadResponse("bad chunk terminator")
+            return status, hdrs, b"".join(chunks), keep
+        cl = hdrs.get("content-length")
+        if cl is not None:
+            return status, hdrs, self._read_exact(int(cl)), keep
+        # no framing: body runs to EOF, connection not reusable
+        parts_body = [self.buf]
+        self.buf = b""
+        while True:
+            chunk = self.sock.recv(1 << 20)
+            if not chunk:
+                break
+            parts_body.append(chunk)
+        return status, hdrs, b"".join(parts_body), False
 
 
 class PooledHTTP:
@@ -29,26 +170,52 @@ class PooledHTTP:
         self.max_idle_per_host = max_idle_per_host
         self.idle_timeout = idle_timeout
         # key -> [(conn, time.monotonic() when parked), ...]
-        self._idle: dict[
-            tuple[str, str],
-            list[tuple[http.client.HTTPConnection, float]]] = {}
+        self._idle: dict[tuple[str, str],
+                         list[tuple[_RawConn, float]]] = {}
         self._lock = threading.Lock()
         self._closed = False
+        self._last_prune = 0.0
 
-    def _connect(self, scheme: str, host: str,
-                 timeout: float) -> http.client.HTTPConnection:
+    @staticmethod
+    def _split_host(netloc: str) -> tuple[str, int | None]:
+        """-> (host, explicit port or None — scheme default applies)."""
+        if netloc.startswith("["):  # [v6]:port
+            host, _, rest = netloc[1:].partition("]")
+            return host, int(rest[1:]) if rest.startswith(":") else None
+        host, sep, port_s = netloc.rpartition(":")
+        if sep and port_s.isdigit():
+            return host, int(port_s)
+        return netloc, None
+
+    def _connect(self, scheme: str, netloc: str,
+                 timeout: float) -> _RawConn:
+        host, port = self._split_host(netloc)
         if scheme == "https":
             from seaweedfs_tpu.security import tls as _tls
-            return http.client.HTTPSConnection(
-                host, timeout=timeout, context=_tls.client_ssl())
-        return http.client.HTTPConnection(host, timeout=timeout)
+            raw = socket.create_connection((host, port or 443),
+                                           timeout=timeout)
+            raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            ctx = _tls.client_ssl()
+            sock = ctx.wrap_socket(raw, server_hostname=host)
+        else:
+            sock = socket.create_connection((host, port or 80),
+                                            timeout=timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return _RawConn(sock, timeout)
 
-    def _prune_locked(self, now: float) -> list[http.client.HTTPConnection]:
+    def _prune_locked(self, now: float) -> list[_RawConn]:
         """Drop expired idle connections from EVERY key (a host we stopped
         talking to would otherwise keep its sockets forever).  Caller
         holds the lock; the expired conns are returned so the actual
-        close() — which may block on TLS shutdown — happens outside it."""
-        expired: list[http.client.HTTPConnection] = []
+        close() — which may block on TLS shutdown — happens outside it.
+        Throttled to ~1Hz: pruning walks every idle list under the global
+        lock, and a hot client calls this twice per request — at
+        benchmark rates that walk was a measurable share of client CPU
+        for a deadline that only needs one-second resolution."""
+        expired: list[_RawConn] = []
+        if now - self._last_prune < 1.0:
+            return expired
+        self._last_prune = now
         for key in list(self._idle):
             fresh = [(c, ts) for c, ts in self._idle[key]
                      if now - ts < self.idle_timeout]
@@ -61,29 +228,19 @@ class PooledHTTP:
         return expired
 
     def _get_conn(self, key: tuple[str, str],
-                  timeout: float) -> tuple[http.client.HTTPConnection, bool]:
+                  timeout: float) -> tuple[_RawConn, bool]:
         now = time.monotonic()
         with self._lock:
             expired = self._prune_locked(now)
             idle = self._idle.get(key)
-            if idle:
-                conn, _ = idle.pop()
-                # the pooled socket keeps the timeout it was created
-                # with — re-arm it so a per-request timeout override
-                # applies to reused connections too
-                conn.timeout = timeout
-                if conn.sock is not None:
-                    conn.sock.settimeout(timeout)
-            else:
-                conn = None
+            conn = idle.pop()[0] if idle else None
         for c in expired:
             c.close()
         if conn is not None:
             return conn, True
         return self._connect(key[0], key[1], timeout), False
 
-    def _put_conn(self, key: tuple[str, str],
-                  conn: http.client.HTTPConnection) -> None:
+    def _put_conn(self, key: tuple[str, str], conn: _RawConn) -> None:
         now = time.monotonic()
         parked = False
         with self._lock:
@@ -101,14 +258,19 @@ class PooledHTTP:
     def request(self, url: str, method: str = "GET", body=None,
                 headers: dict | None = None,
                 timeout: float | None = None) -> tuple[int, dict, bytes]:
-        """-> (status, response headers, body bytes).  Never raises for
-        HTTP error statuses — only for transport failures."""
+        """-> (status, response headers [lowercased keys], body bytes).
+        Never raises for HTTP error statuses — only for transport
+        failures."""
         u = urllib.parse.urlsplit(url)
         key = (u.scheme, u.netloc)
         path = u.path or "/"
         if u.query:
             path += "?" + u.query
         tmo = self.timeout if timeout is None else timeout
+        if isinstance(body, (bytearray, memoryview)):
+            body = bytes(body)
+        elif isinstance(body, str):
+            body = body.encode()
         last: Exception | None = None
         for attempt in range(2):
             if attempt:
@@ -118,20 +280,24 @@ class PooledHTTP:
             else:
                 conn, reused = self._get_conn(key, tmo)
             try:
-                conn.request(method, path, body=body, headers=headers or {})
-                resp = conn.getresponse()
-                data = resp.read()
-            except (http.client.HTTPException, OSError) as e:
+                status, hdrs, data, keep = conn.roundtrip(
+                    method, path, u.netloc, body, headers or {}, tmo)
+            except (http.client.HTTPException, OSError, ValueError) as e:
                 conn.close()
+                # callers expect http.client/OS errors (the http.client
+                # contract this pool replaced); a codec parse failure
+                # surfacing as ValueError would slip their handlers
+                if isinstance(e, ValueError):
+                    e = _BadResponse(str(e))
                 last = e
                 if reused:  # stale idle connection: retry on a fresh one
                     continue
-                raise
-            if resp.will_close:
-                conn.close()
-            else:
+                raise e from None
+            if keep:
                 self._put_conn(key, conn)
-            return resp.status, dict(resp.getheaders()), data
+            else:
+                conn.close()
+            return status, hdrs, data
         raise last  # type: ignore[misc]
 
     def close(self) -> None:
