@@ -508,13 +508,18 @@ def main() -> None:
         _bench_e2e_host(extra)
 
     # read-path engine benches (host-codec only, no device involvement):
-    # batched degraded EC reads and pipelined filer streaming, each raced
-    # against its serial baseline with a regression gate
-    for fn in (_bench_degraded_read, _bench_filer_stream):
+    # batched degraded EC reads and pipelined filer streaming raced
+    # against their serial baselines, and the tracing layer raced against
+    # itself disabled — each with a regression gate
+    for fn in (_bench_degraded_read, _bench_filer_stream,
+               _bench_trace_overhead):
         try:
             fn(extra)
         except Exception as e:
+            # these three carry regression GATES: a harness crash must
+            # fail the run, or a broken gate ships as a green bench
             print(f"bench: {fn.__name__} failed: {e}", file=sys.stderr)
+            extra.setdefault("gated_bench_failed", []).append(fn.__name__)
 
     if force_cpu:
         # best CPU story first: the native AVX2 codec needs no jax at all
@@ -625,7 +630,9 @@ def _exit_code(extra: dict) -> int:
     failed bench instead of a silently slower one."""
     gates = ("ec_encode_e2e_pipeline_regression",
              "blob_read_degraded_regression",
-             "filer_stream_pipeline_regression")
+             "filer_stream_pipeline_regression",
+             "trace_overhead_regression",
+             "gated_bench_failed")
     return 1 if any(extra.get(g) for g in gates) else 0
 
 
@@ -635,6 +642,9 @@ READ_REGRESSION_TOL = 0.90  # batched degraded read vs per-interval serial
 # (~1.05-1.1x) while host weather swings ±10%; the gate exists to catch a
 # COLLAPSE (depth-4 cache thrash measured 0.68x), not weather
 FILER_STREAM_REGRESSION_TOL = 0.80
+# tracing at the default sample rate must cost <= 3% of blob read
+# throughput vs WEEDTPU_TRACE_SAMPLE=0 (ISSUE 3 acceptance bar)
+TRACE_OVERHEAD_TOL = 0.97
 
 
 def _bench_e2e_host(extra: dict) -> None:
@@ -885,7 +895,7 @@ def _bench_degraded_read(extra: dict, n_needles: int = 40,
 def _bench_filer_stream(extra: dict, size: int = 24 * 1024 * 1024,
                         pairs: int = 6) -> None:
     """Whole-file filer streaming MB/s: the bounded readahead pipeline
-    (WEEDTPU_READAHEAD=4, fetch+decode of chunk N+1.. overlapping the
+    (WEEDTPU_READAHEAD=2, fetch+decode of chunk N+1.. overlapping the
     client write of N) vs the serial fetch->write loop (=0), interleaved
     pairs over the same entry on an in-process master+volume+filer
     cluster.  The filer's chunk cache is DISABLED so every GET pays real
@@ -998,6 +1008,117 @@ def _bench_filer_stream(extra: dict, size: int = 24 * 1024 * 1024,
               f"{ratio:.2f}x the serial loop (median of interleaved "
               f"pairs); the chunk prefetch pipeline has stopped "
               f"overlapping. Failing the bench run.", file=sys.stderr)
+
+
+def _bench_trace_overhead(extra: dict, n: int = 1200, size: int = 1024,
+                          concurrency: int = 16, pairs: int = 9) -> None:
+    """Tracing tax on the hottest path: blob reads against an in-process
+    master+volume cluster with tracing at its DEFAULT sample rate vs
+    fully off (WEEDTPU_TRACE_SAMPLE=0), interleaved pairs over the same
+    blobs.  The middleware reads the env per request, so flipping it
+    between reps retargets live servers.  Below TRACE_OVERHEAD_TOL
+    (<= 3% regression allowed) the run FAILS (trace_overhead_regression
+    + nonzero exit).  The true per-request tax is ~1µs against a ~300µs
+    request, so the signal is far below host weather on a narrow box —
+    hence MORE pairs than the other gates (median of 8 ratios), or the
+    3%-tight gate flaps on scheduler noise alone."""
+    import asyncio
+    import concurrent.futures
+    import socket
+    import threading
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    old = os.environ.get("WEEDTPU_TRACE_SAMPLE")
+    best_on = best_off = float("inf")
+    ratios: list[float] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-trov-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs = VolumeServer([d], master.url, port=free_port(),
+                              heartbeat_interval=0.2)
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                client = WeedClient(master.url)
+                payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+                with concurrent.futures.ThreadPoolExecutor(
+                        concurrency) as ex:
+                    fids = list(ex.map(
+                        lambda i: client.upload(payload, name=f"t{i}"),
+                        range(n)))
+
+                def rep(sample: str) -> float:
+                    os.environ["WEEDTPU_TRACE_SAMPLE"] = sample
+                    t0 = time.perf_counter()
+                    with concurrent.futures.ThreadPoolExecutor(
+                            concurrency) as ex:
+                        for data in ex.map(client.download, fids):
+                            assert len(data) == size
+                    return time.perf_counter() - t0
+
+                for i in range(pairs):
+                    if i % 2 == 0:
+                        t_off = rep("0")
+                        t_on = rep("16")  # the default rate, explicit
+                    else:
+                        t_on = rep("16")
+                        t_off = rep("0")
+                    if i == 0:
+                        continue  # warm connections / page cache
+                    best_on = min(best_on, t_on)
+                    best_off = min(best_off, t_off)
+                    ratios.append(t_off / t_on)
+                client.close()
+            finally:
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        if old is None:
+            os.environ.pop("WEEDTPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["WEEDTPU_TRACE_SAMPLE"] = old
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["blob_read_rps_traced"] = round(n / best_on, 1)
+    extra["blob_read_rps_untraced"] = round(n / best_off, 1)
+    extra["trace_overhead_ratio"] = round(ratio, 3)
+    if ratio < TRACE_OVERHEAD_TOL:
+        extra["trace_overhead_regression"] = True
+        print(f"bench: REGRESSION — blob reads with tracing at the "
+              f"default sample rate run at {ratio:.3f}x the untraced "
+              f"rate (median of interleaved pairs); tracing exceeds its "
+              f"3% budget. Failing the bench run.", file=sys.stderr)
 
 
 def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:

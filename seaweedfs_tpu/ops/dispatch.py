@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from seaweedfs_tpu.stats import trace
+
 
 def _host_classes():
     from seaweedfs_tpu.models.rs import RSCode
@@ -27,11 +29,19 @@ def dispatch_parity(codec, batch: np.ndarray):
     array WITHOUT materialising it; host backends compute eagerly."""
     NativeRSCodec, RSCode = _host_classes()
     if isinstance(codec, NativeRSCodec):
-        return codec.encode_parity(batch)
+        with trace.span("codec.dispatch_parity", backend="host",
+                        bytes=batch.nbytes):
+            return codec.encode_parity(batch)
     if isinstance(codec, RSCode):
-        return codec.encode_numpy(batch)[codec.k:]
+        with trace.span("codec.dispatch_parity", backend="host",
+                        bytes=batch.nbytes):
+            return codec.encode_numpy(batch)[codec.k:]
     import jax.numpy as jnp
-    return codec.encode_parity(jnp.asarray(batch))
+    # a device dispatch returns un-materialised: this span times only the
+    # h2d + async enqueue — the sync cost shows up under codec.d2h
+    with trace.span("codec.dispatch_parity", backend="device",
+                    bytes=batch.nbytes):
+        return codec.encode_parity(jnp.asarray(batch))
 
 
 def materialize(parity) -> np.ndarray:
@@ -39,7 +49,8 @@ def materialize(parity) -> np.ndarray:
     numpy; device arrays transfer d2h here."""
     if isinstance(parity, np.ndarray):
         return parity
-    return np.asarray(parity)
+    with trace.span("codec.d2h", bytes=getattr(parity, "nbytes", 0)):
+        return np.asarray(parity)
 
 
 def reconstruct_batch(codec, shards: dict[int, np.ndarray],
@@ -47,11 +58,18 @@ def reconstruct_batch(codec, shards: dict[int, np.ndarray],
     """Rebuild `wanted` shard rows from >=k survivor rows (host bytes
     in/out)."""
     NativeRSCodec, RSCode = _host_classes()
+    nbytes = sum(v.nbytes for v in shards.values())
     if isinstance(codec, NativeRSCodec):
-        return codec.reconstruct(shards, wanted=wanted)
+        with trace.span("codec.reconstruct", backend="host",
+                        bytes=nbytes, wanted=len(wanted)):
+            return codec.reconstruct(shards, wanted=wanted)
     if isinstance(codec, RSCode):
-        return codec.reconstruct_numpy(shards, wanted=wanted)
+        with trace.span("codec.reconstruct", backend="host",
+                        bytes=nbytes, wanted=len(wanted)):
+            return codec.reconstruct_numpy(shards, wanted=wanted)
     import jax.numpy as jnp
-    out = codec.reconstruct({i: jnp.asarray(v) for i, v in shards.items()},
-                            wanted=wanted)
-    return {i: np.asarray(v) for i, v in out.items()}
+    with trace.span("codec.reconstruct", backend="device",
+                    bytes=nbytes, wanted=len(wanted)):
+        out = codec.reconstruct(
+            {i: jnp.asarray(v) for i, v in shards.items()}, wanted=wanted)
+        return {i: np.asarray(v) for i, v in out.items()}

@@ -39,6 +39,8 @@ from seaweedfs_tpu.s3.auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ,
                                    decode_aws_chunked)
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
+from seaweedfs_tpu.stats import trace
+from seaweedfs_tpu.utils.http import aiohttp_trace_config
 
 log = logging.getLogger("s3")
 
@@ -109,7 +111,20 @@ class S3ApiServer:
         self.breaker = breaker or CircuitBreaker()
         self.buckets_dir = buckets_dir.rstrip("/")
         self.security = security
-        self.app = web.Application(client_max_size=5 * 1024 * 1024 * 1024)
+        self.app = web.Application(
+            client_max_size=5 * 1024 * 1024 * 1024,
+            middlewares=[trace.aiohttp_middleware("s3")])
+        # the gateway is the one PUBLIC server: its debug surface answers
+        # loopback operators only, so /debug/* can't leak presigned-URL
+        # query strings or trace paths past the SigV4 wall (and a bucket
+        # literally named "debug" still 403s rather than being shadowed
+        # for remote clients)
+        self.app.add_routes([
+            web.get("/debug/traces", self._debug_local(
+                trace.handle_debug_traces)),
+            web.get("/debug/requests", self._debug_local(
+                trace.handle_debug_requests)),
+        ])
         self.app.add_routes([web.route("*", "/{tail:.*}", self.dispatch)])
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
@@ -118,10 +133,20 @@ class S3ApiServer:
     def url(self) -> str:
         return f"{self.host}:{self.port}"
 
+    @staticmethod
+    def _debug_local(handler):
+        async def guarded(req: web.Request) -> web.Response:
+            if req.remote not in ("127.0.0.1", "::1"):
+                return web.json_response({"error": "forbidden"},
+                                         status=403)
+            return await handler(req)
+        return guarded
+
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
-            timeout=aiohttp.ClientTimeout(total=3600))
+            timeout=aiohttp.ClientTimeout(total=3600),
+            trace_configs=[aiohttp_trace_config()])
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,

@@ -50,8 +50,8 @@ from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
 from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
 from seaweedfs_tpu.filer.abstract_sql import SqliteStore
 from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFound
-from seaweedfs_tpu.stats import metrics
-from seaweedfs_tpu.utils.http import parse_range
+from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.utils.http import aiohttp_trace_config, parse_range
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
 
@@ -113,7 +113,11 @@ class FilerServer:
                            on_delete_chunks=self.deletion.enqueue_chunks)
         self.conf: FilerConf = load_filer_conf(self.filer.store)
 
-        self.app = web.Application(client_max_size=1024 * 1024 * 1024)
+        self.app = web.Application(
+            client_max_size=1024 * 1024 * 1024,
+            middlewares=[trace.aiohttp_middleware(
+                "filer", slow_exempt=("/__meta__/subscribe",))])
+        self.app.add_routes(trace.debug_routes())
         self.app.add_routes([
             web.get("/__meta__/subscribe", self.handle_meta_subscribe),
             web.post("/__admin__/entry", self.handle_raw_entry),
@@ -177,7 +181,8 @@ class FilerServer:
         self._loop = asyncio.get_running_loop()
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
-            timeout=aiohttp.ClientTimeout(total=60))
+            timeout=aiohttp.ClientTimeout(total=60),
+            trace_configs=[aiohttp_trace_config()])
         self.deletion.start()
         self.filer.meta_log.subscribe(self._fanout_event)
         if self.notification is not None:
@@ -373,40 +378,45 @@ class FilerServer:
                          cipher_key=cipher_key, is_compressed=is_compressed)
 
     async def _fetch_chunk(self, fid: str, cache: bool = True) -> bytes:
-        # disk tiers do blocking IO; mem-only lookups stay inline
-        if self.chunk_cache.tiers:
-            cached = await asyncio.to_thread(self.chunk_cache.get, fid)
-        else:
-            cached = self.chunk_cache.get(fid)
-        if cached is not None:
-            return cached
-        vid = fid.partition(",")[0]
-        async with self._session.get(
-                f"{_tls_scheme()}://{self.master_url}/dir/lookup",
-                params={"volumeId": vid}) as r:
-            locs = (await r.json()).get("locations", [])
-        headers = {}
-        if self.security is not None and self.security.volume_read:
-            from seaweedfs_tpu.security.jwt import gen_jwt
-            headers["Authorization"] = "Bearer " + gen_jwt(
-                self.security.volume_read, fid)
-        last = None
-        for loc in locs:
-            try:
-                async with self._session.get(f"{_tls_scheme()}://{loc['url']}/{fid}",
-                                             headers=headers) as r:
-                    if r.status == 200:
-                        blob = await r.read()
-                        if cache and self.chunk_cache.tiers:
-                            await asyncio.to_thread(self.chunk_cache.put,
-                                                    fid, blob)
-                        elif cache:
-                            self.chunk_cache.put(fid, blob)
-                        return blob
-                    last = f"HTTP {r.status}"
-            except aiohttp.ClientError as e:
-                last = str(e)
-        raise IOError(f"chunk {fid}: {last or 'no locations'}")
+        with trace.span("filer.chunk_fetch", fid=fid) as sp:
+            # disk tiers do blocking IO; mem-only lookups stay inline
+            if self.chunk_cache.tiers:
+                cached = await asyncio.to_thread(self.chunk_cache.get, fid)
+            else:
+                cached = self.chunk_cache.get(fid)
+            if cached is not None:
+                sp.set(cache_hit=True, bytes=len(cached))
+                return cached
+            sp.set(cache_hit=False)
+            vid = fid.partition(",")[0]
+            async with self._session.get(
+                    f"{_tls_scheme()}://{self.master_url}/dir/lookup",
+                    params={"volumeId": vid}) as r:
+                locs = (await r.json()).get("locations", [])
+            headers = {}
+            if self.security is not None and self.security.volume_read:
+                from seaweedfs_tpu.security.jwt import gen_jwt
+                headers["Authorization"] = "Bearer " + gen_jwt(
+                    self.security.volume_read, fid)
+            last = None
+            for loc in locs:
+                try:
+                    async with self._session.get(
+                            f"{_tls_scheme()}://{loc['url']}/{fid}",
+                            headers=headers) as r:
+                        if r.status == 200:
+                            blob = await r.read()
+                            sp.set(peer=loc["url"], bytes=len(blob))
+                            if cache and self.chunk_cache.tiers:
+                                await asyncio.to_thread(
+                                    self.chunk_cache.put, fid, blob)
+                            elif cache:
+                                self.chunk_cache.put(fid, blob)
+                            return blob
+                        last = f"HTTP {r.status}"
+                except aiohttp.ClientError as e:
+                    last = str(e)
+            raise IOError(f"chunk {fid}: {last or 'no locations'}")
 
     async def _decode_chunk_blob(self, blob: bytes, cipher_key: bytes,
                                  is_compressed: bool) -> bytes:
@@ -445,9 +455,12 @@ class FilerServer:
             self._chunk_flight[key] = fut
             fut.add_done_callback(
                 lambda _f, k=key: self._chunk_flight.pop(k, None))
-        else:
-            metrics.FILER_SINGLEFLIGHT_JOINED.labels().inc()
-        return await asyncio.shield(fut)
+            return await asyncio.shield(fut)
+        metrics.FILER_SINGLEFLIGHT_JOINED.labels().inc()
+        # the joined fetch's span belongs to the request that started it;
+        # this request's trace records the wait instead
+        with trace.span("filer.chunk_join", fid=v.fid):
+            return await asyncio.shield(fut)
 
     @staticmethod
     def _readahead_depth() -> int:
@@ -493,8 +506,7 @@ class FilerServer:
         # at scrape time so the bench can read filer cache hit ratio
         for stat, value in self.chunk_cache.stats().items():
             metrics.FILER_CHUNK_CACHE.labels(stat).set(value)
-        return web.Response(text=metrics.REGISTRY.render(),
-                            content_type="text/plain")
+        return metrics.scrape_response(req)
 
     async def handle_raw_entry(self, req: web.Request) -> web.Response:
         """Create/replace an entry from a raw entry dict, chunk refs
@@ -1072,46 +1084,53 @@ class FilerServer:
         # only completed head-of-line tasks are written.
         pos = offset
         depth = self._readahead_depth()
-        if depth <= 0:
-            for v in views:
-                if v.logic_offset > pos:
-                    await _write_zeros(resp, v.logic_offset - pos)
-                    pos = v.logic_offset
-                blob = await self._load_chunk_once(v, cache_chunks)
-                await resp.write(
-                    blob[v.offset_in_chunk:v.offset_in_chunk + v.size])
-                pos += v.size
-        else:
-            from collections import deque
-            pending: deque = deque()
-            nxt = 0
-            try:
-                while nxt < len(views) and len(pending) < depth:
-                    v = views[nxt]
-                    nxt += 1
-                    pending.append((v, asyncio.ensure_future(
-                        self._load_chunk_view(v, cache_chunks))))
-                while pending:
-                    v, task = pending.popleft()
-                    blob = await task
+        # entered as a plain CM so readahead tasks created below inherit
+        # this span as their parent (noop when the request is unsampled)
+        with trace.span("filer.stream_range", chunks=len(views),
+                        offset=offset, length=length, readahead=depth,
+                        cache_chunks=cache_chunks):
+            if depth <= 0:
+                for v in views:
                     if v.logic_offset > pos:
                         await _write_zeros(resp, v.logic_offset - pos)
                         pos = v.logic_offset
+                    blob = await self._load_chunk_once(v, cache_chunks)
                     await resp.write(
                         blob[v.offset_in_chunk:v.offset_in_chunk + v.size])
                     pos += v.size
+            else:
+                from collections import deque
+                pending: deque = deque()
+                nxt = 0
+                try:
                     while nxt < len(views) and len(pending) < depth:
                         v = views[nxt]
                         nxt += 1
                         pending.append((v, asyncio.ensure_future(
                             self._load_chunk_view(v, cache_chunks))))
-            finally:
-                for _, task in pending:
-                    # cancelling a waiter never kills a shared in-flight
-                    # fetch (_load_chunk_view shields the real future)
-                    task.cancel()
-        if pos < offset + length:
-            await _write_zeros(resp, offset + length - pos)
+                    while pending:
+                        v, task = pending.popleft()
+                        blob = await task
+                        if v.logic_offset > pos:
+                            await _write_zeros(resp, v.logic_offset - pos)
+                            pos = v.logic_offset
+                        await resp.write(
+                            blob[v.offset_in_chunk:
+                                 v.offset_in_chunk + v.size])
+                        pos += v.size
+                        while nxt < len(views) and len(pending) < depth:
+                            v = views[nxt]
+                            nxt += 1
+                            pending.append((v, asyncio.ensure_future(
+                                self._load_chunk_view(v, cache_chunks))))
+                finally:
+                    for _, task in pending:
+                        # cancelling a waiter never kills a shared
+                        # in-flight fetch (_load_chunk_view shields the
+                        # real future)
+                        task.cancel()
+            if pos < offset + length:
+                await _write_zeros(resp, offset + length - pos)
 
     async def _list_directory(self, req: web.Request,
                               path: str) -> web.Response:

@@ -21,7 +21,8 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
-from seaweedfs_tpu.stats import metrics
+from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.topology.topology import Topology
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
@@ -78,8 +79,12 @@ class MasterServer:
                 apply_command=self._raft_apply,
                 take_snapshot=self._raft_take_snapshot,
                 restore_snapshot=self._raft_restore_snapshot)
-        self.app = web.Application(client_max_size=64 * 1024 * 1024,
-                                   middlewares=[self._guard_middleware])
+        self.app = web.Application(
+            client_max_size=64 * 1024 * 1024,
+            middlewares=[self._guard_middleware,
+                         trace.aiohttp_middleware(
+                             "master", slow_exempt=("/cluster/stream",))])
+        self.app.add_routes(trace.debug_routes())
         self.app.add_routes([
             web.route("*", "/dir/assign", self.handle_assign),
             web.get("/dir/lookup", self.handle_lookup),
@@ -134,7 +139,8 @@ class MasterServer:
         await asyncio.to_thread(pb.available)
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
-            timeout=aiohttp.ClientTimeout(total=30))
+            timeout=aiohttp.ClientTimeout(total=30),
+            trace_configs=[aiohttp_trace_config()])
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
@@ -417,8 +423,7 @@ class MasterServer:
             content_type="text/html")
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
-        return web.Response(text=metrics.REGISTRY.render(),
-                            content_type="text/plain")
+        return metrics.scrape_response(req)
 
     async def handle_heartbeat(self, req: web.Request) -> web.Response:
         if not self.is_leader:

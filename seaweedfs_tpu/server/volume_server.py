@@ -24,7 +24,8 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security import jwt as sjwt
-from seaweedfs_tpu.stats import metrics
+from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import ec_files, ec_volume as ecv, layout
@@ -120,7 +121,10 @@ class VolumeServer:
         self.store = Store(directories, max_volumes, self.public_url)
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
 
-        self.app = web.Application(client_max_size=256 * 1024 * 1024)
+        self.app = web.Application(
+            client_max_size=256 * 1024 * 1024,
+            middlewares=[trace.aiohttp_middleware("volume")])
+        self.app.add_routes(trace.debug_routes())
         self.app.add_routes([
             web.get("/", self.handle_ui),
             web.get("/status", self.handle_status),
@@ -184,7 +188,8 @@ class VolumeServer:
         await asyncio.to_thread(pb.available)
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
-            timeout=aiohttp.ClientTimeout(total=300))
+            timeout=aiohttp.ClientTimeout(total=300),
+            trace_configs=[aiohttp_trace_config()])
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
@@ -408,16 +413,19 @@ class VolumeServer:
         async def one(peer: str) -> str | None:
             url = f"{_tls_scheme()}://{peer}/{fid}?type=replicate"
             try:
-                if method == "PUT":
-                    async with self._session.put(url, data=data,
-                                                 headers=headers) as r:
-                        if r.status >= 300:
-                            return f"replica write to {peer}: {r.status}"
-                else:
-                    async with self._session.delete(url,
-                                                    headers=headers) as r:
-                        if r.status >= 300:
-                            return f"replica delete to {peer}: {r.status}"
+                with trace.span("volume.replicate_peer", peer=peer,
+                                method=method):
+                    if method == "PUT":
+                        async with self._session.put(url, data=data,
+                                                     headers=headers) as r:
+                            if r.status >= 300:
+                                return f"replica write to {peer}: {r.status}"
+                    else:
+                        async with self._session.delete(url,
+                                                        headers=headers) as r:
+                            if r.status >= 300:
+                                return \
+                                    f"replica delete to {peer}: {r.status}"
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 return f"replica {method} to {peer} failed: {e!r}"
             return None
@@ -425,8 +433,10 @@ class VolumeServer:
         # return_exceptions so one unexpected failure cannot abandon the
         # sibling writes as detached tasks that land AFTER the error is
         # reported — every peer's outcome is awaited and folded in
-        results = await asyncio.gather(*(one(p) for p in peers),
-                                       return_exceptions=True)
+        with trace.span("volume.replicate", peers=len(peers),
+                        method=method):
+            results = await asyncio.gather(*(one(p) for p in peers),
+                                           return_exceptions=True)
         for err in results:
             if isinstance(err, BaseException):
                 return f"replica {method} failed: {err!r}"
@@ -632,7 +642,13 @@ class VolumeServer:
     def _shard_reader(self, vid: int):
         """Remote-shard fetch for EC degraded reads: ask the master where
         each shard lives, pull the byte range from a peer
-        (reference: store_ec.go readRemoteEcShardInterval)."""
+        (reference: store_ec.go readRemoteEcShardInterval).  The trace
+        context is captured HERE, on the event loop, because read() runs
+        on executor pool threads that never see the request's copied
+        context — the captured Trace parents the per-fetch spans and
+        rides the X-Weedtpu-Trace header to the peer."""
+        tctx = trace.current()
+
         def read(shard_id: int, offset: int, size: int) -> bytes | None:
             # runs inside a worker thread: use a blocking http client
             import urllib.request
@@ -642,11 +658,28 @@ class VolumeServer:
                     if loc["url"] == self.url:
                         continue
                     try:
-                        req = (f"{_tls_scheme()}://{loc['url']}/admin/ec/shard_read?"
-                               f"volume={vid}&shard={shard_id}"
-                               f"&offset={offset}&size={size}")
-                        with urllib.request.urlopen(req, timeout=30) as rr:
-                            data = rr.read()
+                        with trace.span("volume.shard_fetch", parent=tctx,
+                                        vid=vid, shard=shard_id,
+                                        peer=loc["url"],
+                                        bytes=size) as sp:
+                            req = urllib.request.Request(
+                                f"{_tls_scheme()}://{loc['url']}"
+                                f"/admin/ec/shard_read?"
+                                f"volume={vid}&shard={shard_id}"
+                                f"&offset={offset}&size={size}")
+                            # the peer's span must parent to THIS fetch
+                            # span, not the request root, or the trace
+                            # tree misattributes the peer's time
+                            hdr_ctx = sp.trace or tctx
+                            if hdr_ctx is not None:
+                                req.add_header(
+                                    trace.TRACE_HEADER,
+                                    trace.format_header(hdr_ctx))
+                            with urllib.request.urlopen(req,
+                                                        timeout=30) as rr:
+                                data = rr.read()
+                            if len(data) != size:
+                                sp.set(short=len(data))
                         if len(data) == size:
                             return data
                     except OSError:
@@ -704,8 +737,7 @@ class VolumeServer:
                     totals[stat] = totals.get(stat, 0) + v
         for stat, v in totals.items():
             metrics.EC_DEGRADED_READ.labels(stat).set(v)
-        return web.Response(text=metrics.REGISTRY.render(),
-                            content_type="text/plain")
+        return metrics.scrape_response(req)
 
     async def handle_assign_volume(self, req: web.Request) -> web.Response:
         body = await req.json()

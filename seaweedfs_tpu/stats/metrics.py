@@ -10,7 +10,11 @@ from __future__ import annotations
 
 import threading
 import time
+import urllib.error
 import urllib.request
+
+from seaweedfs_tpu.stats import trace as _trace
+from seaweedfs_tpu.utils import weedlog
 
 _DEFAULT_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -78,10 +82,22 @@ class Counter(_Metric):
     kind = "counter"
     _new_child = staticmethod(_CounterValue)
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+    def render(self, openmetrics: bool = False) -> list[str]:
+        name = self.name
+        if openmetrics:
+            # OpenMetrics names the counter FAMILY without _total and the
+            # samples WITH it — a negotiating Prometheus rejects the whole
+            # scrape otherwise
+            family = name[:-6] if name.endswith("_total") else name
+            out = [f"# HELP {family} {self.help}",
+                   f"# TYPE {family} counter"]
+            for labels, child in self._pairs():
+                out.append(
+                    f"{family}_total{_fmt_labels(labels)} {child.value}")
+            return out
+        out = [f"# HELP {name} {self.help}", f"# TYPE {name} counter"]
         for labels, child in self._pairs():
-            out.append(f"{self.name}{_fmt_labels(labels)} {child.value}")
+            out.append(f"{name}{_fmt_labels(labels)} {child.value}")
         return out
 
 
@@ -106,23 +122,32 @@ class Gauge(_Metric):
 
 
 class _HistogramValue:
-    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+    __slots__ = ("buckets", "counts", "total", "count", "exemplars",
+                 "_lock")
 
     def __init__(self, buckets=_DEFAULT_BUCKETS):
         self.buckets = buckets
         self.counts = [0] * len(buckets)
         self.total = 0.0
         self.count = 0
+        # last sampled-trace observation per bucket (+Inf last):
+        # (value, trace_id, unix_ts) — the exemplar that lets a latency
+        # bucket link to a trace in /debug/traces
+        self.exemplars: list[tuple | None] = [None] * (len(buckets) + 1)
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: str | None = None) -> None:
         with self._lock:
             self.total += value
             self.count += 1
+            slot = len(self.buckets)
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self.counts[i] += 1
+                    slot = i
                     break
+            if trace_id is not None:
+                self.exemplars[slot] = (value, trace_id, time.time())
 
     def time(self):
         return _Timer(self)
@@ -137,8 +162,18 @@ class _Timer:
         return self
 
     def __exit__(self, *exc):
-        self._hist.observe(time.perf_counter() - self._t0)
+        self._hist.observe(time.perf_counter() - self._t0,
+                           _trace.current_exemplar())
         return False
+
+
+def _exemplar_suffix(ex: tuple | None) -> str:
+    """OpenMetrics exemplar: ` # {trace_id="..."} value timestamp` — links
+    a latency bucket to a sampled trace in /debug/traces."""
+    if ex is None:
+        return ""
+    value, trace_id, ts = ex
+    return f' # {{trace_id="{_esc(trace_id)}"}} {value} {round(ts, 3)}'
 
 
 class Histogram(_Metric):
@@ -151,18 +186,21 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistogramValue(self._buckets)
 
-    def render(self) -> list[str]:
+    def render(self, openmetrics: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         for labels, child in self._pairs():
             cum = 0
-            for b, c in zip(child.buckets, child.counts):
+            for i, (b, c) in enumerate(zip(child.buckets, child.counts)):
                 cum += c
                 le = f'le="{b}"'
+                ex = _exemplar_suffix(child.exemplars[i]) \
+                    if openmetrics else ""
                 out.append(f"{self.name}_bucket"
-                           f"{_fmt_labels(labels, le)} {cum}")
+                           f"{_fmt_labels(labels, le)} {cum}{ex}")
             inf = 'le="+Inf"'
+            ex = _exemplar_suffix(child.exemplars[-1]) if openmetrics else ""
             out.append(f"{self.name}_bucket"
-                       f"{_fmt_labels(labels, inf)} {child.count}")
+                       f"{_fmt_labels(labels, inf)} {child.count}{ex}")
             out.append(f"{self.name}_sum{_fmt_labels(labels)} {child.total}")
             out.append(f"{self.name}_count{_fmt_labels(labels)} {child.count}")
         return out
@@ -190,22 +228,94 @@ class Registry:
                   buckets=_DEFAULT_BUCKETS) -> Histogram:
         return self._register(Histogram(name, help_text, tuple(labels), buckets))
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
         lines: list[str] = []
         for m in metrics:
-            lines.extend(m.render())
+            if isinstance(m, (Histogram, Counter)):
+                lines.extend(m.render(openmetrics))
+            else:
+                lines.extend(m.render())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
-    def push(self, gateway_url: str, job: str) -> None:
-        """Push-gateway support (stats/metrics.go:14 StartPushingMetric)."""
+    def push(self, gateway_url: str, job: str) -> bool:
+        """One push-gateway PUT (stats/metrics.go:14 StartPushingMetric).
+        A gateway failure is a monitoring problem, not a server problem:
+        it is logged at V(1) and reported as False — never raised into
+        the caller's loop.  Retry cadence lives in MetricsPusher."""
         body = self.render().encode()
         req = urllib.request.Request(
             f"{gateway_url.rstrip('/')}/metrics/job/{job}",
             data=body, method="PUT",
             headers={"Content-Type": "text/plain"})
-        urllib.request.urlopen(req, timeout=5).close()
+        try:
+            urllib.request.urlopen(req, timeout=5).close()
+            return True
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            weedlog.V(1, "metrics").infof(
+                "metrics push to %s failed: %s", gateway_url, e)
+            return False
+
+
+class MetricsPusher:
+    """Background push-gateway loop (stats/metrics.go StartPushingMetric):
+    pushes every `interval` seconds, backing off exponentially (capped at
+    `max_backoff`) while the gateway is unreachable, and stop()s cleanly
+    at shutdown."""
+
+    def __init__(self, registry: Registry, gateway_url: str, job: str,
+                 interval: float = 15.0, max_backoff: float = 300.0):
+        self.registry = registry
+        self.gateway_url = gateway_url
+        self.job = job
+        self.interval = interval
+        self.max_backoff = max_backoff
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="metrics-pusher", daemon=True)
+
+    def start(self) -> "MetricsPusher":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        delay = self.interval
+        while not self._stop.wait(delay):
+            if self.registry.push(self.gateway_url, self.job):
+                self.failures = 0
+                delay = self.interval
+            else:
+                self.failures += 1
+                delay = min(self.interval * (2 ** self.failures),
+                            self.max_backoff)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+
+def start_pushing(gateway_url: str, job: str, interval: float = 15.0,
+                  registry: "Registry | None" = None) -> MetricsPusher:
+    """stats/metrics.go StartPushingMetric: spawn the pusher thread."""
+    return MetricsPusher(registry or REGISTRY, gateway_url, job,
+                         interval).start()
+
+
+def scrape_response(req):
+    """Shared aiohttp /metrics response with content negotiation: the
+    OpenMetrics rendering (exemplars linking latency buckets to trace
+    ids) when the scraper asks for it, Prometheus text 0.0.4 otherwise."""
+    from aiohttp import web
+    if "application/openmetrics-text" in req.headers.get("Accept", ""):
+        return web.Response(text=REGISTRY.render(openmetrics=True),
+                            content_type="application/openmetrics-text")
+    return web.Response(text=REGISTRY.render(),
+                        content_type="text/plain")
 
 
 # Global registry + the standard gauges/counters each role uses

@@ -1,0 +1,401 @@
+"""Request tracing: context-propagated spans in a per-process ring buffer.
+
+The concurrent data paths (PRs 1-2) span filer -> volume server -> peer
+shard fetch -> batched reconstruct; aggregate counters can't show WHERE
+one slow degraded read spent its time.  This module is the whole tracing
+runtime:
+
+- a `Trace` (128-bit trace id, current span id, sampled flag) carried in a
+  contextvar, so it follows the request across `await`s and into
+  `asyncio.to_thread` workers (both copy the context);
+- cross-process propagation via the `X-Weedtpu-Trace` header
+  (`<trace_id>-<span_id>-<flags>`, flags bit 0 = sampled) — injected by
+  utils/http.py for the pooled blocking client and by the aiohttp client
+  trace-config, extracted by the aiohttp server middleware below;
+- `span(name, **attrs)` context managers recording finished spans into a
+  bounded ring buffer.  Appends are lock-free (one itertools.count next()
+  + a slot store, both atomic under the GIL) and an UNSAMPLED request
+  allocates nothing: span() returns a shared no-op singleton.
+
+Sampling (`WEEDTPU_TRACE_SAMPLE`, default 16 = keep 1/16): every Nth root
+request is fully traced; 0 disables local sampling entirely.  Unsampled
+requests still get a retroactive root span when they finish slow
+(> `WEEDTPU_SLOW_MS`) or errored (status >= 500) — the "keep slow +
+errored" default — plus a slow-request log line.  An incoming sampled
+header always wins over the local rate, so one trace id survives every
+hop of a cross-server request no matter how each server samples.
+
+Introspection, mounted on every server via `debug_routes()`:
+  /debug/traces    recent traces as JSON, ?min_ms= filters, ?limit=
+  /debug/requests  in-flight requests with age — finds the hung peer
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import time
+from contextvars import ContextVar
+
+from seaweedfs_tpu.utils import weedlog
+
+TRACE_HEADER = "X-Weedtpu-Trace"
+
+_rand = random.Random()
+
+
+class Trace:
+    """Immutable trace context: who we are inside which trace."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+
+_current: ContextVar[Trace | None] = ContextVar("weedtpu_trace",
+                                                default=None)
+
+
+def sample_rate() -> int:
+    """1-in-N root sampling; 0 disables local sampling (env read per
+    request so the bench can flip it between interleaved reps)."""
+    try:
+        return int(os.environ.get("WEEDTPU_TRACE_SAMPLE", "16"))
+    except ValueError:
+        return 16
+
+
+def slow_ms() -> float:
+    try:
+        return float(os.environ.get("WEEDTPU_SLOW_MS", "1000"))
+    except ValueError:
+        return 1000.0
+
+
+def _new_trace_id() -> str:
+    return f"{_rand.getrandbits(128):032x}"
+
+
+def _new_span_id() -> str:
+    return f"{_rand.getrandbits(64):016x}"
+
+
+def current() -> Trace | None:
+    return _current.get()
+
+
+def current_exemplar() -> str | None:
+    """Trace id for histogram exemplars — only sampled traces qualify."""
+    t = _current.get()
+    return t.trace_id if t is not None and t.sampled else None
+
+
+def format_header(t: Trace) -> str:
+    return f"{t.trace_id}-{t.span_id}-{1 if t.sampled else 0}"
+
+
+def parse_header(value: str) -> Trace | None:
+    parts = value.split("-")
+    if len(parts) != 3 or len(parts[0]) != 32 or len(parts[1]) != 16:
+        return None
+    try:
+        int(parts[0], 16), int(parts[1], 16)
+    except ValueError:
+        return None
+    return Trace(parts[0], parts[1], parts[2] == "1")
+
+
+def inject(headers: dict) -> dict:
+    """Stamp the current trace context into an outgoing header dict
+    (the blocking-client injection point; aiohttp clients go through
+    aiohttp_trace_config below)."""
+    t = _current.get()
+    if t is not None:
+        headers[TRACE_HEADER] = format_header(t)
+    return headers
+
+
+# -- ring buffer --------------------------------------------------------
+
+def _ring_capacity() -> int:
+    try:
+        return max(64, int(os.environ.get("WEEDTPU_TRACE_BUF", "4096")))
+    except ValueError:
+        return 4096
+
+
+class _Ring:
+    """Fixed-capacity overwrite-oldest span store.  append() is one
+    atomic counter bump plus one list-slot store — no lock, no growth;
+    readers snapshot by copying the slot list."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._slots: list[dict | None] = [None] * capacity
+        self._n = itertools.count()
+
+    def append(self, rec: dict) -> None:
+        self._slots[next(self._n) % self.capacity] = rec
+
+    def snapshot(self) -> list[dict]:
+        return [r for r in list(self._slots) if r is not None]
+
+    def clear(self) -> None:
+        self._slots = [None] * self.capacity
+        self._n = itertools.count()
+
+
+_ring = _Ring(_ring_capacity())
+
+
+def ring_snapshot() -> list[dict]:
+    return _ring.snapshot()
+
+
+def reset_ring() -> None:
+    _ring.clear()
+
+
+# -- spans --------------------------------------------------------------
+
+class _NoopSpan:
+    """Shared do-nothing span for sampled-out requests: entering,
+    exiting, and set() must cost nothing and allocate nothing."""
+
+    __slots__ = ()
+    trace = None  # parity with _Span for callers that propagate headers
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "trace", "parent_id", "attrs", "error",
+                 "_t0", "_start", "_token")
+
+    def __init__(self, name: str, parent: Trace, attrs: dict):
+        self.name = name
+        self.trace = Trace(parent.trace_id, _new_span_id(), True)
+        self.parent_id = parent.span_id
+        self.attrs = attrs
+        self.error = False
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self):
+        self._token = _current.set(self.trace)
+        self._start = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        _current.reset(self._token)
+        record_span(self.name, self.trace.trace_id, self.trace.span_id,
+                    self.parent_id, self._start, dur * 1000.0,
+                    self.attrs, self.error or exc_type is not None)
+        return False
+
+
+def span(name: str, parent: Trace | None = None, **attrs):
+    """Span context manager.  Uses the ambient contextvar trace unless
+    `parent` is passed explicitly (worker threads that were handed a
+    captured Trace rather than a copied context).  Sampled out -> the
+    shared no-op singleton, zero allocation."""
+    t = parent if parent is not None else _current.get()
+    if t is None or not t.sampled:
+        return _NOOP
+    return _Span(name, t, attrs)
+
+
+def record_span(name: str, trace_id: str, span_id: str,
+                parent_id: str | None, start: float, ms: float,
+                attrs: dict | None = None, error: bool = False) -> None:
+    rec = {"name": name, "trace": trace_id, "span": span_id,
+           "parent": parent_id, "start": start, "ms": round(ms, 3)}
+    if attrs:
+        rec["attrs"] = attrs
+    if error:
+        rec["error"] = True
+    _ring.append(rec)
+
+
+def traces(min_ms: float = 0.0, limit: int = 50) -> list[dict]:
+    """Recent traces, newest first: spans grouped by trace id, trace
+    duration = the span envelope (covers cross-server spans recorded by
+    different middlewares into one shared ring in tests)."""
+    by_trace: dict[str, list[dict]] = {}
+    for rec in _ring.snapshot():
+        by_trace.setdefault(rec["trace"], []).append(rec)
+    out = []
+    for tid, spans in by_trace.items():
+        spans.sort(key=lambda r: r["start"])
+        t0 = spans[0]["start"]
+        t1 = max(r["start"] + r["ms"] / 1000.0 for r in spans)
+        total = (t1 - t0) * 1000.0
+        if total < min_ms:
+            continue
+        out.append({"trace_id": tid, "start": t0,
+                    "ms": round(total, 3),
+                    "error": any(r.get("error") for r in spans),
+                    "spans": spans})
+    out.sort(key=lambda t: t["start"], reverse=True)
+    return out[:max(1, limit)]
+
+
+# -- in-flight request registry -----------------------------------------
+
+_inflight: dict[int, dict] = {}
+_inflight_seq = itertools.count(1)
+
+
+def request_started(method: str, path: str, remote: str | None,
+                    trace_id: str | None) -> int:
+    rid = next(_inflight_seq)
+    _inflight[rid] = {"id": rid, "method": method, "path": path,
+                      "remote": remote or "", "trace_id": trace_id or "",
+                      "start": time.time(), "_t0": time.perf_counter()}
+    return rid
+
+
+def request_finished(rid: int) -> None:
+    _inflight.pop(rid, None)
+
+
+def inflight() -> list[dict]:
+    now = time.perf_counter()
+    out = []
+    for rec in list(_inflight.values()):
+        r = {k: v for k, v in rec.items() if not k.startswith("_")}
+        r["age_ms"] = round((now - rec["_t0"]) * 1000.0, 1)
+        out.append(r)
+    out.sort(key=lambda r: r["age_ms"], reverse=True)
+    return out
+
+
+# -- aiohttp server glue ------------------------------------------------
+
+def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
+    """Server-side half of the propagation: extract X-Weedtpu-Trace (or
+    make a root sampling decision), register the request in the in-flight
+    table, and on completion record the root span — always for sampled
+    requests, retroactively for unsampled ones that finished slow or
+    errored (with a slow-request log line either way).  `slow_exempt`
+    lists long-poll paths (meta subscribe and friends) whose lifetime IS
+    their duration — they'd otherwise bury real outliers in the ring.
+    Client disconnects (CancelledError) are neither slow nor errored."""
+    import asyncio
+    from aiohttp import web
+
+    counter = itertools.count(1)
+
+    @web.middleware
+    async def middleware(req: web.Request, handler):
+        hdr = req.headers.get(TRACE_HEADER)
+        t_in = parse_header(hdr) if hdr else None
+        rate = sample_rate()
+        parent_id = None
+        if t_in is not None:
+            # continue the caller's trace under a fresh span id — the
+            # header's span id is the CALLER's current span, our parent
+            parent_id = t_in.span_id
+            t = Trace(t_in.trace_id, _new_span_id(), t_in.sampled)
+        elif rate > 0 and next(counter) % rate == 0:
+            t = Trace(_new_trace_id(), _new_span_id(), True)
+        else:
+            t = None
+        token = _current.set(t) if t is not None else None
+        rid = request_started(req.method, req.path_qs, req.remote,
+                              t.trace_id if t is not None else None)
+        start = time.time()
+        t0 = time.perf_counter()
+        status = 500
+        cancelled = False
+        try:
+            resp = await handler(req)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except (asyncio.CancelledError, ConnectionResetError,
+                BrokenPipeError):
+            # the client hung up (cancelled handler, or resp.write onto
+            # a closed transport): a fact about the caller, not a server
+            # error — trace it if sampled, never retro-keep or slow-log
+            cancelled = True
+            raise
+        finally:
+            ms = (time.perf_counter() - t0) * 1000.0
+            request_finished(rid)
+            if token is not None:
+                _current.reset(token)
+            slow = ms >= slow_ms() and not cancelled and \
+                req.path not in slow_exempt
+            errored = status >= 500 and not cancelled
+            if t is not None and t.sampled:
+                attrs = {"method": req.method, "path": req.path,
+                         "status": status, "server": role}
+                if cancelled:
+                    attrs["cancelled"] = True
+                record_span(f"{role}.request", t.trace_id, t.span_id,
+                            parent_id, start, ms, attrs, errored)
+            elif rate > 0 and (slow or errored):
+                # keep slow + errored even when sampled out: a root span
+                # appears retroactively (children were skipped, but the
+                # trace id in the log line finds it in /debug/traces)
+                retro = t or Trace(_new_trace_id(), _new_span_id(), True)
+                record_span(f"{role}.request", retro.trace_id,
+                            retro.span_id, None, start, ms,
+                            {"method": req.method, "path": req.path,
+                             "status": status, "server": role,
+                             "retro": True}, errored)
+                t = retro
+            if slow and rate > 0:
+                weedlog.info(
+                    "slow request: %s %s %s took %.1fms (status %d) "
+                    "trace=%s", role, req.method, req.path_qs, ms,
+                    status, t.trace_id if t is not None else "-",
+                    name="trace")
+
+    return middleware
+
+
+async def handle_debug_traces(req):
+    from aiohttp import web
+    try:
+        min_ms = float(req.query.get("min_ms", "0"))
+    except ValueError:
+        min_ms = 0.0
+    try:
+        limit = int(req.query.get("limit", "50"))
+    except ValueError:
+        limit = 50
+    return web.json_response({"sample_rate": sample_rate(),
+                              "traces": traces(min_ms, limit)})
+
+
+async def handle_debug_requests(req):
+    from aiohttp import web
+    return web.json_response({"requests": inflight()})
+
+
+def debug_routes():
+    """Routes every server mounts (before any catch-all)."""
+    from aiohttp import web
+    return [web.get("/debug/traces", handle_debug_traces),
+            web.get("/debug/requests", handle_debug_requests)]

@@ -34,6 +34,7 @@ from typing import Callable
 
 import numpy as np
 
+from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.storage import idx as idxf
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
@@ -258,6 +259,10 @@ class EcVolume:
                 data = None if fut.exception() else fut.result()
                 if data is not None:
                     results[futs[fut]] = data
+                    if len(results) >= k:
+                        break  # enough survivors: no wasted disk reads
+            for fut in futs:
+                fut.cancel()  # drop un-started stragglers
         self._bump("local_shard_reads", len(results) * len(segs))
         if len(results) < k and shard_reader is not None:
             need = k - len(results)
@@ -322,7 +327,9 @@ class EcVolume:
             return out  # type: ignore[return-value]
         wanted = sorted({ranges[i][0] for i in todo})
         segs = [(ranges[i][1], ranges[i][2]) for i in todo]
-        rows = self._gather_survivors(set(wanted), segs, shard_reader)
+        with trace.span("ec.gather_survivors", shards_lost=len(wanted),
+                        segs=len(segs)):
+            rows = self._gather_survivors(set(wanted), segs, shard_reader)
         codec = ec_files._get_codec()
         # one dispatch decodes every wanted shard over the WHOLE
         # concatenation even though each segment only consumes its own
@@ -330,7 +337,10 @@ class EcVolume:
         # (f-1)/f of the matmul OUTPUT (microseconds at KB batch sizes),
         # while splitting into per-shard dispatches multiplies the
         # per-call orchestration cost this engine exists to amortize
-        rebuilt = ec_files._reconstruct_batch(codec, rows, wanted)
+        with trace.span("ec.reconstruct_batch", intervals=len(todo),
+                        shards=len(wanted),
+                        bytes=sum(s for _, s in segs)):
+            rebuilt = ec_files._reconstruct_batch(codec, rows, wanted)
         self._bump("reconstruct_batches")
         self._bump("reconstruct_intervals", len(todo))
         pos = 0
@@ -352,20 +362,22 @@ class EcVolume:
         # ranges (a needle spanning whole stripe rows lands contiguous
         # blocks in each shard file), remembering how each original
         # interval slices back out of its merged read
-        per_shard: dict[int, list[tuple[int, int, int]]] = {}
-        for i, (sid, off, size) in enumerate(plan):
-            per_shard.setdefault(sid, []).append((off, size, i))
-        reads: list[list] = []  # [sid, off, size, [(idx, rel_off, sz)..]]
-        for sid, lst in per_shard.items():
-            lst.sort()
-            cur: list | None = None
-            for off, size, idx in lst:
-                if cur is not None and cur[1] + cur[2] == off:
-                    cur[3].append((idx, cur[2], size))
-                    cur[2] += size
-                else:
-                    cur = [sid, off, size, [(idx, 0, size)]]
-                    reads.append(cur)
+        with trace.span("ec.coalesce", intervals=len(plan)) as csp:
+            per_shard: dict[int, list[tuple[int, int, int]]] = {}
+            for i, (sid, off, size) in enumerate(plan):
+                per_shard.setdefault(sid, []).append((off, size, i))
+            reads: list[list] = []  # [sid, off, size, [(idx, rel_off, sz)..]]
+            for sid, lst in per_shard.items():
+                lst.sort()
+                cur: list | None = None
+                for off, size, idx in lst:
+                    if cur is not None and cur[1] + cur[2] == off:
+                        cur[3].append((idx, cur[2], size))
+                        cur[2] += size
+                    else:
+                        cur = [sid, off, size, [(idx, 0, size)]]
+                        reads.append(cur)
+            csp.set(reads=len(reads))
         if len(plan) > len(reads):
             self._bump("intervals_coalesced", len(plan) - len(reads))
 
@@ -382,45 +394,49 @@ class EcVolume:
             else:
                 probe.append(ri)
         # local reads, concurrent when there is anything to overlap
-        if len(probe) == 1:
-            ri = probe[0]
-            sid, off, size, _ = reads[ri]
-            data = self._read_local(sid, off, size)
-            if data is not None and len(data) == size:
-                blobs[ri] = data
-                self._bump("local_shard_reads")
-            else:
-                failed.append(ri)
-        elif probe:
-            pool = _read_pool()
-            futs = {pool.submit(self._read_local, *reads[ri][:3]): ri
-                    for ri in probe}
-            for fut in as_completed(futs):
-                ri = futs[fut]
-                data = None if fut.exception() else fut.result()
-                if data is not None and len(data) == reads[ri][2]:
+        with trace.span("ec.local_pread", reads=len(probe)) as lsp:
+            if len(probe) == 1:
+                ri = probe[0]
+                sid, off, size, _ = reads[ri]
+                data = self._read_local(sid, off, size)
+                if data is not None and len(data) == size:
                     blobs[ri] = data
                     self._bump("local_shard_reads")
                 else:
                     failed.append(ri)
-        # remote fetch of whatever the local disks couldn't serve — on a
-        # throwaway pool so a hung peer can't starve the shared pread pool
-        if failed and shard_reader is not None:
-            still: list[int] = []
-            rpool = ThreadPoolExecutor(max_workers=min(8, len(failed)))
-            try:
-                futs = {rpool.submit(shard_reader, *reads[ri][:3]): ri
-                        for ri in failed}
+            elif probe:
+                pool = _read_pool()
+                futs = {pool.submit(self._read_local, *reads[ri][:3]): ri
+                        for ri in probe}
                 for fut in as_completed(futs):
                     ri = futs[fut]
                     data = None if fut.exception() else fut.result()
                     if data is not None and len(data) == reads[ri][2]:
                         blobs[ri] = data
-                        self._bump("remote_shard_reads")
+                        self._bump("local_shard_reads")
                     else:
-                        still.append(ri)
-            finally:
-                rpool.shutdown(wait=False, cancel_futures=True)
+                        failed.append(ri)
+            lsp.set(missed=len(failed))
+        # remote fetch of whatever the local disks couldn't serve — on a
+        # throwaway pool so a hung peer can't starve the shared pread pool
+        if failed and shard_reader is not None:
+            still: list[int] = []
+            with trace.span("ec.remote_fetch", reads=len(failed)) as rsp:
+                rpool = ThreadPoolExecutor(max_workers=min(8, len(failed)))
+                try:
+                    futs = {rpool.submit(shard_reader, *reads[ri][:3]): ri
+                            for ri in failed}
+                    for fut in as_completed(futs):
+                        ri = futs[fut]
+                        data = None if fut.exception() else fut.result()
+                        if data is not None and len(data) == reads[ri][2]:
+                            blobs[ri] = data
+                            self._bump("remote_shard_reads")
+                        else:
+                            still.append(ri)
+                finally:
+                    rpool.shutdown(wait=False, cancel_futures=True)
+                rsp.set(missed=len(still))
             failed = still
         # one-shot batched reconstruction of every range still missing
         if failed:
@@ -442,16 +458,18 @@ class EcVolume:
         """Full needle read: locate -> plan all intervals -> batched shard
         reads + one-shot reconstruction -> parse.  `mode` (or
         WEEDTPU_EC_READ) = "serial" restores the per-interval loop."""
-        dat_offset, size = self.find_needle(needle_id)
-        length = t.actual_size(size, self.version)
-        intervals = layout.locate_data(
-            self.large_block, self.small_block, self.dat_size,
-            dat_offset, length)
-        plan = []
-        for iv in intervals:
-            sid, off = iv.to_shard_id_and_offset(self.large_block,
-                                                 self.small_block)
-            plan.append((sid, off, iv.size))
+        with trace.span("ec.plan", needle=f"{needle_id:x}") as psp:
+            dat_offset, size = self.find_needle(needle_id)
+            length = t.actual_size(size, self.version)
+            intervals = layout.locate_data(
+                self.large_block, self.small_block, self.dat_size,
+                dat_offset, length)
+            plan = []
+            for iv in intervals:
+                sid, off = iv.to_shard_id_and_offset(self.large_block,
+                                                     self.small_block)
+                plan.append((sid, off, iv.size))
+            psp.set(intervals=len(plan), bytes=length)
         mode = mode or os.environ.get("WEEDTPU_EC_READ", "batched")
         if mode == "serial":
             parts = [self.read_interval(sid, off, size, shard_reader)

@@ -8,6 +8,26 @@ import threading
 import time
 import urllib.parse
 
+from seaweedfs_tpu.stats import trace as _trace
+
+
+def aiohttp_trace_config():
+    """aiohttp client half of trace propagation: a TraceConfig whose
+    on_request_start stamps X-Weedtpu-Trace from the ambient contextvar
+    (requests made outside any trace are untouched).  Every server's
+    ClientSession mounts this so filer->volume->peer hops share one
+    trace id."""
+    import aiohttp
+
+    async def _on_request_start(session, ctx, params) -> None:
+        t = _trace.current()
+        if t is not None:
+            params.headers[_trace.TRACE_HEADER] = _trace.format_header(t)
+
+    tc = aiohttp.TraceConfig()
+    tc.on_request_start.append(_on_request_start)
+    return tc
+
 
 class _BadResponse(http.client.HTTPException):
     pass
@@ -271,6 +291,10 @@ class PooledHTTP:
             body = bytes(body)
         elif isinstance(body, str):
             body = body.encode()
+        # trace propagation: requests made inside a traced context carry
+        # it to the peer (a copy, never mutating the caller's dict)
+        if _trace.current() is not None:
+            headers = _trace.inject(dict(headers or {}))
         last: Exception | None = None
         for attempt in range(2):
             if attempt:

@@ -4,15 +4,46 @@ Reference: weed/glog/glog.go — `glog.V(n).Infof(...)` gates chatty logs by a
 `-v` flag; errors/warnings always print. Here `V(n)` returns a logger bound
 to DEBUG when n <= the process verbosity, else a no-op, layered on stdlib
 logging so handlers/formatting stay standard.
+
+Per-module overrides mirror glog's `-vmodule`: `WEEDTPU_VMODULE=
+ec_volume=2,http=1` (or `set_vmodule()`) raises the effective verbosity for
+just those logger names, so trace-level logging can be turned on for one
+subsystem without drowning the rest.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 _verbosity = 0
 _configured = False
+_vmodule: dict[str, int] = {}
+
+
+def set_vmodule(spec: str) -> None:
+    """Parse a glog -vmodule spec (`name=level,name=level`).  Module names
+    match the `name` argument of V().  Each named logger's stdlib level is
+    pinned to DEBUG so its gated records pass even when the root logger
+    sits at INFO; modules dropped from the spec revert to inheriting."""
+    old = set(_vmodule)
+    _vmodule.clear()
+    for part in spec.split(","):
+        name, sep, lvl = part.strip().partition("=")
+        if not name or not sep:
+            continue
+        try:
+            _vmodule[name] = int(lvl)
+        except ValueError:
+            continue
+    for name in _vmodule:
+        logging.getLogger(name).setLevel(logging.DEBUG)
+    for name in old - set(_vmodule):
+        logging.getLogger(name).setLevel(logging.NOTSET)
+
+
+set_vmodule(os.environ.get("WEEDTPU_VMODULE", ""))
 
 
 def setup(verbosity: int = 0, logfile: str | None = None) -> None:
@@ -32,11 +63,19 @@ def setup(verbosity: int = 0, logfile: str | None = None) -> None:
         datefmt="%m%d %H:%M:%S"))
     root = logging.getLogger()
     root.addHandler(handler)
+    # -vmodule does NOT raise the root level: set_vmodule pins the named
+    # loggers to DEBUG and their records reach root's (level-less)
+    # handler regardless — raising root would drown the log in every
+    # third-party library's debug chatter
     root.setLevel(logging.DEBUG if verbosity > 0 else logging.INFO)
     _configured = True
 
 
-def verbosity() -> int:
+def verbosity(name: str | None = None) -> int:
+    """Process verbosity, or the effective verbosity for one module when
+    a -vmodule override names it."""
+    if name is not None and name in _vmodule:
+        return _vmodule[name]
     return _verbosity
 
 
@@ -59,7 +98,14 @@ _NOOP = _Noop()
 
 
 def V(n: int, name: str = "weed"):
-    """glog.V(n): chatty logging enabled only when -v >= n."""
-    if n <= _verbosity:
+    """glog.V(n): chatty logging enabled only when -v >= n (or the module
+    is raised to >= n via WEEDTPU_VMODULE / set_vmodule)."""
+    if n <= _vmodule.get(name, _verbosity):
         return _V(logging.getLogger(name))
     return _NOOP
+
+
+def info(fmt: str, *args, name: str = "weed") -> None:
+    """Always-on INFO line (glog.Infof): not gated by verbosity — used
+    for operator-facing events like slow-request reports."""
+    logging.getLogger(name).info(fmt, *args, stacklevel=2)

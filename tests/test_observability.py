@@ -1,0 +1,487 @@
+"""Observability stack tests: trace spans + ring buffer + header
+propagation (one trace id across filer -> volume -> peer shard fetch),
+/debug introspection, promtool-style exposition lint, push-gateway
+retry/backoff, histogram exemplars, and weedlog -vmodule parity."""
+
+import asyncio
+import json
+import logging
+import re
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.utils import weedlog
+
+
+# ---- trace core --------------------------------------------------------
+
+def test_header_roundtrip_and_malformed():
+    t = trace.Trace(trace._new_trace_id(), trace._new_span_id(), True)
+    t2 = trace.parse_header(trace.format_header(t))
+    assert (t2.trace_id, t2.span_id, t2.sampled) == \
+        (t.trace_id, t.span_id, True)
+    off = trace.Trace(t.trace_id, t.span_id, False)
+    assert not trace.parse_header(trace.format_header(off)).sampled
+    for bad in ("", "x", "abc-def-1", "-".join(["z" * 32, "0" * 16, "1"]),
+                "0" * 32 + "-" + "0" * 16):
+        assert trace.parse_header(bad) is None, bad
+
+
+def test_span_without_context_is_noop_and_writes_nothing():
+    trace.reset_ring()
+    with trace.span("nope", a=1) as sp:
+        sp.set(b=2)
+    assert trace.ring_snapshot() == []
+    # the sampled-out singleton is shared: zero allocation per request
+    assert trace.span("x") is trace.span("y")
+
+
+def test_span_nesting_records_parentage_and_attrs():
+    trace.reset_ring()
+    t = trace.Trace(trace._new_trace_id(), trace._new_span_id(), True)
+    tok = trace._current.set(t)
+    try:
+        with trace.span("outer", stage="a") as sp:
+            sp.set(extra=1)
+            with trace.span("inner"):
+                pass
+    finally:
+        trace._current.reset(tok)
+    recs = {r["name"]: r for r in trace.ring_snapshot()}
+    assert set(recs) == {"outer", "inner"}
+    assert recs["inner"]["parent"] == recs["outer"]["span"]
+    assert recs["outer"]["parent"] == t.span_id
+    assert recs["outer"]["attrs"] == {"stage": "a", "extra": 1}
+    ts = trace.traces()
+    assert len(ts) == 1 and ts[0]["trace_id"] == t.trace_id
+    assert len(ts[0]["spans"]) == 2
+
+
+def test_ring_overwrites_oldest():
+    ring = trace._Ring(4)
+    for i in range(10):
+        ring.append({"i": i})
+    got = sorted(r["i"] for r in ring.snapshot())
+    assert got == [6, 7, 8, 9]
+
+
+def test_inflight_registry_shows_and_clears():
+    rid = trace.request_started("GET", "/x?y=1", "1.2.3.4", "t" * 32)
+    try:
+        entries = [r for r in trace.inflight() if r["id"] == rid]
+        assert len(entries) == 1
+        assert entries[0]["path"] == "/x?y=1"
+        assert entries[0]["age_ms"] >= 0
+    finally:
+        trace.request_finished(rid)
+    assert not [r for r in trace.inflight() if r["id"] == rid]
+
+
+# ---- exposition lint (promtool-style) ---------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (-?[0-9.e+-]+|NaN)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _lint_exposition(text: str) -> None:
+    """Minimal promtool check-metrics: HELP/TYPE precede a metric's
+    samples, label syntax/escaping parses, `le` is strictly increasing
+    and ends at +Inf, cumulative buckets are monotone, and
+    _bucket/_sum/_count agree."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    typed: dict[str, str] = {}
+    helped: set = set()
+    seen_samples: set = set()
+    hist: dict = {}  # (name, labels-sans-le) -> [(le, cum)]
+    counts: dict = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert name not in helped, f"duplicate HELP {name}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in typed, f"duplicate TYPE {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), kind
+            assert name not in seen_samples, \
+                f"TYPE {name} after its samples"
+            typed[name] = kind
+            continue
+        assert not line.startswith("#"), f"unexpected comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, _, labels_raw, value = m.groups()
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    name[:-len(suffix)] in typed and \
+                    typed[name[:-len(suffix)]] == "histogram":
+                base = name[:-len(suffix)]
+        assert base in typed, f"sample {name} without TYPE"
+        seen_samples.add(base)
+        labels = _LABEL_RE.findall(labels_raw or "")
+        consumed = re.sub(_LABEL_RE, "", labels_raw or "")
+        assert not consumed.strip(" ,"), \
+            f"bad label syntax in {line!r}"
+        if typed[base] == "histogram":
+            key = (base, tuple(sorted(
+                (k, v) for k, v in labels if k != "le")))
+            if name.endswith("_bucket"):
+                le = dict(labels)["le"]
+                le_f = float("inf") if le == "+Inf" else float(le)
+                hist.setdefault(key, []).append((le_f, float(value)))
+            elif name.endswith("_count"):
+                counts[key] = float(value)
+    for key, buckets in hist.items():
+        les = [le for le, _ in buckets]
+        assert les == sorted(les) and len(set(les)) == len(les), \
+            f"le not strictly increasing for {key}"
+        assert les[-1] == float("inf"), f"missing +Inf bucket for {key}"
+        cums = [c for _, c in buckets]
+        assert cums == sorted(cums), f"buckets not cumulative for {key}"
+        assert key in counts, f"missing _count for {key}"
+        assert counts[key] == cums[-1], \
+            f"_count != +Inf bucket for {key}"
+
+
+def test_global_registry_exposition_lints():
+    # exercise the standard metrics, including awkward label values
+    metrics.MASTER_ASSIGN_COUNTER.labels('col"w\\eird\n').inc()
+    metrics.VOLUME_REQUEST_COUNTER.labels("read").inc()
+    metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").observe(0.004)
+    metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").observe(7.0)
+    metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").observe(100.0)
+    metrics.FILER_CHUNK_CACHE.labels("hits").set(3)
+    _lint_exposition(metrics.REGISTRY.render())
+
+
+def test_cardinality_collapses_to_other():
+    reg = metrics.Registry()
+    c = reg.counter("weedtpu_test_cardinality_total", "t", ("who",))
+    for i in range(c.MAX_CHILDREN):
+        c.labels(f"v{i}").inc()
+    overflow_a = c.labels("straggler-a")
+    overflow_b = c.labels("straggler-b")
+    assert overflow_a is overflow_b, "overflow must share one child"
+    overflow_a.inc()
+    text = reg.render()
+    assert '__other__' in text
+    _lint_exposition(text)
+
+
+def test_openmetrics_counters_get_total_suffix():
+    """A negotiating Prometheus parses OpenMetrics strictly: counter
+    samples must end in _total with the family named without it."""
+    reg = metrics.Registry()
+    reg.counter("weedtpu_beats", "no suffix").labels().inc()
+    reg.counter("weedtpu_assign_total", "has suffix").labels().inc(2)
+    om = reg.render(openmetrics=True)
+    assert "# TYPE weedtpu_beats counter" in om
+    assert "weedtpu_beats_total 1" in om
+    assert "# TYPE weedtpu_assign counter" in om
+    assert "weedtpu_assign_total 2" in om
+    assert "weedtpu_assign_total_total" not in om
+    # the 0.0.4 rendering is untouched
+    plain = reg.render()
+    assert "weedtpu_beats 1" in plain and "weedtpu_beats_total" not in plain
+    _lint_exposition(plain)
+
+
+def test_s3_debug_routes_are_loopback_only():
+    from unittest import mock
+
+    from aiohttp.test_utils import make_mocked_request
+
+    from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+
+    guarded = S3ApiServer._debug_local(trace.handle_debug_requests)
+
+    def req_from(peer):
+        tr = mock.Mock()
+        tr.get_extra_info = lambda key, default=None: \
+            (peer, 1234) if key == "peername" else default
+        return make_mocked_request("GET", "/debug/requests", transport=tr)
+
+    resp = asyncio.run(guarded(req_from("203.0.113.9")))
+    assert resp.status == 403
+    resp = asyncio.run(guarded(req_from("127.0.0.1")))
+    assert resp.status == 200
+
+
+def test_histogram_exemplars_openmetrics_only():
+    reg = metrics.Registry()
+    h = reg.histogram("weedtpu_test_seconds", "t")
+    t = trace.Trace(trace._new_trace_id(), trace._new_span_id(), True)
+    tok = trace._current.set(t)
+    try:
+        with h.labels().time():
+            pass
+    finally:
+        trace._current.reset(tok)
+    plain = reg.render()
+    assert "trace_id" not in plain, "exemplars must not leak into 0.0.4"
+    _lint_exposition(plain)
+    om = reg.render(openmetrics=True)
+    assert f'# {{trace_id="{t.trace_id}"}}' in om
+    assert om.rstrip().endswith("# EOF")
+    # unsampled observations leave no exemplar
+    reg2 = metrics.Registry()
+    reg2.histogram("weedtpu_test2_seconds", "t").labels().observe(0.001)
+    assert "trace_id" not in reg2.render(openmetrics=True)
+
+
+# ---- push gateway ------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_push_failure_logged_not_raised(caplog):
+    reg = metrics.Registry()
+    reg.counter("weedtpu_push_test_total", "t").labels().inc()
+    weedlog.set_vmodule("metrics=1")
+    try:
+        with caplog.at_level(logging.DEBUG, logger="metrics"):
+            # nothing listens on this port: must return False, not raise
+            ok = reg.push(f"http://127.0.0.1:{_free_port()}", "job")
+        assert ok is False
+        assert "push" in caplog.text
+    finally:
+        weedlog.set_vmodule("")
+
+
+def test_push_success_against_local_gateway():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    got: dict = {}
+
+    class Gateway(BaseHTTPRequestHandler):
+        def do_PUT(self):
+            got["path"] = self.path
+            got["body"] = self.rfile.read(
+                int(self.headers.get("Content-Length", "0")))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Gateway)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        reg = metrics.Registry()
+        reg.counter("weedtpu_pushed_total", "t").labels().inc()
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        assert reg.push(url, "weedtpu") is True
+        assert got["path"] == "/metrics/job/weedtpu"
+        assert b"weedtpu_pushed_total" in got["body"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_metrics_pusher_backoff_and_stop():
+    reg = metrics.Registry()
+    dead = f"http://127.0.0.1:{_free_port()}"
+    p = metrics.MetricsPusher(reg, dead, "j", interval=0.02,
+                              max_backoff=0.2).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and p.failures < 2:
+        time.sleep(0.02)
+    assert p.failures >= 2, "pusher never retried after failure"
+    p.stop()
+    assert not p._thread.is_alive()
+    # backoff grew but stayed capped
+    assert p.interval * 2 <= min(p.interval * (2 ** p.failures),
+                                 p.max_backoff) <= p.max_backoff
+
+
+# ---- weedlog -vmodule --------------------------------------------------
+
+def test_vmodule_per_module_verbosity(caplog):
+    weedlog.set_vmodule("ec_volume=2,http=1, junk, bad=x")
+    try:
+        assert weedlog.verbosity("ec_volume") == 2
+        assert weedlog.verbosity("http") == 1
+        assert weedlog.verbosity("other") == weedlog.verbosity()
+        with caplog.at_level(logging.DEBUG):
+            weedlog.V(2, "ec_volume").infof("deep %s detail", "engine")
+            weedlog.V(2, "http").infof("http v2 MUST NOT appear")
+            weedlog.V(1, "http").infof("http v1 detail")
+            weedlog.V(1, "other").infof("other v1 MUST NOT appear")
+        assert "deep engine detail" in caplog.text
+        assert "http v1 detail" in caplog.text
+        assert "MUST NOT appear" not in caplog.text
+    finally:
+        weedlog.set_vmodule("")
+    assert weedlog.verbosity("ec_volume") == weedlog.verbosity()
+
+
+# ---- end-to-end trace propagation -------------------------------------
+
+class _Cluster:
+    """master + 2 volume servers + filer on one loop thread."""
+
+    def __init__(self, tmp_path):
+        self.tmp = tmp_path
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever,
+                                       daemon=True)
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(60)
+
+    def start(self):
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        self.thread.start()
+        self.master = MasterServer("127.0.0.1", _free_port())
+        self.submit(self.master.start())
+        self.volume_servers = []
+        for i in range(2):
+            d = self.tmp / f"vs{i}"
+            d.mkdir(exist_ok=True)
+            vs = VolumeServer([str(d)], self.master.url, "127.0.0.1",
+                              _free_port(), max_volumes=20,
+                              heartbeat_interval=0.3)
+            self.submit(vs.start())
+            self.volume_servers.append(vs)
+        # cache off: every GET pays the full filer->volume->shard path
+        self.filer = FilerServer(self.master.url, port=_free_port(),
+                                 chunk_cache_mem=0)
+        self.submit(self.filer.start())
+        deadline = time.time() + 5
+        while time.time() < deadline and len(self.master.topo.nodes) < 2:
+            time.sleep(0.05)
+        return self
+
+    def stop(self):
+        self.submit(self.filer.stop())
+        for vs in self.volume_servers:
+            self.submit(vs.stop())
+        self.submit(self.master.stop())
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(5)
+
+
+def test_trace_propagation_degraded_filer_read(tmp_path, monkeypatch):
+    """A degraded EC read through the filer yields ONE trace id whose
+    spans cover the filer request, the volume-server blob read, and the
+    peer shard fetches; sampled-out requests write nothing to the ring;
+    /debug/traces and /debug/requests serve it all as JSON."""
+    import io
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    from seaweedfs_tpu.storage.ec import layout
+
+    # local sampling off: only the explicit header below may trace, so
+    # the sampled-out assertion sees a quiet ring
+    monkeypatch.setenv("WEEDTPU_TRACE_SAMPLE", "0")
+    c = _Cluster(tmp_path).start()
+    try:
+        size = 10 * 1024 * 1024  # 3 chunks -> needles span many shards
+        payload = np.random.default_rng(11).integers(
+            0, 256, size, dtype=np.uint8).tobytes()
+        url = f"http://127.0.0.1:{c.filer.port}/obs/trace.bin"
+        req = urllib.request.Request(url, data=payload, method="PUT")
+        urllib.request.urlopen(req, timeout=60).read()
+        with urllib.request.urlopen(url + "?metadata=true",
+                                    timeout=10) as r:
+            entry = json.load(r)
+        vids = sorted({int(ch["fid"].partition(",")[0])
+                       for ch in entry["chunks"]})
+        assert vids
+        time.sleep(0.7)
+
+        env = CommandEnv(c.master.url)
+        out = io.StringIO()
+        run_command(env, "lock", out)
+        for vid in vids:
+            run_command(env, f"ec.encode -volumeId {vid}", out)
+        run_command(env, "unlock", out)
+        time.sleep(0.7)
+
+        # drop two data shards everywhere: reads must reconstruct, and
+        # reconstruction needs k=10 survivors while each server holds
+        # ~7 -> the peer shard fetch is guaranteed
+        for vid in vids:
+            body = json.dumps({"volume": vid, "shards": [0, 1]}).encode()
+            for vs in c.volume_servers:
+                dreq = urllib.request.Request(
+                    f"http://{vs.url}/admin/ec/delete_shards", data=body,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(dreq, timeout=10).close()
+        time.sleep(0.7)
+
+        # -- forced-sample degraded GET: one trace id, many spans -------
+        trace.reset_ring()
+        tid = trace._new_trace_id()
+        treq = urllib.request.Request(url, headers={
+            trace.TRACE_HEADER: f"{tid}-{trace._new_span_id()}-1"})
+        with urllib.request.urlopen(treq, timeout=120) as r:
+            assert r.read() == payload
+        spans = [s for s in trace.ring_snapshot() if s["trace"] == tid]
+        names = {s["name"] for s in spans}
+        assert len(spans) >= 5, (len(spans), sorted(names))
+        assert "filer.request" in names
+        assert "filer.chunk_fetch" in names
+        assert "volume.request" in names
+        # EC engine stages from the worker thread
+        assert "ec.plan" in names and "ec.reconstruct_batch" in names
+        # peer shard spans: the fetch on the serving server AND the
+        # peer's handling of /admin/ec/shard_read in the same trace
+        assert "volume.shard_fetch" in names
+        assert any(s["name"] == "volume.request" and
+                   s.get("attrs", {}).get("path") == "/admin/ec/shard_read"
+                   for s in spans)
+        servers = {s.get("attrs", {}).get("server")
+                   for s in spans if s["name"].endswith(".request")}
+        assert {"filer", "volume"} <= servers
+        # every non-root span hangs off a span of the same trace
+        ids = {s["span"] for s in spans}
+        roots = [s for s in spans if s["parent"] not in ids]
+        assert roots, spans
+
+        # visible through the filer's /debug/traces endpoint
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{c.filer.port}/debug/traces?limit=100",
+                timeout=10) as r:
+            dbg = json.load(r)
+        assert tid in {t["trace_id"] for t in dbg["traces"]}
+        # min_ms filter: an absurd floor hides it
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{c.filer.port}"
+                f"/debug/traces?min_ms=1e12", timeout=10) as r:
+            assert json.load(r)["traces"] == []
+
+        # -- sampled-out GET writes NOTHING to the ring -----------------
+        trace.reset_ring()
+        with urllib.request.urlopen(url, timeout=120) as r:
+            assert len(r.read()) == size
+        assert trace.ring_snapshot() == []
+
+        # -- /debug/requests shows the in-flight request (itself), and
+        # it clears once finished
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{c.filer.port}/debug/requests",
+                timeout=10) as r:
+            reqs = json.load(r)["requests"]
+        assert any(e["path"].startswith("/debug/requests")
+                   for e in reqs), reqs
+        time.sleep(0.1)
+        assert not any(e["path"].startswith("/debug/requests")
+                       for e in trace.inflight())
+    finally:
+        c.stop()
