@@ -512,7 +512,8 @@ def main() -> None:
     # against their serial baselines, and the tracing layer raced against
     # itself disabled — each with a regression gate
     for fn in (_bench_degraded_read, _bench_filer_stream,
-               _bench_trace_overhead):
+               _bench_trace_overhead, _bench_heal_time,
+               _bench_scrub_overhead):
         try:
             fn(extra)
         except Exception as e:
@@ -632,6 +633,8 @@ def _exit_code(extra: dict) -> int:
              "blob_read_degraded_regression",
              "filer_stream_pipeline_regression",
              "trace_overhead_regression",
+             "heal_time_regression",
+             "scrub_overhead_regression",
              "gated_bench_failed")
     return 1 if any(extra.get(g) for g in gates) else 0
 
@@ -645,6 +648,13 @@ FILER_STREAM_REGRESSION_TOL = 0.80
 # tracing at the default sample rate must cost <= 3% of blob read
 # throughput vs WEEDTPU_TRACE_SAMPLE=0 (ISSUE 3 acceptance bar)
 TRACE_OVERHEAD_TOL = 0.97
+# automatic healing (planner-driven, concurrent) must not exceed the
+# serial shell-rebuild baseline; the slack covers detection latency
+# (heartbeat + ledger) and host weather on single-shot measurements
+HEAL_REGRESSION_TOL = 1.25
+# foreground blob reads must keep >= 0.95x throughput with the scrubber
+# running at its rate limit (ISSUE 4 acceptance bar)
+SCRUB_OVERHEAD_TOL = 0.95
 
 
 def _bench_e2e_host(extra: dict) -> None:
@@ -1119,6 +1129,336 @@ def _bench_trace_overhead(extra: dict, n: int = 1200, size: int = 1024,
               f"default sample rate run at {ratio:.3f}x the untraced "
               f"rate (median of interleaved pairs); tracing exceeds its "
               f"3% budget. Failing the bench run.", file=sys.stderr)
+
+
+def _bench_heal_time(extra: dict, n_volumes: int = 4,
+                     blobs_per_vol: int = 24, size: int = 48 * 1024) -> None:
+    """seconds-to-reprotected: inject loss of 2 shards in each of
+    `n_volumes` EC volumes on a 2-node cluster and measure how long the
+    automatic repair planner takes to return every volume to 14/14 —
+    against the serial shell-rebuild baseline (ec.rebuild walks volumes
+    one by one) over the same loss pattern.  The planner runs repairs
+    concurrently under its token bucket, so healing slower than the
+    serial loop (beyond HEAL_REGRESSION_TOL slack for detection latency)
+    means the executor stopped overlapping: heal_time_regression +
+    nonzero exit."""
+    import asyncio
+    import io
+    import socket
+    import threading
+    import urllib.request
+
+    from seaweedfs_tpu import native
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(180)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    def post(url, path, body):
+        req = urllib.request.Request(
+            f"http://{url}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=180) as r:
+            return json.loads(r.read())
+
+    def get(url, path):
+        with urllib.request.urlopen(f"http://{url}{path}",
+                                    timeout=30) as r:
+            return json.loads(r.read())
+
+    overrides = {
+        # host codec (never the tunnel), parked background loops (the
+        # bench drives ticks explicitly), wide repair concurrency
+        "WEEDTPU_EC_CODEC": "cpp" if native.available() else "numpy",
+        "WEEDTPU_SCRUB_INTERVAL": "3600",
+        "WEEDTPU_REPAIR_INTERVAL": "3600",
+        "WEEDTPU_REPAIR_CONCURRENCY": "8",
+        "WEEDTPU_REPAIR_BURST": "8",
+    }
+    old_env = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-heal-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            servers = []
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                for i in range(2):
+                    vd = os.path.join(d, f"vs{i}")
+                    os.makedirs(vd, exist_ok=True)
+                    vs = VolumeServer([vd], master.url, port=free_port(),
+                                      max_volumes=20,
+                                      heartbeat_interval=0.2)
+                    run(vs.start())
+                    servers.append(vs)
+                    started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and \
+                        len(master.topo.nodes) < 2:
+                    time.sleep(0.05)
+                env = CommandEnv(master.url)
+                out = io.StringIO()
+                run_command(env, "lock", out)
+                run_command(env, f"volume.grow -count {n_volumes}", out)
+                time.sleep(0.5)
+                client = WeedClient(master.url)
+                rng = np.random.default_rng(11)
+                vids: set[int] = set()
+                for i in range(n_volumes * blobs_per_vol):
+                    data = rng.integers(0, 256, size,
+                                        dtype=np.uint8).tobytes()
+                    fid = client.upload(data, name=f"h{i}.bin")
+                    vids.add(int(fid.split(",")[0]))
+                time.sleep(0.5)
+                vids = sorted(vids)
+                for vid in vids:
+                    run_command(env, f"ec.encode -volumeId {vid}", out)
+                time.sleep(0.7)
+
+                def kill_two(vid: int) -> None:
+                    locs = env.ec_shard_locations(vid)
+                    killed = 0
+                    for sid in sorted(locs):
+                        post(locs[sid][0], "/admin/ec/delete_shards",
+                             {"volume": vid, "shards": [sid]})
+                        killed += 1
+                        if killed == 2:
+                            return
+
+                def wait_missing() -> None:
+                    deadline = time.time() + 15
+                    while time.time() < deadline:
+                        if all(len(env.ec_shard_locations(v)) <= 12
+                               for v in vids):
+                            return
+                        time.sleep(0.1)
+
+                def wait_protected(timeout: float = 120) -> bool:
+                    deadline = time.time() + timeout
+                    while time.time() < deadline:
+                        if all(len(env.ec_shard_locations(v)) == 14
+                               for v in vids):
+                            return True
+                        time.sleep(0.1)
+                    return False
+
+                def serial_rep() -> float:
+                    """Serial baseline: the shell's one-by-one rebuild
+                    walk (holds the admin lock, so the planner yields)."""
+                    for vid in vids:
+                        kill_two(vid)
+                    wait_missing()
+                    run_command(env, "lock", out)
+                    t0 = time.perf_counter()
+                    run_command(env, "ec.rebuild", out)
+                    el = time.perf_counter() - t0
+                    run_command(env, "unlock", out)
+                    assert wait_protected(), "serial rebuild stuck"
+                    return el
+
+                def heal_rep() -> tuple[float, bool]:
+                    for vid in vids:
+                        kill_two(vid)
+                    wait_missing()
+                    t0 = time.perf_counter()
+                    deadline = time.time() + 120
+                    while time.time() < deadline:
+                        post(master.url, "/maintenance/tick",
+                             {"wait": True})
+                        st = get(master.url, "/maintenance/status")
+                        if all(st["volumes"].get(str(v), {}).get("state")
+                               == "healthy" for v in vids):
+                            return time.perf_counter() - t0, True
+                        time.sleep(0.1)
+                    return time.perf_counter() - t0, False
+
+                run_command(env, "unlock", out)
+                # interleaved pairs + best-of per side: single-shot
+                # sub-second measurements on a shared host compare
+                # weather, not strategies (same rationale as
+                # _bench_e2e_ceiling)
+                serial_s = heal_s = float("inf")
+                healed = True
+                for _ in range(2):
+                    serial_s = min(serial_s, serial_rep())
+                    h, ok = heal_rep()
+                    healed = healed and ok
+                    heal_s = min(heal_s, h)
+                client.close()
+            finally:
+                for vs in reversed([s for s in started
+                                    if s is not master]):
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+        extra["heal_time_s"] = round(heal_s, 3)
+        extra["heal_serial_s"] = round(serial_s, 3)
+        extra["heal_volumes"] = n_volumes
+        if not healed:
+            extra["heal_time_regression"] = True
+            print("bench: REGRESSION — automatic healing never converged "
+                  "within its deadline. Failing the bench run.",
+                  file=sys.stderr)
+            return
+        ratio = heal_s / max(serial_s, 1e-9)
+        extra["heal_ratio"] = round(ratio, 3)
+        if ratio > HEAL_REGRESSION_TOL:
+            extra["heal_time_regression"] = True
+            print(f"bench: REGRESSION — automatic healing took "
+                  f"{ratio:.2f}x the serial-rebuild baseline "
+                  f"({heal_s:.2f}s vs {serial_s:.2f}s); the concurrent "
+                  f"repair executor has stopped paying off. Failing the "
+                  f"bench run.", file=sys.stderr)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _bench_scrub_overhead(extra: dict, n: int = 1000, size: int = 1024,
+                          concurrency: int = 16, pairs: int = 7) -> None:
+    """Scrub tax on foreground reads: blob reads against an in-process
+    master+volume cluster with a continuously-cycling rate-limited
+    scrubber vs without, interleaved pairs over the same blobs.  Median
+    ratio below SCRUB_OVERHEAD_TOL (foreground must keep >= 0.95x) fails
+    the run (scrub_overhead_regression + nonzero exit)."""
+    import asyncio
+    import concurrent.futures
+    import socket
+    import threading
+
+    from seaweedfs_tpu import native
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.maintenance.scrub import Scrubber
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    overrides = {
+        "WEEDTPU_EC_CODEC": "cpp" if native.available() else "numpy",
+        "WEEDTPU_SCRUB_INTERVAL": "3600",  # the server's own loop parks
+        "WEEDTPU_REPAIR_INTERVAL": "3600",
+    }
+    old_env = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    best_on = best_off = float("inf")
+    ratios: list[float] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-scrub-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs = VolumeServer([d], master.url, port=free_port(),
+                              heartbeat_interval=0.2)
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                client = WeedClient(master.url)
+                payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+                with concurrent.futures.ThreadPoolExecutor(
+                        concurrency) as ex:
+                    fids = list(ex.map(
+                        lambda i: client.upload(payload, name=f"s{i}"),
+                        range(n)))
+
+                def read_all() -> float:
+                    t0 = time.perf_counter()
+                    with concurrent.futures.ThreadPoolExecutor(
+                            concurrency) as ex:
+                        for data in ex.map(client.download, fids):
+                            assert len(data) == size
+                    return time.perf_counter() - t0
+
+                def rep_on() -> float:
+                    # continuously cycling, rate-limited like production
+                    s = Scrubber(vs.store, mbps=16, interval=0.01).start()
+                    try:
+                        time.sleep(0.05)  # let the first pass begin
+                        return read_all()
+                    finally:
+                        s.stop()
+
+                for i in range(pairs):
+                    if i % 2 == 0:
+                        t_off = read_all()
+                        t_on = rep_on()
+                    else:
+                        t_on = rep_on()
+                        t_off = read_all()
+                    if i == 0:
+                        continue  # warm connections / page cache
+                    best_on = min(best_on, t_on)
+                    best_off = min(best_off, t_off)
+                    ratios.append(t_off / t_on)
+                client.close()
+            finally:
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["blob_read_rps_scrubbed"] = round(n / best_on, 1)
+    extra["blob_read_rps_unscrubbed"] = round(n / best_off, 1)
+    extra["scrub_overhead_ratio"] = round(ratio, 3)
+    if ratio < SCRUB_OVERHEAD_TOL:
+        extra["scrub_overhead_regression"] = True
+        print(f"bench: REGRESSION — foreground blob reads run at "
+              f"{ratio:.3f}x with the scrubber active (median of "
+              f"interleaved pairs); the scrub rate limiter has stopped "
+              f"protecting foreground I/O. Failing the bench run.",
+              file=sys.stderr)
 
 
 def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:

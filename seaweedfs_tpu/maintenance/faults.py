@@ -1,0 +1,117 @@
+"""Test-only fault injection: make the heal loop provable end-to-end.
+
+Faults are injected either through the WEEDTPU_FAULTS env var at volume
+server start, or live through the loopback-only /admin/faults endpoint.
+Supported actions:
+
+  delete_shard:vid:sid          remove one EC shard file (and close its fd
+                                in the mounted EcVolume) — "disk died"
+  flip_bit:vid:sid:offset[:bit] XOR one bit in a shard file in place —
+                                silent corruption the scrubber must catch
+  delay_shard_read:ms           stall every /admin/ec/shard_read response —
+                                a slow peer for degraded-read tests
+
+Env spec: directives joined by ';', e.g.
+  WEEDTPU_FAULTS="delete_shard:1:3;flip_bit:1:7:4096"
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from seaweedfs_tpu.storage.ec import layout
+
+log = logging.getLogger("faults")
+
+
+def parse_env(spec: str) -> list[dict]:
+    out: list[dict] = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        action = fields[0]
+        try:
+            if action == "delete_shard":
+                out.append({"action": action, "volume": int(fields[1]),
+                            "shard": int(fields[2])})
+            elif action == "flip_bit":
+                out.append({"action": action, "volume": int(fields[1]),
+                            "shard": int(fields[2]),
+                            "offset": int(fields[3]),
+                            "bit": int(fields[4]) if len(fields) > 4 else 0})
+            elif action == "delay_shard_read":
+                out.append({"action": action, "ms": float(fields[1])})
+            else:
+                log.warning("faults: unknown directive %r", part)
+        except (IndexError, ValueError):
+            log.warning("faults: malformed directive %r", part)
+    return out
+
+
+def _ec_base(store, vid: int) -> str | None:
+    for loc in store.locations:
+        for cand in (loc.base_path(vid, loc.collections.get(vid, "")),
+                     loc.base_path(vid)):
+            if os.path.exists(cand + ".ecx") or any(
+                    os.path.exists(cand + layout.to_ext(i))
+                    for i in range(layout.TOTAL_SHARDS)):
+                return cand
+    return None
+
+
+def delete_shard(store, vid: int, sid: int) -> bool:
+    """Remove one shard file; the mounted EcVolume drops its fd so the
+    next heartbeat reports the loss."""
+    base = _ec_base(store, vid)
+    if base is None:
+        return False
+    p = base + layout.to_ext(sid)
+    if os.path.exists(p):
+        os.remove(p)
+    ev = store.get_ec_volume(vid)
+    if ev is not None:
+        f = ev.shards.pop(sid, None)
+        if f is not None:
+            f.close()
+    log.warning("faults: deleted shard %d of volume %d", sid, vid)
+    return True
+
+
+def flip_bit(store, vid: int, sid: int, offset: int, bit: int = 0) -> bool:
+    """XOR one bit of a shard file in place (the mounted EcVolume reads
+    through the page cache, so the corruption is immediately live)."""
+    base = _ec_base(store, vid)
+    if base is None:
+        return False
+    p = base + layout.to_ext(sid)
+    if not os.path.exists(p):
+        return False
+    size = os.path.getsize(p)
+    if not size:
+        return False
+    offset %= size
+    with open(p, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ (1 << (bit % 8))]))
+    log.warning("faults: flipped bit %d at offset %d of volume %d "
+                "shard %d", bit, offset, vid, sid)
+    return True
+
+
+def apply(store, fault: dict) -> dict:
+    """Apply one parsed fault to a Store; returns {**fault, ok: bool}.
+    delay_shard_read is server state, not store state — the volume
+    server handles it before calling here."""
+    action = fault.get("action")
+    ok = False
+    if action == "delete_shard":
+        ok = delete_shard(store, int(fault["volume"]), int(fault["shard"]))
+    elif action == "flip_bit":
+        ok = flip_bit(store, int(fault["volume"]), int(fault["shard"]),
+                      int(fault["offset"]), int(fault.get("bit", 0)))
+    return dict(fault, ok=ok)

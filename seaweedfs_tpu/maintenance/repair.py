@@ -1,0 +1,465 @@
+"""Automatic repair planner: the master's self-healing control loop.
+
+The planner folds two signal streams into a per-volume health ledger:
+
+- heartbeat shard maps (Topology.ec_shard_locations / node volume maps):
+  a shard or replica that stops being reported is LOST — detection is a
+  heartbeat diff, no scan needed;
+- scrub verdicts (maintenance/scrub.py, POSTed to the master): a shard
+  that is still reported but failed parity verification is CORRUPT.
+
+Ledger states: healthy / degraded (EC volume missing shards but still
+reconstructable) / under_replicated (normal volume with fewer replicas
+than its placement wants) / corrupt (unresolved scrub verdict) /
+critical (fewer than k shards survive — data loss, not repairable here).
+
+Each tick plans repairs in urgency order — shards-lost ordering, so a
+3-lost volume preempts a 1-lost one — and drives the EXISTING rebuild
+machinery (/admin/ec/copy, /admin/ec/rebuild, mount) through a
+token-bucket-limited executor with per-node concurrency caps and
+exponential backoff; every stage carries a trace span.  Corrupt shards
+are deleted first (their ranges are already quarantined on the owning
+server), which turns "corrupt" into "lost" and reuses the same rebuild
+path — and guarantees the rebuild never uses the bad bytes as a
+survivor.
+
+The planner yields to operators: while the shell holds the master admin
+lock, the background loop skips its tick.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+
+from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.ec import layout
+from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+
+log = logging.getLogger("repair")
+
+HEALTH_STATES = ("healthy", "degraded", "under_replicated", "corrupt",
+                 "critical")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class TokenBucket:
+    """Classic token bucket: `rate` tokens/s refill up to `burst`.  Caps
+    how many repairs one tick may launch — re-protection traffic must not
+    starve foreground I/O (the 1309.0186 lesson: recovery traffic
+    dominates steady-state load when unthrottled)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._last = time.monotonic()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens +
+                          (now - self._last) * self.rate)
+        self._last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+def build_ledger(topo, scrub_reports: dict) -> dict[int, dict]:
+    """Fold the topology's heartbeat-derived volume/shard maps and the
+    stored scrub reports into {vid: health info}."""
+    out: dict[int, dict] = {}
+    with topo._lock:
+        ec = {vid: {sid: [n.url for n in nodes]
+                    for sid, nodes in per.items() if nodes}
+              for vid, per in topo.ec_shard_locations.items()}
+        ec_cols = dict(topo.ec_collections)
+        normal: dict[int, dict] = {}
+        for node in topo.nodes.values():
+            for vid, v in node.volumes.items():
+                rec = normal.setdefault(vid, {
+                    "replicas": [], "collection": v.collection,
+                    "replica_placement": v.replica_placement})
+                rec["replicas"].append(node.url)
+        free_slots = {n.url: n.free_slots for n in topo.nodes.values()}
+
+    for vid, shards in ec.items():
+        present = sorted(shards)
+        missing = [s for s in range(layout.TOTAL_SHARDS)
+                   if s not in shards]
+        info = {
+            "vid": vid, "kind": "ec", "collection": ec_cols.get(vid, ""),
+            "shards_present": present, "shards_missing": missing,
+            "shard_locations": shards,
+        }
+        corrupt: list[dict] = []
+        last_scrub = None
+        quarantined: dict = {}
+        for node, rep in (scrub_reports.get(vid) or {}).items():
+            for c in rep.get("corrupt", []):
+                corrupt.append(dict(c, node=node))
+            ls = rep.get("last_scrub")
+            if ls and (last_scrub is None or ls > last_scrub):
+                last_scrub = ls
+            q = rep.get("quarantined")
+            if q:
+                quarantined[node] = q
+        info["corrupt"] = corrupt
+        info["last_scrub"] = last_scrub
+        info["quarantined"] = quarantined
+        if len(present) < layout.DATA_SHARDS:
+            info["state"] = "critical"
+        elif corrupt:
+            info["state"] = "corrupt"
+        elif missing:
+            info["state"] = "degraded"
+        else:
+            info["state"] = "healthy"
+        # shards-lost ordering: a 3-lost volume preempts a 1-lost one,
+        # and corruption counts like loss (the shard must be replaced)
+        info["urgency"] = len(missing) + len(corrupt)
+        out[vid] = info
+
+    for vid, rec in normal.items():
+        if vid in out:
+            continue  # mid-EC-transition: the shard entry wins
+        try:
+            want = t.ReplicaPlacement.parse(
+                rec.get("replica_placement", "000")).copy_count
+        except (ValueError, KeyError):
+            want = 1
+        reps = sorted(set(rec["replicas"]))
+        rep = (scrub_reports.get(vid) or {})
+        crc = sum(r.get("crc_mismatches", 0) for r in rep.values())
+        info = {
+            "vid": vid, "kind": "normal",
+            "collection": rec.get("collection", ""),
+            "replicas": reps, "want_replicas": want,
+            "crc_mismatches": crc,
+            "last_scrub": max((r.get("last_scrub") or 0
+                               for r in rep.values()), default=None),
+            "free_slots": free_slots,
+        }
+        if crc:
+            info["state"] = "corrupt"
+            info["urgency"] = 1 + crc
+        elif len(reps) < want:
+            info["state"] = "under_replicated"
+            info["urgency"] = want - len(reps)
+        else:
+            info["state"] = "healthy"
+            info["urgency"] = 0
+        out[vid] = info
+    return out
+
+
+class RepairPlanner:
+    """Plans and executes repairs against the cluster's admin HTTP API.
+
+    `master` provides .topo and ._session; everything else rides env
+    knobs: WEEDTPU_REPAIR_CONCURRENCY (per-node active-repair cap,
+    default 2), WEEDTPU_REPAIR_RATE / WEEDTPU_REPAIR_BURST (token bucket,
+    default 1/s burst 4)."""
+
+    def __init__(self, master, *, node_concurrency: int | None = None,
+                 rate: float | None = None, burst: float | None = None,
+                 backoff_base: float = 2.0, backoff_max: float = 300.0):
+        self.master = master
+        self.node_concurrency = node_concurrency if node_concurrency \
+            else int(_env_float("WEEDTPU_REPAIR_CONCURRENCY", 2))
+        self.bucket = TokenBucket(
+            rate if rate is not None
+            else _env_float("WEEDTPU_REPAIR_RATE", 1.0),
+            burst if burst is not None
+            else _env_float("WEEDTPU_REPAIR_BURST", 4.0))
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        # vid -> {node -> last scrub report}
+        self.scrub_reports: dict[int, dict[str, dict]] = {}
+        self._active_vids: set[int] = set()
+        self._active_nodes: dict[str, int] = {}
+        self._backoff: dict[int, tuple[int, float]] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self.history: list[dict] = []
+
+    # -- scrub intake ---------------------------------------------------
+
+    def record_scrub(self, node: str, payload: dict) -> None:
+        for vid_s, rep in (payload.get("volumes") or {}).items():
+            try:
+                vid = int(vid_s)
+            except ValueError:
+                continue
+            per = self.scrub_reports.setdefault(vid, {})
+            per[node] = rep
+        # bound: vid space is client-influenced; drop oldest-known first
+        while len(self.scrub_reports) > 4096:
+            self.scrub_reports.pop(next(iter(self.scrub_reports)))
+
+    # -- ledger / status ------------------------------------------------
+
+    def ledger(self) -> dict[int, dict]:
+        led = build_ledger(self.master.topo, self.scrub_reports)
+        # keep the exported health gauge fresh on every ledger build —
+        # the background tick calls here, so /metrics shows live state
+        # even when nobody polls /maintenance/status
+        counts = {s: 0 for s in HEALTH_STATES}
+        for info in led.values():
+            counts[info["state"]] = counts.get(info["state"], 0) + 1
+        for state, n in counts.items():
+            metrics.VOLUME_HEALTH.labels(state).set(n)
+        return led
+
+    def status(self) -> dict:
+        return {
+            "tokens": round(self.bucket.tokens, 2),
+            "node_concurrency": self.node_concurrency,
+            "active": sorted(self._active_vids),
+            "backoffs": {str(v): {"failures": f,
+                                  "retry_in_s": round(max(0.0, ts -
+                                                          time.monotonic()),
+                                                      1)}
+                         for v, (f, ts) in self._backoff.items()},
+            "history": self.history[-20:],
+        }
+
+    # -- planning -------------------------------------------------------
+
+    def _repair_node(self, info: dict) -> str | None:
+        """The node a repair would run on (for the per-node cap): the EC
+        rebuilder holding the most shards, or the copy target for an
+        under-replicated volume."""
+        if info["kind"] == "ec":
+            counts: dict[str, int] = {}
+            for nodes in info.get("shard_locations", {}).values():
+                for url in nodes:
+                    counts[url] = counts.get(url, 0) + 1
+            return max(counts, key=counts.get) if counts else None
+        free = info.get("free_slots", {})
+        have = set(info.get("replicas", []))
+        for url in sorted(free, key=lambda u: -free[u]):
+            if url not in have and free[url] > 0:
+                return url
+        return None
+
+    async def tick(self) -> list[dict]:
+        """One planning pass: launch repair tasks for the most urgent
+        repairable volumes, bounded by the token bucket and per-node
+        caps.  Returns the actions launched (not their outcomes — await
+        wait_idle() for those)."""
+        led = self.ledger()
+        cands = sorted(
+            (i for i in led.values()
+             if i["state"] in ("degraded", "corrupt", "under_replicated")),
+            key=lambda i: -i["urgency"])
+        now = time.monotonic()
+        actions: list[dict] = []
+        for info in cands:
+            vid = info["vid"]
+            if vid in self._active_vids:
+                continue
+            bo = self._backoff.get(vid)
+            if bo and now < bo[1]:
+                continue
+            if info["kind"] == "normal" and info["state"] == "corrupt":
+                # a corrupt store needle heals by replica reads + vacuum;
+                # nothing to rebuild unless also under-replicated
+                if len(info["replicas"]) >= info["want_replicas"]:
+                    continue
+            node = self._repair_node(info)
+            if node is None:
+                continue
+            if self._active_nodes.get(node, 0) >= self.node_concurrency:
+                continue
+            if not self.bucket.try_acquire():
+                break  # rate-limited: later ticks pick up the rest
+            self._active_vids.add(vid)
+            self._active_nodes[node] = self._active_nodes.get(node, 0) + 1
+            task = asyncio.create_task(self._run_one(info, node))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            actions.append({"vid": vid, "kind": info["kind"],
+                            "state": info["state"], "node": node,
+                            "urgency": info["urgency"]})
+        return actions
+
+    async def wait_idle(self) -> None:
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks),
+                                 return_exceptions=True)
+
+    # -- execution ------------------------------------------------------
+
+    async def _post(self, url: str, path: str, body: dict) -> dict:
+        import aiohttp
+        # the master session's default 30s total timeout would abort a
+        # realistically-sized shard copy or rebuild mid-flight (the shell
+        # gives these 600s too)
+        async with self.master._session.post(
+                f"{_tls_scheme()}://{url}{path}", json=body,
+                timeout=aiohttp.ClientTimeout(total=600)) as r:
+            try:
+                data = await r.json()
+            except Exception:
+                data = {}
+            if r.status != 200:
+                raise RuntimeError(
+                    f"{url}{path}: HTTP {r.status} "
+                    f"{data.get('error', '')}".strip())
+            return data
+
+    async def _run_one(self, info: dict, node: str) -> None:
+        vid = info["vid"]
+        t0 = time.monotonic()
+        root = trace.new_root()
+        outcome = "ok"
+        try:
+            with trace.span("repair.volume", parent=root, vid=vid,
+                            kind=info["kind"], state=info["state"],
+                            urgency=info["urgency"]):
+                if info["kind"] == "ec":
+                    resolved = await self._repair_ec(vid, info)
+                else:
+                    await self._replicate_volume(vid, info, node)
+                    resolved = set()
+            self._backoff.pop(vid, None)
+            # clear ONLY the verdicts this repair actually resolved
+            # (purged + rebuilt); unlocalized or unpurgeable corruption
+            # stays on the ledger until a scrub pass re-verifies it
+            for rep in (self.scrub_reports.get(vid) or {}).values():
+                rep["corrupt"] = [c for c in rep.get("corrupt", [])
+                                  if c.get("shard", -1) not in resolved]
+            metrics.REPAIR_ACTIONS.labels(info["kind"], "ok").inc()
+        except Exception as e:
+            n = self._backoff.get(vid, (0, 0.0))[0] + 1
+            delay = min(self.backoff_base * (2 ** (n - 1)),
+                        self.backoff_max)
+            self._backoff[vid] = (n, time.monotonic() + delay)
+            metrics.REPAIR_ACTIONS.labels(info["kind"], "error").inc()
+            outcome = f"error: {e}"
+            log.warning("repair of volume %d failed (attempt %d, backoff "
+                        "%.1fs): %s", vid, n, delay, e)
+        finally:
+            self._active_vids.discard(vid)
+            left = self._active_nodes.get(node, 1) - 1
+            if left <= 0:
+                self._active_nodes.pop(node, None)
+            else:
+                self._active_nodes[node] = left
+        self.history.append({"vid": vid, "kind": info["kind"],
+                             "state": info["state"], "outcome": outcome,
+                             "seconds": round(time.monotonic() - t0, 3)})
+        del self.history[:-100]
+
+    async def _repair_ec(self, vid: int, info: dict) -> set[int]:
+        """Mirror of the shell's ec.rebuild for ONE volume, preceded by a
+        purge of scrub-verdicted corrupt shards so the rebuild can never
+        pick bad bytes as a survivor.  Returns the corrupt shard ids this
+        run resolved; raises when corruption remains unresolved — a
+        rebuild from possibly-corrupt survivors is worse than staying
+        degraded behind the read-path quarantine."""
+        shards = {sid: list(nodes)
+                  for sid, nodes in info.get("shard_locations", {}).items()}
+        resolved: set[int] = set()
+        unresolved: list[str] = []
+        for c in info.get("corrupt", []):
+            sid, node = c.get("shard", -1), c.get("node")
+            if sid < 0:
+                # unlocalized: quarantine (when any) guards reads, but we
+                # cannot pick a shard to replace — needs operator eyes
+                unresolved.append("unlocalized corruption "
+                                  f"at [{c.get('offset')}, "
+                                  f"+{c.get('size')})")
+                continue
+            owners = shards.get(sid, [])
+            if node not in owners:
+                # remote-scrub verdicts name the REPORTING node; purge on
+                # a node that actually owns the shard
+                node = owners[0] if owners else None
+            if node is None:
+                resolved.add(sid)  # already gone: the loss path rebuilds
+                continue
+            # len(shards) tracks earlier purges in this loop already
+            if sid in shards and len(shards) - 1 < layout.DATA_SHARDS:
+                unresolved.append(
+                    f"shard {sid} corrupt but only {len(shards)} shards "
+                    "present — purging would drop below k")
+                continue
+            with trace.span("repair.purge_corrupt", vid=vid, shard=sid,
+                            peer=node):
+                await self._post(node, "/admin/ec/delete_shards",
+                                 {"volume": vid, "shards": [sid]})
+            nodes = shards.get(sid, [])
+            if node in nodes:
+                nodes.remove(node)
+            if not nodes:
+                shards.pop(sid, None)
+            resolved.add(sid)
+        if unresolved:
+            # do NOT rebuild: /admin/ec/copy streams raw shard files (the
+            # quarantine only guards needle reads), so a rebuild here
+            # could bake the bad bytes into fresh shards
+            raise RuntimeError("; ".join(unresolved))
+        present = set(shards)
+        missing = [s for s in range(layout.TOTAL_SHARDS)
+                   if s not in present]
+        if not missing:
+            return resolved
+        if len(present) < layout.DATA_SHARDS:
+            raise RuntimeError(
+                f"only {len(present)} shards survive, need "
+                f"{layout.DATA_SHARDS}")
+        counts: dict[str, int] = {}
+        for nodes in shards.values():
+            for url in nodes:
+                counts[url] = counts.get(url, 0) + 1
+        rebuilder = max(counts, key=counts.get)
+        collection = info.get("collection", "")
+        borrowed: list[int] = []
+        for sid, nodes in sorted(shards.items()):
+            if rebuilder in nodes:
+                continue
+            with trace.span("repair.copy_survivor", vid=vid, shard=sid,
+                            source=nodes[0], target=rebuilder):
+                await self._post(rebuilder, "/admin/ec/copy",
+                                 {"volume": vid, "collection": collection,
+                                  "source": nodes[0], "shards": [sid],
+                                  "copy_ecx": False})
+            borrowed.append(sid)
+        with trace.span("repair.rebuild", vid=vid, node=rebuilder,
+                        missing=len(missing)):
+            await self._post(rebuilder, "/admin/ec/rebuild",
+                             {"volume": vid})
+        if borrowed:
+            await self._post(rebuilder, "/admin/ec/delete_shards",
+                             {"volume": vid, "shards": borrowed})
+        with trace.span("repair.mount", vid=vid, node=rebuilder):
+            await self._post(rebuilder, "/admin/ec/mount",
+                             {"volume": vid, "collection": collection})
+        log.info("repair: volume %d rebuilt shards %s on %s "
+                 "(purged %d corrupt)", vid, missing, rebuilder,
+                 len(resolved))
+        return resolved
+
+    async def _replicate_volume(self, vid: int, info: dict,
+                                target: str) -> None:
+        source = (info.get("replicas") or [None])[0]
+        if source is None:
+            raise RuntimeError("no surviving replica to copy from")
+        with trace.span("repair.replicate", vid=vid, source=source,
+                        target=target):
+            await self._post(target, "/admin/volume/copy",
+                             {"volume": vid, "source": source,
+                              "collection": info.get("collection", "")})
+        log.info("repair: volume %d re-replicated %s -> %s", vid, source,
+                 target)

@@ -53,6 +53,23 @@ def materialize(parity) -> np.ndarray:
         return np.asarray(parity)
 
 
+def parity_mismatch(codec, data: np.ndarray,
+                    parity_rows: dict[int, np.ndarray]
+                    ) -> dict[int, np.ndarray]:
+    """Scrub seam: recompute the parity of a [k, B] data-stripe window
+    through the SAME backend dispatch the encoder uses and compare
+    against the stored parity bytes.  Returns a boolean mismatch mask
+    per supplied parity row (row index is parity-relative: 0..m-1).
+    One dispatch verifies the whole window — RS(10,4) syndrome checking
+    IS a batched GF(2^8) matmul, the workload this seam accelerates."""
+    expect = materialize(dispatch_parity(codec, data))
+    return {r: np.not_equal(expect[r],
+                            np.frombuffer(stored, dtype=np.uint8)
+                            if isinstance(stored, (bytes, bytearray))
+                            else stored)
+            for r, stored in parity_rows.items()}
+
+
 def reconstruct_batch(codec, shards: dict[int, np.ndarray],
                       wanted: list[int]) -> dict[int, np.ndarray]:
     """Rebuild `wanted` shard rows from >=k survivor rows (host bytes

@@ -101,6 +101,10 @@ class MasterServer:
             web.get("/cluster/stream", self.handle_cluster_stream),
             web.post("/vol/vacuum", self.handle_vacuum),
             web.post("/vol/vacuum_toggle", self.handle_vacuum_toggle),
+            web.get("/maintenance/status", self.handle_maintenance_status),
+            web.post("/maintenance/scrub_report",
+                     self.handle_scrub_report),
+            web.post("/maintenance/tick", self.handle_maintenance_tick),
             web.post("/raft/peers/add", self.handle_raft_peer_add),
             web.post("/raft/peers/remove", self.handle_raft_peer_remove),
             web.get("/raft/status", self.handle_raft_status),
@@ -125,6 +129,11 @@ class MasterServer:
         self._grow_lock = asyncio.Lock()
         self._admin_lock: tuple[str, str, float] | None = None  # (token, owner, ts)
         self._expire_task: asyncio.Task | None = None
+        # self-healing plane: health ledger + automatic repair executor
+        # (maintenance/repair.py); ticked by _repair_loop on the leader
+        from seaweedfs_tpu.maintenance.repair import RepairPlanner
+        self.maintenance = RepairPlanner(self)
+        self._repair_task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------
 
@@ -147,6 +156,7 @@ class MasterServer:
                            ssl_context=_tls.server_ssl("master"))
         await site.start()
         self._expire_task = asyncio.create_task(self._expire_loop())
+        self._repair_task = asyncio.create_task(self._repair_loop())
         if self.raft:
             self.raft.start()
         log.info("master listening on %s", self.url)
@@ -156,6 +166,8 @@ class MasterServer:
             self.raft.stop()
         if self._expire_task:
             self._expire_task.cancel()
+        if self._repair_task:
+            self._repair_task.cancel()
         # wake /cluster/stream subscribers so their handlers return and
         # runner.cleanup() doesn't wait out its shutdown timeout on them
         for q in list(self._vid_subscribers):
@@ -277,6 +289,81 @@ class MasterServer:
                 log.warning("vacuum of %d on %s failed: %s", vid, url, e)
         return vacuumed
 
+    # -- self-healing maintenance plane ---------------------------------
+
+    async def _repair_loop(self) -> None:
+        """Background planner ticks (leader only).  WEEDTPU_REPAIR_INTERVAL
+        <= 0 disables the loop (repairs then run only via explicit
+        /maintenance/tick).  The loop yields while the shell holds the
+        admin lock: automatic maintenance must not race an operator."""
+        import os as _os
+        try:
+            interval = float(_os.environ.get("WEEDTPU_REPAIR_INTERVAL",
+                                             "15"))
+        except ValueError:
+            interval = 15.0
+        if interval <= 0:
+            return
+        while True:
+            await asyncio.sleep(interval)
+            if not self.is_leader:
+                continue
+            if self._admin_lock and \
+                    time.time() - self._admin_lock[2] < 30:
+                continue
+            try:
+                await self.maintenance.tick()
+            except Exception:
+                log.warning("repair tick failed", exc_info=True)
+
+    def _health_snapshot(self) -> dict:
+        led = self.maintenance.ledger()  # also refreshes VOLUME_HEALTH
+        from seaweedfs_tpu.maintenance.repair import HEALTH_STATES
+        counts = {s: 0 for s in HEALTH_STATES}
+        for info in led.values():
+            counts[info["state"]] = counts.get(info["state"], 0) + 1
+        return {"volumes": {str(vid): info
+                            for vid, info in sorted(led.items())},
+                "states": counts,
+                "planner": self.maintenance.status()}
+
+    async def handle_maintenance_status(self, req: web.Request
+                                        ) -> web.Response:
+        """Machine-readable cluster health: the per-volume ledger the
+        repair planner acts on, plus planner/executor state.  The
+        maintenance.status shell command and volume.fsck -json read
+        this."""
+        return web.json_response(self._health_snapshot())
+
+    async def handle_scrub_report(self, req: web.Request) -> web.Response:
+        """Scrub verdict intake from volume servers (maintenance/scrub.py
+        report hook)."""
+        try:
+            body = await req.json()
+        except ValueError:
+            return web.json_response({"error": "bad json"}, status=400)
+        node = body.get("node", "")
+        if not node:
+            return web.json_response({"error": "node required"}, status=400)
+        self.maintenance.record_scrub(node, body)
+        return web.json_response({})
+
+    async def handle_maintenance_tick(self, req: web.Request
+                                      ) -> web.Response:
+        """Force one planner tick; {"wait": true} blocks until every
+        launched repair finishes — the deterministic hook tests and
+        bench.py drive instead of sleeping on the background loop."""
+        if not self.is_leader:
+            return self._not_leader_response()
+        try:
+            body = await req.json()
+        except ValueError:
+            body = {}
+        actions = await self.maintenance.tick()
+        if body.get("wait"):
+            await self.maintenance.wait_idle()
+        return web.json_response({"actions": actions})
+
     async def handle_vacuum_toggle(self, req: web.Request) -> web.Response:
         """Pause/resume the automatic vacuum scan (reference: shell
         volume.vacuum.disable / volume.vacuum.enable)."""
@@ -355,7 +442,9 @@ class MasterServer:
     # the whitelist guards client-facing endpoints only: volume servers must
     # always heartbeat and Prometheus must always scrape (the reference
     # guards HTTP handlers while heartbeats ride unguarded gRPC)
-    _UNGUARDED = ("/heartbeat", "/metrics")
+    # scrub reports ride the same trust boundary as heartbeats: volume
+    # servers must always be able to deliver verdicts
+    _UNGUARDED = ("/heartbeat", "/metrics", "/maintenance/scrub_report")
 
     @web.middleware
     async def _guard_middleware(self, req: web.Request, handler):

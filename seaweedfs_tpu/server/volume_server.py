@@ -155,6 +155,8 @@ class VolumeServer:
             web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
             web.get("/admin/file", self.handle_file_pull),
             web.post("/admin/query", self.handle_query),
+            web.post("/admin/scrub", self.handle_scrub),
+            web.post("/admin/faults", self.handle_faults),
             web.route("*", "/{fid:[^/]*,[^/]+}", self.handle_blob),
         ])
         # in-flight throttling (reference: volume server
@@ -178,6 +180,10 @@ class VolumeServer:
         import threading as _threading
         self._ec_loc_lock = _threading.Lock()
         self._ec_loc_vid_locks: dict[int, _threading.Lock] = {}
+        # self-healing plane: background scrubber (maintenance/scrub.py)
+        # + injected-fault state (maintenance/faults.py, test-only)
+        self.scrubber = None
+        self._fault_delay_shard_read = 0.0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -206,10 +212,32 @@ class VolumeServer:
                 self.master_url = self.master_urls[
                     (i + 1) % len(self.master_urls)]
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        # test-only fault plan from the environment (maintenance/faults.py)
+        from seaweedfs_tpu.maintenance import faults as _faults
+        for f in _faults.parse_env(os.environ.get("WEEDTPU_FAULTS", "")):
+            if f["action"] == "delay_shard_read":
+                self._fault_delay_shard_read = f["ms"] / 1000.0
+            else:
+                try:
+                    _faults.apply(self.store, f)
+                except Exception as e:
+                    log.warning("env fault %s failed: %s", f, e)
+        # background scrubber: WEEDTPU_SCRUB_MBPS=0 disables
+        try:
+            mbps = float(os.environ.get("WEEDTPU_SCRUB_MBPS", "8"))
+        except ValueError:
+            mbps = 8.0
+        if mbps > 0:
+            from seaweedfs_tpu.maintenance.scrub import Scrubber
+            self.scrubber = Scrubber(
+                self.store, mbps=mbps, report=self._report_scrub,
+                shard_reader_factory=self._shard_reader).start()
         log.info("volume server on %s (dirs=%s)", self.url,
                  [l.directory for l in self.store.locations])
 
     async def stop(self) -> None:
+        if self.scrubber is not None:
+            await asyncio.to_thread(self.scrubber.stop)
         if self._hb_task:
             self._hb_task.cancel()
         if self._session:
@@ -477,6 +505,12 @@ class VolumeServer:
             return web.json_response({"error": "not found"}, status=404)
         except PermissionError:
             return web.json_response({"error": "cookie mismatch"}, status=404)
+        except ValueError as e:
+            # needle CRC mismatch / corrupt record: never return the bad
+            # bytes — count it, log with the trace id, and serve from a
+            # replica when one exists (maintenance satellite; the scrubber
+            # finds these offline, this is the online backstop)
+            return await self._blob_corrupt_fallback(req, fid, e)
         except IOError as e:
             return web.json_response({"error": str(e)}, status=500)
         headers = {"Etag": f'"{n.checksum:x}"', "Accept-Ranges": "bytes"}
@@ -565,6 +599,60 @@ class VolumeServer:
             content_type=(meta.mime.decode() if meta.mime
                           else "application/octet-stream"),
             headers=headers)
+
+    async def _blob_corrupt_fallback(self, req: web.Request, fid: t.FileId,
+                                     err: Exception) -> web.StreamResponse:
+        """A read hit corrupt bytes (CRC mismatch / unparseable record):
+        count it, log an always-on line carrying the trace id, and proxy
+        the read to another replica.  The peer is told not to fall back
+        again (X-Weedtpu-No-Fallback) so two corrupt replicas cannot
+        bounce a request between themselves."""
+        from seaweedfs_tpu.utils import weedlog
+        metrics.NEEDLE_CRC_MISMATCH.labels().inc()
+        tctx = trace.current()
+        weedlog.info(
+            "needle %s CRC mismatch on %s (trace %s): %s; trying replica",
+            str(fid), self.url, tctx.trace_id if tctx else "-", err,
+            name="volume")
+        if req.headers.get("X-Weedtpu-No-Fallback"):
+            return web.json_response({"error": str(err)}, status=500)
+        locations: list[dict] = []
+        try:
+            async with self._session.get(
+                    f"{_tls_scheme()}://{self.master_url}/dir/lookup",
+                    params={"volumeId": str(fid.volume_id)}) as r:
+                if r.status == 200:
+                    locations = (await r.json()).get("locations", [])
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass
+        for loc in locations:
+            if loc["url"] == self.url:
+                continue
+            try:
+                fwd = {"X-Weedtpu-No-Fallback": "1"}
+                if req.headers.get("Range"):
+                    fwd["Range"] = req.headers["Range"]
+                with trace.span("volume.crc_fallback", peer=loc["url"]):
+                    async with self._session.get(
+                            f"{_tls_scheme()}://{loc['url']}/{fid}",
+                            headers=fwd) as r:
+                        if r.status not in (200, 206):
+                            continue
+                        body = await r.read()
+                        headers = {"Accept-Ranges": "bytes"}
+                        for h in ("Etag", "Content-Range",
+                                  "Content-Disposition"):
+                            if r.headers.get(h):
+                                headers[h] = r.headers[h]
+                        return web.Response(
+                            body=b"" if req.method == "HEAD" else body,
+                            status=r.status,
+                            content_type=r.headers.get(
+                                "Content-Type", "application/octet-stream"),
+                            headers=headers)
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                continue
+        return web.json_response({"error": str(err)}, status=500)
 
     async def _delete_blob(self, req: web.Request, fid: t.FileId) -> web.Response:
         try:
@@ -1383,7 +1471,67 @@ class VolumeServer:
                 return web.FileResponse(p)
         return web.json_response({"error": "file not found"}, status=404)
 
+    # -- maintenance: scrub + fault injection ----------------------------
+
+    def _report_scrub(self, summary: dict) -> None:
+        """Push a scrub pass's verdicts to the master's repair planner.
+        Runs on the scrub thread -> blocking client."""
+        import json as _json
+        import urllib.request
+        body = _json.dumps({"node": self.url, "ts": summary.get("ts"),
+                            "volumes": summary.get("volumes", {})}).encode()
+        try:
+            r = urllib.request.Request(
+                f"{_tls_scheme()}://{self.master_url}"
+                "/maintenance/scrub_report", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(r, timeout=10).close()
+        except OSError as e:
+            log.warning("scrub report to %s failed: %s", self.master_url, e)
+
+    def _loopback_only(self, req: web.Request) -> web.Response | None:
+        if req.remote not in ("127.0.0.1", "::1"):
+            return web.json_response({"error": "loopback only"}, status=403)
+        return None
+
+    async def handle_scrub(self, req: web.Request) -> web.Response:
+        """Run one scrub pass NOW and return its summary (also reported
+        to the master).  Operator/test hook; the background loop covers
+        steady state."""
+        err = self._loopback_only(req)
+        if err is not None:
+            return err
+        s = self.scrubber
+        if s is None:
+            from seaweedfs_tpu.maintenance.scrub import Scrubber
+            s = Scrubber(self.store, report=None,
+                         shard_reader_factory=self._shard_reader)
+        summary = await asyncio.to_thread(s.scrub_once)
+        await asyncio.to_thread(self._report_scrub, summary)
+        return web.json_response(summary)
+
+    async def handle_faults(self, req: web.Request) -> web.Response:
+        """Test-only fault injection (maintenance/faults.py): flip bits,
+        delete shards, delay peer shard reads.  Loopback only."""
+        err = self._loopback_only(req)
+        if err is not None:
+            return err
+        from seaweedfs_tpu.maintenance import faults as _faults
+        body = await req.json()
+        applied = []
+        for f in body.get("faults", []):
+            if f.get("action") == "delay_shard_read":
+                self._fault_delay_shard_read = float(f.get("ms", 0)) / 1000.0
+                applied.append(dict(f, ok=True))
+                continue
+            applied.append(await asyncio.to_thread(
+                _faults.apply, self.store, f))
+        await self._heartbeat_once()
+        return web.json_response({"applied": applied})
+
     async def handle_ec_shard_read(self, req: web.Request) -> web.Response:
+        if self._fault_delay_shard_read > 0:
+            await asyncio.sleep(self._fault_delay_shard_read)
         q = req.query
         vid, sid = int(q["volume"]), int(q["shard"])
         offset, size = int(q["offset"]), int(q["size"])

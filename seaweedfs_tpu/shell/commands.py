@@ -646,12 +646,61 @@ def cmd_volume_check_disk(env: CommandEnv, args, out):
     print(f"volume.check.disk: {issues} divergent replica pair(s)", file=out)
 
 
+@command("maintenance.status")
+def cmd_maintenance_status(env: CommandEnv, args, out):
+    """Cluster self-healing status from the master's health ledger:
+    per-volume state (healthy/degraded/under_replicated/corrupt/critical),
+    last-scrub time, quarantined ranges, and repair-planner state.
+    -json emits the raw machine-readable ledger for CI assertions."""
+    flags = parse_flags(args)
+    st = env.master_get("/maintenance/status")
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    import datetime as _dt
+    for vid, v in sorted(st.get("volumes", {}).items(),
+                         key=lambda kv: int(kv[0])):
+        if v.get("kind") == "ec":
+            present = v.get("shards_present", [])
+            detail = f"shards {len(present)}/{layout.TOTAL_SHARDS}"
+            if v.get("shards_missing"):
+                detail += f" missing {v['shards_missing']}"
+            if v.get("corrupt"):
+                detail += " corrupt " + str(
+                    sorted({c.get('shard', -1) for c in v['corrupt']}))
+            nq = sum(len(r) for q in (v.get("quarantined") or {}).values()
+                     for r in q.values())
+            if nq:
+                detail += f" quarantined {nq} range(s)"
+        else:
+            detail = (f"replicas {len(v.get('replicas', []))}"
+                      f"/{v.get('want_replicas', 1)}")
+            if v.get("crc_mismatches"):
+                detail += f" crc_mismatches {v['crc_mismatches']}"
+        ls = v.get("last_scrub")
+        scrub = _dt.datetime.fromtimestamp(ls).isoformat(" ", "seconds") \
+            if ls else "never"
+        print(f"volume {vid} [{v.get('kind')}]: {v.get('state'):16s} "
+              f"{detail}  last-scrub {scrub}", file=out)
+    states = st.get("states", {})
+    print("states: " + " ".join(f"{k}={v}" for k, v in sorted(
+        states.items()) if v), file=out)
+    pl = st.get("planner", {})
+    print(f"planner: tokens={pl.get('tokens')} active={pl.get('active')} "
+          f"backoffs={len(pl.get('backoffs', {}))}", file=out)
+
+
 @command("volume.fsck")
 def cmd_volume_fsck(env: CommandEnv, args, out):
     """Cross-check filer chunk references against volume needles
     (reference: command_volume_fsck.go:60-75).  Reports orphan needles
-    (in volumes but unreferenced) and broken refs (referenced but gone)."""
+    (in volumes but unreferenced) and broken refs (referenced but gone).
+    -json emits a machine-readable report including each volume's health
+    state, last-scrub time, and quarantined ranges from the master's
+    maintenance ledger."""
     env.require_lock()
+    flags = parse_flags(args)
+    as_json = "json" in flags
     filer = env.find_filer()
     # collect all chunk fids from the filer
     referenced: dict[int, set[int]] = {}
@@ -689,6 +738,7 @@ def cmd_volume_fsck(env: CommandEnv, args, out):
             r = env.master_get_raw(nid_, "/admin/volume/needles", volume=vid)
             stored.setdefault(vid, set()).update(r.get("needles", []))
             vol_nodes[vid] = nid_
+    report: dict[str, dict] = {}
     orphans = broken = 0
     for vid, needles in sorted(stored.items()):
         refs = referenced.get(vid, set())
@@ -696,14 +746,49 @@ def cmd_volume_fsck(env: CommandEnv, args, out):
         b = refs - needles
         orphans += len(o)
         broken += len(b)
-        if o or b:
+        report[str(vid)] = {"orphans": len(o), "broken_refs": len(b),
+                            "needles": len(needles), "node": vol_nodes[vid]}
+        if (o or b) and not as_json:
             print(f"volume {vid}: {len(o)} orphan needle(s), "
                   f"{len(b)} broken ref(s)", file=out)
-    # refs into volumes that no longer exist anywhere are all broken
+    # refs into volumes that no longer exist anywhere are all broken —
+    # but a volume converted to EC shards still exists (its needles just
+    # can't be enumerated over /admin/volume/needles), so refs into it
+    # are fine, not broken
+    ec_vids = {int(v) for node in topo["nodes"].values()
+               for v in node.get("ec_shards", {})}
     for vid in sorted(set(referenced) - set(stored)):
+        if vid in ec_vids:
+            report[str(vid)] = {"ec": True, "refs": len(referenced[vid])}
+            continue
         b = len(referenced[vid])
         broken += b
-        print(f"volume {vid}: MISSING, {b} broken ref(s)", file=out)
+        report[str(vid)] = {"missing": True, "broken_refs": b}
+        if not as_json:
+            print(f"volume {vid}: MISSING, {b} broken ref(s)", file=out)
+    if as_json:
+        # fold in the master's health ledger so CI can assert on cluster
+        # health (state / last scrub / quarantined ranges) in one pass
+        try:
+            health = env.master_get("/maintenance/status")
+        except RuntimeError:
+            health = {}
+        for vid, v in (health.get("volumes") or {}).items():
+            rec = report.setdefault(vid, {})
+            rec["health"] = {
+                "state": v.get("state"), "kind": v.get("kind"),
+                "last_scrub": v.get("last_scrub"),
+                "quarantined": v.get("quarantined") or {},
+                "shards_missing": v.get("shards_missing", []),
+            }
+        print(json.dumps({
+            "volumes": report, "orphans": orphans, "broken_refs": broken,
+            "states": health.get("states", {}),
+            "healthy": broken == 0 and all(
+                (r.get("health") or {}).get("state") in (None, "healthy")
+                for r in report.values()),
+        }, separators=(",", ":")), file=out)
+        return
     print(f"volume.fsck: {orphans} orphan(s), {broken} broken ref(s) "
           f"across {len(stored)} volume(s)", file=out)
 

@@ -353,3 +353,25 @@ EC_DEGRADED_READ = REGISTRY.gauge(
     "weedtpu_ec_degraded_read", "EC degraded-read engine counters "
     "(shards fetched, intervals coalesced, reconstruct batches/intervals, "
     "cache hits)", ("stat",))
+# self-healing maintenance plane (maintenance/): read-path CRC verdicts,
+# needle-map integrity-repair drops, scrubber progress, and the master's
+# repair planner outcomes + health ledger
+NEEDLE_CRC_MISMATCH = REGISTRY.counter(
+    "weedtpu_needle_crc_mismatch_total",
+    "store-volume reads that failed CRC verification")
+NEEDLE_MAP_DROPS = REGISTRY.counter(
+    "weedtpu_needle_map_integrity_drops_total",
+    "needle-map entries discarded by integrity repair / .sdx rebuild",
+    ("kind",))
+SCRUB_BYTES = REGISTRY.counter(
+    "weedtpu_scrub_bytes_total", "bytes verified by the background "
+    "scrubber", ("kind",))
+SCRUB_CORRUPTIONS = REGISTRY.counter(
+    "weedtpu_scrub_corruptions_total",
+    "corruptions found by the scrubber", ("kind",))
+REPAIR_ACTIONS = REGISTRY.counter(
+    "weedtpu_repair_actions_total",
+    "automatic repair executions by outcome", ("kind", "outcome"))
+VOLUME_HEALTH = REGISTRY.gauge(
+    "weedtpu_volume_health", "volumes per health-ledger state (master)",
+    ("state",))

@@ -88,6 +88,13 @@ def current() -> Trace | None:
     return _current.get()
 
 
+def new_root(sampled: bool = True) -> Trace:
+    """Fresh root context for work that starts outside any request —
+    background maintenance (scrub passes, repair executions) parents its
+    spans here so a whole repair shows up as one trace in /debug/traces."""
+    return Trace(_new_trace_id(), _new_span_id(), sampled)
+
+
 def current_exemplar() -> str | None:
     """Trace id for histogram exemplars — only sampled traces qualify."""
     t = _current.get()

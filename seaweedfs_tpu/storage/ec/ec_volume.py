@@ -116,6 +116,13 @@ class EcVolume:
             OrderedDict()
         self._recon_cache_bytes = 0
         self._recon_lock = threading.Lock()
+        # scrub-verdicted corrupt byte ranges per shard: reads overlapping
+        # a quarantined range treat the local shard as unreadable, so the
+        # interval is served via reconstruction (never from the bad
+        # bytes).  A rebuild + remount replaces the file AND this object,
+        # which is what clears the quarantine.
+        self._quarantine: dict[int, list[tuple[int, int]]] = {}
+        self._quarantine_lock = threading.Lock()
 
     # -- index ---------------------------------------------------------
 
@@ -188,14 +195,51 @@ class EcVolume:
                 _, ev = self._recon_cache.popitem(last=False)
                 self._recon_cache_bytes -= len(ev)
 
+    # -- quarantine ------------------------------------------------------
+
+    def quarantine_range(self, shard_id: int, offset: int, size: int) -> None:
+        """Mark [offset, offset+size) of one shard as corrupt: local reads
+        of any overlapping range fail over to reconstruction.  Adjacent /
+        overlapping ranges merge so the list stays small."""
+        with self._quarantine_lock:
+            ranges = self._quarantine.get(shard_id, [])
+            ranges.append((offset, size))
+            ranges.sort()
+            merged: list[tuple[int, int]] = []
+            for off, sz in ranges:
+                if merged and off <= merged[-1][0] + merged[-1][1]:
+                    lo, lsz = merged[-1]
+                    merged[-1] = (lo, max(lsz, off + sz - lo))
+                else:
+                    merged.append((off, sz))
+            self._quarantine[shard_id] = merged
+
+    def _is_quarantined(self, shard_id: int, offset: int, size: int) -> bool:
+        with self._quarantine_lock:
+            ranges = self._quarantine.get(shard_id)
+            if not ranges:
+                return False
+            return any(off < offset + size and offset < off + sz
+                       for off, sz in ranges)
+
+    def quarantine_snapshot(self) -> dict[str, list[list[int]]]:
+        with self._quarantine_lock:
+            return {str(sid): [[off, sz] for off, sz in ranges]
+                    for sid, ranges in self._quarantine.items() if ranges}
+
     # -- reads ----------------------------------------------------------
 
     def _read_local(self, shard_id: int, offset: int, size: int) -> bytes | None:
         """Positional read on the shard fd: os.pread carries its own file
         offset, so concurrent interval reads of one EcVolume never race a
-        shared seek position."""
+        shared seek position.  Quarantined (scrub-verdicted corrupt)
+        ranges read as unreadable so every caller — the batched engine,
+        survivor gathering, and peer shard_read — falls over to
+        reconstruction instead of the bad bytes."""
         f = self.shards.get(shard_id)
         if f is None:
+            return None
+        if self._quarantine and self._is_quarantined(shard_id, offset, size):
             return None
         try:
             return os.pread(f.fileno(), size, offset)

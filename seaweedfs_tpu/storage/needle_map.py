@@ -25,7 +25,16 @@ from typing import BinaryIO, Iterator
 
 import numpy as np
 
+from seaweedfs_tpu.stats import metrics
 from seaweedfs_tpu.storage import idx, types as t
+
+
+def _count_drop(kind: str, n: int = 1) -> None:
+    """Integrity-repair drops were silently swallowed; they are now a
+    /metrics counter so an operator can see a volume shedding entries
+    (weedtpu_needle_map_integrity_drops_total{kind=...})."""
+    if n > 0:
+        metrics.NEEDLE_MAP_DROPS.labels(kind).inc(n)
 
 
 class NeedleMap:
@@ -75,7 +84,8 @@ class NeedleMap:
     def drop(self, needle_id: int) -> None:
         """Remove an entry without tombstone accounting (integrity repair
         of torn writes: the data never existed, so it isn't 'deleted')."""
-        self._m.pop(needle_id, None)
+        if self._m.pop(needle_id, None) is not None:
+            _count_drop("integrity_repair")
 
     def __len__(self) -> int:
         return sum(1 for v in self._m.values() if t.size_is_valid(v[1]))
@@ -221,6 +231,7 @@ class CompactNeedleMap:
                 self._live -= 1
                 self._live_bytes -= old[1]
             self._overflow[needle_id] = None
+        _count_drop("integrity_repair")
 
     def _merge(self) -> None:
         """Fold the overflow dict into the sorted base columns in one
@@ -401,10 +412,13 @@ class SortedFileNeedleMap:
 
     @classmethod
     def build(cls, idx_path: str, sdx_path: str) -> None:
-        """Compact the .idx log into a sorted .sdx (live entries only)."""
+        """Compact the .idx log into a sorted .sdx (live entries only);
+        discarded entries (tombstoned / superseded latest state) are
+        counted on /metrics rather than silently swallowed."""
         nm = NeedleMap.load_from_idx(idx_path)
         entries = sorted((nid, v) for nid, v in nm._m.items()
                          if t.size_is_valid(v[1]))
+        _count_drop("sdx_rebuild", len(nm._m) - len(entries))
         tmp = sdx_path + ".tmp"
         with open(tmp, "wb") as f:
             for nid, (off, size) in entries:

@@ -28,6 +28,12 @@ if str(REPO_ROOT) not in sys.path:
 REFERENCE_ROOT = pathlib.Path("/root/reference")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: timing-sensitive tests excluded from tier-1 "
+        "(-m 'not slow')")
+
+
 def reference_fixture(relpath: str) -> pathlib.Path | None:
     """Path to a binary test fixture inside the read-only reference checkout,
     or None when the reference isn't mounted (tests then skip the golden
