@@ -112,6 +112,14 @@ KLAUSPOST_AVX2_GBPS = 5.0  # klauspost README single-stream 10+4 AVX2 figure
 RS_SWEEP = [(6, 3), (12, 4), (16, 4)]
 
 
+def free_port() -> int:
+    """An OS-assigned localhost port for the in-process bench clusters."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _probe_once(timeout: float) -> bool:
     """Probe TPU init in a subprocess: the tunneled chip can hang backend
     initialisation entirely when the tunnel is down, which would wedge
@@ -331,7 +339,8 @@ def _bench_rebuild_kernel(k: int, m: int, lost: int, n: int,
 
 def _bench_e2e(size: int, batch: int, codec_env: str | None,
                reps: int = 4, detail: dict | None = None,
-               pipeline_env: str | None = None) -> float:
+               pipeline_env: str | None = None,
+               profile_stacks: bool = False) -> float:
     """file -> shards through write_ec_files in the production layout
     (1MB small blocks, column-batched steps), best of `reps`.
 
@@ -346,6 +355,7 @@ def _bench_e2e(size: int, batch: int, codec_env: str | None,
 
     `pipeline_env` forces WEEDTPU_EC_PIPELINE (serial|pipelined) so the
     two strategies can be raced on the same codec and host."""
+    from seaweedfs_tpu.stats import profile as _profile
     from seaweedfs_tpu.storage.ec import ec_files, layout
     old = os.environ.get("WEEDTPU_EC_CODEC")
     old_pipe = os.environ.get("WEEDTPU_EC_PIPELINE")
@@ -353,6 +363,10 @@ def _bench_e2e(size: int, batch: int, codec_env: str | None,
         os.environ["WEEDTPU_EC_CODEC"] = codec_env
     if pipeline_env is not None:
         os.environ["WEEDTPU_EC_PIPELINE"] = pipeline_env
+    # stack capture is opt-in (the tunnel/XLA scenario): the sampler is
+    # cheap but the host-1g numbers gate regressions and stay untaxed
+    profiler = _profile.SamplingProfiler(97).start() \
+        if profile_stacks and detail is not None else None
     try:
         with tempfile.TemporaryDirectory(prefix="weedtpu-e2e-") as d:
             base = os.path.join(d, "v")
@@ -385,8 +399,16 @@ def _bench_e2e(size: int, batch: int, codec_env: str | None,
                     detail[k_] = (round(best_stats[k_], 4)
                                   if isinstance(best_stats[k_], float)
                                   else best_stats[k_])
+            if profiler is not None:
+                # where the e2e scenario actually burns its time, sampled
+                # across all reps: the top-5 collapsed stacks land in the
+                # bench JSON so a regressed round carries its own profile
+                detail["profile_top5"] = \
+                    profiler.collapsed(limit=5).splitlines()
         return size / 1e9 / best
     finally:
+        if profiler is not None:
+            profiler.stop()
         if codec_env is not None:
             if old is None:
                 os.environ.pop("WEEDTPU_EC_CODEC", None)
@@ -512,8 +534,8 @@ def main() -> None:
     # against their serial baselines, and the tracing layer raced against
     # itself disabled — each with a regression gate
     for fn in (_bench_degraded_read, _bench_filer_stream,
-               _bench_trace_overhead, _bench_heal_time,
-               _bench_scrub_overhead):
+               _bench_trace_overhead, _bench_profile_overhead,
+               _bench_heal_time, _bench_scrub_overhead):
         try:
             fn(extra)
         except Exception as e:
@@ -607,7 +629,8 @@ def main() -> None:
     if on_tpu:
         d: dict = {}
         _try(extra, "ec_encode_e2e_tunnel", _bench_e2e,
-             20 * 1024 * 1024, 2 * 1024 * 1024, "tpu", 2, d)
+             20 * 1024 * 1024, 2 * 1024 * 1024, "tpu", 2, d,
+             profile_stacks=True)
         if "ec_encode_e2e_tunnel" in extra:
             extra["ec_encode_e2e_tunnel_bound"] = True
             if d:
@@ -618,8 +641,12 @@ def main() -> None:
         # key instead of being discarded
         key_e2e = ("ec_encode_e2e_xla" if "ec_encode_e2e" in extra
                    else "ec_encode_e2e")
+        xd: dict = {}
         _try(extra, key_e2e, _bench_e2e,
-             80 * 1024 * 1024, 8 * 1024 * 1024, None)
+             80 * 1024 * 1024, 8 * 1024 * 1024, None, 4, xd,
+             profile_stacks=True)
+        if xd:
+            extra[key_e2e + "_detail"] = xd
 
     _emit(gbps, backend, baseline, extra)
     return _exit_code(extra)
@@ -633,6 +660,7 @@ def _exit_code(extra: dict) -> int:
              "blob_read_degraded_regression",
              "filer_stream_pipeline_regression",
              "trace_overhead_regression",
+             "profile_overhead_regression",
              "heal_time_regression",
              "scrub_overhead_regression",
              "gated_bench_failed")
@@ -655,6 +683,9 @@ HEAL_REGRESSION_TOL = 1.25
 # foreground blob reads must keep >= 0.95x throughput with the scrubber
 # running at its rate limit (ISSUE 4 acceptance bar)
 SCRUB_OVERHEAD_TOL = 0.95
+# blob reads with the HZ=97 sampling profiler walking every thread must
+# keep >= 0.95x the unprofiled rate (ISSUE 5 acceptance bar)
+PROFILE_OVERHEAD_TOL = 0.95
 
 
 def _bench_e2e_host(extra: dict) -> None:
@@ -749,12 +780,6 @@ def _bench_blob_rps(extra: dict, n: int = 2000, size: int = 1024,
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
 
-    import socket
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
 
     loop = asyncio.new_event_loop()
     threading.Thread(target=loop.run_forever, daemon=True).start()
@@ -913,18 +938,12 @@ def _bench_filer_stream(extra: dict, size: int = 24 * 1024 * 1024,
     Below FILER_STREAM_REGRESSION_TOL the run FAILS
     (filer_stream_pipeline_regression + nonzero exit)."""
     import asyncio
-    import socket
     import threading
     import urllib.request
 
     from seaweedfs_tpu.server.filer_server import FilerServer
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
 
     loop = asyncio.new_event_loop()
     threading.Thread(target=loop.run_forever, daemon=True).start()
@@ -1034,17 +1053,11 @@ def _bench_trace_overhead(extra: dict, n: int = 1200, size: int = 1024,
     3%-tight gate flaps on scheduler noise alone."""
     import asyncio
     import concurrent.futures
-    import socket
     import threading
 
     from seaweedfs_tpu.client import WeedClient
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
 
     loop = asyncio.new_event_loop()
     threading.Thread(target=loop.run_forever, daemon=True).start()
@@ -1131,6 +1144,120 @@ def _bench_trace_overhead(extra: dict, n: int = 1200, size: int = 1024,
               f"3% budget. Failing the bench run.", file=sys.stderr)
 
 
+def _bench_profile_overhead(extra: dict, n: int = 1200, size: int = 1024,
+                            concurrency: int = 16, pairs: int = 7) -> None:
+    """Sampling-profiler tax on the hottest path: blob reads against an
+    in-process master+volume cluster with the continuous profiler walking
+    every thread at HZ=97 vs no profiler at all, interleaved pairs over
+    the same blobs.  The sampler holds the GIL for one frame walk per
+    tick; below PROFILE_OVERHEAD_TOL (>= 5% regression) the run FAILS
+    (profile_overhead_regression + nonzero exit).  The winning top
+    collapsed stack is recorded so the JSON shows WHAT the profiler saw
+    while it was being measured."""
+    import asyncio
+    import concurrent.futures
+    import threading
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.stats import profile as _profile
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    # an inherited WEEDTPU_PROFILE_HZ would start the CONTINUOUS profiler
+    # inside the servers below, taxing both arms equally and pinning the
+    # ratio at ~1.0 — the gate could then never fire
+    old_hz = os.environ.pop("WEEDTPU_PROFILE_HZ", None)
+    _profile.shutdown()
+
+    best_on = best_off = float("inf")
+    ratios: list[float] = []
+    top_stack = ""
+    with tempfile.TemporaryDirectory(prefix="weedtpu-prov-") as d:
+        master = MasterServer("127.0.0.1", free_port())
+        vs = VolumeServer([d], master.url, port=free_port(),
+                          heartbeat_interval=0.2)
+        started = []
+        try:
+            run(master.start())
+            started.append(master)
+            run(vs.start())
+            started.append(vs)
+            deadline = time.time() + 10
+            while time.time() < deadline and not master.topo.nodes:
+                time.sleep(0.05)
+            client = WeedClient(master.url)
+            payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+            with concurrent.futures.ThreadPoolExecutor(concurrency) as ex:
+                fids = list(ex.map(
+                    lambda i: client.upload(payload, name=f"p{i}"),
+                    range(n)))
+
+            def rep(profiled: bool) -> float:
+                prof = _profile.SamplingProfiler(97).start() \
+                    if profiled else None
+                try:
+                    t0 = time.perf_counter()
+                    with concurrent.futures.ThreadPoolExecutor(
+                            concurrency) as ex:
+                        for data in ex.map(client.download, fids):
+                            assert len(data) == size
+                    return time.perf_counter() - t0
+                finally:
+                    if prof is not None:
+                        prof.stop()
+                        nonlocal top_stack
+                        top_stack = prof.collapsed(limit=1) or top_stack
+
+            for i in range(pairs):
+                if i % 2 == 0:
+                    t_off = rep(False)
+                    t_on = rep(True)
+                else:
+                    t_on = rep(True)
+                    t_off = rep(False)
+                if i == 0:
+                    continue  # warm connections / page cache
+                best_on = min(best_on, t_on)
+                best_off = min(best_off, t_off)
+                ratios.append(t_off / t_on)
+            client.close()
+        finally:
+            if vs in started:
+                run_quiet(vs.stop())
+            if master in started:
+                run_quiet(master.stop())
+            loop.call_soon_threadsafe(loop.stop)
+            if old_hz is not None:
+                os.environ["WEEDTPU_PROFILE_HZ"] = old_hz
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["blob_read_rps_profiled"] = round(n / best_on, 1)
+    extra["blob_read_rps_unprofiled"] = round(n / best_off, 1)
+    extra["profile_overhead_ratio"] = round(ratio, 3)
+    if top_stack:
+        extra["profile_top_stack"] = top_stack
+    if ratio < PROFILE_OVERHEAD_TOL:
+        extra["profile_overhead_regression"] = True
+        print(f"bench: REGRESSION — blob reads with the sampling "
+              f"profiler at HZ=97 run at {ratio:.3f}x the unprofiled "
+              f"rate (median of interleaved pairs); profiling exceeds "
+              f"its 5% budget. Failing the bench run.", file=sys.stderr)
+
+
 def _bench_heal_time(extra: dict, n_volumes: int = 4,
                      blobs_per_vol: int = 24, size: int = 48 * 1024) -> None:
     """seconds-to-reprotected: inject loss of 2 shards in each of
@@ -1144,7 +1271,6 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
     nonzero exit."""
     import asyncio
     import io
-    import socket
     import threading
     import urllib.request
 
@@ -1153,11 +1279,6 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
     from seaweedfs_tpu.shell.commands import CommandEnv, run_command
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
 
     loop = asyncio.new_event_loop()
     threading.Thread(target=loop.run_forever, daemon=True).start()
@@ -1346,7 +1467,6 @@ def _bench_scrub_overhead(extra: dict, n: int = 1000, size: int = 1024,
     the run (scrub_overhead_regression + nonzero exit)."""
     import asyncio
     import concurrent.futures
-    import socket
     import threading
 
     from seaweedfs_tpu import native
@@ -1354,11 +1474,6 @@ def _bench_scrub_overhead(extra: dict, n: int = 1000, size: int = 1024,
     from seaweedfs_tpu.maintenance.scrub import Scrubber
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
 
     loop = asyncio.new_event_loop()
     threading.Thread(target=loop.run_forever, daemon=True).start()

@@ -9,13 +9,22 @@ codecs dispatch asynchronously and return an un-materialised device array
 whose d2h transfer is the sync point.  Centralising the isinstance
 fan-out here keeps the storage layer free of backend imports and gives
 the overlapped pipeline one seam to time the sync point through.
+
+Every call also feeds the per-kernel profile (stats/profile.KERNELS):
+host wall, H2D conversion, `block_until_ready` device time, and D2H
+transfer are recorded separately per entry point, so a 225 ms `encode`
+span finally decomposes into matmul vs transfer vs host codec time at
+/debug/pprof?format=table.
 """
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from seaweedfs_tpu.stats import trace
+from seaweedfs_tpu.stats.profile import KERNELS
 
 
 def _host_classes():
@@ -30,27 +39,47 @@ def dispatch_parity(codec, batch: np.ndarray):
     NativeRSCodec, RSCode = _host_classes()
     if isinstance(codec, NativeRSCodec):
         with trace.span("codec.dispatch_parity", backend="host",
-                        bytes=batch.nbytes):
+                        bytes=batch.nbytes), \
+                KERNELS.timed("encode_parity", nbytes=batch.nbytes):
             return codec.encode_parity(batch)
     if isinstance(codec, RSCode):
         with trace.span("codec.dispatch_parity", backend="host",
-                        bytes=batch.nbytes):
+                        bytes=batch.nbytes), \
+                KERNELS.timed("encode_parity", nbytes=batch.nbytes):
             return codec.encode_numpy(batch)[codec.k:]
     import jax.numpy as jnp
     # a device dispatch returns un-materialised: this span times only the
     # h2d + async enqueue — the sync cost shows up under codec.d2h
     with trace.span("codec.dispatch_parity", backend="device",
                     bytes=batch.nbytes):
-        return codec.encode_parity(jnp.asarray(batch))
+        t0 = time.perf_counter()
+        dev = jnp.asarray(batch)
+        t1 = time.perf_counter()
+        out = codec.encode_parity(dev)
+        KERNELS.record("encode_parity", "device",
+                       wall_s=time.perf_counter() - t1,
+                       h2d_s=t1 - t0, h2d_bytes=batch.nbytes,
+                       nbytes=batch.nbytes)
+        return out
 
 
-def materialize(parity) -> np.ndarray:
+def materialize(parity, kernel: str = "encode_parity") -> np.ndarray:
     """Sync point of an async dispatch: host backends already returned
-    numpy; device arrays transfer d2h here."""
+    numpy; device arrays `block_until_ready` (device time, attributed to
+    `kernel`) and then transfer d2h here."""
     if isinstance(parity, np.ndarray):
         return parity
-    with trace.span("codec.d2h", bytes=getattr(parity, "nbytes", 0)):
-        return np.asarray(parity)
+    nbytes = getattr(parity, "nbytes", 0)
+    with trace.span("codec.d2h", bytes=nbytes):
+        t0 = time.perf_counter()
+        if hasattr(parity, "block_until_ready"):
+            parity.block_until_ready()
+        t1 = time.perf_counter()
+        out = np.asarray(parity)
+        KERNELS.record(kernel, "device", calls=0,
+                       device_s=t1 - t0,
+                       d2h_s=time.perf_counter() - t1, d2h_bytes=nbytes)
+        return out
 
 
 def parity_mismatch(codec, data: np.ndarray,
@@ -61,7 +90,8 @@ def parity_mismatch(codec, data: np.ndarray,
     against the stored parity bytes.  Returns a boolean mismatch mask
     per supplied parity row (row index is parity-relative: 0..m-1).
     One dispatch verifies the whole window — RS(10,4) syndrome checking
-    IS a batched GF(2^8) matmul, the workload this seam accelerates."""
+    IS a batched GF(2^8) matmul, the workload this seam accelerates.
+    (Profiled under `encode_parity` — it runs the encode kernel.)"""
     expect = materialize(dispatch_parity(codec, data))
     return {r: np.not_equal(expect[r],
                             np.frombuffer(stored, dtype=np.uint8)
@@ -78,15 +108,26 @@ def reconstruct_batch(codec, shards: dict[int, np.ndarray],
     nbytes = sum(v.nbytes for v in shards.values())
     if isinstance(codec, NativeRSCodec):
         with trace.span("codec.reconstruct", backend="host",
-                        bytes=nbytes, wanted=len(wanted)):
+                        bytes=nbytes, wanted=len(wanted)), \
+                KERNELS.timed("reconstruct", nbytes=nbytes):
             return codec.reconstruct(shards, wanted=wanted)
     if isinstance(codec, RSCode):
         with trace.span("codec.reconstruct", backend="host",
-                        bytes=nbytes, wanted=len(wanted)):
+                        bytes=nbytes, wanted=len(wanted)), \
+                KERNELS.timed("reconstruct", nbytes=nbytes):
             return codec.reconstruct_numpy(shards, wanted=wanted)
     import jax.numpy as jnp
     with trace.span("codec.reconstruct", backend="device",
                     bytes=nbytes, wanted=len(wanted)):
-        out = codec.reconstruct(
-            {i: jnp.asarray(v) for i, v in shards.items()}, wanted=wanted)
-        return {i: np.asarray(v) for i, v in out.items()}
+        t0 = time.perf_counter()
+        dev = {i: jnp.asarray(v) for i, v in shards.items()}
+        t1 = time.perf_counter()
+        out = codec.reconstruct(dev, wanted=wanted)
+        t2 = time.perf_counter()
+        host = {i: np.asarray(v) for i, v in out.items()}
+        KERNELS.record("reconstruct", "device",
+                       wall_s=t2 - t1, h2d_s=t1 - t0, h2d_bytes=nbytes,
+                       d2h_s=time.perf_counter() - t2,
+                       d2h_bytes=sum(v.nbytes for v in host.values()),
+                       nbytes=nbytes)
+        return host

@@ -115,16 +115,12 @@ class S3ApiServer:
             client_max_size=5 * 1024 * 1024 * 1024,
             middlewares=[trace.aiohttp_middleware("s3")])
         # the gateway is the one PUBLIC server: its debug surface answers
-        # loopback operators only, so /debug/* can't leak presigned-URL
-        # query strings or trace paths past the SigV4 wall (and a bucket
-        # literally named "debug" still 403s rather than being shadowed
-        # for remote clients)
-        self.app.add_routes([
-            web.get("/debug/traces", self._debug_local(
-                trace.handle_debug_traces)),
-            web.get("/debug/requests", self._debug_local(
-                trace.handle_debug_requests)),
-        ])
+        # loopback operators only (debug_routes ships every handler
+        # pre-wrapped in the shared guard), so /debug/* can't leak
+        # presigned-URL query strings, trace paths, or stack contents
+        # past the SigV4 wall — and a bucket literally named "debug"
+        # still 403s rather than being shadowed for remote clients
+        self.app.add_routes(trace.debug_routes())
         self.app.add_routes([web.route("*", "/{tail:.*}", self.dispatch)])
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
@@ -133,14 +129,9 @@ class S3ApiServer:
     def url(self) -> str:
         return f"{self.host}:{self.port}"
 
-    @staticmethod
-    def _debug_local(handler):
-        async def guarded(req: web.Request) -> web.Response:
-            if req.remote not in ("127.0.0.1", "::1"):
-                return web.json_response({"error": "forbidden"},
-                                         status=403)
-            return await handler(req)
-        return guarded
+    # the shared loopback gate (stats/trace.py): same 403 semantics on
+    # every server's debug surface, one copy of the check
+    _debug_local = staticmethod(trace.debug_guard)
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
@@ -153,6 +144,8 @@ class S3ApiServer:
                            ssl_context=_tls.server_ssl("s3"))
         await site.start()
         self._ident_task = asyncio.create_task(self._identity_sync())
+        from seaweedfs_tpu.stats import profile as _profile
+        _profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         log.info("s3 gateway on %s -> filer %s", self.url, self.filer_url)
 
     async def _identity_sync(self) -> None:

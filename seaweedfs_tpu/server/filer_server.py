@@ -50,7 +50,7 @@ from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
 from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
 from seaweedfs_tpu.filer.abstract_sql import SqliteStore
 from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFound
-from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.stats import metrics, profile, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config, parse_range
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
@@ -193,6 +193,7 @@ class FilerServer:
                            ssl_context=_tls.server_ssl("filer"))
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
+        profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         log.info("filer listening on %s", self.url)
 
     async def _register_loop(self) -> None:

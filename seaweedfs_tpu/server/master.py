@@ -21,7 +21,7 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
-from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.stats import aggregate, metrics, profile, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.topology.topology import Topology
@@ -112,6 +112,8 @@ class MasterServer:
             web.post("/raft/append_entries", self.handle_raft_append),
             web.post("/raft/install_snapshot", self.handle_raft_install),
             web.get("/metrics", self.handle_metrics),
+            web.get("/cluster/metrics", self.handle_cluster_metrics),
+            web.get("/cluster/slo", self.handle_cluster_slo),
             web.get("/", self.handle_ui),
         ])
         # non-volume-server cluster members (filers, brokers, gateways):
@@ -134,6 +136,12 @@ class MasterServer:
         from seaweedfs_tpu.maintenance.repair import RepairPlanner
         self.maintenance = RepairPlanner(self)
         self._repair_task: asyncio.Task | None = None
+        # observability plane: fleet /metrics federation + the SLO
+        # burn-rate engine (stats/aggregate.py).  Pulls every known
+        # node's exposition over PooledHTTP; this master's own registry
+        # is read directly.
+        self.aggregator = aggregate.ClusterAggregator(
+            self._agg_nodes, local=(self.url, metrics.REGISTRY))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -157,6 +165,8 @@ class MasterServer:
         await site.start()
         self._expire_task = asyncio.create_task(self._expire_loop())
         self._repair_task = asyncio.create_task(self._repair_loop())
+        profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
+        self.aggregator.start()
         if self.raft:
             self.raft.start()
         log.info("master listening on %s", self.url)
@@ -172,6 +182,7 @@ class MasterServer:
         # runner.cleanup() doesn't wait out its shutdown timeout on them
         for q in list(self._vid_subscribers):
             q.put_nowait(None)
+        await asyncio.to_thread(self.aggregator.stop)
         if self._session:
             await self._session.close()
         if self._runner:
@@ -316,16 +327,39 @@ class MasterServer:
             except Exception:
                 log.warning("repair tick failed", exc_info=True)
 
+    def _agg_nodes(self) -> dict[str, str]:
+        """Every node the aggregator should pull /metrics from: volume
+        servers straight from the topology, filers/gateways/brokers from
+        the cluster-member registry (fresh within the same 30s horizon
+        /cluster/status uses)."""
+        nodes: dict[str, str] = {}
+        with self.topo._lock:
+            for n in self.topo.nodes.values():
+                nodes[n.url] = n.url
+        horizon = time.time() - 30.0
+        for members in self.cluster_members.values():
+            for addr, ts in members.items():
+                if ts > horizon:
+                    nodes.setdefault(addr, addr)
+        return nodes
+
     def _health_snapshot(self) -> dict:
         led = self.maintenance.ledger()  # also refreshes VOLUME_HEALTH
         from seaweedfs_tpu.maintenance.repair import HEALTH_STATES
         counts = {s: 0 for s in HEALTH_STATES}
         for info in led.values():
             counts[info["state"]] = counts.get(info["state"], 0) + 1
-        return {"volumes": {str(vid): info
+        snap = {"volumes": {str(vid): info
                             for vid, info in sorted(led.items())},
                 "states": counts,
                 "planner": self.maintenance.status()}
+        try:
+            # SLO view from whatever the aggregator last pulled — status
+            # must not block on a fleet scrape
+            snap["slo"] = self.aggregator.slo_status()
+        except Exception:
+            log.warning("slo status failed", exc_info=True)
+        return snap
 
     async def handle_maintenance_status(self, req: web.Request
                                         ) -> web.Response:
@@ -513,6 +547,39 @@ class MasterServer:
 
     async def handle_metrics(self, req: web.Request) -> web.Response:
         return metrics.scrape_response(req)
+
+    async def handle_cluster_metrics(self, req: web.Request
+                                     ) -> web.Response:
+        """Fleet federation: every known node's /metrics merged into one
+        exposition with a `node` label per sample.  ?refresh=1 forces a
+        synchronous pull (tests and impatient operators); otherwise the
+        background loop's last pull is served, refreshed only when
+        stale."""
+        try:
+            await asyncio.to_thread(
+                self.aggregator.ensure_fresh,
+                0.0 if req.query.get("refresh") else None)
+        except Exception:
+            log.warning("cluster metrics pull failed", exc_info=True)
+        return web.Response(text=self.aggregator.render(),
+                            content_type="text/plain")
+
+    async def handle_cluster_slo(self, req: web.Request) -> web.Response:
+        """Burn-rate SLO evaluation over the merged fleet metrics
+        (stats/aggregate.SLOEngine); ?refresh=1 pulls before
+        evaluating."""
+        try:
+            # the backlog rule reads the VOLUME_HEALTH gauge, which only
+            # moves when the ledger is rebuilt — and the repair loop
+            # (its usual rebuilder) parks while operators hold the admin
+            # lock, exactly when they are ASKING about backlog
+            self.maintenance.ledger()
+            await asyncio.to_thread(
+                self.aggregator.ensure_fresh,
+                0.0 if req.query.get("refresh") else None)
+        except Exception:
+            log.warning("cluster slo pull failed", exc_info=True)
+        return web.json_response(self.aggregator.slo_status())
 
     async def handle_heartbeat(self, req: web.Request) -> web.Response:
         if not self.is_leader:

@@ -24,7 +24,7 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security import jwt as sjwt
-from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.stats import metrics, profile, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
@@ -212,6 +212,7 @@ class VolumeServer:
                 self.master_url = self.master_urls[
                     (i + 1) % len(self.master_urls)]
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         # test-only fault plan from the environment (maintenance/faults.py)
         from seaweedfs_tpu.maintenance import faults as _faults
         for f in _faults.parse_env(os.environ.get("WEEDTPU_FAULTS", "")):
@@ -1490,9 +1491,8 @@ class VolumeServer:
             log.warning("scrub report to %s failed: %s", self.master_url, e)
 
     def _loopback_only(self, req: web.Request) -> web.Response | None:
-        if req.remote not in ("127.0.0.1", "::1"):
-            return web.json_response({"error": "loopback only"}, status=403)
-        return None
+        # same gate as the /debug/* surface: one copy (stats/trace.py)
+        return trace.loopback_error(req)
 
     async def handle_scrub(self, req: web.Request) -> web.Response:
         """Run one scrub pass NOW and return its summary (also reported

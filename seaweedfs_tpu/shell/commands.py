@@ -688,6 +688,62 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
     pl = st.get("planner", {})
     print(f"planner: tokens={pl.get('tokens')} active={pl.get('active')} "
           f"backoffs={len(pl.get('backoffs', {}))}", file=out)
+    _print_slo(st.get("slo") or {}, out)
+
+
+def _print_slo(slo: dict, out) -> None:
+    """Shared SLO pretty-printer for maintenance.status / cluster.slo:
+    one line per rule with its per-window burn rates."""
+    if not slo.get("rules"):
+        return
+    print(f"slo: {slo.get('state', 'unknown')} "
+          f"(nodes={len(slo.get('nodes', []))} "
+          f"scrape_errors={len(slo.get('scrape_errors', {}))})", file=out)
+    for r in slo["rules"]:
+        detail = " ".join(
+            f"{w}:burn={win.get('burn_rate')}"
+            + (f",p99={win['p99_ms']}ms" if win.get("p99_ms") is not None
+               else "")
+            for w, win in sorted(r.get("windows", {}).items()))
+        if r["kind"] == "backlog":
+            detail = f"value={r.get('value')}"
+        print(f"  {r['name']:24s} {r['state']:9s} {detail}", file=out)
+
+
+@command("cluster.slo")
+def cmd_cluster_slo(env: CommandEnv, args, out):
+    """Cluster SLO burn-rate status from the master's metrics aggregator
+    (/cluster/slo): per-rule state + multi-window burn rates.
+    -refresh forces a fleet /metrics pull first; -json emits the raw
+    engine output for CI assertions."""
+    flags = parse_flags(args)
+    params = {"refresh": "1"} if "refresh" in flags else {}
+    st = env.master_get("/cluster/slo", **params)
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    _print_slo(st, out)
+    if not st.get("rules"):
+        print(f"slo: {st.get('state', 'unknown')} (no data yet — "
+              "try -refresh)", file=out)
+
+
+@command("cluster.metrics")
+def cmd_cluster_metrics(env: CommandEnv, args, out):
+    """Dump the federated cluster exposition (/cluster/metrics): every
+    node's /metrics merged with a `node` label per sample.  -refresh
+    forces a fleet pull; -grep STR filters sample lines."""
+    flags = parse_flags(args)
+    qs = "?refresh=1" if "refresh" in flags else ""
+    req = urllib.request.Request(
+        f"{_tls_scheme()}://{env.master}/cluster/metrics{qs}")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        text = r.read().decode("utf-8", "replace")
+    needle = flags.get("grep")
+    for line in text.splitlines():
+        if needle and needle not in line:
+            continue
+        print(line, file=out)
 
 
 @command("volume.fsck")

@@ -169,11 +169,14 @@ class _Timer:
 
 def _exemplar_suffix(ex: tuple | None) -> str:
     """OpenMetrics exemplar: ` # {trace_id="..."} value timestamp` — links
-    a latency bucket to a sampled trace in /debug/traces."""
+    a latency bucket to a sampled trace in /debug/traces.  The trace id is
+    escaped exactly like a label value: exemplars go through the same
+    strict OpenMetrics parser, and observe() takes the id from a header
+    the CALLER controls, so a stray quote must not break the scrape."""
     if ex is None:
         return ""
     value, trace_id, ts = ex
-    return f' # {{trace_id="{_esc(trace_id)}"}} {value} {round(ts, 3)}'
+    return f' # {{trace_id="{_esc(str(trace_id))}"}} {value} {round(ts, 3)}'
 
 
 class Histogram(_Metric):
@@ -241,20 +244,29 @@ class Registry:
             lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
-    def push(self, gateway_url: str, job: str) -> bool:
+    def push(self, gateway_url: str, job: str, pool=None) -> bool:
         """One push-gateway PUT (stats/metrics.go:14 StartPushingMetric).
         A gateway failure is a monitoring problem, not a server problem:
         it is logged at V(1) and reported as False — never raised into
-        the caller's loop.  Retry cadence lives in MetricsPusher."""
+        the caller's loop.  Retry cadence lives in MetricsPusher, which
+        passes its PooledHTTP so repeated pushes reuse one keep-alive
+        socket instead of dialing the gateway every interval."""
         body = self.render().encode()
-        req = urllib.request.Request(
-            f"{gateway_url.rstrip('/')}/metrics/job/{job}",
-            data=body, method="PUT",
-            headers={"Content-Type": "text/plain"})
+        url = f"{gateway_url.rstrip('/')}/metrics/job/{job}"
         try:
+            if pool is not None:
+                status, _, _ = pool.request(
+                    url, method="PUT", body=body,
+                    headers={"Content-Type": "text/plain"}, timeout=5.0)
+                if status // 100 != 2:
+                    raise ValueError(f"gateway answered HTTP {status}")
+                return True
+            req = urllib.request.Request(
+                url, data=body, method="PUT",
+                headers={"Content-Type": "text/plain"})
             urllib.request.urlopen(req, timeout=5).close()
             return True
-        except (urllib.error.URLError, OSError, ValueError) as e:
+        except Exception as e:  # URLError/OSError/HTTPException/ValueError
             weedlog.V(1, "metrics").infof(
                 "metrics push to %s failed: %s", gateway_url, e)
             return False
@@ -262,18 +274,33 @@ class Registry:
 
 class MetricsPusher:
     """Background push-gateway loop (stats/metrics.go StartPushingMetric):
-    pushes every `interval` seconds, backing off exponentially (capped at
-    `max_backoff`) while the gateway is unreachable, and stop()s cleanly
-    at shutdown."""
+    pushes every `interval` seconds over one keep-alive PooledHTTP,
+    backing off exponentially (capped at `max_backoff`) while the gateway
+    is unreachable, and stop()s cleanly at shutdown.
+
+    DNS is NOT latched for the process lifetime: the socket pool is keyed
+    by hostname and a parked keep-alive connection pins whatever address
+    the first dial resolved.  After two consecutive push failures the
+    pool is dropped and the gateway hostname re-resolved, so a
+    re-pointed gateway CNAME (the common failover move for a
+    long-lived daemon's monitoring sink) is picked up mid-process
+    instead of failing until restart."""
+
+    RE_RESOLVE_AFTER = 2  # consecutive failures before forcing fresh DNS
 
     def __init__(self, registry: Registry, gateway_url: str, job: str,
                  interval: float = 15.0, max_backoff: float = 300.0):
+        from seaweedfs_tpu.utils.http import PooledHTTP
         self.registry = registry
         self.gateway_url = gateway_url
         self.job = job
         self.interval = interval
         self.max_backoff = max_backoff
         self.failures = 0
+        self.re_resolves = 0
+        self._make_pool = lambda: PooledHTTP(timeout=5.0,
+                                             max_idle_per_host=1)
+        self.pool = self._make_pool()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="metrics-pusher", daemon=True)
@@ -282,14 +309,35 @@ class MetricsPusher:
         self._thread.start()
         return self
 
+    def _re_resolve(self) -> None:
+        """Drop every pooled socket and ask the resolver again: the next
+        push dials whatever the gateway name points at NOW."""
+        import socket
+        import urllib.parse
+        self.pool.close()
+        self.pool = self._make_pool()
+        self.re_resolves += 1
+        host = urllib.parse.urlsplit(self.gateway_url).hostname or ""
+        try:
+            addrs = sorted({ai[4][0] for ai in
+                            socket.getaddrinfo(host, None)})
+        except OSError as e:
+            addrs = [f"unresolvable: {e}"]
+        weedlog.V(1, "metrics").infof(
+            "gateway %s unreachable %d times; re-resolved %s -> %s",
+            self.gateway_url, self.failures, host, addrs)
+
     def _run(self) -> None:
         delay = self.interval
         while not self._stop.wait(delay):
-            if self.registry.push(self.gateway_url, self.job):
+            if self.registry.push(self.gateway_url, self.job,
+                                  pool=self.pool):
                 self.failures = 0
                 delay = self.interval
             else:
                 self.failures += 1
+                if self.failures >= self.RE_RESOLVE_AFTER:
+                    self._re_resolve()
                 delay = min(self.interval * (2 ** self.failures),
                             self.max_backoff)
 
@@ -297,6 +345,7 @@ class MetricsPusher:
         self._stop.set()
         if self._thread.is_alive():
             self._thread.join(timeout)
+        self.pool.close()
 
 
 def start_pushing(gateway_url: str, job: str, interval: float = 15.0,
@@ -325,6 +374,13 @@ REGISTRY = Registry()
 
 MASTER_RECEIVED_HEARTBEATS = REGISTRY.counter(
     "weedtpu_master_received_heartbeats", "Heartbeats received by master")
+# every completed HTTP request by role/read-write/status class, counted in
+# the trace middleware so all four servers feed it — the availability
+# input of the cluster SLO engine (stats/aggregate.py)
+HTTP_REQUESTS = REGISTRY.counter(
+    "weedtpu_http_requests_total",
+    "completed requests by server role, read/write op, and status class",
+    ("server", "op", "class"))
 MASTER_ASSIGN_COUNTER = REGISTRY.counter(
     "weedtpu_master_assign_total", "fid assignments", ("collection",))
 VOLUME_REQUEST_COUNTER = REGISTRY.counter(

@@ -296,6 +296,27 @@ def inflight() -> list[dict]:
 
 # -- aiohttp server glue ------------------------------------------------
 
+# cluster-internal surfaces: monitoring pulls, heartbeats, raft, debug,
+# maintenance, and admin control traffic.  They get op="internal" in the
+# request counter so the SLO availability rules (op=read/write) measure
+# the DATA plane — on a lightly-loaded cluster the self-generated
+# heartbeat/scrape volume would otherwise dominate the denominator and
+# mask real client failures.
+_INTERNAL_PREFIXES = ("/metrics", "/heartbeat", "/raft", "/debug",
+                      "/cluster", "/maintenance", "/admin",
+                      "/__meta__", "/__admin__", "/__ui__", "/status")
+
+
+def _request_op(method: str, path: str) -> str:
+    # exact-or-slash matching: a filer file /status-reports/x or an s3
+    # bucket named "metrics-dump" is DATA-plane traffic, not internal —
+    # a bare startswith would hide its failures from the SLO
+    if any(path == p or path.startswith(p + "/")
+           for p in _INTERNAL_PREFIXES):
+        return "internal"
+    return "read" if method in ("GET", "HEAD") else "write"
+
+
 def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
     """Server-side half of the propagation: extract X-Weedtpu-Trace (or
     make a root sampling decision), register the request in the in-flight
@@ -351,6 +372,15 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
             request_finished(rid)
             if token is not None:
                 _current.reset(token)
+            if not cancelled:
+                # per-class request counters: the SLO engine's
+                # availability input (a disconnect is the caller's fact,
+                # not an availability event). Lazy import: metrics
+                # imports this module at its own top level.
+                from seaweedfs_tpu.stats import metrics as _metrics
+                _metrics.HTTP_REQUESTS.labels(
+                    role, _request_op(req.method, req.path),
+                    f"{status // 100}xx").inc()
             slow = ms >= slow_ms() and not cancelled and \
                 req.path not in slow_exempt
             errored = status >= 500 and not cancelled
@@ -401,8 +431,37 @@ async def handle_debug_requests(req):
     return web.json_response({"requests": inflight()})
 
 
-def debug_routes():
-    """Routes every server mounts (before any catch-all)."""
+def loopback_error(req):
+    """None when the request originates on loopback; a 403 JSON response
+    otherwise.  The ONE copy of the operator-surface gate — /debug/* on
+    every server and the volume server's fault/scrub admin hooks all
+    route through here."""
     from aiohttp import web
-    return [web.get("/debug/traces", handle_debug_traces),
-            web.get("/debug/requests", handle_debug_requests)]
+    if req.remote not in ("127.0.0.1", "::1"):
+        return web.json_response({"error": "forbidden"}, status=403)
+    return None
+
+
+def debug_guard(handler):
+    """Wrap a debug handler in the shared loopback gate: the debug
+    surface (traces, in-flight requests, profiles) must not leak request
+    paths, presigned-URL query strings, or stack contents to remote
+    callers on ANY server."""
+    async def guarded(req):
+        err = loopback_error(req)
+        if err is not None:
+            return err
+        return await handler(req)
+    return guarded
+
+
+def debug_routes():
+    """Routes every server mounts (before any catch-all), loopback-gated
+    as one unit: /debug/traces, /debug/requests, /debug/pprof."""
+    from aiohttp import web
+
+    from seaweedfs_tpu.stats import profile as _profile
+    return [web.get("/debug/traces", debug_guard(handle_debug_traces)),
+            web.get("/debug/requests", debug_guard(handle_debug_requests)),
+            web.get("/debug/pprof",
+                    debug_guard(_profile.handle_debug_pprof))]

@@ -80,6 +80,7 @@ def _get_codec(kind: str | None = None):
 
 # backend seam (ops/dispatch.py): parity dispatch, the d2h sync point,
 # and reconstruction, without backend imports in this layer
+from seaweedfs_tpu.stats import profile as _profile  # noqa: E402
 from seaweedfs_tpu.ops.dispatch import (  # noqa: E402
     dispatch_parity as _dispatch_parity,
     materialize as _materialize,
@@ -705,7 +706,11 @@ def _host_parity_unit(codec, dat_view: np.ndarray, tailbuf: np.ndarray,
     code = codec.code
     mat = code.parity_matrix if nz == code.k else \
         np.ascontiguousarray(code.parity_matrix[:, :nz])
-    native.gf_matmul_ptrs(mat, rows, list(pbuf), step)
+    # the zero-copy path bypasses ops/dispatch, so it feeds the kernel
+    # profile itself — otherwise host-encode time vanishes from
+    # /debug/pprof?format=table
+    with _profile.KERNELS.timed("encode_parity", nbytes=nz * step):
+        native.gf_matmul_ptrs(mat, rows, list(pbuf), step)
 
 
 def _encode_serial_host(codec, dat_fd: int, dat_view: np.ndarray,
@@ -1055,7 +1060,9 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
                 if native_host:
                     rows = [views[i][off:off + n] for i in use]
                     outs = [obuf[r, :n] for r in range(len(missing))]
-                    native.gf_matmul_ptrs(dec_mat, rows, outs, n)
+                    with _profile.KERNELS.timed("reconstruct",
+                                                nbytes=len(use) * n):
+                        native.gf_matmul_ptrs(dec_mat, rows, outs, n)
                 else:
                     if stage is None:
                         stage = np.empty((layout.DATA_SHARDS,

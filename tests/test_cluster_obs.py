@@ -1,0 +1,199 @@
+"""Cluster observability plane, end-to-end: /cluster/metrics federation
+with per-node labels, the SLO burn-rate engine flipping ok -> violated
+under injected delay_shard_read faults, the SLO surface inside
+maintenance.status / cluster.slo, and /debug/pprof catching ec_volume
+frames on a loaded volume server."""
+
+import io
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import WeedClient
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from tests.test_cluster import Cluster
+from tests.test_maintenance import _get, _post
+
+
+@pytest.fixture()
+def obs_cluster(tmp_path, monkeypatch):
+    """3 volume servers, EC everywhere, deterministic observability: no
+    background aggregation (endpoints scrape on demand), 1s/3s SLO
+    windows, a 50ms read-latency rule tight enough that the injected
+    100ms shard-read delay blows it."""
+    monkeypatch.setenv("WEEDTPU_EC_CODEC", "numpy")
+    monkeypatch.setenv("WEEDTPU_SCRUB_MBPS", "0")
+    monkeypatch.setenv("WEEDTPU_REPAIR_INTERVAL", "3600")
+    monkeypatch.setenv("WEEDTPU_AGG_INTERVAL", "0")
+    monkeypatch.setenv("WEEDTPU_SLO_WINDOWS", "1,3")
+    monkeypatch.setenv(
+        "WEEDTPU_SLO_RULES",
+        "read_availability=availability,op=read,target=0.999;"
+        "read_latency=latency,family=weedtpu_volume_request_seconds,"
+        "label.type=read,ms=50,target=0.8;"
+        "repair_backlog=backlog,family=weedtpu_volume_health,"
+        "label.state!=healthy")
+    c = Cluster(tmp_path, n_volume_servers=3).start()
+    c.wait_heartbeats()
+    yield c
+    c.stop()
+
+
+def _upload_and_encode_all(cluster, n=24, size=600 * 1024, seed=5):
+    """Upload blobs, then EC-encode EVERY volume they landed on, so every
+    later read takes the EC path (shards spread over the 3 nodes).
+
+    The payloads must be big enough that the volume spans MANY 1MB EC
+    blocks: the layout stripes blocks across the 10 data shards, so a
+    tiny volume would land every needle in shard 0 and reads would never
+    leave the shard-0 holder — with ~14MB the needles spread over all
+    data shards and most reads cross to a peer."""
+    client = WeedClient(cluster.master.url)
+    rng = np.random.default_rng(seed)
+    payloads = {}
+    for i in range(n):
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        payloads[client.upload(data, name=f"o{i}.bin")] = data
+    time.sleep(0.7)  # heartbeats pick up the volumes
+    vids = sorted({int(fid.partition(",")[0]) for fid in payloads})
+    env = CommandEnv(cluster.master.url)
+    out = io.StringIO()
+    run_command(env, "lock", out)
+    for vid in vids:
+        run_command(env, f"ec.encode -volumeId {vid}", out)
+    run_command(env, "unlock", out)
+    time.sleep(0.7)  # shard heartbeats
+    client._vid_cache.clear()
+    return client, payloads
+
+
+def _read_all(client, payloads):
+    for fid, data in payloads.items():
+        assert client.download(fid) == data, fid
+
+
+def _slo(master_url, refresh=True):
+    qs = "?refresh=1" if refresh else ""
+    return _get(master_url, f"/cluster/slo{qs}", timeout=60)
+
+
+def _rule(slo, name):
+    return next(r for r in slo["rules"] if r["name"] == name)
+
+
+def test_cluster_slo_flips_under_delay_faults(obs_cluster):
+    c = obs_cluster
+    client, payloads = _upload_and_encode_all(c)
+
+    # -- healthy phase: reads are fast, the latency rule reads ok -------
+    _slo(c.master.url)  # baseline snapshot before the good reads
+    _read_all(client, payloads)
+    time.sleep(0.1)
+    slo = _slo(c.master.url)
+    assert set(c.master.aggregator.per_node) >= \
+        {vs.url for vs in c.volume_servers}
+    r = _rule(slo, "read_latency")
+    assert r["state"] == "ok", r
+    assert _rule(slo, "read_availability")["state"] == "ok"
+    assert _rule(slo, "repair_backlog")["state"] == "ok"
+
+    # -- fault phase: every peer shard fetch stalls 100ms ---------------
+    for vs in c.volume_servers:
+        _post(vs.url, "/admin/faults", {"faults": [
+            {"action": "delay_shard_read", "ms": 100}]})
+    _read_all(client, payloads)  # most needles live on a peer shard
+    slo = _slo(c.master.url)
+    r = _rule(slo, "read_latency")
+    assert r["state"] == "violated", r
+    assert all(w["burn_rate"] > 1 for w in r["windows"].values()), r
+    # the merged p99 over the fault window reflects the injected delay
+    worst = max(w.get("p99_ms") or 0 for w in r["windows"].values())
+    assert worst >= 50, r
+    # reads still SUCCEED (slow, not failed): availability stays ok
+    assert _rule(slo, "read_availability")["state"] == "ok"
+    assert slo["state"] == "violated"
+
+    # -- the SLO surfaces in maintenance.status and cluster.slo ---------
+    env = CommandEnv(c.master.url)
+    out = io.StringIO()
+    run_command(env, "cluster.slo -json", out)
+    st = json.loads(out.getvalue())
+    assert _rule(st, "read_latency")["state"] == "violated"
+    out = io.StringIO()
+    run_command(env, "maintenance.status", out)
+    text = out.getvalue()
+    assert "slo:" in text and "read_latency" in text, text
+    out = io.StringIO()
+    run_command(env, "cluster.slo", out)
+    assert "violated" in out.getvalue()
+
+    # -- recovery: drop the fault, fast reads, burn decays --------------
+    for vs in c.volume_servers:
+        _post(vs.url, "/admin/faults", {"faults": [
+            {"action": "delay_shard_read", "ms": 0}]})
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        _read_all(client, payloads)
+        r = _rule(_slo(c.master.url), "read_latency")
+        if r["state"] == "ok":
+            break
+        time.sleep(0.5)
+    assert r["state"] == "ok", r
+
+
+def test_cluster_metrics_federation_and_pprof_under_load(obs_cluster):
+    c = obs_cluster
+    client, payloads = _upload_and_encode_all(c, n=16)
+    _read_all(client, payloads)
+
+    # -- /cluster/metrics: one exposition, node label per sample --------
+    with urllib.request.urlopen(
+            f"http://{c.master.url}/cluster/metrics?refresh=1",
+            timeout=60) as resp:
+        text = resp.read().decode()
+    for vs in c.volume_servers:
+        assert f'node="{vs.url}"' in text, vs.url
+        assert f'weedtpu_cluster_node_up{{node="{vs.url}"}} 1' in text
+    assert f'node="{c.master.url}"' in text
+    assert "weedtpu_http_requests_total" in text
+    assert "# TYPE weedtpu_volume_request_seconds histogram" in text
+
+    # -- /debug/pprof?seconds=N on a loaded volume server ---------------
+    stop = threading.Event()
+
+    def hammer():
+        fids = list(payloads)
+        i = 0
+        while not stop.is_set():
+            try:
+                client.download(fids[i % len(fids)])
+            except Exception:
+                pass
+            i += 1
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        vs = c.volume_servers[0]
+        with urllib.request.urlopen(
+                f"http://{vs.url}/debug/pprof?seconds=1.5&hz=147",
+                timeout=30) as resp:
+            prof = resp.read().decode()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+    lines = [l for l in prof.splitlines() if l.strip()]
+    assert lines, "pprof returned no collapsed stacks"
+    assert any("ec_volume." in l or "dispatch." in l for l in lines), \
+        prof[:2000]
+    # flamegraph format: every line is stack-semicolons + a count
+    for l in lines[:10]:
+        stack, _, count = l.rpartition(" ")
+        assert count.isdigit() and stack, l

@@ -198,24 +198,33 @@ def test_openmetrics_counters_get_total_suffix():
     _lint_exposition(plain)
 
 
-def test_s3_debug_routes_are_loopback_only():
+def _mock_req(path, peer):
     from unittest import mock
 
     from aiohttp.test_utils import make_mocked_request
+    tr = mock.Mock()
+    tr.get_extra_info = lambda key, default=None: \
+        (peer, 1234) if key == "peername" else default
+    return make_mocked_request("GET", path, transport=tr)
 
+
+def test_debug_routes_share_one_loopback_guard():
+    """The loopback gate is ONE helper (trace.debug_guard): the s3
+    gateway's debug surface uses it verbatim, and it 403s non-loopback
+    peers for traces, requests, and pprof alike."""
     from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+    from seaweedfs_tpu.stats import profile
 
-    guarded = S3ApiServer._debug_local(trace.handle_debug_requests)
+    assert S3ApiServer._debug_local is trace.debug_guard
 
-    def req_from(peer):
-        tr = mock.Mock()
-        tr.get_extra_info = lambda key, default=None: \
-            (peer, 1234) if key == "peername" else default
-        return make_mocked_request("GET", "/debug/requests", transport=tr)
-
-    resp = asyncio.run(guarded(req_from("203.0.113.9")))
-    assert resp.status == 403
-    resp = asyncio.run(guarded(req_from("127.0.0.1")))
+    for handler in (trace.handle_debug_requests, trace.handle_debug_traces,
+                    profile.handle_debug_pprof):
+        guarded = trace.debug_guard(handler)
+        resp = asyncio.run(guarded(
+            _mock_req("/debug/requests", "203.0.113.9")))
+        assert resp.status == 403, handler
+    resp = asyncio.run(trace.debug_guard(trace.handle_debug_requests)(
+        _mock_req("/debug/requests", "127.0.0.1")))
     assert resp.status == 200
 
 
@@ -327,6 +336,376 @@ def test_vmodule_per_module_verbosity(caplog):
     finally:
         weedlog.set_vmodule("")
     assert weedlog.verbosity("ec_volume") == weedlog.verbosity()
+
+
+# ---- sampling profiler -------------------------------------------------
+
+def _spin(stop: threading.Event) -> None:
+    while not stop.is_set():
+        sum(range(500))
+
+
+def test_profiler_samples_busy_thread_and_stops_clean():
+    from seaweedfs_tpu.stats import profile
+    stop = threading.Event()
+    worker = threading.Thread(target=_spin, args=(stop,), daemon=True)
+    worker.start()
+    p = profile.SamplingProfiler(hz=400).start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and p.samples < 20:
+            time.sleep(0.01)
+    finally:
+        p.stop()
+        stop.set()
+        worker.join(2)
+    assert p.samples >= 20
+    collapsed = p.collapsed()
+    assert "_spin" in collapsed, collapsed[:400]
+    # collapsed-stack format: "root;...;leaf count" per line
+    line = next(l for l in collapsed.splitlines() if "_spin" in l)
+    stack, _, count = line.rpartition(" ")
+    assert int(count) > 0 and ";" in stack
+    table = p.table()
+    assert "_spin" in table and "self" in table
+
+
+def test_profiler_start_stop_leaves_zero_threads(monkeypatch):
+    from seaweedfs_tpu.stats import profile
+
+    def profiler_threads():
+        return [t for t in threading.enumerate()
+                if t.name == "weedtpu-profiler"]
+
+    for _ in range(3):
+        p = profile.SamplingProfiler(hz=500).start()
+        assert profiler_threads()
+        p.stop()
+        assert not profiler_threads()
+    # the env-driven continuous profiler is idempotent and shuts down
+    monkeypatch.setenv("WEEDTPU_PROFILE_HZ", "250")
+    p1 = profile.ensure_started()
+    p2 = profile.ensure_started()
+    assert p1 is p2 and p1.running
+    profile.shutdown()
+    assert not profiler_threads()
+    monkeypatch.setenv("WEEDTPU_PROFILE_HZ", "0")
+    assert profile.ensure_started() is None
+    assert not profiler_threads()
+
+
+def test_debug_pprof_on_demand_window_and_formats():
+    from seaweedfs_tpu.stats import profile
+    profile.shutdown()  # no continuous profiler: seconds=0 must 400
+    resp = asyncio.run(profile.handle_debug_pprof(
+        _mock_req("/debug/pprof", "127.0.0.1")))
+    assert resp.status == 400
+
+    stop = threading.Event()
+    worker = threading.Thread(target=_spin, args=(stop,), daemon=True)
+    worker.start()
+    try:
+        resp = asyncio.run(profile.handle_debug_pprof(_mock_req(
+            "/debug/pprof?seconds=0.25&hz=400", "127.0.0.1")))
+        assert resp.status == 200
+        assert "_spin" in resp.text
+        resp = asyncio.run(profile.handle_debug_pprof(_mock_req(
+            "/debug/pprof?seconds=0.2&hz=400&format=table",
+            "127.0.0.1")))
+        assert "kernel profile" in resp.text
+        resp = asyncio.run(profile.handle_debug_pprof(_mock_req(
+            "/debug/pprof?seconds=0.2&hz=400&format=json",
+            "127.0.0.1")))
+        body = json.loads(resp.text)
+        assert body["samples"] > 0 and isinstance(body["stacks"], list)
+        assert "kernels" in body
+    finally:
+        stop.set()
+        worker.join(2)
+    # the window samplers are gone once their responses are built
+    assert not [t for t in threading.enumerate()
+                if t.name == "weedtpu-profiler"]
+
+
+def test_kernel_profile_accumulates_from_dispatch():
+    from seaweedfs_tpu.models import rs
+    from seaweedfs_tpu.ops import dispatch
+    from seaweedfs_tpu.stats import profile
+
+    profile.KERNELS.reset()
+    codec = rs.get_code(10, 4)
+    batch = np.arange(10 * 64, dtype=np.uint8).reshape(10, 64)
+    parity = dispatch.materialize(dispatch.dispatch_parity(codec, batch))
+    assert parity.shape == (4, 64)
+    shards = {i: batch[i] for i in range(2, 10)}
+    shards.update({10 + r: parity[r] for r in range(2)})
+    out = dispatch.reconstruct_batch(codec, shards, wanted=[0, 1])
+    assert np.array_equal(out[0], batch[0])
+    snap = profile.KERNELS.snapshot()
+    assert snap["encode_parity[host]"]["calls"] == 1
+    assert snap["encode_parity[host]"]["bytes"] == batch.nbytes
+    assert snap["encode_parity[host]"]["wall_s"] >= 0
+    assert snap["reconstruct[host]"]["calls"] == 1
+    assert "encode_parity" in profile.KERNELS.table()
+
+
+# ---- exemplar escaping + OpenMetrics lint ------------------------------
+
+_EXEMPLAR_RE = re.compile(
+    r' # \{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"\} '
+    r'-?[0-9.e+-]+( [0-9.]+)?$')
+
+
+def _lint_openmetrics(text: str) -> None:
+    """Exemplar-aware lint: every ` # {...}` suffix must parse as a
+    properly escaped OpenMetrics exemplar (raw quotes or newlines in a
+    trace id would break a negotiating scraper), and the exposition
+    sans exemplars must pass the plain lint."""
+    stripped: list[str] = []
+    for line in text.splitlines():
+        if " # " in line and not line.startswith("#"):
+            body, _, _ = line.partition(" # ")
+            suffix = line[len(body):]
+            assert _EXEMPLAR_RE.match(suffix), f"bad exemplar: {line!r}"
+            line = body
+        stripped.append(line)
+    assert stripped[-1] == "# EOF"
+    plain = "\n".join(stripped[:-1]) + "\n"
+    # counters are _total-suffixed in OM; the plain linter only needs
+    # label syntax + histogram shape, which survive the strip
+    for ln in plain.splitlines():
+        if ln.startswith("#") or not ln:
+            continue
+        assert _SAMPLE_RE.match(ln), f"unparseable after strip: {ln!r}"
+
+
+def test_exemplar_trace_ids_are_escaped():
+    reg = metrics.Registry()
+    h = reg.histogram("weedtpu_esc_seconds", "t")
+    # a hostile trace id must come out escaped, not spliced raw
+    h.labels().observe(0.001, trace_id='evil"id\\with\nnewline')
+    om = reg.render(openmetrics=True)
+    assert '\\"' in om and "\\n" in om
+    assert 'evil"id' not in om.replace('evil\\"id', "")
+    _lint_openmetrics(om)
+    # and the global registry's OM rendering lints clean too
+    metrics.VOLUME_REQUEST_HISTOGRAM.labels("read").observe(0.004)
+    _lint_openmetrics(metrics.REGISTRY.render(openmetrics=True))
+
+
+# ---- pusher DNS re-resolution ------------------------------------------
+
+def test_metrics_pusher_re_resolves_on_consecutive_failures():
+    """Two consecutive push failures drop the socket pool and re-query
+    DNS, so a re-pointed gateway name is picked up mid-process."""
+    reg = metrics.Registry()
+    dead = f"http://127.0.0.1:{_free_port()}"
+    p = metrics.MetricsPusher(reg, dead, "j", interval=0.02,
+                              max_backoff=0.2)
+    pool0 = p.pool
+    p.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and p.re_resolves < 1:
+        time.sleep(0.02)
+    p.stop()
+    assert p.re_resolves >= 1, "pusher never re-resolved"
+    assert p.pool is not pool0, "socket pool not replaced"
+    assert pool0._closed, "old pool left open"
+    assert not p._thread.is_alive()
+
+
+# ---- cluster aggregation unit layer ------------------------------------
+
+def test_parse_exposition_roundtrip():
+    from seaweedfs_tpu.stats import aggregate as ag
+    reg = metrics.Registry()
+    reg.counter("weedtpu_agg_total", "c", ("who",)).labels(
+        'we"ird\\v\n').inc(3)
+    reg.gauge("weedtpu_agg_gauge", "g").labels().set(7.5)
+    reg.histogram("weedtpu_agg_seconds", "h").labels().observe(0.003)
+    fams = ag.parse_exposition(reg.render())
+    assert fams["weedtpu_agg_total"]["type"] == "counter"
+    name, labels, value = fams["weedtpu_agg_total"]["samples"][0]
+    assert labels == {"who": 'we"ird\\v\n'} and value == 3.0
+    assert fams["weedtpu_agg_gauge"]["samples"][0][2] == 7.5
+    hist = fams["weedtpu_agg_seconds"]
+    assert hist["type"] == "histogram"
+    names = {s[0] for s in hist["samples"]}
+    assert {"weedtpu_agg_seconds_bucket", "weedtpu_agg_seconds_sum",
+            "weedtpu_agg_seconds_count"} <= names
+
+
+def test_counters_sum_across_nodes_and_federation_labels():
+    from seaweedfs_tpu.stats import aggregate as ag
+
+    def reg_with(n):
+        reg = metrics.Registry()
+        reg.counter("weedtpu_sum_total", "c", ("op",)).labels("read").inc(n)
+        return ag.parse_exposition(reg.render())
+
+    per_node = {"n1": reg_with(5), "n2": reg_with(7)}
+    merged = ag.merge_counters(per_node)
+    assert merged[("weedtpu_sum_total", (("op", "read"),))] == 12.0
+
+
+def test_histogram_bucket_merge_p99_between_per_node_p99s():
+    """Two nodes with different counts and different latency profiles:
+    the merged histogram's count is the sum and its p99 lands between
+    the two per-node p99s."""
+    from seaweedfs_tpu.stats import aggregate as ag
+
+    def node(obs):
+        reg = metrics.Registry()
+        h = reg.histogram("weedtpu_m_seconds", "h", ("type",))
+        for v in obs:
+            h.labels("read").observe(v)
+        return ag.parse_exposition(reg.render())
+
+    fast = node([0.001] * 180 + [0.02] * 20)       # p99 ~ 25ms
+    slow = node([0.3] * 30 + [2.0] * 10)           # p99 ~ seconds
+    key = ("weedtpu_m_seconds", (("type", "read"),))
+
+    def p99(per_node):
+        return ag.histogram_quantile(
+            ag.merge_histograms(per_node)[key]["buckets"], 0.99)
+
+    p_fast, p_slow = p99({"a": fast}), p99({"b": slow})
+    merged = ag.merge_histograms({"a": fast, "b": slow})[key]
+    assert merged["count"] == 240.0
+    # buckets summed per le: the +Inf cum equals the count
+    import math as _math
+    assert merged["buckets"][_math.inf] == 240.0
+    p_merged = ag.histogram_quantile(merged["buckets"], 0.99)
+    assert min(p_fast, p_slow) < p_merged < max(p_fast, p_slow), \
+        (p_fast, p_merged, p_slow)
+
+
+def _avail_counters(good, bad):
+    from seaweedfs_tpu.stats import aggregate as ag
+    reg = metrics.Registry()
+    c = reg.counter("weedtpu_http_requests_total", "t",
+                    ("server", "op", "class"))
+    c.labels("volume", "read", "2xx").inc(good)
+    c.labels("volume", "read", "5xx").inc(bad)
+    return ag.merge_counters({"n": ag.parse_exposition(reg.render())})
+
+
+def test_slo_engine_burn_rate_flips():
+    """Error-free window -> ok; a 5% 5xx ratio against a 99.9% target
+    burns 50x the budget in BOTH windows -> violated; recovery -> ok."""
+    from seaweedfs_tpu.stats import aggregate as ag
+
+    def snap(good, bad):
+        return {"n": _avail_counters(good, bad)}
+
+    eng = ag.SLOEngine(rules=ag.parse_rules(
+        "read_availability=availability,op=read,target=0.999"),
+        windows=[5.0, 30.0])
+    t0 = time.time()
+    hist = [(t0 - 20, snap(0, 0), {}), (t0 - 10, snap(100, 0), {})]
+    ok = eng.evaluate(hist)
+    assert ok["state"] == "ok", ok
+    hist.append((t0, snap(195, 5), {}))
+    bad = eng.evaluate(hist)
+    rule = bad["rules"][0]
+    assert rule["state"] == "violated", rule
+    assert all(w["burn_rate"] > 1 for w in rule["windows"].values())
+    # recovery: later windows see no new errors
+    hist = [(t0 - 10, snap(195, 5), {}), (t0, snap(400, 5), {})]
+    assert eng.evaluate(hist)["rules"][0]["state"] == "ok"
+
+
+def test_slo_engine_survives_node_counter_reset():
+    """Deltas are per-node (rate-before-sum): node B restarting with
+    zeroed counters must NOT clamp the cluster delta to zero while node
+    A serves a 5xx burst — and B's post-restart errors count from 0."""
+    from seaweedfs_tpu.stats import aggregate as ag
+    eng = ag.SLOEngine(rules=ag.parse_rules(
+        "read_availability=availability,op=read,target=0.999"),
+        windows=[5.0, 30.0])
+    t0 = time.time()
+    hist = [
+        (t0 - 10, {"a": _avail_counters(1000, 0),
+                   "b": _avail_counters(5000, 0)}, {}),
+        # b restarted (5000 -> 40 with 4 fresh errors); a burst 20 errors
+        (t0, {"a": _avail_counters(1080, 20),
+              "b": _avail_counters(40, 4)}, {}),
+    ]
+    rule = eng.evaluate(hist)["rules"][0]
+    assert rule["state"] == "violated", rule
+    win = rule["windows"]["5s"]
+    # a: 20 bad / 100 total; b (reset): 4 bad / 44 total
+    assert win["bad"] == 24.0 and win["total"] == 144.0, win
+
+
+def test_slo_rule_parsing_and_defaults():
+    from seaweedfs_tpu.stats import aggregate as ag
+    rules = ag.parse_rules(None)  # defaults
+    names = {r["name"] for r in rules}
+    assert {"read_availability", "write_availability", "read_latency_p99",
+            "repair_backlog"} <= names
+    custom = ag.parse_rules(
+        "p99=latency,family=weedtpu_x_seconds,label.type=read,ms=250,"
+        "target=0.99;junk;bl=backlog,family=weedtpu_g,"
+        "label.state!=healthy")
+    assert len(custom) == 2
+    assert custom[0]["ms"] == 250.0 and custom[0]["labels"] == \
+        {"type": "read"}
+    assert custom[1]["not_labels"] == {"state": "healthy"}
+
+
+def test_cluster_aggregator_scrapes_local_and_http_node():
+    """Aggregator end-to-end at the unit level: one local registry, one
+    node served over real HTTP; federation output carries a node label
+    per sample and the merged counters sum both."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from seaweedfs_tpu.stats import aggregate as ag
+
+    reg_a = metrics.Registry()
+    reg_a.counter("weedtpu_fed_total", "c").labels().inc(2)
+    # big counters must render at full precision (':g' would emit
+    # 1.23457e+07 and rate() over federated data would read zero)
+    reg_a.counter("weedtpu_fed_big_total", "c").labels().inc(12345678)
+    reg_b = metrics.Registry()
+    reg_b.counter("weedtpu_fed_total", "c").labels().inc(3)
+
+    class Node(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = reg_b.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Node)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    node_b = f"127.0.0.1:{srv.server_address[1]}"
+    agg = ag.ClusterAggregator(lambda: {node_b: node_b},
+                               local=("master:1", reg_a), interval=0)
+    try:
+        agg.scrape_once()
+        text = agg.render()
+        assert 'node="master:1"' in text and f'node="{node_b}"' in text
+        assert 'weedtpu_cluster_node_up{node="master:1"} 1' in text
+        assert 'weedtpu_fed_big_total{node="master:1"} 12345678' in text
+        merged = ag.merge_counters(agg.per_node)
+        assert merged[("weedtpu_fed_total", ())] == 5.0
+        # a vanished node shows up as an error, not an exception
+        agg.nodes_fn = lambda: {"127.0.0.1:1": "127.0.0.1:1"}
+        agg.scrape_once()
+        assert "127.0.0.1:1" in agg.errors
+        assert 'weedtpu_cluster_node_up{node="127.0.0.1:1"} 0' \
+            in agg.render()
+        st = agg.slo_status()
+        assert st["state"] in ("ok", "warn", "violated", "unknown")
+    finally:
+        agg.stop()
+        srv.shutdown()
+        srv.server_close()
 
 
 # ---- end-to-end trace propagation -------------------------------------
