@@ -496,6 +496,10 @@ def _emit(gbps: float, backend: str, baseline: float | None,
 
 
 def main() -> None:
+    # the canary loop would inject probe traffic into every in-process
+    # bench cluster below; the flow/canary overhead bench re-enables it
+    # deliberately for its ON arm
+    os.environ.setdefault("WEEDTPU_CANARY_INTERVAL", "0")
     force_cpu = False
     platforms = [p for p in os.environ.get("JAX_PLATFORMS", "").split(",")
                  if p]
@@ -535,7 +539,8 @@ def main() -> None:
     # itself disabled — each with a regression gate
     for fn in (_bench_degraded_read, _bench_filer_stream,
                _bench_trace_overhead, _bench_profile_overhead,
-               _bench_heal_time, _bench_scrub_overhead):
+               _bench_heal_time, _bench_scrub_overhead,
+               _bench_flow_canary_overhead):
         try:
             fn(extra)
         except Exception as e:
@@ -663,6 +668,7 @@ def _exit_code(extra: dict) -> int:
              "profile_overhead_regression",
              "heal_time_regression",
              "scrub_overhead_regression",
+             "flow_canary_overhead_regression",
              "gated_bench_failed")
     return 1 if any(extra.get(g) for g in gates) else 0
 
@@ -683,6 +689,9 @@ HEAL_REGRESSION_TOL = 1.25
 # foreground blob reads must keep >= 0.95x throughput with the scrubber
 # running at its rate limit (ISSUE 4 acceptance bar)
 SCRUB_OVERHEAD_TOL = 0.95
+# byte-flow accounting + a fast-cycling canary prober together must keep
+# >= 0.97x foreground blob-read throughput (ISSUE 6 acceptance bar)
+FLOW_CANARY_OVERHEAD_TOL = 0.97
 # blob reads with the HZ=97 sampling profiler walking every thread must
 # keep >= 0.95x the unprofiled rate (ISSUE 5 acceptance bar)
 PROFILE_OVERHEAD_TOL = 0.95
@@ -1382,16 +1391,25 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
                         time.sleep(0.1)
                     return False
 
+                from seaweedfs_tpu.stats import netflow as _nf
+
+                repair_bytes = {"heal": 0.0, "naive": 0.0}
+
                 def serial_rep() -> float:
                     """Serial baseline: the shell's one-by-one rebuild
-                    walk (holds the admin lock, so the planner yields)."""
+                    walk (holds the admin lock, so the planner yields).
+                    Its class=repair byte delta IS the naive
+                    10-survivor-read cost ROADMAP item 1 must beat."""
                     for vid in vids:
                         kill_two(vid)
                     wait_missing()
                     run_command(env, "lock", out)
+                    b0 = _nf.class_total("recv", "repair")
                     t0 = time.perf_counter()
                     run_command(env, "ec.rebuild", out)
                     el = time.perf_counter() - t0
+                    repair_bytes["naive"] = \
+                        _nf.class_total("recv", "repair") - b0
                     run_command(env, "unlock", out)
                     assert wait_protected(), "serial rebuild stuck"
                     return el
@@ -1400,6 +1418,7 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
                     for vid in vids:
                         kill_two(vid)
                     wait_missing()
+                    b0 = _nf.class_total("recv", "repair")
                     t0 = time.perf_counter()
                     deadline = time.time() + 120
                     while time.time() < deadline:
@@ -1408,6 +1427,8 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
                         st = get(master.url, "/maintenance/status")
                         if all(st["volumes"].get(str(v), {}).get("state")
                                == "healthy" for v in vids):
+                            repair_bytes["heal"] = _nf.class_total(
+                                "recv", "repair") - b0
                             return time.perf_counter() - t0, True
                         time.sleep(0.1)
                     return time.perf_counter() - t0, False
@@ -1435,6 +1456,11 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
         extra["heal_time_s"] = round(heal_s, 3)
         extra["heal_serial_s"] = round(serial_s, 3)
         extra["heal_volumes"] = n_volumes
+        # fleet-scale repair traffic (arXiv:1309.0186): bytes the heal
+        # moved under class=repair, and the shell walk's naive cost —
+        # the baseline ROADMAP item 1's reduced-read decode must beat
+        extra["repair_network_bytes"] = int(repair_bytes["heal"])
+        extra["repair_network_bytes_naive"] = int(repair_bytes["naive"])
         if not healed:
             extra["heal_time_regression"] = True
             print("bench: REGRESSION — automatic healing never converged "
@@ -1456,6 +1482,146 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def _bench_flow_canary_overhead(extra: dict, n: int = 1200,
+                                size: int = 1024, concurrency: int = 16,
+                                pairs: int = 7) -> None:
+    """Flight-recorder tax on the hottest path: blob reads with byte-flow
+    accounting ON plus a fast-cycling canary prober (0.25s rounds writing
+    /reading/deleting sentinel blobs through the live cluster) vs both
+    OFF (WEEDTPU_NETFLOW=0, no canary), interleaved pairs over the same
+    blobs.  Median ratio below FLOW_CANARY_OVERHEAD_TOL (foreground must
+    keep >= 0.97x) fails the run (flow_canary_overhead_regression +
+    nonzero exit).  The ON arm's canary p99 is recorded as
+    canary_probe_p99_ms."""
+    import asyncio
+    import concurrent.futures
+    import threading
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    overrides = {
+        "WEEDTPU_CANARY_INTERVAL": "0",  # the bench drives start/stop
+        "WEEDTPU_CANARY_PATHS": "blob",
+        "WEEDTPU_SCRUB_MBPS": "0",
+        "WEEDTPU_REPAIR_INTERVAL": "3600",
+    }
+    old_env = {k: os.environ.get(k) for k in overrides}
+    old_netflow = os.environ.get("WEEDTPU_NETFLOW")
+    os.environ.update(overrides)
+    best_on = best_off = float("inf")
+    ratios: list[float] = []
+    p99 = None
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-flow-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs = VolumeServer([d], master.url, port=free_port(),
+                              heartbeat_interval=0.2)
+            started = []
+
+            async def canary_on():
+                master.canary.start(0.25)
+
+            async def canary_off():
+                master.canary.stop()
+
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                client = WeedClient(master.url)
+                payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+                with concurrent.futures.ThreadPoolExecutor(
+                        concurrency) as ex:
+                    fids = list(ex.map(
+                        lambda i: client.upload(payload, name=f"fc{i}"),
+                        range(n)))
+
+                def rep(recorder: bool) -> float:
+                    os.environ["WEEDTPU_NETFLOW"] = \
+                        "1" if recorder else "0"
+                    if recorder:
+                        run(canary_on())
+                    try:
+                        t0 = time.perf_counter()
+                        with concurrent.futures.ThreadPoolExecutor(
+                                concurrency) as ex:
+                            for data in ex.map(client.download, fids):
+                                assert len(data) == size
+                        return time.perf_counter() - t0
+                    finally:
+                        if recorder:
+                            run(canary_off())
+
+                for i in range(pairs):
+                    if i % 2 == 0:
+                        t_off = rep(False)
+                        t_on = rep(True)
+                    else:
+                        t_on = rep(True)
+                        t_off = rep(False)
+                    if i == 0:
+                        continue  # warm connections / page cache
+                    best_on = min(best_on, t_on)
+                    best_off = min(best_off, t_off)
+                    ratios.append(t_off / t_on)
+                # guarantee latency samples even when every rep beat
+                # the 0.25s canary tick to the finish line
+                run(master.canary.run_once(paths=("blob",)))
+                st = master.canary.status()
+                p99 = st.get("paths", {}).get("blob", {}).get("p99_ms")
+                client.close()
+            finally:
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if old_netflow is None:
+            os.environ.pop("WEEDTPU_NETFLOW", None)
+        else:
+            os.environ["WEEDTPU_NETFLOW"] = old_netflow
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["blob_read_rps_recorded"] = round(n / best_on, 1)
+    extra["blob_read_rps_unrecorded"] = round(n / best_off, 1)
+    extra["flow_canary_overhead_ratio"] = round(ratio, 3)
+    if p99 is not None:
+        extra["canary_probe_p99_ms"] = round(p99, 2)
+    if ratio < FLOW_CANARY_OVERHEAD_TOL:
+        extra["flow_canary_overhead_regression"] = True
+        print(f"bench: REGRESSION — blob reads with byte-flow accounting "
+              f"+ the canary prober run at {ratio:.3f}x the unrecorded "
+              f"rate (median of interleaved pairs); the flight recorder "
+              f"exceeds its 3% budget. Failing the bench run.",
+              file=sys.stderr)
 
 
 def _bench_scrub_overhead(extra: dict, n: int = 1000, size: int = 1024,

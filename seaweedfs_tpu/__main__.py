@@ -634,7 +634,8 @@ async def _run_server(args) -> int:
         from seaweedfs_tpu.s3.s3api_server import S3ApiServer
         iam = IdentityAccessManagement.from_file(args.s3Config) \
             if args.s3Config else IdentityAccessManagement()
-        s3 = S3ApiServer(f.url, args.ip, args.s3Port, iam=iam, security=sec)
+        s3 = S3ApiServer(f.url, args.ip, args.s3Port, iam=iam, security=sec,
+                         master_url=m.url)
         await s3.start()
     if getattr(args, "webdav", False):
         from seaweedfs_tpu.server.webdav_server import WebDavServer

@@ -34,7 +34,7 @@ import logging
 import os
 import time
 
-from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.stats import metrics, netflow, trace
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import layout
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
@@ -324,9 +324,14 @@ class RepairPlanner:
         root = trace.new_root()
         outcome = "ok"
         try:
-            with trace.span("repair.volume", parent=root, vid=vid,
-                            kind=info["kind"], state=info["state"],
-                            urgency=info["urgency"]):
+            # every byte this repair moves — survivor copies, purges,
+            # rebuild orchestration, and the shard pulls the target
+            # volume server makes on our behalf (the class header
+            # re-enters its middleware) — books as class=repair
+            with netflow.flow("repair"), \
+                    trace.span("repair.volume", parent=root, vid=vid,
+                               kind=info["kind"], state=info["state"],
+                               urgency=info["urgency"]):
                 if info["kind"] == "ec":
                     resolved = await self._repair_ec(vid, info)
                 else:

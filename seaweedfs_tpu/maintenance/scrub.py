@@ -38,7 +38,7 @@ import time
 
 import numpy as np
 
-from seaweedfs_tpu.stats import metrics, trace
+from seaweedfs_tpu.stats import metrics, netflow, trace
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import layout
@@ -244,7 +244,11 @@ class Scrubber:
     def scrub_once(self) -> dict:
         """One full pass over every mounted volume; returns the summary
         that also goes upstream: {ts, bytes, volumes: {vid: verdict}}."""
-        with self._mu, trace.span("scrub.pass", parent=trace.new_root()) \
+        # every remote byte this pass pulls (peer shard reads for the
+        # syndrome checks) books as class=scrub — the shard_reader
+        # factory captures the ambient class right here on this thread
+        with self._mu, netflow.flow("scrub"), \
+                trace.span("scrub.pass", parent=trace.new_root()) \
                 as pass_span:
             limiter = RateLimiter(self.mbps * 1e6)
             vols: dict[str, dict] = {}

@@ -39,7 +39,7 @@ from seaweedfs_tpu.s3.auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ,
                                    decode_aws_chunked)
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
-from seaweedfs_tpu.stats import trace
+from seaweedfs_tpu.stats import netflow, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 
 log = logging.getLogger("s3")
@@ -98,8 +98,12 @@ class S3ApiServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
                  port: int = 8333, iam: IdentityAccessManagement | None = None,
                  buckets_dir: str = BUCKETS_DIR, security=None,
-                 breaker=None):
+                 breaker=None, master_url: str | None = None):
         self.filer_url = filer_url
+        # optional master registration: announces this gateway in the
+        # cluster-member registry so /cluster/metrics federates it and
+        # the canary prober can exercise the s3 path
+        self.master_url = master_url
         self.host, self.port = host, port
         self.iam = iam or IdentityAccessManagement()
         from seaweedfs_tpu.s3.policy import BucketPolicyStore, PolicyError
@@ -113,7 +117,14 @@ class S3ApiServer:
         self.security = security
         self.app = web.Application(
             client_max_size=5 * 1024 * 1024 * 1024,
-            middlewares=[trace.aiohttp_middleware("s3")])
+            # trust_flow="loopback": this is the one PUBLIC server —
+            # a remote client's X-Weedtpu-Class/-Role headers must not
+            # reclassify its requests out of the SLO denominators or
+            # poison the per-class byte ledger, while the same-host
+            # master's canary probes stay class=internal
+            middlewares=[trace.aiohttp_middleware(
+                "s3", trust_flow="loopback")])
+        netflow.install(self.app, "s3")
         # the gateway is the one PUBLIC server: its debug surface answers
         # loopback operators only (debug_routes ships every handler
         # pre-wrapped in the shared guard), so /debug/* can't leak
@@ -137,13 +148,16 @@ class S3ApiServer:
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=3600),
-            trace_configs=[aiohttp_trace_config()])
+            trace_configs=[aiohttp_trace_config("s3")])
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
                            ssl_context=_tls.server_ssl("s3"))
         await site.start()
         self._ident_task = asyncio.create_task(self._identity_sync())
+        self._register_task = None
+        if self.master_url:
+            self._register_task = asyncio.create_task(self._register_loop())
         from seaweedfs_tpu.stats import profile as _profile
         _profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         log.info("s3 gateway on %s -> filer %s", self.url, self.filer_url)
@@ -210,12 +224,35 @@ class S3ApiServer:
             await asyncio.sleep(5)
 
     async def stop(self) -> None:
+        if getattr(self, "_register_task", None):
+            self._register_task.cancel()
         if getattr(self, "_ident_task", None):
             self._ident_task.cancel()
         if self._session:
             await self._session.close()
         if self._runner:
             await self._runner.cleanup()
+
+    async def _register_loop(self) -> None:
+        """Announce this gateway to the master every 10s (the same
+        cadence and registry the filer uses — cluster.go in the
+        reference); members expire 30s after the last beat."""
+        while True:
+            try:
+                async with self._session.post(
+                        f"{_tls_scheme()}://{self.master_url}"
+                        f"/cluster/register",
+                        json={"type": "s3", "address": self.url}) as r:
+                    await r.read()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # same contract as the filer's loop: registration must
+                # survive anything (incl. session-recreate races) — a
+                # dead loop silently ages the gateway out of the
+                # cluster-member registry within 30s
+                pass
+            await asyncio.sleep(10)
 
     # -- filer client --------------------------------------------------
 
@@ -1112,6 +1149,10 @@ class S3ApiServer:
             await resp.prepare(req)
             if req.method != "HEAD":
                 async for chunk in r.content.iter_chunked(1 << 20):
+                    # streamed reads bypass the aiohttp trace hooks:
+                    # book the proxied object bytes explicitly
+                    netflow.account("recv", netflow.current_class(),
+                                    "filer", len(chunk))
                     await resp.write(chunk)
             await resp.write_eof()
             return resp

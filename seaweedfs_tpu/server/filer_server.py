@@ -50,7 +50,7 @@ from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
 from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
 from seaweedfs_tpu.filer.abstract_sql import SqliteStore
 from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFound
-from seaweedfs_tpu.stats import metrics, profile, trace
+from seaweedfs_tpu.stats import metrics, netflow, profile, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config, parse_range
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
@@ -117,6 +117,7 @@ class FilerServer:
             client_max_size=1024 * 1024 * 1024,
             middlewares=[trace.aiohttp_middleware(
                 "filer", slow_exempt=("/__meta__/subscribe",))])
+        netflow.install(self.app, "filer")
         self.app.add_routes(trace.debug_routes())
         self.app.add_routes([
             web.get("/__meta__/subscribe", self.handle_meta_subscribe),
@@ -182,7 +183,7 @@ class FilerServer:
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=60),
-            trace_configs=[aiohttp_trace_config()])
+            trace_configs=[aiohttp_trace_config("filer")])
         self.deletion.start()
         self.filer.meta_log.subscribe(self._fanout_event)
         if self.notification is not None:
@@ -462,6 +463,14 @@ class FilerServer:
         # this request's trace records the wait instead
         with trace.span("filer.chunk_join", fid=v.fid):
             return await asyncio.shield(fut)
+
+    async def _load_prefetch(self, v, cache: bool) -> bytes:
+        """Speculative pipeline fetch: upstream bytes pulled BEFORE the
+        in-order writer needs them book as class=readahead in the flow
+        ledger, so `/cluster/metrics` can separate bytes the client asked
+        for from bytes the pipeline gambled on."""
+        with netflow.flow("readahead"):
+            return await self._load_chunk_view(v, cache)
 
     @staticmethod
     def _readahead_depth() -> int:
@@ -1104,11 +1113,16 @@ class FilerServer:
                 pending: deque = deque()
                 nxt = 0
                 try:
+                    # a task created while another is already pending is
+                    # speculative (class=readahead); the head-of-line
+                    # fetch the writer is about to wait on is plain data
                     while nxt < len(views) and len(pending) < depth:
                         v = views[nxt]
                         nxt += 1
+                        fetch = self._load_prefetch if pending \
+                            else self._load_chunk_view
                         pending.append((v, asyncio.ensure_future(
-                            self._load_chunk_view(v, cache_chunks))))
+                            fetch(v, cache_chunks))))
                     while pending:
                         v, task = pending.popleft()
                         blob = await task
@@ -1122,8 +1136,10 @@ class FilerServer:
                         while nxt < len(views) and len(pending) < depth:
                             v = views[nxt]
                             nxt += 1
+                            fetch = self._load_prefetch if pending \
+                                else self._load_chunk_view
                             pending.append((v, asyncio.ensure_future(
-                                self._load_chunk_view(v, cache_chunks))))
+                                fetch(v, cache_chunks))))
                 finally:
                     for _, task in pending:
                         # cancelling a waiter never kills a shared

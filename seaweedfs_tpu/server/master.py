@@ -21,7 +21,8 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
-from seaweedfs_tpu.stats import aggregate, metrics, profile, trace
+from seaweedfs_tpu.stats import aggregate, metrics, netflow, profile, trace
+from seaweedfs_tpu.stats.canary import CanaryProber
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.topology.topology import Topology
@@ -114,8 +115,12 @@ class MasterServer:
             web.get("/metrics", self.handle_metrics),
             web.get("/cluster/metrics", self.handle_cluster_metrics),
             web.get("/cluster/slo", self.handle_cluster_slo),
+            web.get("/cluster/trace/{tid}", self.handle_cluster_trace),
+            web.get("/cluster/traces", self.handle_cluster_traces),
+            web.get("/cluster/canary", self.handle_cluster_canary),
             web.get("/", self.handle_ui),
         ])
+        netflow.install(self.app, "master")
         # non-volume-server cluster members (filers, brokers, gateways):
         # type -> {address: last_seen} (reference: weed/cluster/cluster.go)
         self.cluster_members: dict[str, dict[str, float]] = {}
@@ -142,6 +147,10 @@ class MasterServer:
         # is read directly.
         self.aggregator = aggregate.ClusterAggregator(
             self._agg_nodes, local=(self.url, metrics.REGISTRY))
+        # flight recorder: always-on canary probes through every gateway
+        # path (stats/canary.py), feeding the SLO engine and pinning
+        # their trace ids for ready-made failure waterfalls
+        self.canary = CanaryProber(self)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -157,7 +166,7 @@ class MasterServer:
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=30),
-            trace_configs=[aiohttp_trace_config()])
+            trace_configs=[aiohttp_trace_config("master")])
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
@@ -167,6 +176,7 @@ class MasterServer:
         self._repair_task = asyncio.create_task(self._repair_loop())
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         self.aggregator.start()
+        self.canary.start()  # WEEDTPU_CANARY_INTERVAL <= 0 disables
         if self.raft:
             self.raft.start()
         log.info("master listening on %s", self.url)
@@ -174,6 +184,7 @@ class MasterServer:
     async def stop(self) -> None:
         if self.raft:
             self.raft.stop()
+        self.canary.stop()
         if self._expire_task:
             self._expire_task.cancel()
         if self._repair_task:
@@ -342,6 +353,173 @@ class MasterServer:
                 if ts > horizon:
                     nodes.setdefault(addr, addr)
         return nodes
+
+    # -- cluster flight recorder: cross-node trace assembly --------------
+
+    def _fan_debug_traces(self, query: str
+                          ) -> tuple[list[tuple[str, list[dict]]],
+                                     dict[str, str]]:
+        """GET /debug/traces?{query} from every known node over the
+        shared PooledHTTP (the aggregator's pool — thread-safe).
+        -> ([(node, traces)], {node: error}): a trace is better partial
+        than absent, but a node that refused or timed out is REPORTED —
+        on a multi-host cluster the loopback gate on /debug/* answers
+        403 to the master, and a waterfall that silently shrank to one
+        node's spans would hide exactly that (run the master on a
+        trusted network with the debug surface reachable, or tunnel)."""
+        import concurrent.futures
+        import json as _json
+        nodes = self._agg_nodes()
+
+        def pull(item):
+            name, netloc = item
+            try:
+                status, _, body = self.aggregator.pool.request(
+                    f"{_tls_scheme()}://{netloc}/debug/traces?{query}",
+                    timeout=5.0)
+                if status != 200:
+                    return name, [], f"HTTP {status}"
+                return name, _json.loads(body).get("traces", []), None
+            except Exception as e:
+                return name, [], str(e) or type(e).__name__
+
+        out: list[tuple[str, list[dict]]] = []
+        errors: dict[str, str] = {}
+        if nodes:
+            with concurrent.futures.ThreadPoolExecutor(
+                    min(8, len(nodes)), "trace-pull") as ex:
+                for name, traces_, err in ex.map(pull,
+                                                 sorted(nodes.items())):
+                    out.append((name, traces_))
+                    if err is not None:
+                        errors[name] = err
+        return out, errors
+
+    def collect_trace(self, tid: str) -> dict:
+        """One trace id -> a single parent-ordered waterfall stitched
+        from every node's span ring (each fan-out carries pin=1, so the
+        spans survive ring wrap on all hops while someone is looking).
+        Thread-safe sync function: handlers call it via to_thread, the
+        canary via the same route on failures."""
+        trace.pin_trace(tid)  # local ring first (and retro-keep it)
+        spans: list[dict] = []
+        for rec in trace.traces(tid=tid):
+            for s in rec["spans"]:
+                s = dict(s)
+                s.setdefault("node", self.url)
+                spans.append(s)
+        pulled, errors = self._fan_debug_traces(f"tid={tid}&pin=1")
+        for node, remote in pulled:
+            for rec in remote:
+                for s in rec.get("spans", []):
+                    s = dict(s)
+                    s.setdefault("node", node)
+                    spans.append(s)
+        wf = trace.assemble(spans)
+        if errors:
+            wf["node_errors"] = errors
+        return wf
+
+    def collect_traces(self, min_ms: float, limit: int
+                       ) -> tuple[list[dict], dict[str, str]]:
+        """Fleet-wide trace listing: every node's recent traces merged by
+        trace id (one request's spans live in several rings), newest
+        first, summarized without span bodies.  Also returns per-node
+        pull errors (a 403ing debug gate must be visible, not silent)."""
+        by_tid: dict[str, dict] = {}
+
+        def fold(node: str, recs: list[dict]) -> None:
+            for rec in recs:
+                tid = rec.get("trace_id")
+                if not tid:
+                    continue
+                agg = by_tid.setdefault(
+                    tid, {"trace_id": tid, "start": rec["start"],
+                          "end": 0.0, "error": False, "spans": 0,
+                          "nodes": set(), "servers": set()})
+                agg["start"] = min(agg["start"], rec["start"])
+                agg["end"] = max(agg["end"],
+                                 rec["start"] + rec["ms"] / 1000.0)
+                agg["error"] = agg["error"] or bool(rec.get("error"))
+                agg["spans"] += len(rec.get("spans", []))
+                agg["nodes"].add(node)
+                for s in rec.get("spans", []):
+                    server = (s.get("attrs") or {}).get("server")
+                    if server:
+                        agg["servers"].add(server)
+
+        fold(self.url, trace.traces(min_ms=min_ms, limit=limit))
+        pulled, errors = self._fan_debug_traces(
+            f"min_ms={min_ms:g}&limit={limit}")
+        for node, remote in pulled:
+            fold(node, remote)
+        out = []
+        for agg in by_tid.values():
+            ms = (agg.pop("end") - agg["start"]) * 1000.0
+            if ms < min_ms:
+                continue
+            agg["ms"] = round(ms, 3)
+            agg["nodes"] = sorted(agg["nodes"])
+            agg["servers"] = sorted(agg["servers"])
+            out.append(agg)
+        out.sort(key=lambda r: r["start"], reverse=True)
+        return out[:max(1, limit)], errors
+
+    async def handle_cluster_trace(self, req: web.Request) -> web.Response:
+        """/cluster/trace/<tid>: the stitched cross-node waterfall for
+        one trace id (loopback-gated like every debug surface)."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        tid = req.match_info["tid"]
+        if len(tid) != 32 or any(c not in "0123456789abcdef"
+                                 for c in tid):
+            return web.json_response({"error": "bad trace id"},
+                                     status=400)
+        result = await asyncio.to_thread(self.collect_trace, tid)
+        if not result["spans"]:
+            # keep node_errors in the 404: "trace expired" and "every
+            # node's debug gate refused the master" must be
+            # distinguishable from the operator's seat
+            return web.json_response(
+                {"error": "trace not found on any node",
+                 "trace_id": tid,
+                 "node_errors": result.get("node_errors", {})},
+                status=404)
+        return web.json_response(result)
+
+    async def handle_cluster_traces(self, req: web.Request
+                                    ) -> web.Response:
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        try:
+            min_ms = float(req.query.get("min_ms", "0"))
+        except ValueError:
+            min_ms = 0.0
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        traces_, errors = await asyncio.to_thread(
+            self.collect_traces, min_ms, limit)
+        return web.json_response({"traces": traces_,
+                                  "node_errors": errors})
+
+    async def handle_cluster_canary(self, req: web.Request
+                                    ) -> web.Response:
+        """Canary prober status: per-path outcomes, latency quantiles,
+        pinned trace ids, and the last failure's stitched waterfall.
+        Loopback-gated like the rest of the trace surface — a failure
+        waterfall is a cross-node trace and must not leak to remote
+        callers.  ?probe=1 runs one probe round inline (tests and
+        impatient operators)."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        if req.query.get("probe"):
+            await self.canary.run_once()
+        return web.json_response(self.canary.status())
 
     def _health_snapshot(self) -> dict:
         led = self.maintenance.ledger()  # also refreshes VOLUME_HEALTH

@@ -24,7 +24,7 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security import jwt as sjwt
-from seaweedfs_tpu.stats import metrics, profile, trace
+from seaweedfs_tpu.stats import metrics, netflow, profile, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
@@ -124,6 +124,7 @@ class VolumeServer:
         self.app = web.Application(
             client_max_size=256 * 1024 * 1024,
             middlewares=[trace.aiohttp_middleware("volume")])
+        netflow.install(self.app, "volume")
         self.app.add_routes(trace.debug_routes())
         self.app.add_routes([
             web.get("/", self.handle_ui),
@@ -153,6 +154,7 @@ class VolumeServer:
             web.post("/admin/ec/copy", self.handle_ec_copy),
             web.post("/admin/ec/to_volume", self.handle_ec_to_volume),
             web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
+            web.get("/admin/ec/probe_read", self.handle_ec_probe_read),
             web.get("/admin/file", self.handle_file_pull),
             web.post("/admin/query", self.handle_query),
             web.post("/admin/scrub", self.handle_scrub),
@@ -195,7 +197,7 @@ class VolumeServer:
         self._session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(ssl=_tls.client_ssl()),
             timeout=aiohttp.ClientTimeout(total=300),
-            trace_configs=[aiohttp_trace_config()])
+            trace_configs=[aiohttp_trace_config("volume")])
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
@@ -461,9 +463,12 @@ class VolumeServer:
 
         # return_exceptions so one unexpected failure cannot abandon the
         # sibling writes as detached tasks that land AFTER the error is
-        # reported — every peer's outcome is awaited and folded in
-        with trace.span("volume.replicate", peers=len(peers),
-                        method=method):
+        # reported — every peer's outcome is awaited and folded in.
+        # Replica fan-out bytes are class=replication in the ledger; the
+        # contextvar set here rides into the gathered tasks' contexts.
+        with netflow.flow("replication"), \
+                trace.span("volume.replicate", peers=len(peers),
+                           method=method):
             results = await asyncio.gather(*(one(p) for p in peers),
                                            return_exceptions=True)
         for err in results:
@@ -732,11 +737,15 @@ class VolumeServer:
         """Remote-shard fetch for EC degraded reads: ask the master where
         each shard lives, pull the byte range from a peer
         (reference: store_ec.go readRemoteEcShardInterval).  The trace
-        context is captured HERE, on the event loop, because read() runs
-        on executor pool threads that never see the request's copied
-        context — the captured Trace parents the per-fetch spans and
-        rides the X-Weedtpu-Trace header to the peer."""
+        context AND the ambient traffic class are captured HERE, on the
+        calling thread, because read() runs on executor pool threads
+        that never see the request's copied context — the captured Trace
+        parents the per-fetch spans, and the class (data for a foreground
+        degraded read, scrub when the scrubber asked, repair under the
+        planner) rides X-Weedtpu-Class to the peer so both sides book
+        the shard bytes under the same flow."""
         tctx = trace.current()
+        flow_cls = netflow.current_class() or "data"
 
         def read(shard_id: int, offset: int, size: int) -> bytes | None:
             # runs inside a worker thread: use a blocking http client
@@ -764,9 +773,13 @@ class VolumeServer:
                                 req.add_header(
                                     trace.TRACE_HEADER,
                                     trace.format_header(hdr_ctx))
+                            req.add_header(netflow.CLASS_HEADER, flow_cls)
+                            req.add_header(netflow.ROLE_HEADER, "volume")
                             with urllib.request.urlopen(req,
                                                         timeout=30) as rr:
                                 data = rr.read()
+                            netflow.account("recv", flow_cls, "volume",
+                                            len(data))
                             if len(data) != size:
                                 sp.set(short=len(data))
                         if len(data) == size:
@@ -1136,6 +1149,11 @@ class VolumeServer:
                             status=500)
                     with open(base + ext, "wb") as f:
                         async for chunk in r.content.iter_chunked(1 << 20):
+                            # streamed reads bypass the aiohttp trace
+                            # hooks: book the shard bytes explicitly
+                            netflow.account("recv",
+                                            netflow.current_class(),
+                                            "volume", len(chunk))
                             f.write(chunk)
             except aiohttp.ClientError as e:
                 return web.json_response({"error": str(e)}, status=500)
@@ -1195,6 +1213,9 @@ class VolumeServer:
                             f"pull {name} from {source}: HTTP {r.status}")
                     with open(base + tmp_ext[ext], "wb") as f:
                         async for chunk in r.content.iter_chunked(1 << 20):
+                            netflow.account("recv",
+                                            netflow.current_class(),
+                                            "volume", len(chunk))
                             f.write(chunk)
             if staging:
                 # marker lands BEFORE the .dat appears: a crash between the
@@ -1320,6 +1341,9 @@ class VolumeServer:
                 elif r.status == 206:
                     with open(tail_path, "wb") as f:
                         async for chunk in r.content.iter_chunked(1 << 20):
+                            netflow.account("recv",
+                                            netflow.current_class(),
+                                            "volume", len(chunk))
                             f.write(chunk)
                             appended_hint += len(chunk)
                 elif r.status == 200:
@@ -1543,6 +1567,51 @@ class VolumeServer:
             return web.json_response({"error": "shard not local"}, status=404)
         return web.Response(body=data,
                             content_type="application/octet-stream")
+
+    async def handle_ec_probe_read(self, req: web.Request) -> web.Response:
+        """Canary degraded-read probe (stats/canary.py): read one REAL
+        needle from an EC volume with one present shard deliberately
+        skipped, forcing the reconstruction path end to end.  Read-only;
+        returns the byte count and which shard was withheld."""
+        try:
+            vid = int(req.query.get("volume", "0"))
+        except ValueError:
+            return web.json_response({"error": "bad volume"}, status=400)
+        ev = self.store.get_ec_volume(vid)
+        if ev is None:
+            return web.json_response({"error": "not mounted"}, status=404)
+        nid = next((int(i) for i, sz in zip(ev.ids, ev.sizes)
+                    if t.size_is_valid(int(sz))), None)
+        if nid is None:
+            return web.json_response({"error": "no needles"}, status=404)
+        # withhold a shard the needle's data actually LIVES on — skipping
+        # an unplanned shard would serve the read without ever touching
+        # the decode path, and the probe exists to exercise exactly that.
+        # skip_shards blocks the remote reader too, so any planned shard
+        # forces reconstruction whether or not it is local.
+        try:
+            dat_off, size = ev.find_needle(nid)
+            intervals = layout.locate_data(
+                ev.large_block, ev.small_block, ev.dat_size, dat_off,
+                t.actual_size(size, ev.version))
+            planned = sorted({iv.to_shard_id_and_offset(
+                ev.large_block, ev.small_block)[0] for iv in intervals})
+        except KeyError:
+            planned = []
+        if not planned:
+            return web.json_response({"error": "no needles"}, status=404)
+        skip = next((s for s in planned if s in ev.shards), planned[0])
+        reader = self._shard_reader(vid)
+        try:
+            with trace.span("volume.probe_read", vid=vid, skip=skip):
+                n = await asyncio.to_thread(
+                    ev.read_needle, nid, reader, None, frozenset({skip}))
+        except (KeyError, IOError, ValueError) as e:
+            return web.json_response(
+                {"error": f"degraded probe read failed: {e}"}, status=503)
+        return web.json_response({"needle": f"{nid:x}",
+                                  "bytes": len(n.data),
+                                  "skipped_shard": skip})
 
     async def handle_ec_to_volume(self, req: web.Request) -> web.Response:
         """VolumeEcShardsToVolume (volume_grpc_erasure_coding.go:407):

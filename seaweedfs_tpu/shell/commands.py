@@ -14,6 +14,7 @@ import shlex
 import urllib.parse
 import urllib.request
 
+from seaweedfs_tpu.stats import netflow
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import layout
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
@@ -39,10 +40,15 @@ class CommandEnv:
     def _call(self, url: str, body: dict | None = None,
               method: str | None = None, timeout: float = 600.0) -> dict:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} \
+            if body is not None else {}
+        # byte-flow class: an ec.rebuild's shard copies must book as
+        # class=repair whether the planner or an operator drove them
+        netflow.inject(headers, "/" + url.partition("/")[2], "shell")
         req = urllib.request.Request(
             f"{_tls_scheme()}://{url}", data=data,
             method=method or ("POST" if body is not None else "GET"),
-            headers={"Content-Type": "application/json"} if body is not None else {})
+            headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 raw = r.read()
@@ -373,6 +379,11 @@ def _ec_encode_one(env: CommandEnv, vid: int, collection: str, out):
 def cmd_ec_rebuild(env: CommandEnv, args, out):
     """Rebuild missing shards (reference: command_ec_rebuild.go:58-281)."""
     env.require_lock()
+    with netflow.flow("repair"):
+        _ec_rebuild_all(env, out)
+
+
+def _ec_rebuild_all(env: CommandEnv, out) -> None:
     topo = env.topology()
     ec_vids = {int(v) for node in topo["nodes"].values()
                for v in node["ec_shards"]}
@@ -609,9 +620,11 @@ def cmd_volume_fix_replication(env: CommandEnv, args, out):
                       f"copy {nodes[0]} -> {dst}"
                       + ("" if apply else " (dry run)"), file=out)
                 if apply:
-                    env.vs_post(dst, "/admin/volume/copy",
-                                {"volume": vid, "source": nodes[0],
-                                 "collection": rec.get("collection", "")})
+                    with netflow.flow("replication"):
+                        env.vs_post(dst, "/admin/volume/copy",
+                                    {"volume": vid, "source": nodes[0],
+                                     "collection":
+                                     rec.get("collection", "")})
                 fixed += 1
     print(f"volume.fix.replication: {fixed} action(s)"
           + ("" if apply else " planned"), file=out)
@@ -744,6 +757,70 @@ def cmd_cluster_metrics(env: CommandEnv, args, out):
         if needle and needle not in line:
             continue
         print(line, file=out)
+
+
+@command("cluster.trace")
+def cmd_cluster_trace(env: CommandEnv, args, out):
+    """Cross-node trace waterfall.  `cluster.trace <trace_id>` stitches
+    one trace id from every node's span ring into a parent-ordered tree
+    with per-hop network time; with no id (optionally -min_ms N) it
+    lists recent traces fleet-wide.  -json emits the raw assembly."""
+    flags = parse_flags(args)
+    tid = next((a for a in args if not a.startswith("-")
+                and a not in flags.values()), None)
+    if tid is None:
+        qs = urllib.parse.urlencode(
+            {"min_ms": flags.get("min_ms", "0"),
+             "limit": flags.get("limit", "20")})
+        listing = env.master_get(f"/cluster/traces?{qs}")
+        for rec in listing.get("traces", []):
+            mark = " ERR" if rec.get("error") else ""
+            print(f"  {rec['trace_id']} {rec['ms']:10.1f}ms "
+                  f"spans={rec['spans']:<4d} "
+                  f"servers={','.join(rec['servers'])}{mark}", file=out)
+        if not listing.get("traces"):
+            print("no traces (raise the sample rate or lower -min_ms)",
+                  file=out)
+        return
+    wf = env.master_get(f"/cluster/trace/{tid}")
+    if "json" in flags:
+        print(json.dumps(wf, separators=(",", ":")), file=out)
+        return
+    print(f"trace {wf['trace_id']}: {wf['ms']}ms, "
+          f"{wf['span_count']} spans across "
+          f"{', '.join(wf['servers']) or 'unknown servers'}"
+          + (" [ERROR]" if wf.get("error") else ""), file=out)
+    for sp in wf.get("spans", []):
+        pad = "  " * (sp.get("depth", 0) + 1)
+        net = f" net={sp['net_ms']}ms" if "net_ms" in sp else ""
+        err = " ERR" if sp.get("error") else ""
+        node = f" @{sp['node']}" if sp.get("node") else ""
+        print(f"{pad}{sp['name']:<28s} {sp['ms']:9.2f}ms"
+              f"{net}{node}{err}", file=out)
+
+
+@command("cluster.canary")
+def cmd_cluster_canary(env: CommandEnv, args, out):
+    """Canary prober status (/cluster/canary): per-gateway-path probe
+    outcomes, latency quantiles, and the pinned trace id of the last
+    probe (feed it to cluster.trace).  -probe runs one round now;
+    -json dumps the raw status."""
+    flags = parse_flags(args)
+    params = {"probe": "1"} if "probe" in flags else {}
+    st = env.master_get("/cluster/canary", **params)
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    print(f"canary: interval={st.get('interval_s')}s "
+          f"running={st.get('running')} "
+          f"paths={','.join(st.get('enabled_paths', []))}", file=out)
+    if not st.get("paths"):
+        print("  no probes recorded yet (try -probe)", file=out)
+    for path, rec in sorted(st.get("paths", {}).items()):
+        p99 = f" p99={rec['p99_ms']:.1f}ms" if rec.get("p99_ms") else ""
+        err = f" error={rec['error']}" if rec.get("error") else ""
+        print(f"  {path:9s} {rec['outcome']:5s} {rec['ms']:8.1f}ms"
+              f"{p99} trace={rec['trace_id']}{err}", file=out)
 
 
 @command("volume.fsck")

@@ -226,7 +226,12 @@ _DEFAULT_RULES = (
     "read_latency_p99=latency,family=weedtpu_volume_request_seconds,"
     "label.type=read,ms=500,target=0.99;"
     "repair_backlog=backlog,family=weedtpu_volume_health,"
-    "label.state!=healthy")
+    "label.state!=healthy;"
+    # the canary prober's probes carry their status bucket in a `class`
+    # label, so the stock availability machinery evaluates them: the SLO
+    # stays live BETWEEN real requests (stats/canary.py)
+    "canary_availability=availability,"
+    "family=weedtpu_canary_probes_total,target=0.99")
 
 
 def parse_rules(spec: str | None = None) -> list[dict]:
@@ -436,7 +441,8 @@ class ClusterAggregator:
         self.nodes_fn = nodes_fn  # () -> {node name: netloc}
         self.local = local        # (node name, Registry) served locally
         self.pool = pool or PooledHTTP(timeout=5.0,
-                                       max_idle_per_host=2)
+                                       max_idle_per_host=2,
+                                       role="master")
         self.interval = agg_interval() if interval is None else interval
         self.engine = SLOEngine(rules, windows)
         # (ts, {node: counters}, {node: hists}); trimmed to the longest
@@ -445,6 +451,10 @@ class ClusterAggregator:
         self.per_node: dict[str, dict] = {}
         self.errors: dict[str, str] = {}
         self.last_scrape: float = 0.0
+        # node -> ts of its last SUCCESSFUL pull: a dead node's age grows
+        # visibly in /cluster/metrics instead of its last values sitting
+        # there silently stale
+        self.last_ok: dict[str, float] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -521,6 +531,13 @@ class ClusterAggregator:
             self.per_node = per_node
             self.errors = errors
             self.last_scrape = ts
+            for n in per_node:
+                self.last_ok[n] = ts
+            # forget nodes that left the topology entirely (still listed
+            # while erroring: an operator needs to SEE the gap grow)
+            known = set(per_node) | set(errors)
+            for n in [n for n in self.last_ok if n not in known]:
+                del self.last_ok[n]
             self.history.append((ts, counters, hists))
             horizon = ts - (max(self.engine.windows) + 2 * max(
                 self.interval, 1.0))
@@ -545,6 +562,7 @@ class ClusterAggregator:
         with self._lock:
             per_node = dict(self.per_node)
             errors = dict(self.errors)
+            last_ok = dict(self.last_ok)
         fams: dict[str, dict] = {}
         for node, nf in per_node.items():
             for fname, fam in nf.items():
@@ -570,6 +588,17 @@ class ClusterAggregator:
             out.append(f'weedtpu_cluster_node_up{{node="{_esc(node)}"}} 1')
         for node in sorted(errors):
             out.append(f'weedtpu_cluster_node_up{{node="{_esc(node)}"}} 0')
+        # per-node scrape staleness: a dead node's age keeps growing
+        # (its last successful pull recedes) — the visible gap that
+        # distinguishes "node quiet" from "values silently stale"
+        now = time.time()
+        out.append("# HELP weedtpu_agg_scrape_age_seconds seconds since "
+                   "this node's last successful /metrics pull")
+        out.append("# TYPE weedtpu_agg_scrape_age_seconds gauge")
+        for node in sorted(last_ok):
+            age = max(0.0, now - last_ok[node])
+            out.append(f'weedtpu_agg_scrape_age_seconds'
+                       f'{{node="{_esc(node)}"}} {round(age, 3)}')
         return "\n".join(out) + "\n"
 
     def slo_status(self) -> dict:

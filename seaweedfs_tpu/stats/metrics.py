@@ -381,6 +381,32 @@ HTTP_REQUESTS = REGISTRY.counter(
     "weedtpu_http_requests_total",
     "completed requests by server role, read/write op, and status class",
     ("server", "op", "class"))
+# byte-flow ledger (stats/netflow.py): body bytes crossing a process
+# boundary, by direction (sent/recv), traffic class (data/replication/
+# repair/scrub/readahead/internal — carried on X-Weedtpu-Class), and the
+# peer's role.  Sender and receiver totals conserve per class.
+NET_BYTES = REGISTRY.counter(
+    "weedtpu_net_bytes_total",
+    "network body bytes by direction, traffic class, and peer role",
+    ("direction", "class", "peer_role"))
+# PooledHTTP connection economics: how often a request rode a warm
+# keep-alive socket vs paid a fresh dial — without these the per-peer
+# byte counters can't distinguish "chatty" from "reconnect storm"
+HTTP_POOL_REUSE = REGISTRY.counter(
+    "weedtpu_http_pool_reuse_total",
+    "pooled-client requests served on a reused keep-alive connection")
+HTTP_POOL_DIAL = REGISTRY.counter(
+    "weedtpu_http_pool_dial_total",
+    "pooled-client requests that dialed a fresh connection")
+# canary prober (stats/canary.py): synthetic write/read/delete probes
+# through each gateway path.  The class label holds the status bucket
+# (2xx/5xx) so the SLO engine's availability machinery evaluates probe
+# success like any other request family.
+CANARY_PROBES = REGISTRY.counter(
+    "weedtpu_canary_probes_total",
+    "canary probes by gateway path and status class", ("path", "class"))
+CANARY_PROBE_SECONDS = REGISTRY.histogram(
+    "weedtpu_canary_probe_seconds", "canary probe latency", ("path",))
 MASTER_ASSIGN_COUNTER = REGISTRY.counter(
     "weedtpu_master_assign_total", "fid assignments", ("collection",))
 VOLUME_REQUEST_COUNTER = REGISTRY.counter(

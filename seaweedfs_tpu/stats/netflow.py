@@ -1,0 +1,203 @@
+"""Byte-flow accounting: network bytes per traffic class, per direction.
+
+The Facebook warehouse study (PAPERS.md, arXiv:1309.0186) makes repair
+traffic THE fleet-scale EC bottleneck, and the SSD-array study
+(arXiv:1709.05365) asks how online encode/repair interferes with
+foreground traffic — neither question is answerable without a ledger of
+WHO moved WHICH bytes.  This module is that ledger:
+
+- every byte that crosses a process boundary is counted into
+  ``weedtpu_net_bytes_total{direction,class,peer_role}`` (direction is
+  ``sent``/``recv``, body bytes — framing overhead is excluded on both
+  sides so sender and receiver totals conserve per class);
+- the **traffic class** rides a contextvar (``flow("repair")``) and the
+  ``X-Weedtpu-Class`` request header: a call site declares its class
+  once (repair planner, scrubber, replica fan-out, readahead prefetch)
+  and every downstream hop inherits it — the server middleware re-enters
+  the class from the header, so a volume server pulling survivor shards
+  on behalf of a repair request still books those bytes as ``repair``;
+- the **peer role** rides ``X-Weedtpu-Role`` both ways (request header
+  names the caller's role; ``on_response_prepare`` stamps the server's
+  role on replies) so ``/cluster/metrics`` can answer "how many bytes
+  did volume servers exchange with each other for repair this window".
+
+Classes: ``data`` (foreground client payload), ``replication`` (replica
+fan-out), ``repair`` (rebuild/survivor movement), ``scrub`` (syndrome
+verification reads), ``readahead`` (speculative prefetch), ``internal``
+(metrics/heartbeat/control).  Unlabeled traffic classifies by path:
+cluster-internal surfaces are ``internal``, everything else ``data``.
+
+``WEEDTPU_NETFLOW=0`` disables the accounting (read per call so the
+bench can flip it between interleaved reps).
+"""
+
+from __future__ import annotations
+
+import os
+from contextvars import ContextVar
+
+CLASS_HEADER = "X-Weedtpu-Class"
+ROLE_HEADER = "X-Weedtpu-Role"
+
+CLASSES = frozenset({"data", "replication", "repair", "scrub",
+                     "readahead", "internal"})
+
+# cluster-internal surfaces (monitoring pulls, heartbeats, raft, debug,
+# maintenance, admin control traffic).  Shared with the trace
+# middleware's op="internal" request classification — one list, so the
+# SLO denominator and the byte ledger can never disagree about what
+# "internal" means.
+INTERNAL_PREFIXES = ("/metrics", "/heartbeat", "/raft", "/debug",
+                     "/cluster", "/maintenance", "/admin",
+                     "/__meta__", "/__admin__", "/__ui__", "/status")
+
+
+def is_internal(path: str) -> bool:
+    """Exact-or-slash matching: a filer file /status-reports/x or an s3
+    bucket named "metrics-dump" is DATA-plane traffic, not internal."""
+    return any(path == p or path.startswith(p + "/")
+               for p in INTERNAL_PREFIXES)
+
+
+def classify(path: str) -> str:
+    """Default class for traffic nobody labeled explicitly."""
+    return "internal" if is_internal(path) else "data"
+
+
+_flow: ContextVar[str | None] = ContextVar("weedtpu_netflow", default=None)
+
+
+def current_class() -> str | None:
+    return _flow.get()
+
+
+def set_class(cls: str | None):
+    """Raw contextvar set -> reset token (the server middleware's seam;
+    call sites should prefer the ``flow()`` CM)."""
+    return _flow.set(cls)
+
+
+def reset(token) -> None:
+    _flow.reset(token)
+
+
+class flow:
+    """``with flow("repair"):`` — every request made inside (same task,
+    same thread, or any ``asyncio`` work spawned from it) carries the
+    class to its peer.  Plain class, not @contextmanager: the repair and
+    scrub loops enter/exit this on worker threads at high rate."""
+
+    __slots__ = ("cls", "_token")
+
+    def __init__(self, cls: str):
+        self.cls = cls if cls in CLASSES else "data"
+
+    def __enter__(self):
+        self._token = _flow.set(self.cls)
+        return self
+
+    def __exit__(self, *exc):
+        _flow.reset(self._token)
+        return False
+
+
+def enabled() -> bool:
+    """Accounting switch, read per call (the bench flips it between
+    interleaved reps to price the ledger itself)."""
+    return os.environ.get("WEEDTPU_NETFLOW", "1") != "0"
+
+
+_NET_BYTES = None
+
+
+def _counter():
+    # lazy: metrics imports trace which imports this module — a
+    # top-level import here would be circular
+    global _NET_BYTES
+    if _NET_BYTES is None:
+        from seaweedfs_tpu.stats import metrics as _metrics
+        _NET_BYTES = _metrics.NET_BYTES
+    return _NET_BYTES
+
+
+def account(direction: str, cls: str | None, peer_role: str,
+            nbytes: int) -> None:
+    """Book `nbytes` body bytes moving `direction` for traffic class
+    `cls` against `peer_role`.  Zero-byte moves are not booked — a GET's
+    empty request body must not fabricate series."""
+    if nbytes <= 0 or not enabled():
+        return
+    if cls not in CLASSES:
+        cls = "data"
+    _counter().labels(direction, cls, peer_role or "client").inc(nbytes)
+
+
+def class_total(direction: str, cls: str) -> float:
+    """Sum of this process's ledger for one (direction, class) over all
+    peer roles — the bench's repair_network_bytes probe and the
+    conservation tests read deltas of this."""
+    total = 0.0
+    c = _counter()
+    for labels, child in c._pairs():
+        ld = dict(labels)
+        if ld.get("direction") == direction and ld.get("class") == cls:
+            total += child.value
+    return total
+
+
+def inject(headers: dict, path: str = "", role: str | None = None) -> dict:
+    """Stamp the outgoing class (+ caller role) headers onto a header
+    dict, in place.  The class is the ambient flow class, else the
+    path-default — the receiver books bytes under the same class either
+    way."""
+    headers[CLASS_HEADER] = _flow.get() or classify(path)
+    if role:
+        headers[ROLE_HEADER] = role
+    return headers
+
+
+def extract_class(headers, path: str) -> str:
+    """Server-side class resolution: the caller's declared class when
+    valid, else the path default."""
+    cls = headers.get(CLASS_HEADER, "")
+    return cls if cls in CLASSES else classify(path)
+
+
+def response_bytes(resp) -> int:
+    """Best-effort body size of an aiohttp response object after the
+    handler returned: plain Responses know their body; an
+    already-written StreamResponse reports what its writer moved (which
+    includes framing — the reason conservation asserts ~1%, not
+    equality)."""
+    if resp is None:
+        return 0
+    body = getattr(resp, "body", None)
+    if body is not None:
+        try:
+            return len(body)
+        except TypeError:
+            pass  # Payload body: fall through to the writer
+    w = getattr(resp, "_payload_writer", None)
+    if w is not None and getattr(w, "output_size", 0):
+        return int(w.output_size)
+    try:
+        return int(getattr(resp, "content_length", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def on_response_prepare(role: str):
+    """aiohttp ``app.on_response_prepare`` hook: stamp this server's role
+    on every reply (including prepared StreamResponses, which the
+    middleware can no longer touch) so the CLIENT side of the ledger can
+    label its recv bytes with the true peer role."""
+    async def _prepare(req, resp) -> None:
+        resp.headers[ROLE_HEADER] = role
+    return _prepare
+
+
+def install(app, role: str) -> None:
+    """Wire the role-stamping prepare hook into a server app (the byte
+    counting itself lives in trace.aiohttp_middleware, which every
+    server already mounts)."""
+    app.on_response_prepare.append(on_response_prepare(role))

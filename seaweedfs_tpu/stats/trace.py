@@ -36,8 +36,10 @@ import itertools
 import os
 import random
 import time
+from collections import OrderedDict
 from contextvars import ContextVar
 
+from seaweedfs_tpu.stats import netflow
 from seaweedfs_tpu.utils import weedlog
 
 TRACE_HEADER = "X-Weedtpu-Trace"
@@ -158,6 +160,36 @@ class _Ring:
 
 _ring = _Ring(_ring_capacity())
 
+# pinned traces: span lists that survive ring wrap-around.  The master's
+# cross-node assembler pins any trace id it is asked about (an operator
+# or the canary prober is LOOKING at it — the worst moment for the ring
+# to overwrite the evidence), and record_span mirrors further spans of a
+# pinned trace here as they finish.  Bounded FIFO of _PIN_CAP ids.
+_PIN_CAP = 64
+_PIN_SPAN_CAP = 512  # per-trace: a runaway pinned trace can't hoard
+_pinned: "OrderedDict[str, list[dict]]" = OrderedDict()
+
+
+def pin_trace(trace_id: str) -> None:
+    """Retro-keep `trace_id`: copy its spans currently in the ring into
+    the pinned store and keep mirroring new ones.  Also forces SAMPLING
+    for future requests carrying this trace id, so a pinned id survives
+    every hop regardless of each server's local rate."""
+    spans = _pinned.get(trace_id)
+    if spans is None:
+        _pinned[trace_id] = spans = []
+        while len(_pinned) > _PIN_CAP:
+            _pinned.popitem(last=False)
+    seen = {r["span"] for r in spans}
+    for rec in _ring.snapshot():
+        if rec["trace"] == trace_id and rec["span"] not in seen:
+            spans.append(rec)
+            seen.add(rec["span"])
+
+
+def pinned_ids() -> list[str]:
+    return list(_pinned)
+
 
 def ring_snapshot() -> list[dict]:
     return _ring.snapshot()
@@ -165,6 +197,7 @@ def ring_snapshot() -> list[dict]:
 
 def reset_ring() -> None:
     _ring.clear()
+    _pinned.clear()
 
 
 # -- spans --------------------------------------------------------------
@@ -239,29 +272,116 @@ def record_span(name: str, trace_id: str, span_id: str,
     if error:
         rec["error"] = True
     _ring.append(rec)
+    if _pinned:  # one truthiness test on the hot path
+        spans = _pinned.get(trace_id)
+        if spans is not None and len(spans) < _PIN_SPAN_CAP:
+            spans.append(rec)
 
 
-def traces(min_ms: float = 0.0, limit: int = 50) -> list[dict]:
-    """Recent traces, newest first: spans grouped by trace id, trace
-    duration = the span envelope (covers cross-server spans recorded by
-    different middlewares into one shared ring in tests)."""
+def _trace_spans(tid: str) -> list[dict]:
+    """Every known span of one trace id: ring + pinned store, deduped by
+    span id, start-time ordered."""
+    seen: set[str] = set()
+    spans: list[dict] = []
+    for rec in _ring.snapshot() + _pinned.get(tid, []):
+        if rec["trace"] == tid and rec["span"] not in seen:
+            seen.add(rec["span"])
+            spans.append(rec)
+    spans.sort(key=lambda r: r["start"])
+    return spans
+
+
+def traces(min_ms: float = 0.0, limit: int = 50,
+           tid: str | None = None) -> list[dict]:
+    """Recent traces, newest first: spans grouped by trace id — in
+    start-time order inside each trace, the contract the cross-node
+    assembler stitches on — trace duration = the span envelope (covers
+    cross-server spans recorded by different middlewares into one shared
+    ring in tests).  `tid` is an exact lookup: that one trace (pinned
+    spans included), or nothing."""
     by_trace: dict[str, list[dict]] = {}
-    for rec in _ring.snapshot():
-        by_trace.setdefault(rec["trace"], []).append(rec)
+    if tid is not None:
+        spans = _trace_spans(tid)
+        if spans:
+            by_trace[tid] = spans
+        min_ms = 0.0
+    else:
+        for rec in _ring.snapshot():
+            by_trace.setdefault(rec["trace"], []).append(rec)
     out = []
-    for tid, spans in by_trace.items():
+    for t_id, spans in by_trace.items():
         spans.sort(key=lambda r: r["start"])
         t0 = spans[0]["start"]
         t1 = max(r["start"] + r["ms"] / 1000.0 for r in spans)
         total = (t1 - t0) * 1000.0
         if total < min_ms:
             continue
-        out.append({"trace_id": tid, "start": t0,
+        out.append({"trace_id": t_id, "start": t0,
                     "ms": round(total, 3),
                     "error": any(r.get("error") for r in spans),
                     "spans": spans})
     out.sort(key=lambda t: t["start"], reverse=True)
     return out[:max(1, limit)]
+
+
+def assemble(spans: list[dict]) -> dict:
+    """Stitch one trace's spans (possibly collected from several nodes,
+    possibly overlapping) into a parent-ordered waterfall.
+
+    Dedupes by span id, orders depth-first with siblings by start time,
+    and stamps each span with its tree ``depth``.  For a server-side
+    request span whose parent (the client's send span) is present, the
+    per-hop network cost is inferred from the two clocks we have:
+    ``net_ms`` = client-observed duration minus server-observed duration
+    (wire + framing, both directions) and ``send_ms`` = server start
+    minus client start (one-way send + clock skew).  Orphan spans (their
+    parent fell out of a remote ring) become extra roots and are counted
+    in ``orphans``."""
+    by_id: dict[str, dict] = {}
+    for s in spans:
+        by_id.setdefault(s["span"], dict(s))
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    orphans = 0
+    for s in by_id.values():
+        pid = s.get("parent")
+        if pid and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            if pid:
+                orphans += 1
+            roots.append(s)
+    for lst in children.values():
+        lst.sort(key=lambda r: r["start"])
+    roots.sort(key=lambda r: r["start"])
+    out: list[dict] = []
+
+    def emit(s: dict, depth: int) -> None:
+        s["depth"] = depth
+        parent = by_id.get(s.get("parent") or "")
+        if parent is not None and s["name"].endswith(".request"):
+            # a cross-process hop: the gap between what the caller saw
+            # and what the server measured is the network's share
+            s["net_ms"] = round(max(0.0, parent["ms"] - s["ms"]), 3)
+            s["send_ms"] = round((s["start"] - parent["start"]) * 1000.0, 3)
+        out.append(s)
+        for c in children.get(s["span"], []):
+            emit(c, depth + 1)
+
+    for r in roots:
+        emit(r, 0)
+    if not out:
+        return {"spans": [], "span_count": 0, "servers": [], "nodes": []}
+    t0 = min(s["start"] for s in out)
+    t1 = max(s["start"] + s["ms"] / 1000.0 for s in out)
+    servers = sorted({s.get("attrs", {}).get("server") for s in out
+                      if s.get("attrs", {}).get("server")})
+    nodes = sorted({s["node"] for s in out if s.get("node")})
+    return {"trace_id": out[0]["trace"], "start": t0,
+            "ms": round((t1 - t0) * 1000.0, 3),
+            "error": any(s.get("error") for s in out),
+            "span_count": len(out), "servers": servers, "nodes": nodes,
+            "orphans": orphans, "spans": out}
 
 
 # -- in-flight request registry -----------------------------------------
@@ -296,28 +416,21 @@ def inflight() -> list[dict]:
 
 # -- aiohttp server glue ------------------------------------------------
 
-# cluster-internal surfaces: monitoring pulls, heartbeats, raft, debug,
-# maintenance, and admin control traffic.  They get op="internal" in the
-# request counter so the SLO availability rules (op=read/write) measure
-# the DATA plane — on a lightly-loaded cluster the self-generated
-# heartbeat/scrape volume would otherwise dominate the denominator and
-# mask real client failures.
-_INTERNAL_PREFIXES = ("/metrics", "/heartbeat", "/raft", "/debug",
-                      "/cluster", "/maintenance", "/admin",
-                      "/__meta__", "/__admin__", "/__ui__", "/status")
-
-
 def _request_op(method: str, path: str) -> str:
-    # exact-or-slash matching: a filer file /status-reports/x or an s3
-    # bucket named "metrics-dump" is DATA-plane traffic, not internal —
-    # a bare startswith would hide its failures from the SLO
-    if any(path == p or path.startswith(p + "/")
-           for p in _INTERNAL_PREFIXES):
+    # cluster-internal surfaces get op="internal" in the request counter
+    # so the SLO availability rules (op=read/write) measure the DATA
+    # plane — on a lightly-loaded cluster the self-generated
+    # heartbeat/scrape volume would otherwise dominate the denominator
+    # and mask real client failures.  The prefix list (exact-or-slash
+    # matched) lives in netflow so the byte ledger's default class and
+    # this op classification can never disagree.
+    if netflow.is_internal(path):
         return "internal"
     return "read" if method in ("GET", "HEAD") else "write"
 
 
-def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
+def aiohttp_middleware(role: str, slow_exempt: tuple = (),
+                       trust_flow: bool = True):
     """Server-side half of the propagation: extract X-Weedtpu-Trace (or
     make a root sampling decision), register the request in the in-flight
     table, and on completion record the root span — always for sampled
@@ -325,7 +438,19 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
     errored (with a slow-request log line either way).  `slow_exempt`
     lists long-poll paths (meta subscribe and friends) whose lifetime IS
     their duration — they'd otherwise bury real outliers in the ring.
-    Client disconnects (CancelledError) are neither slow nor errored."""
+    Client disconnects (CancelledError) are neither slow nor errored.
+
+    `trust_flow` controls whether incoming X-Weedtpu-Class/-Role headers
+    are honored: an external client could otherwise declare itself
+    `internal` to drop its failures out of the data-plane availability
+    SLO, or `repair` to poison the byte ledger's repair-traffic
+    measurement.  The public s3 gateway passes "loopback" (trust only
+    same-host callers — the all-in-one master's canary — never remote
+    clients).  Cluster-internal servers keep the default True: that
+    propagation is how a repair's shard pulls book as repair two hops
+    away, and a caller who can reach those servers directly is already
+    inside the cluster's trusted-network boundary (the same posture as
+    the open /admin surface)."""
     import asyncio
     from aiohttp import web
 
@@ -339,26 +464,52 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
         parent_id = None
         if t_in is not None:
             # continue the caller's trace under a fresh span id — the
-            # header's span id is the CALLER's current span, our parent
+            # header's span id is the CALLER's current span, our parent.
+            # A pinned trace id samples regardless of the header bit:
+            # someone is actively looking at that trace.
             parent_id = t_in.span_id
-            t = Trace(t_in.trace_id, _new_span_id(), t_in.sampled)
+            sampled = t_in.sampled or (bool(_pinned)
+                                       and t_in.trace_id in _pinned)
+            t = Trace(t_in.trace_id, _new_span_id(), sampled)
         elif rate > 0 and next(counter) % rate == 0:
             t = Trace(_new_trace_id(), _new_span_id(), True)
         else:
             t = None
         token = _current.set(t) if t is not None else None
+        # byte-flow ledger: the caller's declared traffic class (or the
+        # path default) becomes ambient for the handler, so requests the
+        # handler makes downstream inherit it across the next hop
+        trusted = trust_flow is True or \
+            (trust_flow == "loopback" and req.remote in ("127.0.0.1",
+                                                         "::1"))
+        if trusted:
+            flow_cls = netflow.extract_class(req.headers, req.path)
+            flow_peer = req.headers.get(netflow.ROLE_HEADER, "client")
+        else:
+            flow_cls = netflow.classify(req.path)
+            flow_peer = "client"
+        # a declared-internal request (canary probes, cluster plumbing
+        # hitting data-plane paths) must not inflate the data-plane
+        # availability denominators — the same dilution the path-based
+        # op=internal classification exists to prevent
+        op = "internal" if flow_cls == "internal" \
+            else _request_op(req.method, req.path)
+        flow_token = netflow.set_class(flow_cls)
         rid = request_started(req.method, req.path_qs, req.remote,
                               t.trace_id if t is not None else None)
         start = time.time()
         t0 = time.perf_counter()
         status = 500
         cancelled = False
+        resp_obj = None
         try:
             resp = await handler(req)
             status = resp.status
+            resp_obj = resp
             return resp
         except web.HTTPException as e:
             status = e.status
+            resp_obj = e  # an HTTPException IS a Response (has a body)
             raise
         except (asyncio.CancelledError, ConnectionResetError,
                 BrokenPipeError):
@@ -372,6 +523,14 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
             request_finished(rid)
             if token is not None:
                 _current.reset(token)
+            netflow.reset(flow_token)
+            # chunked uploads have no Content-Length; the payload
+            # StreamReader's total_bytes knows what actually arrived
+            recv = req.content_length if req.content_length is not None \
+                else getattr(req.content, "total_bytes", 0)
+            netflow.account("recv", flow_cls, flow_peer, recv or 0)
+            netflow.account("sent", flow_cls, flow_peer,
+                            netflow.response_bytes(resp_obj))
             if not cancelled:
                 # per-class request counters: the SLO engine's
                 # availability input (a disconnect is the caller's fact,
@@ -379,8 +538,7 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = ()):
                 # imports this module at its own top level.
                 from seaweedfs_tpu.stats import metrics as _metrics
                 _metrics.HTTP_REQUESTS.labels(
-                    role, _request_op(req.method, req.path),
-                    f"{status // 100}xx").inc()
+                    role, op, f"{status // 100}xx").inc()
             slow = ms >= slow_ms() and not cancelled and \
                 req.path not in slow_exempt
             errored = status >= 500 and not cancelled
@@ -422,8 +580,13 @@ async def handle_debug_traces(req):
         limit = int(req.query.get("limit", "50"))
     except ValueError:
         limit = 50
+    tid = req.query.get("tid") or None
+    if tid is not None and req.query.get("pin"):
+        # the master's cross-node assembler asks with pin=1: keep this
+        # trace's spans alive past ring wrap while it is being examined
+        pin_trace(tid)
     return web.json_response({"sample_rate": sample_rate(),
-                              "traces": traces(min_ms, limit)})
+                              "traces": traces(min_ms, limit, tid=tid)})
 
 
 async def handle_debug_requests(req):

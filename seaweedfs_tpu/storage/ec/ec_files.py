@@ -80,6 +80,7 @@ def _get_codec(kind: str | None = None):
 
 # backend seam (ops/dispatch.py): parity dispatch, the d2h sync point,
 # and reconstruction, without backend imports in this layer
+from seaweedfs_tpu.stats import netflow as _netflow  # noqa: E402
 from seaweedfs_tpu.stats import profile as _profile  # noqa: E402
 from seaweedfs_tpu.ops.dispatch import (  # noqa: E402
     dispatch_parity as _dispatch_parity,
@@ -1018,6 +1019,11 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
         from seaweedfs_tpu import native
         dec_mat = codec.code.decode_matrix(list(use), list(missing))
 
+    # a rebuild IS repair work: unless a caller already declared a class
+    # (the planner's header re-entered through the middleware), any
+    # network hop made on this thread while we run — a remote
+    # shard_reader for survivors not on local disk — books as repair
+    _flow_token = _netflow.set_class(_netflow.current_class() or "repair")
     t_wall = time.perf_counter()
     import mmap as mmap_mod
     ins = {i: open(base + layout.to_ext(i), "rb") for i in use}
@@ -1096,6 +1102,7 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
                 stats["overlap_frac"] = frac
         ok = True
     finally:
+        _netflow.reset(_flow_token)
         writers.close()  # idempotent; the fds must outlive the workers
         for f in ins.values():
             f.close()

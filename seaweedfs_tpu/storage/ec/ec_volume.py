@@ -498,10 +498,39 @@ class EcVolume:
 
     def read_needle(self, needle_id: int,
                     shard_reader: ShardReader | None = None,
-                    mode: str | None = None) -> ndl.Needle:
+                    mode: str | None = None,
+                    skip_shards: frozenset | None = None) -> ndl.Needle:
         """Full needle read: locate -> plan all intervals -> batched shard
         reads + one-shot reconstruction -> parse.  `mode` (or
-        WEEDTPU_EC_READ) = "serial" restores the per-interval loop."""
+        WEEDTPU_EC_READ) = "serial" restores the per-interval loop.
+
+        `skip_shards` withholds those shards from BOTH the local files
+        and the remote reader, forcing the read through reconstruction —
+        the canary prober's deliberate degraded read.  Implemented as a
+        shallow view sharing fds/index/caches/stats with self (the
+        reconstruction cache is keyed by range, so results are identical
+        whichever survivors produced them), never mutating this volume."""
+        if skip_shards:
+            import copy as _copy
+            skip = frozenset(skip_shards)
+            view = _copy.copy(self)
+            view.shards = {s: f for s, f in self.shards.items()
+                           if s not in skip}
+            # the view must NOT share the reconstruction-range LRU: a
+            # cache hit would serve the probe without touching the
+            # decode path (defeating a canary that exists to exercise
+            # it), and probe results must not displace real entries
+            view._recon_cache = OrderedDict()
+            view._recon_cache_bytes = 0
+            view._recon_lock = threading.Lock()
+            inner = shard_reader
+
+            def skipping_reader(sid: int, off: int, size: int):
+                if sid in skip or inner is None:
+                    return None
+                return inner(sid, off, size)
+
+            return view.read_needle(needle_id, skipping_reader, mode)
         with trace.span("ec.plan", needle=f"{needle_id:x}") as psp:
             dat_offset, size = self.find_needle(needle_id)
             length = t.actual_size(size, self.version)

@@ -8,24 +8,88 @@ import threading
 import time
 import urllib.parse
 
+from seaweedfs_tpu.stats import netflow as _netflow
 from seaweedfs_tpu.stats import trace as _trace
 
 
-def aiohttp_trace_config():
-    """aiohttp client half of trace propagation: a TraceConfig whose
-    on_request_start stamps X-Weedtpu-Trace from the ambient contextvar
-    (requests made outside any trace are untouched).  Every server's
-    ClientSession mounts this so filer->volume->peer hops share one
-    trace id."""
+def aiohttp_trace_config(role: str | None = None):
+    """aiohttp client half of trace propagation AND byte-flow
+    accounting: a TraceConfig whose on_request_start opens a dedicated
+    **client-send span** per outgoing request on sampled traces (the
+    peer's server span parents to it, so the cross-node assembler can
+    difference client-observed vs server-observed duration into per-hop
+    network time — without it the server span parents to the caller's
+    whole enclosing span and the inference is meaningless), stamps
+    X-Weedtpu-Trace plus the traffic-class/caller-role headers, and
+    whose chunk hooks book body bytes into the netflow ledger.  Every
+    server's ClientSession mounts this (passing its role) so
+    filer->volume->peer hops share one trace id and every replicated /
+    repaired byte is accounted on the SENDING side too — the
+    conservation tests compare these against the receiving middleware's
+    counts."""
     import aiohttp
+
+    def _finish_span(ctx, error: bool) -> None:
+        span = getattr(ctx, "send_span", None)
+        if span is None:
+            return
+        ctx.send_span = None
+        child, parent_id, start, t0 = span
+        _trace.record_span(
+            "http.send", child.trace_id, child.span_id, parent_id,
+            start, (time.perf_counter() - t0) * 1000.0,
+            ctx.send_attrs, error)
 
     async def _on_request_start(session, ctx, params) -> None:
         t = _trace.current()
+        ctx.send_span = None
         if t is not None:
-            params.headers[_trace.TRACE_HEADER] = _trace.format_header(t)
+            hdr_ctx = t
+            if t.sampled:
+                child = _trace.Trace(t.trace_id, _trace._new_span_id(),
+                                     True)
+                ctx.send_span = (child, t.span_id, time.time(),
+                                 time.perf_counter())
+                ctx.send_attrs = {"method": params.method,
+                                  "peer": f"{params.url.host}:"
+                                          f"{params.url.port}"}
+                hdr_ctx = child
+            params.headers[_trace.TRACE_HEADER] = \
+                _trace.format_header(hdr_ctx)
+        ctx.flow_cls = _netflow.current_class() or \
+            _netflow.classify(params.url.path)
+        params.headers[_netflow.CLASS_HEADER] = ctx.flow_cls
+        if role:
+            params.headers[_netflow.ROLE_HEADER] = role
+        ctx.flow_sent = 0
+        ctx.flow_peer = None
+
+    async def _on_request_chunk_sent(session, ctx, params) -> None:
+        # buffered until the response arrives: only then do we know the
+        # peer's role (stamped by its on_response_prepare hook)
+        ctx.flow_sent += len(params.chunk)
+
+    async def _on_request_end(session, ctx, params) -> None:
+        ctx.flow_peer = params.response.headers.get(
+            _netflow.ROLE_HEADER, "server")
+        _netflow.account("sent", ctx.flow_cls, ctx.flow_peer,
+                         ctx.flow_sent)
+        ctx.flow_sent = 0
+        _finish_span(ctx, params.response.status >= 500)
+
+    async def _on_request_exception(session, ctx, params) -> None:
+        _finish_span(ctx, True)
+
+    async def _on_response_chunk_received(session, ctx, params) -> None:
+        _netflow.account("recv", ctx.flow_cls,
+                         ctx.flow_peer or "server", len(params.chunk))
 
     tc = aiohttp.TraceConfig()
     tc.on_request_start.append(_on_request_start)
+    tc.on_request_chunk_sent.append(_on_request_chunk_sent)
+    tc.on_request_end.append(_on_request_end)
+    tc.on_request_exception.append(_on_request_exception)
+    tc.on_response_chunk_received.append(_on_response_chunk_received)
     return tc
 
 
@@ -185,10 +249,14 @@ class PooledHTTP:
     does not hold fds to every peer it ever contacted."""
 
     def __init__(self, timeout: float = 30.0, max_idle_per_host: int = 16,
-                 idle_timeout: float = 60.0):
+                 idle_timeout: float = 60.0, role: str = "client"):
         self.timeout = timeout
         self.max_idle_per_host = max_idle_per_host
         self.idle_timeout = idle_timeout
+        # announced to peers in X-Weedtpu-Role so their byte ledger can
+        # label who it was talking to (the master's aggregator and the
+        # shell pass their own roles; plain clients stay "client")
+        self.role = role
         # key -> [(conn, time.monotonic() when parked), ...]
         self._idle: dict[tuple[str, str],
                          list[tuple[_RawConn, float]]] = {}
@@ -292,9 +360,16 @@ class PooledHTTP:
         elif isinstance(body, str):
             body = body.encode()
         # trace propagation: requests made inside a traced context carry
-        # it to the peer (a copy, never mutating the caller's dict)
+        # it to the peer (a copy, never mutating the caller's dict);
+        # byte-flow class + caller role ride along unconditionally
+        headers = dict(headers or {})
         if _trace.current() is not None:
-            headers = _trace.inject(dict(headers or {}))
+            _trace.inject(headers)
+        _netflow.inject(headers, u.path or "/", self.role)
+        flow_cls = headers.get(_netflow.CLASS_HEADER)
+        # lazy: stats.metrics imports stats.trace, which this module
+        # also imports — binding at call time keeps startup order free
+        from seaweedfs_tpu.stats import metrics as _metrics
         last: Exception | None = None
         for attempt in range(2):
             if attempt:
@@ -303,9 +378,11 @@ class PooledHTTP:
                 conn, reused = self._connect(key[0], key[1], tmo), False
             else:
                 conn, reused = self._get_conn(key, tmo)
+            (_metrics.HTTP_POOL_REUSE if reused
+             else _metrics.HTTP_POOL_DIAL).labels().inc()
             try:
                 status, hdrs, data, keep = conn.roundtrip(
-                    method, path, u.netloc, body, headers or {}, tmo)
+                    method, path, u.netloc, body, headers, tmo)
             except (http.client.HTTPException, OSError, ValueError) as e:
                 conn.close()
                 # callers expect http.client/OS errors (the http.client
@@ -321,6 +398,10 @@ class PooledHTTP:
                 self._put_conn(key, conn)
             else:
                 conn.close()
+            peer = hdrs.get(_netflow.ROLE_HEADER.lower(), "server")
+            _netflow.account("sent", flow_cls, peer,
+                             len(body) if body is not None else 0)
+            _netflow.account("recv", flow_cls, peer, len(data))
             return status, hdrs, data
         raise last  # type: ignore[misc]
 

@@ -10,6 +10,12 @@ import sys
 import pathlib
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env may pin a TPU platform
+
+# the canary prober's background loop writes sentinel blobs through real
+# gateway paths — nondeterministic traffic inside timing-sensitive tests.
+# Default it off for the suite; the flight-recorder tests drive probes
+# explicitly via run_once() (and may re-enable the loop themselves).
+os.environ.setdefault("WEEDTPU_CANARY_INTERVAL", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

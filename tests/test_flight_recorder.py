@@ -1,0 +1,412 @@
+"""Cluster flight recorder, end-to-end: byte-flow accounting conservation,
+cross-node trace assembly (/cluster/trace), canary probes flipping
+/cluster/slo, pinned traces, and the PooledHTTP dial/reuse counters."""
+
+import io
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import WeedClient
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.stats import netflow, trace
+from tests.test_cluster import Cluster, free_port
+
+
+def _get_json(url: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _flow_snapshot() -> dict:
+    """{(direction, class): bytes} for the process-global ledger."""
+    return {(d, c): netflow.class_total(d, c)
+            for d in ("sent", "recv") for c in sorted(netflow.CLASSES)}
+
+
+def _flow_delta(before: dict) -> dict:
+    after = _flow_snapshot()
+    return {k: after[k] - before.get(k, 0.0) for k in after}
+
+
+# -- netflow unit behaviour ------------------------------------------------
+
+def test_netflow_flow_and_classify():
+    assert netflow.current_class() is None
+    with netflow.flow("repair"):
+        assert netflow.current_class() == "repair"
+        with netflow.flow("scrub"):
+            assert netflow.current_class() == "scrub"
+        assert netflow.current_class() == "repair"
+    assert netflow.current_class() is None
+    # unknown classes collapse to data rather than growing label space
+    with netflow.flow("nonsense"):
+        assert netflow.current_class() == "data"
+    assert netflow.classify("/metrics") == "internal"
+    assert netflow.classify("/admin/ec/copy") == "internal"
+    assert netflow.classify("/bucket/metrics-dump") == "data"
+    assert netflow.classify("/3,0102030405") == "data"
+    h = netflow.inject({}, "/3,0102030405", role="volume")
+    assert h[netflow.CLASS_HEADER] == "data"
+    assert h[netflow.ROLE_HEADER] == "volume"
+    with netflow.flow("readahead"):
+        assert netflow.inject({}, "/x")[netflow.CLASS_HEADER] == \
+            "readahead"
+
+
+def test_trace_tid_lookup_pin_and_ordering():
+    trace.reset_ring()
+    tid_a = "a" * 32
+    tid_b = "b" * 32
+    now = time.time()
+    # record out of start order: the ?tid view must sort by start
+    trace.record_span("late", tid_a, "2" * 16, "1" * 16, now + 1.0, 5.0)
+    trace.record_span("root", tid_a, "1" * 16, None, now, 2000.0)
+    trace.record_span("other", tid_b, "3" * 16, None, now, 1.0)
+    got = trace.traces(tid=tid_a)
+    assert len(got) == 1 and got[0]["trace_id"] == tid_a
+    names = [s["name"] for s in got[0]["spans"]]
+    assert names == ["root", "late"]
+    # min_ms filtering never hides an exact-tid lookup
+    assert trace.traces(min_ms=10_000.0, tid=tid_b)
+    # pin, then wrap the ring with unrelated spans: pinned spans survive
+    trace.pin_trace(tid_a)
+    for i in range(trace._ring.capacity + 10):
+        trace.record_span("noise", "c" * 32, f"{i:016x}", None, now, 0.1)
+    assert not any(r["trace"] == tid_a for r in trace.ring_snapshot())
+    kept = trace.traces(tid=tid_a)
+    assert kept and len(kept[0]["spans"]) == 2
+    # spans recorded AFTER the pin are mirrored too
+    trace.record_span("post-pin", tid_a, "4" * 16, "1" * 16,
+                      now + 2.0, 1.0)
+    assert len(trace.traces(tid=tid_a)[0]["spans"]) == 3
+    trace.reset_ring()
+
+
+def test_assemble_waterfall_order_and_net_ms():
+    now = time.time()
+    spans = [
+        {"name": "volume.request", "trace": "t", "span": "c" * 16,
+         "parent": "b" * 16, "start": now + 0.010, "ms": 30.0,
+         "attrs": {"server": "volume"}, "node": "v1"},
+        {"name": "s3.request", "trace": "t", "span": "a" * 16,
+         "parent": None, "start": now, "ms": 100.0,
+         "attrs": {"server": "s3"}, "node": "s3gw"},
+        {"name": "filer.request", "trace": "t", "span": "b" * 16,
+         "parent": "a" * 16, "start": now + 0.005, "ms": 80.0,
+         "attrs": {"server": "filer"}, "node": "f1"},
+        # duplicate from a second node's ring: deduped by span id
+        {"name": "filer.request", "trace": "t", "span": "b" * 16,
+         "parent": "a" * 16, "start": now + 0.005, "ms": 80.0,
+         "attrs": {"server": "filer"}, "node": "f1"},
+    ]
+    wf = trace.assemble(spans)
+    assert wf["span_count"] == 3
+    assert [s["depth"] for s in wf["spans"]] == [0, 1, 2]
+    # parent-ordered: every span's parent appears before it
+    seen = set()
+    for s in wf["spans"]:
+        assert not s.get("parent") or s["parent"] in seen
+        seen.add(s["span"])
+    assert wf["servers"] == ["filer", "s3", "volume"]
+    filer_span = wf["spans"][1]
+    assert filer_span["net_ms"] == pytest.approx(20.0)
+    assert filer_span["send_ms"] == pytest.approx(5.0, abs=0.5)
+
+
+# -- PooledHTTP dial/reuse counters ---------------------------------------
+
+def test_pool_reuse_and_dial_counters(tmp_path):
+    from seaweedfs_tpu.stats import metrics
+    from seaweedfs_tpu.utils.http import PooledHTTP
+    c = Cluster(tmp_path, n_volume_servers=0).start()
+    try:
+        dial0 = metrics.HTTP_POOL_DIAL.labels().value
+        reuse0 = metrics.HTTP_POOL_REUSE.labels().value
+        pool = PooledHTTP(timeout=10.0)
+        for _ in range(3):
+            status, hdrs, _ = pool.request(
+                f"http://{c.master.url}/cluster/status")
+            assert status == 200
+            # the server announced its role for the client-side ledger
+            assert hdrs.get(netflow.ROLE_HEADER.lower()) == "master"
+        pool.close()
+        assert metrics.HTTP_POOL_DIAL.labels().value == dial0 + 1
+        assert metrics.HTTP_POOL_REUSE.labels().value == reuse0 + 2
+    finally:
+        c.stop()
+
+
+# -- byte conservation -----------------------------------------------------
+
+def test_byte_conservation_replicated_write(tmp_path):
+    """Client-side sent bytes == server-side received bytes per class
+    (within framing overhead) across a 3-node write with replication:
+    client -> volume A books class=data, volume A -> volume B fan-out
+    books class=replication, and each class conserves independently."""
+    c = Cluster(tmp_path, n_volume_servers=2, replication="001").start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.master.url)
+        size = 256 * 1024
+        rng = np.random.default_rng(7)
+        before = _flow_snapshot()
+        payloads = {}
+        for i in range(8):
+            data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            payloads[client.upload(data, name=f"cons{i}.bin")] = data
+        for fid, data in payloads.items():
+            assert client.download(fid) == data
+        delta = _flow_delta(before)
+        client.close()
+        total = 8 * size
+        # the replica fan-out moved every uploaded byte once more
+        assert delta[("recv", "replication")] >= total
+        assert delta[("recv", "data")] >= 2 * total  # uploads + reads
+        for cls in ("data", "replication"):
+            sent = delta[("sent", cls)]
+            recv = delta[("recv", cls)]
+            assert recv > 0, cls
+            assert abs(sent - recv) <= 0.01 * max(sent, recv), (
+                cls, sent, recv)
+    finally:
+        c.stop()
+
+
+# -- cross-node waterfall for one s3 PUT ----------------------------------
+
+@pytest.fixture()
+def s3_stack(tmp_path):
+    from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = Cluster(tmp_path, n_volume_servers=2, replication="001").start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port(),
+                        data_dir=str(tmp_path / "f"))
+    c.submit(filer.start())
+    s3 = S3ApiServer(filer.url, port=free_port(),
+                     master_url=c.master.url)
+    c.submit(s3.start())
+    yield c, filer, s3
+    c.submit(s3.stop())
+    c.submit(filer.stop())
+    c.stop()
+
+
+def test_cluster_trace_stitches_s3_put(s3_stack):
+    c, filer, s3 = s3_stack
+    trace.reset_ring()
+    tid = "f00d" * 8
+    hdr = f"{tid}-{'9' * 16}-1"  # sampled root from "the client"
+    body = bytes(range(256)) * 64
+    req = urllib.request.Request(
+        f"http://{s3.url}/flight/rec.bin", data=body, method="PUT",
+        headers={trace.TRACE_HEADER: hdr})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status in (200, 201)
+    wf = _get_json(f"http://{c.master.url}/cluster/trace/{tid}")
+    assert wf["trace_id"] == tid
+    # one s3 PUT's waterfall spans >= 3 distinct servers
+    assert {"s3", "filer", "volume"} <= set(wf["servers"]), wf["servers"]
+    assert wf["span_count"] >= 4
+    # parent-ordered: a span never precedes its parent (the root's own
+    # parent is the external client's span id, which no ring recorded)
+    ids = {s["span"] for s in wf["spans"]}
+    seen = set()
+    for s in wf["spans"]:
+        if s.get("parent") in ids:
+            assert s["parent"] in seen, s
+        seen.add(s["span"])
+    # at least one cross-process hop carries inferred network time
+    assert any("net_ms" in s for s in wf["spans"])
+    # the replicated write reached the peer volume server too
+    assert any(s["name"] == "volume.replicate_peer"
+               for s in wf["spans"])
+    # the fan-out pinned the trace on every hop it found spans on
+    assert tid in trace.pinned_ids()
+    # fleet-wide listing surfaces the same trace
+    listing = _get_json(
+        f"http://{c.master.url}/cluster/traces?min_ms=0&limit=50")
+    assert any(t["trace_id"] == tid for t in listing["traces"])
+
+
+# -- canary probes ---------------------------------------------------------
+
+def test_canary_probe_ok_then_failure_flips_slo(tmp_path):
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    try:
+        c.wait_heartbeats()
+        st = c.submit(c.master.canary.run_once(paths=("blob",)))
+        blob = st["paths"]["blob"]
+        assert blob["outcome"] == "ok", blob
+        assert blob["ms"] > 0 and len(blob["trace_id"]) == 32
+        # the probe's trace id is pinned and assembles to a waterfall
+        wf = _get_json(
+            f"http://{c.master.url}/cluster/trace/{blob['trace_id']}")
+        assert any(s["name"] == "canary.blob" for s in wf["spans"])
+        assert "volume" in wf["servers"]
+        slo = _get_json(
+            f"http://{c.master.url}/cluster/slo?refresh=1", timeout=60)
+        canary_rule = next(r for r in slo["rules"]
+                           if r["name"] == "canary_availability")
+        assert canary_rule["state"] == "ok"
+
+        # kill the only volume server: the next probe must fail and the
+        # canary availability rule must flip the SLO to violated
+        vs = c.volume_servers[0]
+        c.submit(vs.stop())
+        st = c.submit(c.master.canary.run_once(paths=("blob",)))
+        blob = st["paths"]["blob"]
+        assert blob["outcome"] == "fail", blob
+        assert blob.get("error")
+        # failed probes ship a ready-made stitched waterfall
+        assert blob.get("waterfall", {}).get("spans")
+        slo = _get_json(
+            f"http://{c.master.url}/cluster/slo?refresh=1", timeout=60)
+        canary_rule = next(r for r in slo["rules"]
+                           if r["name"] == "canary_availability")
+        assert canary_rule["state"] == "violated", canary_rule
+        assert slo["state"] == "violated"
+        c.volume_servers.remove(vs)  # already stopped
+    finally:
+        c.stop()
+
+
+def test_canary_degraded_probe_reconstructs(tmp_path):
+    """The degraded canary path drives /admin/ec/probe_read: a real
+    needle read with one present shard deliberately withheld."""
+    c = Cluster(tmp_path, n_volume_servers=2).start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.master.url)
+        rng = np.random.default_rng(3)
+        fids = [client.upload(rng.integers(0, 256, 40_000,
+                                           dtype=np.uint8).tobytes(),
+                              name=f"deg{i}.bin") for i in range(12)]
+        vid = int(fids[0].partition(",")[0])
+        time.sleep(0.7)
+        env = CommandEnv(c.master.url)
+        out = io.StringIO()
+        run_command(env, "lock", out)
+        run_command(env, f"ec.encode -volumeId {vid}", out)
+        run_command(env, "unlock", out)
+        time.sleep(0.7)
+        client.close()
+
+        st = c.submit(c.master.canary.run_once(paths=("degraded",)))
+        rec = st["paths"]["degraded"]
+        assert rec["outcome"] == "ok", rec
+        # and the handler reports which shard it withheld
+        holder = next(vs for vs in c.volume_servers
+                      if any(vid in loc.ec_volumes
+                             for loc in vs.store.locations))
+        probe = _get_json(
+            f"http://{holder.url}/admin/ec/probe_read?volume={vid}")
+        assert probe["bytes"] > 0 and "skipped_shard" in probe
+    finally:
+        c.stop()
+
+
+def test_heal_books_repair_class_bytes(tmp_path, monkeypatch):
+    """A planner-driven heal on a 2-node cluster must book its survivor
+    copies as class=repair — on the order of the shard bytes it moved
+    (the measurement ROADMAP item 1's repair-traffic gate rides on)."""
+    monkeypatch.setenv("WEEDTPU_SCRUB_INTERVAL", "3600")
+    monkeypatch.setenv("WEEDTPU_REPAIR_INTERVAL", "3600")
+    c = Cluster(tmp_path, n_volume_servers=2).start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.master.url)
+        rng = np.random.default_rng(9)
+        fids = [client.upload(rng.integers(0, 256, 50_000,
+                                           dtype=np.uint8).tobytes(),
+                              name=f"hb{i}.bin") for i in range(12)]
+        vid = int(fids[0].partition(",")[0])
+        time.sleep(0.7)
+        env = CommandEnv(c.master.url)
+        out = io.StringIO()
+        run_command(env, "lock", out)
+        run_command(env, f"ec.encode -volumeId {vid}", out)
+        run_command(env, "unlock", out)
+        time.sleep(0.7)
+        client.close()
+        shard_size = next(
+            loc.ec_volumes[vid].shard_size
+            for vs in c.volume_servers for loc in vs.store.locations
+            if vid in loc.ec_volumes)
+        # drop two shards, one per node, then let the planner heal
+        dropped = 0
+        for vs in c.volume_servers:
+            held = sorted(s for loc in vs.store.locations
+                          if vid in loc.ec_volumes
+                          for s in loc.ec_volumes[vid].shard_ids())
+            if held and dropped < 2:
+                body = json.dumps({"volume": vid,
+                                   "shards": [held[0]]}).encode()
+                req = urllib.request.Request(
+                    f"http://{vs.url}/admin/ec/delete_shards", data=body,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req).close()
+                dropped += 1
+        assert dropped == 2
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = _get_json(f"http://{c.master.url}/maintenance/status")
+            if len(st["volumes"].get(str(vid), {})
+                   .get("shards_missing", [])) == 2:
+                break
+            time.sleep(0.1)
+        b0 = netflow.class_total("recv", "repair")
+        body = json.dumps({"wait": True}).encode()
+        req = urllib.request.Request(
+            f"http://{c.master.url}/maintenance/tick", data=body,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=120).close()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st = _get_json(f"http://{c.master.url}/maintenance/status")
+            v = st["volumes"].get(str(vid), {})
+            if v.get("state") == "healthy" and \
+                    len(v.get("shards_present", [])) == 14:
+                break
+            time.sleep(0.1)
+        assert v.get("state") == "healthy", v
+        moved = netflow.class_total("recv", "repair") - b0
+        # the rebuilder borrowed survivors and/or shipped rebuilt
+        # shards: at least one full shard crossed the wire as repair
+        assert moved >= shard_size, (moved, shard_size)
+    finally:
+        c.stop()
+
+
+# -- aggregator scrape staleness ------------------------------------------
+
+def test_agg_scrape_age_and_dead_node_gap(tmp_path):
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    try:
+        c.wait_heartbeats()
+        with urllib.request.urlopen(
+                f"http://{c.master.url}/cluster/metrics?refresh=1",
+                timeout=30) as r:
+            text = r.read().decode()
+        vs = c.volume_servers[0]
+        assert f'weedtpu_agg_scrape_age_seconds{{node="{vs.url}"}}' \
+            in text
+        assert f'weedtpu_agg_scrape_age_seconds{{node="{c.master.url}"}}' \
+            in text
+        # a node that stops answering keeps its (growing) age AND flips
+        # node_up to 0 — a visible gap, not silently stale values
+        from seaweedfs_tpu.stats.aggregate import ClusterAggregator
+        dead = f"127.0.0.1:{free_port()}"
+        agg = ClusterAggregator(lambda: {vs.url: vs.url, dead: dead},
+                                interval=0)
+        agg.scrape_once()
+        out = agg.render()
+        assert f'weedtpu_cluster_node_up{{node="{dead}"}} 0' in out
+        assert f'weedtpu_agg_scrape_age_seconds{{node="{vs.url}"}}' in out
+        agg.stop()
+    finally:
+        c.stop()
