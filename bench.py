@@ -540,7 +540,8 @@ def main() -> None:
     for fn in (_bench_degraded_read, _bench_filer_stream,
                _bench_trace_overhead, _bench_profile_overhead,
                _bench_heal_time, _bench_scrub_overhead,
-               _bench_flow_canary_overhead):
+               _bench_flow_canary_overhead, _bench_heat_overhead,
+               _bench_serving_knee):
         try:
             fn(extra)
         except Exception as e:
@@ -669,6 +670,7 @@ def _exit_code(extra: dict) -> int:
              "heal_time_regression",
              "scrub_overhead_regression",
              "flow_canary_overhead_regression",
+             "heat_overhead_regression",
              "gated_bench_failed")
     return 1 if any(extra.get(g) for g in gates) else 0
 
@@ -695,6 +697,9 @@ FLOW_CANARY_OVERHEAD_TOL = 0.97
 # blob reads with the HZ=97 sampling profiler walking every thread must
 # keep >= 0.95x the unprofiled rate (ISSUE 5 acceptance bar)
 PROFILE_OVERHEAD_TOL = 0.95
+# blob reads with the workload heat sketches updating per request must
+# keep >= 0.97x the untracked rate (ISSUE 8 acceptance bar)
+HEAT_OVERHEAD_TOL = 0.97
 
 
 def _bench_e2e_host(extra: dict) -> None:
@@ -1740,6 +1745,311 @@ def _bench_scrub_overhead(extra: dict, n: int = 1000, size: int = 1024,
               f"interleaved pairs); the scrub rate limiter has stopped "
               f"protecting foreground I/O. Failing the bench run.",
               file=sys.stderr)
+
+
+def _bench_heat_overhead(extra: dict, n: int = 1200, size: int = 1024,
+                         concurrency: int = 16, pairs: int = 7) -> None:
+    """Workload-heat tax on the hottest path: blob reads with the heat
+    sketches updating per request (WEEDTPU_HEAT=1, the default) vs fully
+    off (=0), interleaved pairs over the same blobs.  The tracker reads
+    the env per record call, so flipping it between reps retargets live
+    servers.  Median ratio below HEAT_OVERHEAD_TOL (foreground must keep
+    >= 0.97x) fails the run (heat_overhead_regression + nonzero
+    exit)."""
+    import asyncio
+    import concurrent.futures
+    import threading
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    old = os.environ.get("WEEDTPU_HEAT")
+    best_on = best_off = float("inf")
+    ratios: list[float] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-heat-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs = VolumeServer([d], master.url, port=free_port(),
+                              heartbeat_interval=0.2)
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                client = WeedClient(master.url)
+                payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+                with concurrent.futures.ThreadPoolExecutor(
+                        concurrency) as ex:
+                    fids = list(ex.map(
+                        lambda i: client.upload(payload, name=f"ht{i}"),
+                        range(n)))
+
+                def rep(tracking: str) -> float:
+                    os.environ["WEEDTPU_HEAT"] = tracking
+                    # the tracker caches the env switch for up to 0.5s;
+                    # let the flip take effect before timing the arm
+                    time.sleep(0.6)
+                    t0 = time.perf_counter()
+                    with concurrent.futures.ThreadPoolExecutor(
+                            concurrency) as ex:
+                        for data in ex.map(client.download, fids):
+                            assert len(data) == size
+                    return time.perf_counter() - t0
+
+                for i in range(pairs):
+                    if i % 2 == 0:
+                        t_off = rep("0")
+                        t_on = rep("1")
+                    else:
+                        t_on = rep("1")
+                        t_off = rep("0")
+                    if i == 0:
+                        continue  # warm connections / page cache
+                    best_on = min(best_on, t_on)
+                    best_off = min(best_off, t_off)
+                    ratios.append(t_off / t_on)
+                client.close()
+            finally:
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        if old is None:
+            os.environ.pop("WEEDTPU_HEAT", None)
+        else:
+            os.environ["WEEDTPU_HEAT"] = old
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["blob_read_rps_heat"] = round(n / best_on, 1)
+    extra["blob_read_rps_unheat"] = round(n / best_off, 1)
+    extra["heat_overhead_ratio"] = round(ratio, 3)
+    if ratio < HEAT_OVERHEAD_TOL:
+        extra["heat_overhead_regression"] = True
+        print(f"bench: REGRESSION — blob reads with workload-heat "
+              f"tracking run at {ratio:.3f}x the untracked rate (median "
+              f"of interleaved pairs); the heat sketches exceed their "
+              f"3% budget. Failing the bench run.", file=sys.stderr)
+
+
+def _bench_serving_knee(extra: dict, n_blobs: int = 400,
+                        size: int = 1024, start_rps: float = 50.0,
+                        step: float = 1.6, max_rps: float = 8000.0,
+                        level_s: float = 2.0) -> None:
+    """Open-loop serving knee: Poisson arrivals at a TARGET rate (fired
+    on schedule whether or not earlier requests finished — the
+    closed-loop benches above self-throttle and can never see queueing
+    collapse) stepped up until `/cluster/slo` flips off `ok`.  Reports
+    `serving_knee_rps` (the last SLO-compliant arrival rate),
+    `serving_knee_p99_ms` (client p99 at that rate), and the first
+    violating rate — the measurement harness the ROADMAP item 4 serving
+    plane will be gated on.  Tight 1s/3s SLO windows + an on-demand
+    aggregator make each level's verdict reflect THAT level's traffic.
+
+    The flip signal rides the CANARY's latency histogram: the
+    server-side request histograms time the handler body, so overload
+    queueing (which piles up in the accept queue and event loop, before
+    any handler runs) is structurally invisible to them — but the
+    canary prober is a CLIENT of the gateway paths, its probes queue
+    behind the open-loop backlog like real requests, and its latency
+    histogram already feeds the SLO engine.  A fast-cycling blob canary
+    plus a `canary_latency` rule makes /cluster/slo flip exactly when
+    the fleet stops absorbing the arrival rate."""
+    import asyncio
+    import concurrent.futures
+    import random as _random
+    import threading
+    import urllib.request
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    overrides = {
+        "WEEDTPU_AGG_INTERVAL": "0",  # scrape on demand per level
+        "WEEDTPU_SLO_WINDOWS": "1,3",
+        # the knee definition: canary-observed blob latency through
+        # 250ms (the queueing-sensitive signal), volume-side service
+        # time through 100ms (a genuinely slow store knees here), and
+        # read availability
+        "WEEDTPU_SLO_RULES":
+            "read_availability=availability,op=read,target=0.999;"
+            "read_latency=latency,family=weedtpu_volume_request_seconds,"
+            "label.type=read,ms=100,target=0.9;"
+            "canary_latency=latency,"
+            "family=weedtpu_canary_probe_seconds,label.path=blob,"
+            "ms=250,target=0.8;"
+            "canary_availability=availability,"
+            "family=weedtpu_canary_probes_total,target=0.99",
+        "WEEDTPU_CANARY_INTERVAL": "0",  # started explicitly below
+        "WEEDTPU_CANARY_PATHS": "blob",
+        "WEEDTPU_REPAIR_INTERVAL": "3600",
+        "WEEDTPU_SCRUB_MBPS": "0",
+    }
+    old_env = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    knee = None
+    knee_p99 = None
+    flip_rps = None
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-knee-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs = VolumeServer([d], master.url, port=free_port(),
+                              heartbeat_interval=0.2)
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                client = WeedClient(master.url)
+                payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+                with concurrent.futures.ThreadPoolExecutor(16) as ex:
+                    fids = list(ex.map(
+                        lambda i: client.upload(payload, name=f"k{i}"),
+                        range(n_blobs)))
+
+                async def canary_on():
+                    master.canary.start(0.25)
+
+                run(canary_on())
+
+                def slo_state() -> str:
+                    with urllib.request.urlopen(
+                            f"http://{master.url}/cluster/slo?refresh=1",
+                            timeout=30) as r:
+                        return json.loads(r.read()).get("state", "unknown")
+
+                rng = _random.Random(17)
+                # wide pool: past the knee, completions lag arrivals and
+                # in-flight requests pile up — a narrow pool would
+                # quietly re-close the loop at its own width and the
+                # arrival pressure would never reach the server
+                pool = concurrent.futures.ThreadPoolExecutor(512)
+
+                def level(rate: float) -> tuple[float | None, str]:
+                    """Drive one open-loop level; -> (p99_ms, slo)."""
+                    lat: list[float] = []
+                    lat_lock = threading.Lock()
+
+                    def one(fid: str) -> None:
+                        t0 = time.perf_counter()
+                        try:
+                            client.download(fid)
+                        except Exception:
+                            pass  # a failed read is the SLO's problem
+                        ms = (time.perf_counter() - t0) * 1000.0
+                        with lat_lock:
+                            lat.append(ms)
+
+                    slo_state()  # window edge: snapshot before the load
+                    t_next = time.perf_counter()
+                    t_end = t_next + level_s
+                    i = 0
+                    while True:
+                        t_next += rng.expovariate(rate)
+                        if t_next >= t_end:
+                            break
+                        delay = t_next - time.perf_counter()
+                        if delay > 0:
+                            time.sleep(delay)
+                        # open loop: fire on schedule, never wait for
+                        # completions — backlog is the signal
+                        pool.submit(one, fids[i % len(fids)])
+                        i += 1
+                    # verdict scrape while the backlog is LIVE (the
+                    # canary's in-window probes are queueing behind it);
+                    # only then drain so the next level starts clean and
+                    # the client p99 covers every fired request
+                    state = slo_state()
+                    drain = time.time() + 30
+                    while time.time() < drain:
+                        with lat_lock:
+                            done = len(lat)
+                        if done >= i:
+                            break
+                        time.sleep(0.05)
+                    with lat_lock:
+                        ls = sorted(lat)
+                    p99 = ls[min(len(ls) - 1, int(0.99 * len(ls)))] \
+                        if ls else None
+                    return p99, state
+
+                rate = start_rps
+                levels: list[dict] = []
+                while rate <= max_rps:
+                    p99, state = level(rate)
+                    levels.append({"rps": round(rate, 1),
+                                   "p99_ms": None if p99 is None
+                                   else round(p99, 2),
+                                   "slo": state})
+                    if state != "ok":
+                        flip_rps = rate
+                        break
+                    knee, knee_p99 = rate, p99
+                    rate *= step
+                pool.shutdown(wait=False, cancel_futures=True)
+                extra["serving_knee_levels"] = levels
+                client.close()
+            finally:
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if knee is None and flip_rps is None:
+        return  # no level completed: the harness itself failed
+    # knee None = even the first level violated: report the floor
+    extra["serving_knee_rps"] = round(knee if knee is not None
+                                      else start_rps, 1)
+    if knee_p99 is not None:
+        extra["serving_knee_p99_ms"] = round(knee_p99, 2)
+    if flip_rps is not None:
+        extra["serving_knee_flip_rps"] = round(flip_rps, 1)
+    else:
+        # the fleet outran the bench's ceiling without flipping
+        extra["serving_knee_saturated"] = True
 
 
 def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:

@@ -39,7 +39,7 @@ from seaweedfs_tpu.s3.auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ,
                                    decode_aws_chunked)
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
-from seaweedfs_tpu.stats import netflow, trace
+from seaweedfs_tpu.stats import heat, netflow, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 
 log = logging.getLogger("s3")
@@ -121,9 +121,16 @@ class S3ApiServer:
             # a remote client's X-Weedtpu-Class/-Role headers must not
             # reclassify its requests out of the SLO denominators or
             # poison the per-class byte ledger, while the same-host
-            # master's canary probes stay class=internal
+            # master's canary probes stay class=internal.  The same
+            # loopback rule covers X-Weedtpu-Tenant: a remote caller
+            # cannot bill its traffic to another tenant — the gateway
+            # resolves identity from the request itself (access key,
+            # else bucket, else anonymous) once per request, and heat,
+            # per-tenant counters, and future QoS all read that field.
             middlewares=[trace.aiohttp_middleware(
-                "s3", trust_flow="loopback")])
+                "s3", trust_flow="loopback",
+                tenant_resolver=lambda req: heat.resolve_tenant(
+                    req.headers, req.query, req.path))])
         netflow.install(self.app, "s3")
         # the gateway is the one PUBLIC server: its debug surface answers
         # loopback operators only (debug_routes ships every handler
@@ -132,6 +139,12 @@ class S3ApiServer:
         # past the SigV4 wall — and a bucket literally named "debug"
         # still 403s rather than being shadowed for remote clients
         self.app.add_routes(trace.debug_routes())
+        # workload heat sketch: loopback-only on the public gateway (it
+        # names tenants and object fids), like the rest of the debug
+        # surface; a bucket literally named "heat" still 403s remotely
+        # rather than being shadowed
+        self.app.add_routes([web.get("/heat",
+                                     trace.debug_guard(heat.handle_heat))])
         self.app.add_routes([web.route("*", "/{tail:.*}", self.dispatch)])
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None

@@ -50,7 +50,7 @@ from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
 from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
 from seaweedfs_tpu.filer.abstract_sql import SqliteStore
 from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFound
-from seaweedfs_tpu.stats import metrics, netflow, profile, trace
+from seaweedfs_tpu.stats import heat, metrics, netflow, profile, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config, parse_range
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
@@ -131,6 +131,7 @@ class FilerServer:
             web.get("/__admin__/status", self.handle_status),
             web.get("/__ui__", self.handle_ui),
             web.get("/metrics", self.handle_metrics),
+            web.get("/heat", heat.handle_heat),
             web.route("*", "/{path:.*}", self.handle_path),
         ])
         self.notification = notification  # MessageQueue | None
@@ -375,12 +376,20 @@ class FilerServer:
                 headers=headers) as r:
             if r.status >= 300:
                 raise RuntimeError(f"chunk upload: HTTP {r.status}")
+        if heat.ambient_is_data():
+            heat.record("chunk", a["fid"], logical_size, "write")
         return FileChunk(fid=a["fid"], offset=0, size=logical_size,
                          mtime=time.time_ns(), etag=etag,
                          cipher_key=cipher_key, is_compressed=is_compressed)
 
     async def _fetch_chunk(self, fid: str, cache: bool = True) -> bytes:
         with trace.span("filer.chunk_fetch", fid=fid) as sp:
+            # workload heat: every demanded chunk access counts, cache
+            # hit or miss — "hot" means requested often, and the future
+            # hot-chunk cache tier sizes itself on exactly this signal.
+            # Readahead counts too (it is demand one chunk early);
+            # canary/internal traffic does not.
+            track = heat.ambient_is_data(include_readahead=True)
             # disk tiers do blocking IO; mem-only lookups stay inline
             if self.chunk_cache.tiers:
                 cached = await asyncio.to_thread(self.chunk_cache.get, fid)
@@ -388,6 +397,8 @@ class FilerServer:
                 cached = self.chunk_cache.get(fid)
             if cached is not None:
                 sp.set(cache_hit=True, bytes=len(cached))
+                if track:
+                    heat.record("chunk", fid, len(cached), "read")
                 return cached
             sp.set(cache_hit=False)
             vid = fid.partition(",")[0]
@@ -409,6 +420,9 @@ class FilerServer:
                         if r.status == 200:
                             blob = await r.read()
                             sp.set(peer=loc["url"], bytes=len(blob))
+                            if track:
+                                heat.record("chunk", fid, len(blob),
+                                            "read")
                             if cache and self.chunk_cache.tiers:
                                 await asyncio.to_thread(
                                     self.chunk_cache.put, fid, blob)

@@ -21,7 +21,8 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
-from seaweedfs_tpu.stats import aggregate, metrics, netflow, profile, trace
+from seaweedfs_tpu.stats import (aggregate, heat, metrics, netflow, profile,
+                                 trace)
 from seaweedfs_tpu.stats.canary import CanaryProber
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import types as t
@@ -113,8 +114,10 @@ class MasterServer:
             web.post("/raft/append_entries", self.handle_raft_append),
             web.post("/raft/install_snapshot", self.handle_raft_install),
             web.get("/metrics", self.handle_metrics),
+            web.get("/heat", heat.handle_heat),
             web.get("/cluster/metrics", self.handle_cluster_metrics),
             web.get("/cluster/slo", self.handle_cluster_slo),
+            web.get("/cluster/heat", self.handle_cluster_heat),
             web.get("/cluster/trace/{tid}", self.handle_cluster_trace),
             web.get("/cluster/traces", self.handle_cluster_traces),
             web.get("/cluster/canary", self.handle_cluster_canary),
@@ -151,6 +154,10 @@ class MasterServer:
         # path (stats/canary.py), feeding the SLO engine and pinning
         # their trace ids for ready-made failure waterfalls
         self.canary = CanaryProber(self)
+        # workload heat: last fleet-merged /cluster/heat view (ts, dict)
+        import threading as _threading
+        self._heat_cache: tuple[float, dict] | None = None
+        self._heat_lock = _threading.Lock()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -359,41 +366,111 @@ class MasterServer:
     def _fan_debug_traces(self, query: str
                           ) -> tuple[list[tuple[str, list[dict]]],
                                      dict[str, str]]:
-        """GET /debug/traces?{query} from every known node over the
-        shared PooledHTTP (the aggregator's pool — thread-safe).
-        -> ([(node, traces)], {node: error}): a trace is better partial
-        than absent, but a node that refused or timed out is REPORTED —
-        on a multi-host cluster the loopback gate on /debug/* answers
-        403 to the master, and a waterfall that silently shrank to one
-        node's spans would hide exactly that (run the master on a
-        trusted network with the debug surface reachable, or tunnel)."""
-        import concurrent.futures
+        """GET /debug/traces?{query} from every known node (via
+        _fan_get). -> ([(node, traces)], {node: error}): a trace is
+        better partial than absent, but a refusing/timed-out node is
+        still reported."""
         import json as _json
+        out: list[tuple[str, list[dict]]] = []
+        errors: dict[str, str] = {}
+        for name, traces_, err in self._fan_get(
+                f"/debug/traces?{query}", "trace-pull",
+                lambda body: _json.loads(body).get("traces", [])):
+            out.append((name, traces_ or []))
+            if err is not None:
+                errors[name] = err
+        return out, errors
+
+    # -- fleet fan-out (shared by trace assembly + heat merge) -----------
+
+    def _fan_get(self, path_qs: str, pool_name: str, parse
+                 ) -> list[tuple[str, object, str | None]]:
+        """GET `path_qs` from every known node over the aggregator's
+        (thread-safe) PooledHTTP, fanned out so a few partitioned nodes
+        cost max-of not sum-of their timeouts.  -> [(node,
+        parsed_or_None, error_or_None)] in node order.  Errors are
+        REPORTED, not swallowed: on a multi-host cluster a
+        loopback-gated endpoint answers 403 to the master, and a view
+        that silently shrank to the reachable nodes would hide exactly
+        that (run the master on a trusted network with the surface
+        reachable, or tunnel)."""
+        import concurrent.futures
         nodes = self._agg_nodes()
 
         def pull(item):
             name, netloc = item
             try:
                 status, _, body = self.aggregator.pool.request(
-                    f"{_tls_scheme()}://{netloc}/debug/traces?{query}",
-                    timeout=5.0)
+                    f"{_tls_scheme()}://{netloc}{path_qs}", timeout=5.0)
                 if status != 200:
-                    return name, [], f"HTTP {status}"
-                return name, _json.loads(body).get("traces", []), None
+                    return name, None, f"HTTP {status}"
+                return name, parse(body), None
             except Exception as e:
-                return name, [], str(e) or type(e).__name__
+                return name, None, str(e) or type(e).__name__
 
-        out: list[tuple[str, list[dict]]] = []
+        if not nodes:
+            return []
+        with concurrent.futures.ThreadPoolExecutor(
+                min(8, len(nodes)), pool_name) as ex:
+            return list(ex.map(pull, sorted(nodes.items())))
+
+    # -- workload heat: fleet-merged hot chunks/volumes/tenants ----------
+
+    def collect_heat(self) -> dict:
+        """Pull every known node's /heat sketch (plus this master's own)
+        over the aggregator's pool, merge the Space-Saving/Count-Min
+        summaries, and return the fleet top-K view.  Thread-safe sync
+        function: the handler calls it via to_thread."""
+        import json as _json
+        snaps: list[dict] = [heat.serialize()]
         errors: dict[str, str] = {}
-        if nodes:
-            with concurrent.futures.ThreadPoolExecutor(
-                    min(8, len(nodes)), "trace-pull") as ex:
-                for name, traces_, err in ex.map(pull,
-                                                 sorted(nodes.items())):
-                    out.append((name, traces_))
-                    if err is not None:
-                        errors[name] = err
-        return out, errors
+        pulled_nodes: list[str] = []
+        # dedupe by tracker id: several "nodes" sharing one process (the
+        # all-in-one binary, in-process test clusters) serve the SAME
+        # tracker — merging it once per node would inflate every
+        # estimate N-fold past its error bound
+        seen_ids = {snaps[0].get("id")}
+        for name, snap, err in self._fan_get("/heat", "heat-pull",
+                                             _json.loads):
+            if err is not None:
+                errors[name] = err
+                continue
+            pulled_nodes.append(name)
+            tid = snap.get("id")
+            if tid is None or tid not in seen_ids:
+                seen_ids.add(tid)
+                snaps.append(snap)
+        merged = heat.merge_serialized(snaps)
+        merged["nodes"] = sorted(pulled_nodes + [self.url])
+        if errors:
+            merged["node_errors"] = errors
+        with self._heat_lock:
+            self._heat_cache = (time.time(), merged)
+        return merged
+
+    def cached_heat(self, max_age: float = 5.0) -> dict:
+        """Last merged heat view, refreshed when stale — the cheap read
+        maintenance.status embeds without a per-status fleet fan-out."""
+        with self._heat_lock:
+            cached = self._heat_cache
+        if cached is not None and time.time() - cached[0] <= max_age:
+            return cached[1]
+        return self.collect_heat()
+
+    async def handle_cluster_heat(self, req: web.Request) -> web.Response:
+        """/cluster/heat: fleet-merged top-K hot chunks, volumes, and
+        tenants with decayed RPS/byte-rate estimates, read/write mix,
+        and per-volume degraded-read fraction.  Loopback-gated (it names
+        tenants and object fids).  ?refresh=1 forces a fresh fan-out;
+        otherwise a <=5s-old cached merge may be served."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        if req.query.get("refresh"):
+            merged = await asyncio.to_thread(self.collect_heat)
+        else:
+            merged = await asyncio.to_thread(self.cached_heat)
+        return web.json_response(merged)
 
     def collect_trace(self, tid: str) -> dict:
         """One trace id -> a single parent-ordered waterfall stitched
@@ -537,6 +614,18 @@ class MasterServer:
             snap["slo"] = self.aggregator.slo_status()
         except Exception:
             log.warning("slo status failed", exc_info=True)
+        with self._heat_lock:
+            cached = self._heat_cache
+        if cached is not None:
+            # workload heat headline from the LAST merged view only —
+            # status never blocks on a fleet fan-out (hit /cluster/heat
+            # for a fresh one)
+            ts, merged = cached
+            snap["heat"] = {
+                "ts": ts,
+                "volumes": merged.get("volumes", {}).get("top", [])[:5],
+                "tenants": merged.get("tenants", {}).get("top", [])[:5],
+            }
         return snap
 
     async def handle_maintenance_status(self, req: web.Request

@@ -24,7 +24,7 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security import jwt as sjwt
-from seaweedfs_tpu.stats import metrics, netflow, profile, trace
+from seaweedfs_tpu.stats import heat, metrics, netflow, profile, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
@@ -130,6 +130,7 @@ class VolumeServer:
             web.get("/", self.handle_ui),
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
+            web.get("/heat", heat.handle_heat),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/volume/delete", self.handle_volume_delete),
             web.post("/admin/leave", self.handle_leave),
@@ -402,6 +403,10 @@ class VolumeServer:
         except PermissionError as e:
             return web.json_response({"error": str(e)}, status=409)
         del size
+        if heat.ambient_is_data():
+            # workload heat: replica fan-in (class=replication) and
+            # canary sentinels (internal) stay out of the sketches
+            heat.record("volume", str(fid.volume_id), len(data), "write")
 
         if req.query.get("type") != "replicate":
             err = await self._replicate(fid, "PUT", data, name, mime)
@@ -519,6 +524,8 @@ class VolumeServer:
             return await self._blob_corrupt_fallback(req, fid, e)
         except IOError as e:
             return web.json_response({"error": str(e)}, status=500)
+        if heat.ambient_is_data():
+            heat.record("volume", str(fid.volume_id), len(n.data), "read")
         headers = {"Etag": f'"{n.checksum:x}"', "Accept-Ranges": "bytes"}
         if n.name:
             headers["Content-Disposition"] = \
@@ -593,6 +600,8 @@ class VolumeServer:
             return web.json_response({"error": "not found"}, status=404)
         except (ValueError, EOFError, OSError):
             return None
+        if heat.ambient_is_data():
+            heat.record("volume", str(fid.volume_id), len(data), "read")
         headers = {"Accept-Ranges": "bytes",
                    "Etag": f'"{meta.checksum:x}"',
                    "Content-Range":
@@ -616,7 +625,11 @@ class VolumeServer:
         from seaweedfs_tpu.utils import weedlog
         metrics.NEEDLE_CRC_MISMATCH.labels().inc()
         tctx = trace.current()
-        weedlog.info(
+        # rate-limited per volume: a single hot corrupt chunk read
+        # thousands of times a second must not storm the log (the
+        # counter above still counts every one)
+        weedlog.warn_ratelimited(
+            f"crc_fallback:{fid.volume_id}", 5.0,
             "needle %s CRC mismatch on %s (trace %s): %s; trying replica",
             str(fid), self.url, tctx.trace_id if tctx else "-", err,
             name="volume")

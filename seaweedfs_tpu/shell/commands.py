@@ -823,6 +823,49 @@ def cmd_cluster_canary(env: CommandEnv, args, out):
               f"{p99} trace={rec['trace_id']}{err}", file=out)
 
 
+@command("cluster.heat")
+def cmd_cluster_heat(env: CommandEnv, args, out):
+    """Fleet workload heat (/cluster/heat): top-K hot chunks, volumes,
+    and tenants from the decayed streaming sketches, with estimated RPS,
+    byte rates, read/write mix, and per-volume degraded-read fraction.
+    -refresh forces a fresh fleet fan-out; -top N rows per dimension
+    (default 10); -json dumps the raw merge.  Runbook: an SLO burn alert
+    names the symptom — this names the tenant/volume driving it, and
+    cluster.trace shows where its requests spend their time."""
+    flags = parse_flags(args)
+    params = {"refresh": "1"} if "refresh" in flags else {}
+    st = env.master_get("/cluster/heat", **params)
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    try:
+        top_n = max(1, int(flags.get("top", "10")))
+    except ValueError:
+        top_n = 10
+    print(f"heat: k={st.get('k')} halflife={st.get('halflife_s')}s "
+          f"nodes={len(st.get('nodes', []))}"
+          + (f" node_errors={len(st['node_errors'])}"
+             if st.get("node_errors") else ""), file=out)
+    for dim in ("chunks", "volumes", "tenants"):
+        d = st.get(dim, {})
+        rows = d.get("top", [])[:top_n]
+        print(f"{dim}: total ~{d.get('total_rps', 0)} rps", file=out)
+        if not rows:
+            print("  (no samples yet)", file=out)
+            continue
+        for r in rows:
+            extras = []
+            if r.get("read_fraction") is not None:
+                extras.append(f"read%={100 * r['read_fraction']:.0f}")
+            if r.get("degraded_fraction") is not None:
+                extras.append(
+                    f"degraded%={100 * r['degraded_fraction']:.1f}")
+            print(f"  {r['key']:32s} ~{r['rps']:9.2f} rps "
+                  f"~{r['bytes_rate'] / 1e6:8.3f} MB/s "
+                  f"(est={r['est']:.1f}±{r['err']:.1f}) "
+                  + " ".join(extras), file=out)
+
+
 @command("volume.fsck")
 def cmd_volume_fsck(env: CommandEnv, args, out):
     """Cross-check filer chunk references against volume needles

@@ -1,0 +1,543 @@
+"""Workload heat analytics: streaming heavy-hitters with time decay.
+
+The serving plane (ROADMAP item 4: distributed hot-chunk cache,
+per-tenant QoS) needs answers the aggregate counters can't give: WHICH
+objects are hot, WHICH tenants drive the load, and how the mix shifts —
+the SSD-array EC study (arXiv:1709.05365) and the Facebook warehouse
+study (arXiv:1309.0186) both show interference effects that are only
+visible once workload composition is measured.  Logging every access is
+off the table on a hot path, so this module keeps O(1)-memory streaming
+sketches:
+
+- **Space-Saving top-K** (Metwally et al.): at most K counters per
+  dimension; a new key evicts the minimum counter and inherits its
+  count as its error bound.  Guarantees: ``est >= true`` and
+  ``est - err <= true`` for every tracked key, with
+  ``err <= total / K`` — so the estimate for a genuinely hot key is
+  provably tight.
+
+- **Count-Min sketch**: a depth x width matrix of counters updated via
+  deterministic hashes (crc32 — Python's ``hash()`` is salted per
+  process and would break cross-node merging), answering a frequency
+  estimate for ANY key (not just survivors) with one-sided error.
+
+Both decay **exponentially** (half-life ``WEEDTPU_HEAT_HALFLIFE``,
+default 300s) via a lazy multiplicative sweep, so "hot" means *hot
+lately*: a steady rate ``r`` settles at an equilibrium decayed count of
+``r * H / ln2``, which is inverted to report decayed RPS / byte-rate
+estimates.  Decay scales true counts and estimates by the same factor,
+so the Space-Saving guarantees survive it.
+
+Both sketches are **mergeable**: every server serializes its tracker at
+``/heat`` and the master folds the fleet into ``/cluster/heat`` (keys
+absent from one node's Space-Saving contribute that node's minimum
+counter to est AND err — the standard mergeable-summaries rule that
+preserves the overestimate invariant; Count-Min matrices add
+element-wise).
+
+Dimensions tracked (``HeatTracker``): ``chunk`` (fid, fed by the filer
+chunk fetch), ``volume`` (vid, fed by volume blob reads/writes and EC
+reconstruction), ``tenant`` (s3 access key / bucket, resolved once per
+request — see ``resolve_tenant``).  ``WEEDTPU_HEAT=0`` disables the
+tracking (read per call so the bench can flip it between interleaved
+reps); ``WEEDTPU_HEAT_K`` sizes the per-dimension top-K (default 64).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+import zlib
+from contextvars import ContextVar
+
+LN2 = math.log(2.0)
+
+DIMS = ("chunk", "volume", "tenant")
+
+# ops recorded per key; "degraded" marks an EC read that actually
+# reconstructed (the expensive path the hot-chunk cache must absorb)
+OPS = ("read", "write", "degraded")
+
+CMS_WIDTH = 512
+CMS_DEPTH = 4
+
+# sweep cadence for the lazy decay (seconds) and the floor below which a
+# decayed Space-Saving entry is dropped entirely
+DECAY_TICK = 1.0
+EPS = 1e-3
+
+TENANT_HEADER = "X-Weedtpu-Tenant"
+
+
+_enabled_cache: list = [True, 0.0]  # [value, monotonic expiry]
+
+
+def enabled() -> bool:
+    """Tracking switch.  The env is re-read at most every 0.5s: a raw
+    os.environ.get per record was ~20% of the hot-path cost, and the
+    only consumer of fast flips (the bench's interleaved on/off reps)
+    runs multi-second arms."""
+    now = time.monotonic()
+    if now >= _enabled_cache[1]:
+        _enabled_cache[0] = os.environ.get("WEEDTPU_HEAT", "1") != "0"
+        _enabled_cache[1] = now + 0.5
+    return _enabled_cache[0]
+
+
+def ambient_is_data(include_readahead: bool = False) -> bool:
+    """True when the ambient netflow traffic class is foreground data —
+    the gate hot-path call sites use so synthetic traffic (canary
+    probes, scrub syndrome reads, repair shard pulls, replica fan-out)
+    never skews the heat sketches toward the cluster's own plumbing."""
+    from seaweedfs_tpu.stats import netflow
+    cls = netflow.current_class()
+    return cls in (None, "data") or \
+        (include_readahead and cls == "readahead")
+
+
+def heat_k() -> int:
+    try:
+        return max(8, int(os.environ.get("WEEDTPU_HEAT_K", "64")))
+    except ValueError:
+        return 64
+
+
+def halflife_s() -> float:
+    try:
+        h = float(os.environ.get("WEEDTPU_HEAT_HALFLIFE", "300"))
+    except ValueError:
+        return 300.0
+    return h if h > 0 else 300.0
+
+
+# per-row crc32 seeds (golden-ratio spread): deterministic and
+# process-independent — the same key must land in the same Count-Min
+# cells on every node or the matrices would not be mergeable
+_CMS_SEEDS = tuple((d * 0x9E3779B1) & 0xFFFFFFFF
+                   for d in range(CMS_DEPTH))
+
+
+def _cells(key: str, width: int, depth: int) -> list[int]:
+    """The key's cell per row — the ONE cell computation every reader
+    and writer shares, with the key encoded once (a per-row encode was
+    a measurable share of the hot-path record cost)."""
+    kb = key.encode("utf-8", "replace")
+    crc = zlib.crc32
+    return [crc(kb, _CMS_SEEDS[d]) % width for d in range(depth)]
+
+
+class SpaceSaving:
+    """Decayed Space-Saving heavy-hitter summary.
+
+    ``entries`` maps key -> [count, err, aux] where ``aux`` holds
+    decayed per-key sub-counters (bytes, per-op counts) that ride along
+    with the main counter and die with the entry on eviction.  Not
+    thread-safe by itself — HeatTracker serializes access per dimension.
+    """
+
+    __slots__ = ("k", "halflife", "entries", "total", "_now", "_last")
+
+    def __init__(self, k: int, halflife: float, now_fn=time.time):
+        self.k = k
+        self.halflife = halflife
+        self.entries: dict[str, list] = {}
+        self.total = 0.0
+        self._now = now_fn
+        self._last = now_fn()
+
+    def _decay(self, now: float) -> None:
+        dt = now - self._last
+        if dt < DECAY_TICK:
+            return
+        self._last = now
+        f = 0.5 ** (dt / self.halflife)
+        self.total *= f
+        drop = []
+        for key, ent in self.entries.items():
+            ent[0] *= f
+            ent[1] *= f
+            aux = ent[2]
+            for a in aux:
+                aux[a] *= f
+            if ent[0] < EPS:
+                drop.append(key)
+        for key in drop:
+            del self.entries[key]
+
+    def offer(self, key: str, weight: float = 1.0,
+              aux: dict | None = None) -> None:
+        """`weight=0` is an AUX-ONLY update (annotate an event onto an
+        already-hot key without counting a second request — the
+        degraded-read marker rides the same read this way): it never
+        evicts, and only creates an entry when there is free room."""
+        now = self._now()
+        self._decay(now)
+        self.total += weight
+        ent = self.entries.get(key)
+        if ent is None:
+            if len(self.entries) < self.k:
+                ent = self.entries[key] = [0.0, 0.0, {}]
+            elif weight <= 0:
+                return  # not worth an eviction for an annotation
+            else:
+                # evict the minimum counter; the newcomer inherits its
+                # count as the error bound (the Space-Saving exchange)
+                victim = min(self.entries, key=lambda q:
+                             self.entries[q][0])
+                vcount = self.entries.pop(victim)[0]
+                ent = self.entries[key] = [vcount, vcount, {}]
+        ent[0] += weight
+        if aux:
+            a = ent[2]
+            for name, v in aux.items():
+                a[name] = a.get(name, 0.0) + v
+
+    def min_count(self) -> float:
+        """The floor a key NOT in the summary could hide beneath: the
+        minimum tracked counter once full, else 0."""
+        if len(self.entries) < self.k:
+            return 0.0
+        return min(e[0] for e in self.entries.values())
+
+    def snapshot(self) -> dict:
+        """Serialized, mergeable form (counts as-of ``ts``; the merger
+        decay-adjusts by its own clock)."""
+        now = self._now()
+        self._decay(now)
+        return {"ts": now, "k": self.k, "halflife": self.halflife,
+                "total": self.total, "min": self.min_count(),
+                "entries": [[key, ent[0], ent[1], dict(ent[2])]
+                            for key, ent in self.entries.items()]}
+
+    @staticmethod
+    def merge(snaps: list[dict], k: int, halflife: float,
+              now: float | None = None) -> dict:
+        """Fold node snapshots into one summary dict.  A key absent from
+        one node's summary contributes that node's minimum counter to
+        both est and err (it may have been evicted there holding up to
+        min), preserving ``est >= true`` and ``est - err <= true`` over
+        the union stream."""
+        if now is None:
+            now = time.time()
+        keys: set[str] = set()
+        adj = []
+        for s in snaps:
+            f = 0.5 ** (max(0.0, now - s.get("ts", now)) / halflife)
+            ents = {e[0]: e for e in s.get("entries", [])}
+            adj.append((f, ents, s.get("min", 0.0) * f))
+            keys.update(ents)
+        total = sum(s.get("total", 0.0) *
+                    0.5 ** (max(0.0, now - s.get("ts", now)) / halflife)
+                    for s in snaps)
+        merged = []
+        for key in keys:
+            est = err = 0.0
+            aux: dict[str, float] = {}
+            for f, ents, minc in adj:
+                ent = ents.get(key)
+                if ent is None:
+                    est += minc
+                    err += minc
+                    continue
+                est += ent[1] * f
+                err += ent[2] * f
+                for name, v in (ent[3] or {}).items():
+                    aux[name] = aux.get(name, 0.0) + v * f
+            merged.append([key, est, err, aux])
+        merged.sort(key=lambda e: e[1], reverse=True)
+        return {"ts": now, "k": k, "halflife": halflife, "total": total,
+                "min": 0.0, "entries": merged[:k]}
+
+
+class CountMin:
+    """Decayed Count-Min sketch over float counters.  Plain Python
+    lists, deliberately: the hot path is single-cell `rows[d][i] += w`
+    (~100ns on a list vs ~1µs through numpy scalar indexing), and the
+    decay sweep only touches all depth*width cells once per
+    DECAY_TICK."""
+
+    __slots__ = ("width", "depth", "halflife", "rows", "_now", "_last")
+
+    def __init__(self, halflife: float, now_fn=time.time):
+        # layout is FIXED (CMS_WIDTH x CMS_DEPTH): every node must hash
+        # into the same cells or the matrices would not be mergeable,
+        # so per-instance sizing is deliberately not offered
+        self.width = CMS_WIDTH
+        self.depth = CMS_DEPTH
+        self.halflife = halflife
+        self.rows = [[0.0] * self.width for _ in range(self.depth)]
+        self._now = now_fn
+        self._last = now_fn()
+
+    def _decay(self, now: float) -> None:
+        dt = now - self._last
+        if dt < DECAY_TICK:
+            return
+        self._last = now
+        f = 0.5 ** (dt / self.halflife)
+        for row in self.rows:
+            for i, v in enumerate(row):
+                row[i] = v * f
+
+    def add(self, key: str, weight: float = 1.0) -> None:
+        self._decay(self._now())
+        for d, i in enumerate(_cells(key, self.width, self.depth)):
+            self.rows[d][i] += weight
+
+    def estimate(self, key: str) -> float:
+        self._decay(self._now())
+        return min(self.rows[d][i]
+                   for d, i in enumerate(_cells(key, self.width,
+                                                self.depth)))
+
+    def snapshot(self) -> dict:
+        now = self._now()
+        self._decay(now)
+        return {"ts": now, "width": self.width, "depth": self.depth,
+                "halflife": self.halflife,
+                "rows": [[round(v, 6) for v in row]
+                         for row in self.rows]}
+
+    @staticmethod
+    def merge(snaps: list[dict], halflife: float,
+              now: float | None = None):
+        if now is None:
+            now = time.time()
+        m = CountMin(halflife)
+        m._last = now
+        for s in snaps:
+            if s.get("width") != CMS_WIDTH or s.get("depth") != CMS_DEPTH:
+                continue  # layout mismatch: skip rather than corrupt
+            f = 0.5 ** (max(0.0, now - s.get("ts", now)) / halflife)
+            rows = s.get("rows", [])
+            if len(rows) != CMS_DEPTH or \
+                    any(len(r) != CMS_WIDTH for r in rows):
+                continue
+            for d in range(CMS_DEPTH):
+                out = m.rows[d]
+                for i, v in enumerate(rows[d]):
+                    out[i] += v * f
+        return m
+
+
+# -- the per-process tracker ---------------------------------------------
+
+class HeatTracker:
+    """One Space-Saving + one Count-Min per dimension, one lock per
+    dimension (a filer hammering chunks must not contend with the
+    middleware stamping tenants)."""
+
+    def __init__(self, k: int | None = None,
+                 halflife: float | None = None, now_fn=time.time):
+        import uuid
+        self.k = k if k is not None else heat_k()
+        self.halflife = halflife if halflife is not None else halflife_s()
+        # identifies THIS tracker instance in serialized form: several
+        # servers sharing one process (the all-in-one binary, in-process
+        # test clusters) all serve the same tracker at /heat, and the
+        # master dedupes on this id so the fleet merge counts a shared
+        # sketch once instead of once per pulled node
+        self.tracker_id = uuid.uuid4().hex
+        self._now = now_fn
+        self._locks = {dim: threading.Lock() for dim in DIMS}
+        self._top = {dim: SpaceSaving(self.k, self.halflife, now_fn)
+                     for dim in DIMS}
+        self._cms = {dim: CountMin(self.halflife, now_fn=now_fn)
+                     for dim in DIMS}
+
+    def record(self, dim: str, key: str, nbytes: int = 0,
+               op: str = "read", weight: float = 1.0) -> None:
+        """`weight=0` annotates without counting: the event bumps the
+        key's aux sub-counters but adds nothing to its request estimate
+        or the Count-Min frequencies — a degraded read is the SAME
+        request its op=read record already counted, just more
+        expensive."""
+        if not key or dim not in self._locks or not enabled():
+            return
+        if op not in OPS:
+            op = "read"
+        aux = {"bytes": float(nbytes), op: 1.0} if nbytes \
+            else {op: 1.0}
+        with self._locks[dim]:
+            self._top[dim].offer(key, weight, aux)
+            if weight:
+                self._cms[dim].add(key, weight)
+
+    def estimate(self, dim: str, key: str) -> float:
+        with self._locks[dim]:
+            return self._cms[dim].estimate(key)
+
+    def serialize(self) -> dict:
+        dims = {}
+        cms = {}
+        for dim in DIMS:
+            with self._locks[dim]:
+                dims[dim] = self._top[dim].snapshot()
+                cms[dim] = self._cms[dim].snapshot()
+        return {"ts": self._now(), "id": self.tracker_id, "k": self.k,
+                "halflife": self.halflife, "dims": dims, "cms": cms}
+
+    def reset(self) -> None:
+        for dim in DIMS:
+            with self._locks[dim]:
+                self._top[dim] = SpaceSaving(self.k, self.halflife,
+                                             self._now)
+                self._cms[dim] = CountMin(self.halflife,
+                                          now_fn=self._now)
+
+
+TRACKER = HeatTracker()
+
+
+def record(dim: str, key: str, nbytes: int = 0, op: str = "read",
+           weight: float = 1.0) -> None:
+    """Module-level convenience over the process singleton."""
+    TRACKER.record(dim, key, nbytes, op, weight)
+
+
+def reset() -> None:
+    TRACKER.reset()
+
+
+def serialize() -> dict:
+    return TRACKER.serialize()
+
+
+# -- fleet merge (the master's /cluster/heat) ----------------------------
+
+def _entry_view(ent: list, halflife: float) -> dict:
+    """One merged Space-Saving entry -> the operator-facing record.
+    RPS/byte-rate invert the decay equilibrium (steady rate r settles at
+    r * H/ln2), so they read as recent-rate estimates."""
+    key, est, err, aux = ent
+    rate = LN2 / halflife
+    reads = aux.get("read", 0.0)
+    writes = aux.get("write", 0.0)
+    degraded = aux.get("degraded", 0.0)
+    rec = {"key": key, "est": round(est, 3), "err": round(err, 3),
+           "rps": round(est * rate, 3),
+           "bytes_rate": round(aux.get("bytes", 0.0) * rate, 1),
+           "reads": round(reads, 2), "writes": round(writes, 2)}
+    rw = reads + writes
+    if rw > 0:
+        rec["read_fraction"] = round(reads / rw, 4)
+    if degraded > 0:
+        rec["degraded"] = round(degraded, 2)
+        if reads > 0:
+            rec["degraded_fraction"] = round(min(1.0, degraded / reads), 4)
+    return rec
+
+
+def merge_serialized(snaps: list[dict], k: int | None = None,
+                     halflife: float | None = None,
+                     now: float | None = None) -> dict:
+    """Node tracker serializations -> the fleet /cluster/heat body:
+    per-dimension top-K with decayed rate estimates, plus the merge
+    bookkeeping the tests assert error bounds against."""
+    if now is None:
+        now = time.time()
+    k = k if k is not None else heat_k()
+    halflife = halflife if halflife is not None else halflife_s()
+    out: dict = {"ts": now, "k": k, "halflife_s": halflife,
+                 "nodes": len(snaps)}
+    for dim in DIMS:
+        merged = SpaceSaving.merge(
+            [s.get("dims", {}).get(dim, {}) for s in snaps],
+            k, halflife, now)
+        name = {"chunk": "chunks", "volume": "volumes",
+                "tenant": "tenants"}[dim]
+        out[name] = {
+            "total_rps": round(merged["total"] * LN2 / halflife, 3),
+            "top": [_entry_view(e, halflife) for e in merged["entries"]],
+        }
+    return out
+
+
+def merged_estimate(snaps: list[dict], dim: str, key: str,
+                    now: float | None = None) -> float:
+    """Count-Min point estimate for one key over the merged fleet.
+    Reads the merged cells directly (estimate() would re-decay against
+    the real clock, which is wrong for as-of-`now` snapshots)."""
+    cms = CountMin.merge([s.get("cms", {}).get(dim, {}) for s in snaps],
+                         halflife_s(), now)
+    return float(min(cms.rows[d][i]
+                     for d, i in enumerate(_cells(key, cms.width,
+                                                  cms.depth))))
+
+
+async def handle_heat(req):
+    """`/heat`: this process's serialized tracker — the mergeable form
+    the master's /cluster/heat fan-out pulls.  Mounted open on
+    cluster-internal servers (the same trusted-network posture as
+    /admin); the public s3 gateway wraps it in the loopback debug
+    guard."""
+    from aiohttp import web
+    return web.json_response(serialize())
+
+
+# -- tenant identity -----------------------------------------------------
+
+_tenant: ContextVar[str | None] = ContextVar("weedtpu_tenant",
+                                             default=None)
+
+
+def current_tenant() -> str | None:
+    return _tenant.get()
+
+
+def set_tenant(tenant: str | None):
+    """Raw contextvar set -> reset token (the server middleware's
+    seam)."""
+    return _tenant.set(tenant)
+
+
+def reset_tenant(token) -> None:
+    _tenant.reset(token)
+
+
+def inject(headers: dict) -> dict:
+    """Stamp the ambient tenant on an outgoing header dict, in place —
+    the s3 gateway's downstream hops (filer, volume) attribute their
+    work to the same tenant the edge resolved."""
+    tenant = _tenant.get()
+    if tenant:
+        headers[TENANT_HEADER] = tenant
+    return headers
+
+
+def resolve_tenant(headers, query: dict, path: str) -> str:
+    """Resolve the tenant identity of one s3 request, syntactically (no
+    signature verification needed — attribution, not authorization):
+    the SigV4/V2 access key when one is presented, else the bucket name,
+    else ``anonymous``.  Resolved ONCE per request at the gateway and
+    stamped on the request context; everything downstream (heat,
+    per-tenant counters, future QoS admission) reads that one field."""
+    auth = headers.get("Authorization", "")
+    tenant = _raw_tenant(auth, query, path)
+    # bound the identity: it becomes a metric label and a sketch key,
+    # and the header/path it came from is attacker-sized
+    return tenant[:64]
+
+
+def _raw_tenant(auth: str, query: dict, path: str) -> str:
+    if auth.startswith("AWS4-HMAC-SHA256"):
+        # Credential=AKIA.../20260803/us-east-1/s3/aws4_request
+        idx = auth.find("Credential=")
+        if idx >= 0:
+            cred = auth[idx + len("Credential="):]
+            key = cred.split("/", 1)[0].split(",", 1)[0].strip()
+            if key:
+                return key
+    elif auth.startswith("AWS "):
+        key = auth[4:].split(":", 1)[0].strip()
+        if key:
+            return key
+    cred = query.get("X-Amz-Credential", "")
+    if cred:
+        key = cred.split("/", 1)[0].strip()
+        if key:
+            return key
+    bucket = path.lstrip("/").partition("/")[0]
+    return bucket or "anonymous"

@@ -373,7 +373,8 @@ def scrape_response(req):
 REGISTRY = Registry()
 
 MASTER_RECEIVED_HEARTBEATS = REGISTRY.counter(
-    "weedtpu_master_received_heartbeats", "Heartbeats received by master")
+    "weedtpu_master_received_heartbeats_total",
+    "Heartbeats received by master")
 # every completed HTTP request by role/read-write/status class, counted in
 # the trace middleware so all four servers feed it — the availability
 # input of the cluster SLO engine (stats/aggregate.py)
@@ -407,6 +408,19 @@ CANARY_PROBES = REGISTRY.counter(
     "canary probes by gateway path and status class", ("path", "class"))
 CANARY_PROBE_SECONDS = REGISTRY.histogram(
     "weedtpu_canary_probe_seconds", "canary probe latency", ("path",))
+# per-tenant accounting (stats/heat.py resolves the tenant once per s3
+# request: access key, else bucket, else "anonymous").  The request
+# counter is the future QoS admission plane's rate input; the byte
+# counter conserves with the netflow ledger's data-class totals on the
+# gateway that resolved the tenant.
+TENANT_REQUESTS = REGISTRY.counter(
+    "weedtpu_tenant_requests_total",
+    "completed gateway requests by tenant and read/write op",
+    ("tenant", "op"))
+TENANT_BYTES = REGISTRY.counter(
+    "weedtpu_tenant_bytes_total",
+    "body bytes moved for a tenant by direction and op",
+    ("tenant", "direction", "op"))
 MASTER_ASSIGN_COUNTER = REGISTRY.counter(
     "weedtpu_master_assign_total", "fid assignments", ("collection",))
 VOLUME_REQUEST_COUNTER = REGISTRY.counter(

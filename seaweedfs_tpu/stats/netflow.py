@@ -51,12 +51,19 @@ INTERNAL_PREFIXES = ("/metrics", "/heartbeat", "/raft", "/debug",
                      "/cluster", "/maintenance", "/admin",
                      "/__meta__", "/__admin__", "/__ui__", "/status")
 
+# exact-path-only internal surfaces: /heat has no sub-paths, and an s3
+# bucket literally named "heat" must keep its OBJECT traffic
+# (/heat/obj) on the data plane — only the sketch endpoint itself is
+# cluster plumbing
+INTERNAL_EXACT = ("/heat",)
+
 
 def is_internal(path: str) -> bool:
     """Exact-or-slash matching: a filer file /status-reports/x or an s3
     bucket named "metrics-dump" is DATA-plane traffic, not internal."""
-    return any(path == p or path.startswith(p + "/")
-               for p in INTERNAL_PREFIXES)
+    return path in INTERNAL_EXACT or \
+        any(path == p or path.startswith(p + "/")
+            for p in INTERNAL_PREFIXES)
 
 
 def classify(path: str) -> str:

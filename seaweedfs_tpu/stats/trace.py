@@ -39,7 +39,7 @@ import time
 from collections import OrderedDict
 from contextvars import ContextVar
 
-from seaweedfs_tpu.stats import netflow
+from seaweedfs_tpu.stats import heat, netflow
 from seaweedfs_tpu.utils import weedlog
 
 TRACE_HEADER = "X-Weedtpu-Trace"
@@ -430,7 +430,7 @@ def _request_op(method: str, path: str) -> str:
 
 
 def aiohttp_middleware(role: str, slow_exempt: tuple = (),
-                       trust_flow: bool = True):
+                       trust_flow: bool = True, tenant_resolver=None):
     """Server-side half of the propagation: extract X-Weedtpu-Trace (or
     make a root sampling decision), register the request in the in-flight
     table, and on completion record the root span — always for sampled
@@ -450,7 +450,18 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
     propagation is how a repair's shard pulls book as repair two hops
     away, and a caller who can reach those servers directly is already
     inside the cluster's trusted-network boundary (the same posture as
-    the open /admin surface)."""
+    the open /admin surface).
+
+    `tenant_resolver` marks this server as a TENANT EDGE (the s3
+    gateway): the callable resolves the request's tenant identity once
+    (stats/heat.resolve_tenant — access key, else bucket, else
+    anonymous), the resolved tenant rides the request contextvar (so
+    downstream hops and future QoS admission read one field), and the
+    per-tenant request/byte counters + the tenant heat dimension are
+    accounted HERE and only here — inner servers inherit the tenant via
+    X-Weedtpu-Tenant (same trust rule as the flow headers: the public
+    gateway only honors it from loopback) without double-counting the
+    same logical request fleet-wide."""
     import asyncio
     from aiohttp import web
 
@@ -494,6 +505,22 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
         # op=internal classification exists to prevent
         op = "internal" if flow_cls == "internal" \
             else _request_op(req.method, req.path)
+        # tenant identity: a trusted header wins (an inner hop inheriting
+        # the edge's resolution, or the same-host canary declaring one);
+        # otherwise the tenant edge resolves it from the request itself
+        tenant = None
+        hdr_tenant = req.headers.get(heat.TENANT_HEADER)
+        if hdr_tenant and trusted:
+            # same bound resolve_tenant enforces: the value becomes a
+            # metric label and a sketch key, and the header is
+            # caller-sized
+            tenant = hdr_tenant[:64]
+        elif tenant_resolver is not None:
+            try:
+                tenant = tenant_resolver(req)
+            except Exception:
+                tenant = "anonymous"
+        tenant_token = heat.set_tenant(tenant) if tenant else None
         flow_token = netflow.set_class(flow_cls)
         rid = request_started(req.method, req.path_qs, req.remote,
                               t.trace_id if t is not None else None)
@@ -524,13 +551,38 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
             if token is not None:
                 _current.reset(token)
             netflow.reset(flow_token)
+            if tenant_token is not None:
+                heat.reset_tenant(tenant_token)
             # chunked uploads have no Content-Length; the payload
             # StreamReader's total_bytes knows what actually arrived
             recv = req.content_length if req.content_length is not None \
                 else getattr(req.content, "total_bytes", 0)
+            sent = netflow.response_bytes(resp_obj)
             netflow.account("recv", flow_cls, flow_peer, recv or 0)
-            netflow.account("sent", flow_cls, flow_peer,
-                            netflow.response_bytes(resp_obj))
+            netflow.account("sent", flow_cls, flow_peer, sent)
+            if tenant and tenant_resolver is not None \
+                    and op != "internal":
+                # per-tenant accounting at the resolving edge only: the
+                # byte counter mirrors the netflow booking above (same
+                # values, same spot) so tenant totals conserve with the
+                # data-class ledger on this gateway.  The COUNTERS are
+                # gated on success: the tenant identity is syntactic
+                # (pre-auth), and booking 4xx requests would let an
+                # unauthenticated client mint label children from
+                # random access keys until every real tenant collapses
+                # into __other__ — rejected load still shows in the
+                # bounded, decaying heat sketch below.
+                if status < 400 and not cancelled:
+                    from seaweedfs_tpu.stats import metrics as _metrics
+                    _metrics.TENANT_REQUESTS.labels(tenant, op).inc()
+                    if recv:
+                        _metrics.TENANT_BYTES.labels(
+                            tenant, "recv", op).inc(recv)
+                    if sent:
+                        _metrics.TENANT_BYTES.labels(
+                            tenant, "sent", op).inc(sent)
+                heat.record("tenant", tenant, (recv or 0) + sent,
+                            "write" if op == "write" else "read")
             if not cancelled:
                 # per-class request counters: the SLO engine's
                 # availability input (a disconnect is the caller's fact,

@@ -34,7 +34,7 @@ from typing import Callable
 
 import numpy as np
 
-from seaweedfs_tpu.stats import trace
+from seaweedfs_tpu.stats import heat, trace
 from seaweedfs_tpu.storage import idx as idxf
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
@@ -79,6 +79,12 @@ class EcVolume:
                  small_block: int = layout.SMALL_BLOCK_SIZE,
                  version: int = t.CURRENT_VERSION):
         self.base = base
+        # the volume id this EC volume serves — the workload heat
+        # tracker's key for degraded reads.  Base names are "<vid>" or
+        # "<collection>_<vid>" (store.Location.base_path); take the
+        # trailing id so a collection volume's reconstructions land on
+        # the SAME heat key as its blob reads
+        self.vid = os.path.basename(base).rsplit("_", 1)[-1]
         self.large_block = large_block
         self.small_block = small_block
         vif = ec_files.read_vif(base)
@@ -387,6 +393,13 @@ class EcVolume:
             rebuilt = ec_files._reconstruct_batch(codec, rows, wanted)
         self._bump("reconstruct_batches")
         self._bump("reconstruct_intervals", len(todo))
+        if heat.ambient_is_data():
+            # a read that actually reconstructed: the expensive event
+            # the per-volume degraded-read fraction in /cluster/heat
+            # measures (canary/scrub/repair classes stay out).  Weight
+            # 0: this is the SAME request the serving path's op=read
+            # record counts — annotate it, don't count it twice
+            heat.record("volume", self.vid, 0, "degraded", weight=0.0)
         pos = 0
         for idx in todo:
             sid, off, size = ranges[idx]

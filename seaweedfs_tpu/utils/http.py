@@ -8,6 +8,7 @@ import threading
 import time
 import urllib.parse
 
+from seaweedfs_tpu.stats import heat as _heat
 from seaweedfs_tpu.stats import netflow as _netflow
 from seaweedfs_tpu.stats import trace as _trace
 
@@ -61,6 +62,8 @@ def aiohttp_trace_config(role: str | None = None):
         params.headers[_netflow.CLASS_HEADER] = ctx.flow_cls
         if role:
             params.headers[_netflow.ROLE_HEADER] = role
+        # the tenant the edge resolved rides to the next hop (heat.py)
+        _heat.inject(params.headers)
         ctx.flow_sent = 0
         ctx.flow_peer = None
 
@@ -366,6 +369,7 @@ class PooledHTTP:
         if _trace.current() is not None:
             _trace.inject(headers)
         _netflow.inject(headers, u.path or "/", self.role)
+        _heat.inject(headers)
         flow_cls = headers.get(_netflow.CLASS_HEADER)
         # lazy: stats.metrics imports stats.trace, which this module
         # also imports — binding at call time keeps startup order free

@@ -16,6 +16,8 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import threading
+import time
 
 _verbosity = 0
 _configured = False
@@ -109,3 +111,44 @@ def info(fmt: str, *args, name: str = "weed") -> None:
     """Always-on INFO line (glog.Infof): not gated by verbosity — used
     for operator-facing events like slow-request reports."""
     logging.getLogger(name).info(fmt, *args, stacklevel=2)
+
+
+def warning(fmt: str, *args, name: str = "weed") -> None:
+    """Always-on WARNING line (glog.Warningf)."""
+    logging.getLogger(name).warning(fmt, *args, stacklevel=2)
+
+
+# rate-limited warnings: key -> [monotonic ts of last emit, suppressed]
+_rl_state: dict[str, list] = {}
+_rl_lock = threading.Lock()
+_RL_MAX_KEYS = 4096
+
+
+def warn_ratelimited(key: str, interval_s: float, fmt: str, *args,
+                     name: str = "weed") -> bool:
+    """At most one WARNING per `key` per `interval_s` seconds — the
+    hot-path guard: a single hot corrupt chunk served thousands of
+    times a second must not storm the log with one line per read.
+    Suppressed repeats are counted and reported on the next emitted
+    line (`(N similar suppressed)`).  Returns True when the line was
+    actually emitted."""
+    now = time.monotonic()
+    with _rl_lock:
+        st = _rl_state.get(key)
+        if st is not None and now - st[0] < interval_s:
+            st[1] += 1
+            return False
+        suppressed = st[1] if st is not None else 0
+        _rl_state[key] = [now, 0]
+        if len(_rl_state) > _RL_MAX_KEYS:
+            # keys can be client-influenced (per-volume, per-fid):
+            # bound the table by dropping the stalest half
+            for stale in sorted(_rl_state,
+                                key=lambda q: _rl_state[q][0])[
+                                    :_RL_MAX_KEYS // 2]:
+                del _rl_state[stale]
+    if suppressed:
+        fmt += " (%d similar suppressed)"
+        args = args + (suppressed,)
+    logging.getLogger(name).warning(fmt, *args, stacklevel=2)
+    return True

@@ -1,0 +1,426 @@
+"""Workload heat analytics: Space-Saving error bounds on a Zipf stream,
+exponential decay schedules, sketch merge == union stream, 3-node
+/cluster/heat federation surfacing a deliberately-hammered chunk, tenant
+resolution + per-tenant accounting conserving with the netflow ledger,
+and the rate-limited warn helper."""
+
+import io
+import json
+import logging
+import time
+import urllib.request
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import WeedClient
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.stats import heat, metrics, netflow
+from seaweedfs_tpu.utils import weedlog
+from tests.test_cluster import Cluster, free_port
+
+
+def _get_json(url: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# -- Space-Saving guarantees ----------------------------------------------
+
+def test_space_saving_error_bounds_on_zipf_stream():
+    """On a Zipf stream the classic guarantees must hold for every
+    tracked key: est >= true, est - err <= true, err <= total / k."""
+    clock = [1000.0]
+    ss = heat.SpaceSaving(k=32, halflife=1e9, now_fn=lambda: clock[0])
+    rng = np.random.default_rng(7)
+    stream = [f"key{z}" for z in rng.zipf(1.3, size=20_000) if z < 10_000]
+    true = Counter(stream)
+    for key in stream:
+        ss.offer(key)
+    total = len(stream)
+    assert ss.total == pytest.approx(total)
+    snap = ss.snapshot()
+    assert len(snap["entries"]) <= 32
+    for key, est, err, _aux in snap["entries"]:
+        assert est + 1e-6 >= true[key], (key, est, true[key])
+        assert est - err <= true[key] + 1e-6, (key, est, err, true[key])
+        assert err <= total / 32 + 1e-6
+    # the genuinely hot head of the Zipf is tracked exactly-ish
+    hottest, hot_count = true.most_common(1)[0]
+    ent = {e[0]: e for e in snap["entries"]}
+    assert hottest in ent
+    est, err = ent[hottest][1], ent[hottest][2]
+    assert est - err <= hot_count <= est + 1e-6
+
+
+def test_decay_halves_estimates_on_schedule():
+    clock = [0.0]
+    tr = heat.HeatTracker(k=16, halflife=10.0, now_fn=lambda: clock[0])
+    for _ in range(400):
+        tr.record("volume", "7", 1000, "read")
+    assert tr.estimate("volume", "7") == pytest.approx(400.0)
+    clock[0] += 10.0  # one half-life
+    snap = tr.serialize()
+    ent = {e[0]: e for e in snap["dims"]["volume"]["entries"]}
+    assert ent["7"][1] == pytest.approx(200.0, rel=1e-6)
+    # aux sub-counters (bytes, per-op) decay on the same schedule
+    assert ent["7"][3]["bytes"] == pytest.approx(200_000.0, rel=1e-6)
+    assert ent["7"][3]["read"] == pytest.approx(200.0, rel=1e-6)
+    assert tr.estimate("volume", "7") == pytest.approx(200.0, rel=1e-6)
+    clock[0] += 10.0  # a second half-life: a quarter remains
+    assert tr.estimate("volume", "7") == pytest.approx(100.0, rel=1e-6)
+    # fully-decayed entries are dropped, not kept as dust
+    clock[0] += 10.0 * 40
+    assert not tr.serialize()["dims"]["volume"]["entries"]
+
+
+def test_sketch_merge_equals_union_stream():
+    """Merging per-node sketches must answer like one sketch that saw
+    the union stream: exactly for Count-Min (same hash layout, counters
+    add), within the summed error bounds for Space-Saving."""
+    clock = [50.0]
+    now = lambda: clock[0]  # noqa: E731
+    a = heat.HeatTracker(k=16, halflife=1e9, now_fn=now)
+    b = heat.HeatTracker(k=16, halflife=1e9, now_fn=now)
+    union = heat.HeatTracker(k=16, halflife=1e9, now_fn=now)
+    rng = np.random.default_rng(11)
+    for i in range(4000):
+        key = f"c{rng.zipf(1.5) % 50}"
+        side = a if i % 2 == 0 else b
+        side.record("chunk", key, 100, "read")
+        union.record("chunk", key, 100, "read")
+    snaps = [a.serialize(), b.serialize()]
+    for key in ("c1", "c2", "c7"):
+        merged_cms = heat.merged_estimate(snaps, "chunk", key,
+                                          now=clock[0])
+        assert merged_cms == pytest.approx(
+            union.estimate("chunk", key), rel=1e-9)
+    merged = heat.SpaceSaving.merge(
+        [s["dims"]["chunk"] for s in snaps], 16, 1e9, now=clock[0])
+    union_snap = union.serialize()["dims"]["chunk"]
+    uent = {e[0]: e for e in union_snap["entries"]}
+    for key, est, err, _aux in merged["entries"][:5]:
+        if key in uent:
+            u_est, u_err = uent[key][1], uent[key][2]
+            # both summaries bound the same true count: the intervals
+            # [est-err, est] and [u_est-u_err, u_est] must overlap
+            assert est - err <= u_est + 1e-6
+            assert u_est - u_err <= est + 1e-6
+    # totals conserve exactly
+    assert merged["total"] == pytest.approx(union_snap["total"])
+
+
+def test_merge_decay_aligns_snapshot_clocks():
+    """A node snapshot taken dt seconds ago contributes its counts
+    decayed by 0.5^(dt/halflife) — two nodes reporting the same rate at
+    different scrape times merge to the same heat."""
+    clock = [0.0]
+    tr = heat.HeatTracker(k=8, halflife=60.0, now_fn=lambda: clock[0])
+    for _ in range(100):
+        tr.record("tenant", "acme", 10, "read")
+    stale = tr.serialize()  # ts = 0
+    merged = heat.SpaceSaving.merge([stale["dims"]["tenant"]], 8, 60.0,
+                                    now=60.0)
+    ent = merged["entries"][0]
+    assert ent[0] == "acme" and ent[1] == pytest.approx(50.0, rel=1e-6)
+
+
+def test_degraded_annotation_does_not_double_count():
+    """A degraded read is the SAME request its op=read record counted:
+    the weight-0 degraded record bumps the aux marker only — est, CMS
+    frequency, and byte totals must not inflate for degraded volumes."""
+    clock = [0.0]
+    tr = heat.HeatTracker(k=8, halflife=1e9, now_fn=lambda: clock[0])
+    for _ in range(10):
+        tr.record("volume", "3", 4096, "read")
+        tr.record("volume", "3", 0, "degraded", weight=0.0)
+    snap = tr.serialize()["dims"]["volume"]
+    ent = {e[0]: e for e in snap["entries"]}["3"]
+    assert ent[1] == pytest.approx(10.0)  # requests counted once
+    assert ent[3]["bytes"] == pytest.approx(40960.0)
+    assert ent[3]["degraded"] == pytest.approx(10.0)
+    assert tr.estimate("volume", "3") == pytest.approx(10.0)
+    m = heat.merge_serialized([tr.serialize()], k=8, halflife=1e9,
+                              now=0.0)
+    rec = m["volumes"]["top"][0]
+    assert rec["degraded_fraction"] == pytest.approx(1.0)
+    # an annotation never evicts a hot key for a cold one
+    for i in range(8):
+        tr.record("volume", f"v{i}", 0, "read")  # fill the table
+    tr.record("volume", "cold-annotated", 0, "degraded", weight=0.0)
+    keys = {e[0] for e in tr.serialize()["dims"]["volume"]["entries"]}
+    assert "cold-annotated" not in keys
+
+
+# -- tenant resolution ----------------------------------------------------
+
+def test_resolve_tenant_access_key_bucket_anonymous():
+    v4 = ("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20260803/us-east-1/"
+          "s3/aws4_request, SignedHeaders=host, Signature=deadbeef")
+    assert heat.resolve_tenant({"Authorization": v4}, {}, "/b/k") == \
+        "AKIDEXAMPLE"
+    assert heat.resolve_tenant({"Authorization": "AWS AKV2:sig"}, {},
+                               "/b/k") == "AKV2"
+    assert heat.resolve_tenant(
+        {}, {"X-Amz-Credential": "AKPRE/20260803/r/s3/aws4_request"},
+        "/b/k") == "AKPRE"
+    assert heat.resolve_tenant({}, {}, "/images/cat.png") == "images"
+    assert heat.resolve_tenant({}, {}, "/") == "anonymous"
+
+
+# -- 3-node federation ----------------------------------------------------
+
+@pytest.fixture()
+def heat_cluster(tmp_path, monkeypatch):
+    """3 volume servers + a filer, with a fresh long-half-life tracker
+    so counts measured over a few test seconds barely decay and the
+    error-bound asserts stay exact."""
+    monkeypatch.setenv("WEEDTPU_HEAT_HALFLIFE", "100000")
+    monkeypatch.setenv("WEEDTPU_SCRUB_MBPS", "0")
+    monkeypatch.setenv("WEEDTPU_REPAIR_INTERVAL", "3600")
+    monkeypatch.setenv("WEEDTPU_AGG_INTERVAL", "0")
+    old = heat.TRACKER
+    heat.TRACKER = heat.HeatTracker(k=64, halflife=100000.0)
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = Cluster(tmp_path, n_volume_servers=3).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port(),
+                        data_dir=str(tmp_path / "f"))
+    c.submit(filer.start())
+    yield c, filer
+    c.submit(filer.stop())
+    c.stop()
+    heat.TRACKER = old
+
+
+def test_cluster_heat_surfaces_hammered_chunk(heat_cluster):
+    c, filer = heat_cluster
+    base = f"http://{filer.url}"
+    body = bytes(range(256)) * 512  # one 128KB chunk
+    req = urllib.request.Request(f"{base}/hot/hammered.bin", data=body,
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status in (200, 201)
+    # a handful of cold files so the hot one has competition
+    for i in range(6):
+        req = urllib.request.Request(f"{base}/cold/f{i}.bin",
+                                     data=b"z" * 4096, method="PUT")
+        urllib.request.urlopen(req, timeout=30).close()
+        urllib.request.urlopen(f"{base}/cold/f{i}.bin",
+                               timeout=30).close()
+    meta = _get_json(f"{base}/hot/hammered.bin?metadata=true")
+    chunk_fids = [ch["fid"] for ch in meta["chunks"]]
+    assert chunk_fids
+    hot_fid = chunk_fids[0]
+
+    n_reads = 40
+    for _ in range(n_reads):
+        with urllib.request.urlopen(f"{base}/hot/hammered.bin",
+                                    timeout=30) as r:
+            assert len(r.read()) == len(body)
+
+    merged = _get_json(
+        f"http://{c.master.url}/cluster/heat?refresh=1", timeout=60)
+    # every volume server + the filer was pulled
+    assert set(merged["nodes"]) >= \
+        {vs.url for vs in c.volume_servers} | {filer.url}
+    assert not merged.get("node_errors"), merged.get("node_errors")
+
+    top_chunks = merged["chunks"]["top"]
+    by_key = {r["key"]: r for r in top_chunks}
+    assert hot_fid in by_key, (hot_fid, top_chunks[:5])
+    rec = by_key[hot_fid]
+    # the acceptance bound: the Space-Saving estimate for the hottest
+    # key sits within its guaranteed error bound of the TRUE count
+    # (n_reads chunk fetches + 1 chunk write; halflife is huge, so
+    # decay over the test's few seconds is < 0.1%)
+    true_count = n_reads + 1
+    assert rec["est"] + 1e-6 >= true_count * 0.995, rec
+    assert rec["est"] - rec["err"] <= true_count + 1e-6, rec
+    # and it is the hottest chunk fleet-wide
+    assert top_chunks[0]["key"] == hot_fid, top_chunks[:3]
+    # with the test's huge half-life the decayed-rate estimates round
+    # toward zero; the per-op aux counters carry the mix instead
+    assert rec["reads"] >= n_reads * 0.99, rec
+    assert rec["writes"] >= 0.99, rec
+
+    # the hammered volume dominates the volume dimension too
+    hot_vid = hot_fid.partition(",")[0]
+    vol_keys = [r["key"] for r in merged["volumes"]["top"]]
+    assert hot_vid in vol_keys, (hot_vid, vol_keys)
+
+    # the shell renders it
+    env = CommandEnv(c.master.url)
+    out = io.StringIO()
+    run_command(env, "cluster.heat", out)
+    text = out.getvalue()
+    assert hot_fid in text and "rps" in text, text
+    out = io.StringIO()
+    run_command(env, "cluster.heat -json", out)
+    assert json.loads(out.getvalue())["chunks"]["top"]
+
+    # maintenance.status embeds the cached headline
+    st = _get_json(f"http://{c.master.url}/maintenance/status")
+    assert "heat" in st and st["heat"]["volumes"], st.get("heat")
+
+
+def test_cluster_heat_loopback_gate_and_internal_class(heat_cluster):
+    c, _filer = heat_cluster
+    # /heat classifies as cluster-internal traffic for the byte ledger —
+    # but ONLY the exact endpoint path: an s3 bucket literally named
+    # "heat" keeps its object traffic on the data plane
+    assert netflow.is_internal("/heat")
+    assert netflow.classify("/heat") == "internal"
+    assert netflow.classify("/heat/obj") == "data"
+    assert netflow.classify("/heatwave") == "data"
+    # /cluster/heat itself never shows up as a tenant or data-plane op
+    merged = _get_json(f"http://{c.master.url}/cluster/heat")
+    assert "chunks" in merged and "volumes" in merged \
+        and "tenants" in merged
+
+
+# -- tenant accounting conserves with netflow ------------------------------
+
+def _tenant_bytes_total(direction: str) -> float:
+    total = 0.0
+    for labels, child in metrics.TENANT_BYTES._pairs():
+        if dict(labels).get("direction") == direction:
+            total += child.value
+    return total
+
+
+def _tenant_requests() -> dict:
+    out: dict = {}
+    for labels, child in metrics.TENANT_REQUESTS._pairs():
+        ld = dict(labels)
+        out[(ld["tenant"], ld["op"])] = child.value
+    return out
+
+
+@pytest.fixture()
+def s3_heat_stack(tmp_path, monkeypatch):
+    monkeypatch.setenv("WEEDTPU_SCRUB_MBPS", "0")
+    monkeypatch.setenv("WEEDTPU_REPAIR_INTERVAL", "3600")
+    old = heat.TRACKER
+    heat.TRACKER = heat.HeatTracker(k=64, halflife=100000.0)
+    from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    filer = FilerServer(c.master.url, port=free_port(),
+                        data_dir=str(tmp_path / "f"))
+    c.submit(filer.start())
+    s3 = S3ApiServer(filer.url, port=free_port(), master_url=c.master.url)
+    c.submit(s3.start())
+    yield c, filer, s3
+    c.submit(s3.stop())
+    c.submit(filer.stop())
+    c.stop()
+    heat.TRACKER = old
+
+
+def test_tenant_counters_conserve_with_netflow(s3_heat_stack):
+    c, filer, s3 = s3_heat_stack
+    base = f"http://{s3.url}"
+    # unauthenticated requests resolve tenant = bucket name
+    payload_a = bytes(range(256)) * 64   # 16 KiB
+    payload_b = b"q" * 5000
+    req0 = _tenant_requests()
+    b0_recv = _tenant_bytes_total("recv")
+    # netflow books the s3 edge's client traffic under peer_role=client
+    # (the urllib test client sends no role header); in-process, every
+    # OTHER hop books under a server peer_role — so the client-facing
+    # slice is exactly what tenant accounting must conserve with
+    nf0_recv = 0.0
+    for labels, child in metrics.NET_BYTES._pairs():
+        ld = dict(labels)
+        if ld.get("direction") == "recv" and ld.get("class") == "data" \
+                and ld.get("peer_role") == "client":
+            nf0_recv += child.value
+
+    for bucket, payload, n in (("tenant-a", payload_a, 3),
+                               ("tenant-b", payload_b, 2)):
+        req = urllib.request.Request(f"{base}/{bucket}", method="PUT")
+        urllib.request.urlopen(req, timeout=30).close()
+        for i in range(n):
+            req = urllib.request.Request(f"{base}/{bucket}/obj{i}",
+                                         data=payload, method="PUT")
+            urllib.request.urlopen(req, timeout=30).close()
+            with urllib.request.urlopen(f"{base}/{bucket}/obj{i}",
+                                        timeout=30) as r:
+                assert r.read() == payload
+
+    reqs = _tenant_requests()
+    d = {k: reqs.get(k, 0) - req0.get(k, 0) for k in reqs}
+    # 1 bucket PUT + n object PUTs per tenant; n GETs per tenant
+    assert d[("tenant-a", "write")] == 4, d
+    assert d[("tenant-a", "read")] == 3, d
+    assert d[("tenant-b", "write")] == 3, d
+    assert d[("tenant-b", "read")] == 2, d
+
+    # conservation: tenant recv bytes == the netflow ledger's
+    # client-facing data recv bytes, both booked in the same middleware
+    # from the same values
+    nf_recv = 0.0
+    for labels, child in metrics.NET_BYTES._pairs():
+        ld = dict(labels)
+        if ld.get("direction") == "recv" and ld.get("class") == "data" \
+                and ld.get("peer_role") == "client":
+            nf_recv += child.value
+    tenant_recv = _tenant_bytes_total("recv") - b0_recv
+    expect = 3 * len(payload_a) + 2 * len(payload_b)
+    assert tenant_recv >= expect  # PUT bodies at minimum
+    assert tenant_recv == pytest.approx(nf_recv - nf0_recv, rel=0.01), \
+        (tenant_recv, nf_recv - nf0_recv)
+
+    # the tenant heat dimension saw both tenants
+    snap = heat.TRACKER.serialize()["dims"]["tenant"]
+    keys = {e[0] for e in snap["entries"]}
+    assert {"tenant-a", "tenant-b"} <= keys, keys
+
+    # a loopback caller may DECLARE a tenant (the canary / an inner
+    # gateway); the edge honors it instead of re-resolving
+    req = urllib.request.Request(
+        f"{base}/tenant-a/obj0",
+        headers={heat.TENANT_HEADER: "declared-tenant"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200 and r.read() == payload_a
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            ("declared-tenant", "read") not in _tenant_requests():
+        time.sleep(0.05)  # the middleware books in its finally
+    assert ("declared-tenant", "read") in _tenant_requests()
+
+
+# -- rate-limited warnings -------------------------------------------------
+
+def test_warn_ratelimited_suppresses_storms(caplog):
+    key = f"test-rl-{time.time()}"
+    with caplog.at_level(logging.WARNING, logger="ratelimit-test"):
+        assert weedlog.warn_ratelimited(key, 0.3, "boom %d", 1,
+                                        name="ratelimit-test")
+        for i in range(50):
+            assert not weedlog.warn_ratelimited(key, 0.3, "boom %d", i,
+                                                name="ratelimit-test")
+        time.sleep(0.35)
+        assert weedlog.warn_ratelimited(key, 0.3, "boom %d", 99,
+                                        name="ratelimit-test")
+    msgs = [r.getMessage() for r in caplog.records]
+    assert len(msgs) == 2, msgs
+    assert "boom 1" in msgs[0]
+    # the suppressed-count rides the next emitted line
+    assert "boom 99" in msgs[1] and "50 similar suppressed" in msgs[1]
+
+
+def test_warn_ratelimited_bounds_key_table():
+    logger = logging.getLogger("ratelimit-bound")
+    logger.propagate = False  # don't spray 4k lines into the test log
+    logger.addHandler(logging.NullHandler())
+    try:
+        for i in range(weedlog._RL_MAX_KEYS + 100):
+            weedlog.warn_ratelimited(f"bound-{time.time()}-{i}", 3600.0,
+                                     "x", name="ratelimit-bound")
+        assert len(weedlog._rl_state) <= weedlog._RL_MAX_KEYS
+    finally:
+        logger.propagate = True

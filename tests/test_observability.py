@@ -166,6 +166,42 @@ def test_global_registry_exposition_lints():
     _lint_exposition(metrics.REGISTRY.render())
 
 
+def test_registry_wide_metric_conventions():
+    """Registry-wide lint (tier-1): every series in the global registry —
+    today's AND every future one — follows the naming convention:
+    `weedtpu_`-prefixed lowercase snake_case, counters `_total`-suffixed
+    (the OpenMetrics rendering depends on it), histograms unit-suffixed,
+    non-counters never faking the counter suffix, and non-empty help
+    text on everything.  Importing the modules that register metrics
+    lazily makes the sweep cover them."""
+    import seaweedfs_tpu.stats.canary  # noqa: F401 — registers counters
+    import seaweedfs_tpu.stats.heat  # noqa: F401
+    import seaweedfs_tpu.stats.netflow  # noqa: F401
+    with metrics.REGISTRY._lock:
+        families = dict(metrics.REGISTRY._metrics)
+    assert families, "global registry is empty?"
+    for name, m in families.items():
+        assert re.fullmatch(r"weedtpu_[a-z0-9_]+", name), \
+            f"{name}: not weedtpu_-prefixed lowercase snake_case"
+        assert m.help and m.help.strip(), f"{name}: missing help text"
+        assert m.kind in ("counter", "gauge", "histogram"), \
+            f"{name}: unknown kind {m.kind}"
+        if m.kind == "counter":
+            assert name.endswith("_total"), \
+                f"{name}: counters must be _total-suffixed"
+        else:
+            assert not name.endswith("_total"), \
+                f"{name}: _total suffix is reserved for counters"
+        if m.kind == "histogram":
+            assert name.endswith(("_seconds", "_bytes")), \
+                f"{name}: histograms carry a unit suffix"
+        assert len(m.label_names) == len(set(m.label_names)), \
+            f"{name}: duplicate label names"
+        for label in m.label_names:
+            assert re.fullmatch(r"[a-z][a-z0-9_]*", label), \
+                f"{name}: bad label name {label!r}"
+
+
 def test_cardinality_collapses_to_other():
     reg = metrics.Registry()
     c = reg.counter("weedtpu_test_cardinality_total", "t", ("who",))
