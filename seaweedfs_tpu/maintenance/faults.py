@@ -1,8 +1,10 @@
-"""Test-only fault injection: make the heal loop provable end-to-end.
+"""Test-only fault injection: make the heal AND resilience loops provable.
 
-Faults are injected either through the WEEDTPU_FAULTS env var at volume
-server start, or live through the loopback-only /admin/faults endpoint.
-Supported actions:
+Two fault planes live here:
+
+**Store faults** (applied to one volume server's Store) — injected
+through the WEEDTPU_FAULTS env var at volume server start, or live
+through the loopback-only /admin/faults endpoint:
 
   delete_shard:vid:sid          remove one EC shard file (and close its fd
                                 in the mounted EcVolume) — "disk died"
@@ -11,19 +13,206 @@ Supported actions:
   delay_shard_read:ms           stall every /admin/ec/shard_read response —
                                 a slow peer for degraded-read tests
 
+**Process-wide faults** (network + disk) — a module-level registry the
+HTTP stacks and the EC shard writer consult, so an in-process chaos
+cluster (every server in one interpreter) can cut links and fail disks
+without containers:
+
+  partition:a:b                 refuse dials between a and b (each a role
+                                name like "filer"/"volume"/"master"/"s3",
+                                a netloc, or "*"); bidirectional
+  peer_latency:dst:ms[:jitter]  add latency to every dial/request toward
+                                dst (role or netloc)
+  peer_error:dst:pct            fail requests toward dst with probability
+                                pct/100 (injected ConnectionResetError)
+  shard_write_error:EIO|ENOSPC  every EC shard write (encode/rebuild)
+                                raises that OSError; "off" clears
+  clear_net                     drop every process-wide fault
+
 Env spec: directives joined by ';', e.g.
   WEEDTPU_FAULTS="delete_shard:1:3;flip_bit:1:7:4096"
+
+Servers call ``register_node(netloc, role)`` at start so role↔role
+partitions resolve a dial's destination netloc back to its role.  All
+check_* hooks are O(1) no-ops while no process-wide fault is armed
+(one module-global truthiness test on the hot path).
 """
 
 from __future__ import annotations
 
+import errno as _errno
 import logging
 import os
+import random
+import threading
 
 from seaweedfs_tpu.storage.ec import layout
 
 log = logging.getLogger("faults")
 
+_rand = random.Random()
+
+# -- process-wide network/disk fault registry ----------------------------
+
+_lock = threading.Lock()
+_partitions: set[tuple[str, str]] = set()        # bidirectional pairs
+_latency: dict[str, tuple[float, float]] = {}    # dst -> (ms, jitter_ms)
+_error_rate: dict[str, float] = {}               # dst -> probability 0..1
+_disk_shard_write: str | None = None             # "EIO" | "ENOSPC" | None
+_roles: dict[str, str] = {}                      # netloc -> role
+NET_ACTIVE = False  # cheap hot-path gate; True while any fault is armed
+
+
+def register_node(netloc: str, role: str) -> None:
+    """Record netloc→role so role↔role partitions can match a dial's
+    destination.  Called by every server at start; harmless twice."""
+    _roles[netloc] = role
+
+
+def _recompute_active() -> None:
+    global NET_ACTIVE
+    NET_ACTIVE = bool(_partitions or _latency or _error_rate
+                      or _disk_shard_write)
+
+
+def clear_net() -> None:
+    global _disk_shard_write
+    with _lock:
+        _partitions.clear()
+        _latency.clear()
+        _error_rate.clear()
+        _disk_shard_write = None
+        _recompute_active()
+
+
+def add_partition(a: str, b: str) -> None:
+    with _lock:
+        _partitions.add((a, b))
+        _recompute_active()
+
+
+def remove_partition(a: str, b: str) -> None:
+    with _lock:
+        _partitions.discard((a, b))
+        _partitions.discard((b, a))
+        _recompute_active()
+
+
+def set_peer_latency(dst: str, ms: float, jitter_ms: float = 0.0) -> None:
+    with _lock:
+        if ms <= 0 and jitter_ms <= 0:
+            _latency.pop(dst, None)
+        else:
+            _latency[dst] = (ms, jitter_ms)
+        _recompute_active()
+
+
+def set_peer_error_rate(dst: str, pct: float) -> None:
+    with _lock:
+        if pct <= 0:
+            _error_rate.pop(dst, None)
+        else:
+            _error_rate[dst] = min(1.0, pct / 100.0)
+        _recompute_active()
+
+
+def set_shard_write_error(kind: str | None) -> None:
+    global _disk_shard_write
+    with _lock:
+        _disk_shard_write = kind if kind in ("EIO", "ENOSPC") else None
+        _recompute_active()
+
+
+def net_snapshot() -> dict:
+    with _lock:
+        return {"partitions": sorted(list(p) for p in _partitions),
+                "latency_ms": {d: list(v) for d, v in _latency.items()},
+                "error_rate": {d: round(p * 100.0, 1)
+                               for d, p in _error_rate.items()},
+                "shard_write_error": _disk_shard_write,
+                "nodes": dict(_roles)}
+
+
+def _ids(netloc_or_role: str) -> set[str]:
+    """Every identity a side of a dial answers to: its literal name, its
+    registered role (for netlocs), and the wildcard."""
+    out = {netloc_or_role, "*"}
+    role = _roles.get(netloc_or_role)
+    if role:
+        out.add(role)
+    return out
+
+
+def check_dial(src: str, dst_netloc: str) -> None:
+    """Raise ConnectionRefusedError when (src, dst) crosses an armed
+    partition.  `src` is the caller's role (clients don't know their own
+    netloc); `dst_netloc` resolves to its role via register_node."""
+    if not NET_ACTIVE:
+        return
+    srcs = _ids(src)
+    dsts = _ids(dst_netloc)
+    with _lock:
+        parts = list(_partitions)
+    for a, b in parts:
+        if (a in srcs and b in dsts) or (a in dsts and b in srcs):
+            raise ConnectionRefusedError(
+                _errno.ECONNREFUSED,
+                f"faults: partition {a}<->{b} refuses {src} -> "
+                f"{dst_netloc}")
+
+
+def dial_latency_s(dst_netloc: str) -> float:
+    """Injected latency (seconds) for a request toward dst, 0 when
+    none is armed."""
+    if not NET_ACTIVE:
+        return 0.0
+    with _lock:
+        lat = dict(_latency)
+    for key in _ids(dst_netloc):
+        if key in lat:
+            ms, jitter = lat[key]
+            return max(0.0, ms + _rand.uniform(-jitter, jitter)) / 1000.0
+    return 0.0
+
+
+def maybe_inject_error(dst_netloc: str) -> None:
+    """Raise ConnectionResetError with the armed probability for dst."""
+    if not NET_ACTIVE:
+        return
+    with _lock:
+        rates = dict(_error_rate)
+    for key in _ids(dst_netloc):
+        p = rates.get(key)
+        if p is not None and _rand.random() < p:
+            raise ConnectionResetError(
+                _errno.ECONNRESET,
+                f"faults: injected error toward {dst_netloc}")
+
+
+def check_net(src: str, dst_netloc: str) -> float:
+    """Combined client hook: partition check + error injection; returns
+    the latency (seconds) the caller should sleep.  One call site per
+    HTTP stack keeps the hooks from drifting apart."""
+    if not NET_ACTIVE:
+        return 0.0
+    check_dial(src, dst_netloc)
+    maybe_inject_error(dst_netloc)
+    return dial_latency_s(dst_netloc)
+
+
+def check_shard_write(path: str) -> None:
+    """Raise the armed disk error before an EC shard write (encode and
+    rebuild both call here before opening their tmp shard files)."""
+    if not NET_ACTIVE or _disk_shard_write is None:
+        return
+    if _disk_shard_write == "ENOSPC":
+        raise OSError(_errno.ENOSPC,
+                      f"faults: injected ENOSPC writing shards for {path}")
+    raise OSError(_errno.EIO,
+                  f"faults: injected EIO writing shards for {path}")
+
+
+# -- env / admin parsing -------------------------------------------------
 
 def parse_env(spec: str) -> list[dict]:
     out: list[dict] = []
@@ -44,12 +233,52 @@ def parse_env(spec: str) -> list[dict]:
                             "bit": int(fields[4]) if len(fields) > 4 else 0})
             elif action == "delay_shard_read":
                 out.append({"action": action, "ms": float(fields[1])})
+            elif action in ("partition", "unpartition"):
+                out.append({"action": action, "a": fields[1],
+                            "b": fields[2]})
+            elif action == "peer_latency":
+                out.append({"action": action, "dst": fields[1],
+                            "ms": float(fields[2]),
+                            "jitter": float(fields[3])
+                            if len(fields) > 3 else 0.0})
+            elif action == "peer_error":
+                out.append({"action": action, "dst": fields[1],
+                            "pct": float(fields[2])})
+            elif action == "shard_write_error":
+                out.append({"action": action, "kind": fields[1]})
+            elif action == "clear_net":
+                out.append({"action": action})
             else:
                 log.warning("faults: unknown directive %r", part)
         except (IndexError, ValueError):
             log.warning("faults: malformed directive %r", part)
     return out
 
+
+def apply_net(fault: dict) -> bool:
+    """Apply one parsed PROCESS-WIDE fault; False when it isn't one
+    (store faults go through apply())."""
+    action = fault.get("action")
+    if action == "partition":
+        add_partition(str(fault["a"]), str(fault["b"]))
+    elif action == "unpartition":
+        remove_partition(str(fault["a"]), str(fault["b"]))
+    elif action == "peer_latency":
+        set_peer_latency(str(fault["dst"]), float(fault["ms"]),
+                         float(fault.get("jitter", 0.0)))
+    elif action == "peer_error":
+        set_peer_error_rate(str(fault["dst"]), float(fault["pct"]))
+    elif action == "shard_write_error":
+        set_shard_write_error(str(fault.get("kind", "")) or None)
+    elif action == "clear_net":
+        clear_net()
+    else:
+        return False
+    log.warning("faults: applied %s", fault)
+    return True
+
+
+# -- store faults --------------------------------------------------------
 
 def _ec_base(store, vid: int) -> str | None:
     for loc in store.locations:
@@ -76,6 +305,7 @@ def delete_shard(store, vid: int, sid: int) -> bool:
         f = ev.shards.pop(sid, None)
         if f is not None:
             f.close()
+        ev.clear_quarantine(sid)
     log.warning("faults: deleted shard %d of volume %d", sid, vid)
     return True
 
@@ -106,7 +336,8 @@ def flip_bit(store, vid: int, sid: int, offset: int, bit: int = 0) -> bool:
 def apply(store, fault: dict) -> dict:
     """Apply one parsed fault to a Store; returns {**fault, ok: bool}.
     delay_shard_read is server state, not store state — the volume
-    server handles it before calling here."""
+    server handles it before calling here.  Process-wide faults route
+    through apply_net first."""
     action = fault.get("action")
     ok = False
     if action == "delete_shard":
@@ -114,4 +345,6 @@ def apply(store, fault: dict) -> dict:
     elif action == "flip_bit":
         ok = flip_bit(store, int(fault["volume"]), int(fault["shard"]),
                       int(fault["offset"]), int(fault.get("bit", 0)))
+    else:
+        ok = apply_net(fault)
     return dict(fault, ok=ok)

@@ -38,6 +38,7 @@ from seaweedfs_tpu.stats import metrics, netflow, trace
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.ec import layout
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.utils import resilience
 
 log = logging.getLogger("repair")
 
@@ -347,8 +348,10 @@ class RepairPlanner:
             metrics.REPAIR_ACTIONS.labels(info["kind"], "ok").inc()
         except Exception as e:
             n = self._backoff.get(vid, (0, 0.0))[0] + 1
-            delay = min(self.backoff_base * (2 ** (n - 1)),
-                        self.backoff_max)
+            # decorrelated jitter (utils/resilience.py): N volumes whose
+            # repairs failed together must not retry together
+            delay = resilience.backoff_delay(n, self.backoff_base,
+                                             self.backoff_max)
             self._backoff[vid] = (n, time.monotonic() + delay)
             metrics.REPAIR_ACTIONS.labels(info["kind"], "error").inc()
             outcome = f"error: {e}"

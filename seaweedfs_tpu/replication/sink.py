@@ -22,25 +22,31 @@ log = logging.getLogger("replication.sink")
 
 def retry(fn, attempts: int = 4, base_delay: float = 0.5,
           retriable=(urllib.error.URLError, ConnectionError, OSError)):
-    """Exponential-backoff retry for sink IO (reference: util.Retry wraps
+    """Budgeted jittered retry for sink IO (reference: util.Retry wraps
     every sink write) — without it one transient 500 during filer.sync
-    drops the event permanently."""
-    delay = base_delay
-    for attempt in range(attempts):
+    drops the event permanently.  Rides the unified resilience layer:
+    decorrelated-jitter delays, and every retry spends a token from the
+    process-wide budget so a down replication target can't storm.
+    Client errors (HTTP < 500) won't heal by retrying and raise
+    immediately."""
+    from seaweedfs_tpu.utils import resilience
+
+    def giveup(e: BaseException) -> bool:
+        return isinstance(e, urllib.error.HTTPError) and e.code < 500
+
+    def wrapped():
         try:
             return fn()
-        except urllib.error.HTTPError as e:
-            # client errors won't heal by retrying; server errors might
-            if e.code < 500 or attempt == attempts - 1:
-                raise
-            log.warning("sink call failed (HTTP %s), retry in %.1fs",
-                        e.code, delay)
         except retriable as e:
-            if attempt == attempts - 1:
-                raise
-            log.warning("sink call failed (%s), retry in %.1fs", e, delay)
-        time.sleep(delay)
-        delay *= 2
+            log.warning("sink call failed (%s); may retry", e)
+            raise
+
+    return resilience.retry_call(
+        wrapped, attempts=attempts, base=base_delay, cap=30.0,
+        cls="replication",
+        retry_on=(retriable if isinstance(retriable, tuple)
+                  else (retriable,)),
+        giveup=giveup)
 
 
 def entry_is_directory(entry: dict) -> bool:

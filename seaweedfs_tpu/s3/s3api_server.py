@@ -173,6 +173,8 @@ class S3ApiServer:
             self._register_task = asyncio.create_task(self._register_loop())
         from seaweedfs_tpu.stats import profile as _profile
         _profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
+        from seaweedfs_tpu.maintenance import faults as _faults
+        _faults.register_node(self.url, "s3")
         log.info("s3 gateway on %s -> filer %s", self.url, self.filer_url)
 
     async def _identity_sync(self) -> None:
@@ -250,6 +252,8 @@ class S3ApiServer:
         """Announce this gateway to the master every 10s (the same
         cadence and registry the filer uses — cluster.go in the
         reference); members expire 30s after the last beat."""
+        from seaweedfs_tpu.utils.resilience import Backoff
+        bo = Backoff(base=2.0, cap=30.0)
         while True:
             try:
                 async with self._session.post(
@@ -263,8 +267,12 @@ class S3ApiServer:
                 # same contract as the filer's loop: registration must
                 # survive anything (incl. session-recreate races) — a
                 # dead loop silently ages the gateway out of the
-                # cluster-member registry within 30s
-                pass
+                # cluster-member registry within 30s.  Failed beats
+                # retry on the shared jittered backoff instead of the
+                # full steady-state cadence
+                await asyncio.sleep(bo.next())
+                continue
+            bo.reset()
             await asyncio.sleep(10)
 
     # -- filer client --------------------------------------------------

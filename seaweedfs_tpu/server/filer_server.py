@@ -196,12 +196,16 @@ class FilerServer:
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
+        from seaweedfs_tpu.maintenance import faults as _faults
+        _faults.register_node(self.url, "filer")
         log.info("filer listening on %s", self.url)
 
     async def _register_loop(self) -> None:
         """Announce this filer in the master's cluster membership so shells
         and peers can discover it (reference: weed/cluster/cluster.go
         filer registration through KeepConnected)."""
+        from seaweedfs_tpu.utils.resilience import Backoff
+        bo = Backoff(base=2.0, cap=30.0)
         while True:
             try:
                 async with self._session.post(
@@ -215,9 +219,16 @@ class FilerServer:
             except Exception:
                 # the registration loop must survive anything (a dead
                 # master, truncated JSON, timeouts) or the filer silently
-                # drops out of the cluster until restart
+                # drops out of the cluster until restart.  Failures retry
+                # on the shared jittered backoff — quickly at first (a
+                # master restart should re-register us well inside the
+                # 30s membership horizon), decorrelated under a longer
+                # outage so a filer fleet doesn't stampede the master
                 log.warning("register/aggregate refresh failed",
                             exc_info=True)
+                await asyncio.sleep(bo.next())
+                continue
+            bo.reset()
             await asyncio.sleep(10)
 
     # -- meta aggregator (reference: weed/filer/meta_aggregator.go) ------
@@ -467,7 +478,21 @@ class FilerServer:
         key = (v.fid, cache)
         fut = self._chunk_flight.get(key)
         if fut is None:
-            fut = asyncio.ensure_future(self._load_chunk_once(v, cache))
+            async def flight():
+                # the flight is SHARED: it may outlive the waiter that
+                # started it and serve waiters with different budgets.
+                # Strip the starter's deadline so a deadline-free reader
+                # joining a budget-poisoned flight doesn't inherit the
+                # upstream 504 (enforcement stays at the waiter level —
+                # the middleware cancels ITS wait, the shielded flight
+                # finishes for everyone else)
+                from seaweedfs_tpu.utils import resilience as _res
+                tok = _res.set_deadline(None)
+                try:
+                    return await self._load_chunk_once(v, cache)
+                finally:
+                    _res.reset_deadline(tok)
+            fut = asyncio.ensure_future(flight())
             self._chunk_flight[key] = fut
             fut.add_done_callback(
                 lambda _f, k=key: self._chunk_flight.pop(k, None))
@@ -1025,6 +1050,18 @@ class FilerServer:
             headers["Content-Length"] = str(length)
             return web.Response(status=status, headers=headers,
                                 content_type=mime)
+
+        # deadline-armed requests fetch the FIRST chunk before the 200
+        # is committed: a slow/broken upstream then surfaces as the
+        # middleware's clean 504 instead of a torn mid-stream 200 (and
+        # costs no extra upstream load — the fetch lands in the
+        # singleflight/chunk-cache the stream loop reads from)
+        from seaweedfs_tpu.utils import resilience as _res
+        if _res.deadline() is not None and chunks:
+            first = self._group_for(path, entry, chunks).read_views(
+                offset, length)
+            if first:
+                await self._load_chunk_view(first[0], True)
 
         resp = web.StreamResponse(status=status, headers=headers)
         resp.content_type = mime

@@ -182,6 +182,8 @@ class MasterServer:
         self._expire_task = asyncio.create_task(self._expire_loop())
         self._repair_task = asyncio.create_task(self._repair_loop())
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
+        from seaweedfs_tpu.maintenance import faults as _faults
+        _faults.register_node(self.url, "master")
         self.aggregator.start()
         self.canary.start()  # WEEDTPU_CANARY_INTERVAL <= 0 disables
         if self.raft:
@@ -608,6 +610,19 @@ class MasterServer:
                             for vid, info in sorted(led.items())},
                 "states": counts,
                 "planner": self.maintenance.status()}
+        # resilience plane: per-peer breaker states feed the health
+        # ledger (a tripped breaker is a node the data path has already
+        # given up on — often minutes before the heartbeat horizon says
+        # so), plus armed chaos faults so `chaos.status` can show an
+        # operator what is injected vs what is organically broken
+        from seaweedfs_tpu.maintenance import faults as _faults
+        from seaweedfs_tpu.utils import resilience as _res
+        snap["resilience"] = {
+            "breakers": _res.breakers_snapshot(),
+            "retry_budget": _res.retry_budget().snapshot(),
+            "hedge_pct": _res.hedge_pct(),
+            "faults": _faults.net_snapshot(),
+        }
         try:
             # SLO view from whatever the aggregator last pulled — status
             # must not block on a fleet scrape
@@ -927,6 +942,14 @@ class MasterServer:
         vid = int(raw.partition(",")[0])
         nodes = self.topo.lookup(vid, req.query.get("collection", ""))
         if not nodes:
+            # a raft FOLLOWER's topology is empty (heartbeats only reach
+            # the leader): a local miss there means "ask the leader",
+            # not "volume gone" — without the 409 redirect, clients that
+            # landed on a follower after failover would read every
+            # volume as deleted (found by the chaos master-failover
+            # scenario)
+            if not self.is_leader:
+                return self._not_leader_response()
             return web.json_response(
                 {"volumeId": raw, "error": "volume id not found"}, status=404)
         return web.json_response({
@@ -939,6 +962,8 @@ class MasterServer:
         vid = int(req.query.get("volumeId", "0"))
         shards = self.topo.lookup_ec_shards(vid)
         if shards is None:
+            if not self.is_leader:  # same follower-miss redirect as
+                return self._not_leader_response()  # handle_lookup
             return web.json_response({"error": "not an ec volume"}, status=404)
         return web.json_response({
             "volumeId": vid,

@@ -25,6 +25,7 @@ from aiohttp import web
 
 from seaweedfs_tpu.security import jwt as sjwt
 from seaweedfs_tpu.stats import heat, metrics, netflow, profile, trace
+from seaweedfs_tpu.utils import resilience
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
@@ -218,6 +219,7 @@ class VolumeServer:
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         # test-only fault plan from the environment (maintenance/faults.py)
         from seaweedfs_tpu.maintenance import faults as _faults
+        _faults.register_node(self.url, "volume")
         for f in _faults.parse_env(os.environ.get("WEEDTPU_FAULTS", "")):
             if f["action"] == "delay_shard_read":
                 self._fault_delay_shard_read = f["ms"] / 1000.0
@@ -759,16 +761,41 @@ class VolumeServer:
         the shard bytes under the same flow."""
         tctx = trace.current()
         flow_cls = netflow.current_class() or "data"
+        # the ambient deadline is request-context state; capture it HERE
+        # (the calling thread) so pool-thread fetches still honor it
+        dl = resilience.deadline()
 
         def read(shard_id: int, offset: int, size: int) -> bytes | None:
             # runs inside a worker thread: use a blocking http client
             import urllib.request
+            from seaweedfs_tpu.maintenance import faults as _faults
             try:
                 shards = self._ec_shard_locations(vid)
                 for loc in shards.get(str(shard_id), []):
                     if loc["url"] == self.url:
                         continue
+                    # per-peer circuit breaker: a tripped peer is skipped
+                    # outright — the next location (or reconstruction)
+                    # serves the interval without paying its timeout
+                    breaker = resilience.breaker_for(loc["url"]) \
+                        if resilience.breaker_enabled() else None
+                    if breaker is not None and not breaker.allow():
+                        continue
                     try:
+                        if _faults.NET_ACTIVE:
+                            lat = _faults.check_net("volume", loc["url"])
+                            if lat > 0:
+                                time.sleep(lat)
+                        # socket timeout respects the captured budget: a
+                        # 200ms request must not park this thread for 30s
+                        tmo = 30.0
+                        if dl is not None:
+                            tmo = min(tmo, dl - time.monotonic())
+                            if tmo <= 0.01:
+                                # budget spent: failing is OUR state,
+                                # not the peer's — don't even dial (and
+                                # never ding its breaker for it)
+                                return None
                         with trace.span("volume.shard_fetch", parent=tctx,
                                         vid=vid, shard=shard_id,
                                         peer=loc["url"],
@@ -788,16 +815,38 @@ class VolumeServer:
                                     trace.format_header(hdr_ctx))
                             req.add_header(netflow.CLASS_HEADER, flow_cls)
                             req.add_header(netflow.ROLE_HEADER, "volume")
+                            if dl is not None:
+                                req.add_header(
+                                    resilience.DEADLINE_HEADER,
+                                    str(max(1, int((dl - time.monotonic())
+                                                   * 1000))))
                             with urllib.request.urlopen(req,
-                                                        timeout=30) as rr:
+                                                        timeout=tmo) as rr:
                                 data = rr.read()
                             netflow.account("recv", flow_cls, "volume",
                                             len(data))
                             if len(data) != size:
                                 sp.set(short=len(data))
+                        if breaker is not None:
+                            breaker.record(True)
                         if len(data) == size:
                             return data
+                    except urllib.error.HTTPError:
+                        # the peer ANSWERED (404 shard moved, 5xx): a
+                        # routing/content miss, not a transport failure —
+                        # breakers only count unreachable peers
+                        if breaker is not None:
+                            breaker.record(True)
+                        continue
                     except OSError:
+                        # a timeout caused by OUR nearly-spent budget is
+                        # not evidence against the peer; real transport
+                        # failures (and timeouts with budget to spare)
+                        # are
+                        if breaker is not None and \
+                                (dl is None
+                                 or dl - time.monotonic() > 0.05):
+                            breaker.record(False)
                         continue
             except OSError:
                 return None
@@ -1125,6 +1174,9 @@ class VolumeServer:
                 f = mounted.shards.pop(sid, None)
                 if f is not None:
                     f.close()
+                # a purged shard's scrub verdicts die with its file — a
+                # rebuilt replacement must not inherit the quarantine
+                mounted.clear_quarantine(sid)
         # if no shards remain anywhere, drop index files too
         if not any(os.path.exists(base + layout.to_ext(i))
                    for i in range(layout.TOTAL_SHARDS)):

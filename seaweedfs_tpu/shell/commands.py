@@ -823,6 +823,67 @@ def cmd_cluster_canary(env: CommandEnv, args, out):
               f"{p99} trace={rec['trace_id']}{err}", file=out)
 
 
+@command("chaos.status")
+def cmd_chaos_status(env: CommandEnv, args, out):
+    """Resilience-plane status: per-peer circuit-breaker states, the
+    retry-budget fill, hedging config, armed chaos faults (partitions /
+    injected latency / error rates / disk faults), and the canary's
+    last outcomes — the operator's one-stop "what is broken vs what did
+    we break on purpose" view.  -json dumps the raw snapshot.  Runbook:
+    SLO burn alert -> cluster.canary (which path) -> cluster.trace
+    (which hop) -> chaos.status (is a breaker open / a fault armed)."""
+    flags = parse_flags(args)
+    st = env.master_get("/maintenance/status")
+    res = st.get("resilience") or {}
+    try:
+        canary = env.master_get("/cluster/canary")
+    except RuntimeError:
+        canary = {}
+    if "json" in flags:
+        print(json.dumps({"resilience": res,
+                          "states": st.get("states", {}),
+                          "canary": canary.get("paths", {})},
+                         separators=(",", ":")), file=out)
+        return
+    breakers = res.get("breakers") or {}
+    if breakers:
+        for peer, b in sorted(breakers.items()):
+            extra = f" reopens_in={b['open_for_s']}s" \
+                if "open_for_s" in b else ""
+            print(f"breaker {peer}: {b.get('state'):9s} "
+                  f"failures={b.get('failures')} trips={b.get('trips')}"
+                  f"{extra}", file=out)
+    else:
+        print("breakers: all closed", file=out)
+    budget = res.get("retry_budget") or {}
+    classes = budget.get("classes") or {}
+    print(f"retry budget: rate={budget.get('rate')}/s "
+          f"burst={budget.get('burst')}"
+          + ("".join(f" {c}={v}" for c, v in sorted(classes.items()))
+             if classes else ""), file=out)
+    print(f"hedge: pct={res.get('hedge_pct')}", file=out)
+    faults = res.get("faults") or {}
+    armed = [f"partition {a}<->{b}"
+             for a, b in faults.get("partitions", [])]
+    armed += [f"latency {d}={ms[0]}ms±{ms[1]}"
+              for d, ms in (faults.get("latency_ms") or {}).items()]
+    armed += [f"error_rate {d}={p}%"
+              for d, p in (faults.get("error_rate") or {}).items()]
+    if faults.get("shard_write_error"):
+        armed.append(f"shard_write_error={faults['shard_write_error']}")
+    print("faults: " + ("; ".join(armed) if armed else "none armed"),
+          file=out)
+    states = st.get("states", {})
+    if any(v for k, v in states.items() if k != "healthy"):
+        print("volume states: " + " ".join(
+            f"{k}={v}" for k, v in sorted(states.items()) if v),
+            file=out)
+    for path, rec in sorted((canary.get("paths") or {}).items()):
+        print(f"canary {path:9s} {rec.get('outcome'):5s} "
+              f"{rec.get('ms', 0):8.1f}ms trace={rec.get('trace_id')}",
+              file=out)
+
+
 @command("cluster.heat")
 def cmd_cluster_heat(env: CommandEnv, args, out):
     """Fleet workload heat (/cluster/heat): top-K hot chunks, volumes,
@@ -942,31 +1003,49 @@ def cmd_volume_fsck(env: CommandEnv, args, out):
         report[str(vid)] = {"missing": True, "broken_refs": b}
         if not as_json:
             print(f"volume {vid}: MISSING, {b} broken ref(s)", file=out)
+    # fold in the master's health ledger so both output modes gate on
+    # cluster health (state / quarantined ranges), not just refs
+    try:
+        health = env.master_get("/maintenance/status")
+    except RuntimeError:
+        health = {}
+    for vid, v in (health.get("volumes") or {}).items():
+        rec = report.setdefault(vid, {})
+        rec["health"] = {
+            "state": v.get("state"), "kind": v.get("kind"),
+            "last_scrub": v.get("last_scrub"),
+            "quarantined": v.get("quarantined") or {},
+            "shards_missing": v.get("shards_missing", []),
+        }
+    # `ok` is the chaos/CI gate: false — and a nonzero shell exit — on
+    # anything that means data is damaged or being served around damage
+    # (broken refs, corrupt/critical state, quarantined ranges).
+    # Degraded/under-replicated volumes still read correctly, and
+    # orphans are garbage not damage: neither flips it.  `healthy`
+    # stays the stricter everything-is-green bit.  BOTH output modes
+    # return the same exit code — a gate written without -json must not
+    # quietly pass on a quarantined cluster.
+    damaged = broken > 0
+    for r in report.values():
+        h = r.get("health") or {}
+        if h.get("state") in ("corrupt", "critical") or \
+                h.get("quarantined"):
+            damaged = True
     if as_json:
-        # fold in the master's health ledger so CI can assert on cluster
-        # health (state / last scrub / quarantined ranges) in one pass
-        try:
-            health = env.master_get("/maintenance/status")
-        except RuntimeError:
-            health = {}
-        for vid, v in (health.get("volumes") or {}).items():
-            rec = report.setdefault(vid, {})
-            rec["health"] = {
-                "state": v.get("state"), "kind": v.get("kind"),
-                "last_scrub": v.get("last_scrub"),
-                "quarantined": v.get("quarantined") or {},
-                "shards_missing": v.get("shards_missing", []),
-            }
         print(json.dumps({
             "volumes": report, "orphans": orphans, "broken_refs": broken,
             "states": health.get("states", {}),
+            "ok": not damaged,
             "healthy": broken == 0 and all(
                 (r.get("health") or {}).get("state") in (None, "healthy")
                 for r in report.values()),
         }, separators=(",", ":")), file=out)
-        return
+        return 1 if damaged else 0
     print(f"volume.fsck: {orphans} orphan(s), {broken} broken ref(s) "
-          f"across {len(stored)} volume(s)", file=out)
+          f"across {len(stored)} volume(s)"
+          + ("" if not damaged else " — DAMAGED (corrupt/quarantined "
+             "state; see maintenance.status)"), file=out)
+    return 1 if damaged else 0
 
 
 @command("collection.list")
@@ -1418,15 +1497,20 @@ def cmd_volume_vacuum_all(env: CommandEnv, args, out):
     print(f"vacuumed {r.get('vacuumed', 0)} volume(s)", file=out)
 
 
-def run_command(env: CommandEnv, line: str, out) -> None:
+def run_command(env: CommandEnv, line: str, out) -> int:
+    """Run one shell line; returns the command's exit code (commands
+    return None for success — a nonzero int marks an assertion-style
+    failure, e.g. volume.fsck finding corruption, so scripted/CI
+    invocations can gate on it)."""
     parts = shlex.split(line)
     if not parts:
-        return
+        return 0
     fn = COMMANDS.get(parts[0])
     if fn is None:
         raise RuntimeError(f"unknown command {parts[0]!r} "
                            f"(have: {', '.join(sorted(COMMANDS))})")
-    fn(env, parts[1:], out)
+    rc = fn(env, parts[1:], out)
+    return int(rc) if rc else 0
 
 
 # ---- breadth pass: cluster/raft/fs/tier/remote/mq commands --------------

@@ -33,8 +33,11 @@ def repl(master: str, script: str | None = None) -> int:
             for line in script.split(";"):
                 line = line.strip()
                 if line:
-                    run_command(env, line, sys.stdout)
-            return 0
+                    # a command's nonzero rc (volume.fsck on a corrupt
+                    # cluster) must surface as the process exit code so
+                    # CI/chaos harnesses can gate on `weedtpu shell -c`
+                    rc = max(rc, run_command(env, line, sys.stdout))
+            return rc
         _setup_completion()
         while True:
             try:

@@ -478,10 +478,19 @@ class ClusterAggregator:
         self.pool.close()
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+        from seaweedfs_tpu.utils.resilience import Backoff
+        bo = Backoff(base=self.interval, cap=max(self.interval * 8, 60.0))
+        delay = self.interval
+        while not self._stop.wait(delay):
+            delay = self.interval
             try:
                 self.scrape_once()
+                bo.reset()
             except Exception as e:  # a bad node must not kill the loop
+                # (per-node pull errors are folded into self.errors; a
+                # raise here is the harness itself failing — back off
+                # with jitter rather than spinning on it)
+                delay = bo.next()
                 weedlog.V(1, "aggregate").infof("scrape failed: %s", e)
 
     # -- scraping -------------------------------------------------------

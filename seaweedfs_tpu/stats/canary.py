@@ -98,13 +98,22 @@ class CanaryProber:
             self._task = None
 
     async def _run(self, interval: float) -> None:
+        from seaweedfs_tpu.utils.resilience import Backoff
+        bo = Backoff(base=interval, cap=max(interval * 8, 60.0))
+        delay = interval
         while True:
-            await asyncio.sleep(interval)
+            await asyncio.sleep(delay)
+            delay = interval
             if not self.master.is_leader or not self.master.topo.nodes:
                 continue  # nothing to probe (or not our job)
             try:
                 await self.run_once()
-            except Exception as e:  # the loop must survive anything
+                bo.reset()
+            except Exception as e:  # the loop must survive anything;
+                # a HARNESS failure (not a probe outcome — those are
+                # state) backs off with jitter instead of hammering a
+                # cluster that is clearly having a bad day
+                delay = bo.next()
                 weedlog.V(1, "canary").infof(
                     "probe round failed: %s: %s", type(e).__name__, e)
 

@@ -328,18 +328,23 @@ class MetricsPusher:
             self.gateway_url, self.failures, host, addrs)
 
     def _run(self) -> None:
+        # shared decorrelated-jitter backoff (utils/resilience.py): a
+        # fleet of pushers whose gateway died must NOT re-converge on
+        # one retry instant the way synchronized exponential delays do
+        from seaweedfs_tpu.utils.resilience import Backoff
+        bo = Backoff(base=self.interval, cap=self.max_backoff)
         delay = self.interval
         while not self._stop.wait(delay):
             if self.registry.push(self.gateway_url, self.job,
                                   pool=self.pool):
                 self.failures = 0
+                bo.reset()
                 delay = self.interval
             else:
                 self.failures += 1
                 if self.failures >= self.RE_RESOLVE_AFTER:
                     self._re_resolve()
-                delay = min(self.interval * (2 ** self.failures),
-                            self.max_backoff)
+                delay = bo.next()
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
@@ -399,6 +404,22 @@ HTTP_POOL_REUSE = REGISTRY.counter(
 HTTP_POOL_DIAL = REGISTRY.counter(
     "weedtpu_http_pool_dial_total",
     "pooled-client requests that dialed a fresh connection")
+# resilience layer (utils/resilience.py): every retry anywhere spends a
+# token from one process-wide budget — `denied` climbing under a fault
+# is the storm-damper working, not a bug.  Hedge outcomes and deadline
+# 504s complete the picture chaos tests assert on.
+RETRY_TOTAL = REGISTRY.counter(
+    "weedtpu_retry_total",
+    "retry-budget spends by traffic class and outcome (allowed/denied)",
+    ("class", "outcome"))
+HEDGE_TOTAL = REGISTRY.counter(
+    "weedtpu_hedge_total",
+    "hedged degraded-read outcomes (fired / hedge_won / primary_rescued)",
+    ("outcome",))
+DEADLINE_TIMEOUTS = REGISTRY.counter(
+    "weedtpu_deadline_timeouts_total",
+    "requests aborted with 504 by an expired deadline budget",
+    ("server",))
 # canary prober (stats/canary.py): synthetic write/read/delete probes
 # through each gateway path.  The class label holds the status bucket
 # (2xx/5xx) so the SLO engine's availability machinery evaluates probe

@@ -193,6 +193,13 @@ def response_bytes(resp) -> int:
         return 0
 
 
+# request-dict key marking that SOME response was prepared (headers on
+# the wire) for this request — the deadline enforcement in
+# trace.aiohttp_middleware reads it to decide between a clean 504 and
+# tearing the connection down mid-stream
+PREPARED_KEY = "weedtpu_response_prepared"
+
+
 def on_response_prepare(role: str):
     """aiohttp ``app.on_response_prepare`` hook: stamp this server's role
     on every reply (including prepared StreamResponses, which the
@@ -200,6 +207,7 @@ def on_response_prepare(role: str):
     label its recv bytes with the true peer role."""
     async def _prepare(req, resp) -> None:
         resp.headers[ROLE_HEADER] = role
+        req[PREPARED_KEY] = True
     return _prepare
 
 

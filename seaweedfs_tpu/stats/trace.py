@@ -40,7 +40,7 @@ from collections import OrderedDict
 from contextvars import ContextVar
 
 from seaweedfs_tpu.stats import heat, netflow
-from seaweedfs_tpu.utils import weedlog
+from seaweedfs_tpu.utils import resilience, weedlog
 
 TRACE_HEADER = "X-Weedtpu-Trace"
 
@@ -522,15 +522,64 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
                 tenant = "anonymous"
         tenant_token = heat.set_tenant(tenant) if tenant else None
         flow_token = netflow.set_class(flow_cls)
+        # deadline budget (utils/resilience.py): honor an incoming
+        # X-Weedtpu-Deadline always; apply the WEEDTPU_DEADLINE_MS edge
+        # default only to data-plane requests (internal plumbing and
+        # long-polls manage their own lifetimes).  The handler is
+        # aborted at expiry with a fast 504 — the "slow shard fetch
+        # can't eat the whole request" contract — and the root span is
+        # tagged op=timeout so the waterfall names the hop that died.
+        deadline_s = resilience.extract_deadline_s(req.headers)
+        if deadline_s is None and op != "internal" \
+                and req.path not in slow_exempt:
+            edge = resilience.default_deadline_ms()
+            if edge > 0:
+                deadline_s = edge / 1000.0
+        dl_token = resilience.set_deadline(
+            time.monotonic() + deadline_s) if deadline_s is not None \
+            else None
         rid = request_started(req.method, req.path_qs, req.remote,
                               t.trace_id if t is not None else None)
         start = time.time()
         t0 = time.perf_counter()
         status = 500
         cancelled = False
+        timed_out = False
         resp_obj = None
         try:
-            resp = await handler(req)
+            if dl_token is not None:
+                try:
+                    resp = await asyncio.wait_for(handler(req),
+                                                  timeout=deadline_s)
+                except (asyncio.TimeoutError,
+                        resilience.DeadlineExceeded) as te:
+                    # only OUR budget expiring is a deadline 504: a
+                    # timeout escaping the handler with budget still on
+                    # the clock (an upstream session timeout, a futures
+                    # timeout) is that code path's own failure and must
+                    # surface as such, not masquerade as budget expiry
+                    rem = resilience.remaining()
+                    if not isinstance(te, resilience.DeadlineExceeded) \
+                            and rem is not None and rem > 0.01:
+                        raise
+                    timed_out = True
+                    from seaweedfs_tpu.stats import metrics as _metrics
+                    _metrics.DEADLINE_TIMEOUTS.labels(role).inc()
+                    if req.get(netflow.PREPARED_KEY):
+                        # a StreamResponse already put headers on the
+                        # wire: a fresh 504 can't be delivered — tear
+                        # the connection down so the client fails NOW
+                        # instead of waiting out the stream
+                        if req.transport is not None:
+                            req.transport.close()
+                        raise ConnectionResetError(
+                            "deadline exceeded mid-stream") from None
+                    resp = web.json_response(
+                        {"error": "deadline exceeded",
+                         "budget_ms": round(deadline_s * 1000.0, 1)},
+                        status=504)
+            else:
+                resp = await handler(req)
             status = resp.status
             resp_obj = resp
             return resp
@@ -542,14 +591,23 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
                 BrokenPipeError):
             # the client hung up (cancelled handler, or resp.write onto
             # a closed transport): a fact about the caller, not a server
-            # error — trace it if sampled, never retro-keep or slow-log
-            cancelled = True
+            # error — trace it if sampled, never retro-keep or slow-log.
+            # EXCEPT the mid-stream deadline teardown we raised
+            # ourselves just above: that one is the SERVER failing the
+            # request and must count as a 5xx in the availability SLO
+            # exactly like the pre-headers 504 does
+            if timed_out:
+                status = 504
+            else:
+                cancelled = True
             raise
         finally:
             ms = (time.perf_counter() - t0) * 1000.0
             request_finished(rid)
             if token is not None:
                 _current.reset(token)
+            if dl_token is not None:
+                resilience.reset_deadline(dl_token)
             netflow.reset(flow_token)
             if tenant_token is not None:
                 heat.reset_tenant(tenant_token)
@@ -599,6 +657,10 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
                          "status": status, "server": role}
                 if cancelled:
                     attrs["cancelled"] = True
+                if timed_out:
+                    # the waterfall's "this hop ran out of budget" mark
+                    attrs["op"] = "timeout"
+                    attrs["budget_ms"] = round(deadline_s * 1000.0, 1)
                 record_span(f"{role}.request", t.trace_id, t.span_id,
                             parent_id, start, ms, attrs, errored)
             elif rate > 0 and (slow or errored):
@@ -606,11 +668,14 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
                 # appears retroactively (children were skipped, but the
                 # trace id in the log line finds it in /debug/traces)
                 retro = t or Trace(_new_trace_id(), _new_span_id(), True)
+                retro_attrs = {"method": req.method, "path": req.path,
+                               "status": status, "server": role,
+                               "retro": True}
+                if timed_out:
+                    retro_attrs["op"] = "timeout"
                 record_span(f"{role}.request", retro.trace_id,
                             retro.span_id, None, start, ms,
-                            {"method": req.method, "path": req.path,
-                             "status": status, "server": role,
-                             "retro": True}, errored)
+                            retro_attrs, errored)
                 t = retro
             if slow and rate > 0:
                 weedlog.info(

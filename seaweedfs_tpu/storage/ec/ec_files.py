@@ -171,6 +171,12 @@ def write_ec_files(base: str, dat_path: str | None = None,
     dat_size = os.path.getsize(dat_path)
     codec = _get_codec()
 
+    # chaos hook: an armed shard_write_error fault (maintenance/faults)
+    # fails the encode exactly like a dying disk would — before any tmp
+    # shard file exists, so the previous valid shard set stays intact
+    from seaweedfs_tpu.maintenance import faults as _faults
+    _faults.check_shard_write(base)
+
     tmp_paths = [base + layout.to_ext(i) + ".tmp"
                  for i in range(layout.TOTAL_SHARDS)]
     # O_RDWR without O_TRUNC: recycle pages of stale tmp files (see above);
@@ -1005,6 +1011,9 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     if len(present) < layout.DATA_SHARDS:
         raise ValueError(
             f"need >= {layout.DATA_SHARDS} shards to rebuild, have {len(present)}")
+    # chaos hook: fail like a dying disk BEFORE tmp shard files exist
+    from seaweedfs_tpu.maintenance import faults as _faults
+    _faults.check_shard_write(base)
     codec = _get_codec()
     use = present[: layout.DATA_SHARDS]
     shard_size = os.path.getsize(base + layout.to_ext(use[0]))

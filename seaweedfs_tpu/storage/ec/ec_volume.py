@@ -28,13 +28,17 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
-from concurrent.futures import ThreadPoolExecutor, as_completed
+from concurrent.futures import (ThreadPoolExecutor,
+                                TimeoutError as _FutTimeout,
+                                as_completed, wait as _futures_wait)
 from typing import Callable
 
 import numpy as np
 
 from seaweedfs_tpu.stats import heat, trace
+from seaweedfs_tpu.utils import resilience
 from seaweedfs_tpu.storage import idx as idxf
 from seaweedfs_tpu.storage import needle as ndl
 from seaweedfs_tpu.storage import types as t
@@ -227,6 +231,14 @@ class EcVolume:
                 return False
             return any(off < offset + size and offset < off + sz
                        for off, sz in ranges)
+
+    def clear_quarantine(self, shard_id: int) -> None:
+        """Forget a shard's quarantined ranges — called when the shard
+        FILE is deleted (purged corrupt, or lost): the verdict named
+        bytes in a file that no longer exists, and a freshly rebuilt or
+        re-copied replacement must not inherit it."""
+        with self._quarantine_lock:
+            self._quarantine.pop(shard_id, None)
 
     def quarantine_snapshot(self) -> dict[str, list[list[int]]]:
         with self._quarantine_lock:
@@ -475,31 +487,106 @@ class EcVolume:
                         failed.append(ri)
             lsp.set(missed=len(failed))
         # remote fetch of whatever the local disks couldn't serve — on a
-        # throwaway pool so a hung peer can't starve the shared pread pool
+        # throwaway pool so a hung peer can't starve the shared pread
+        # pool.  The wait is HEDGED (utils/resilience.py): after a
+        # p99-informed delay, ranges still in flight are handed to
+        # reconstruction from OTHER survivors — a slow-but-alive peer
+        # then costs the hedge delay plus one decode, not its full
+        # latency.  Completions that beat the cutoff feed the latency
+        # tracker; abandoned fetches do not (they would teach the
+        # tracker that slow is normal and quietly disable hedging).
+        pending: dict = {}  # abandoned primary future -> read index
         if failed and shard_reader is not None:
             still: list[int] = []
-            with trace.span("ec.remote_fetch", reads=len(failed)) as rsp:
+            hedge_s = resilience.hedge_delay_s()
+
+            def timed_fetch(sid: int, off: int, size: int):
+                t0 = time.perf_counter()
+                return shard_reader(sid, off, size), \
+                    time.perf_counter() - t0
+
+            def collect(fut, ri) -> None:
+                res = None if fut.exception() else fut.result()
+                data = res[0] if res else None
+                if data is not None and len(data) == reads[ri][2]:
+                    blobs[ri] = data
+                    self._bump("remote_shard_reads")
+                    if hedge_s is not None:
+                        # only completions that BEAT a hedge cutoff may
+                        # teach the tracker: with hedging off there is
+                        # no cutoff, and feeding unfiltered (possibly
+                        # slow-peer) latencies here would raise the
+                        # hedge delay toward exactly the latency it
+                        # exists to cut
+                        resilience.SHARD_FETCH.observe(res[1])
+                else:
+                    still.append(ri)
+
+            with trace.span("ec.remote_fetch", reads=len(failed),
+                            hedge_ms=None if hedge_s is None else
+                            round(hedge_s * 1000.0, 1)) as rsp:
                 rpool = ThreadPoolExecutor(max_workers=min(8, len(failed)))
+                futs = {rpool.submit(timed_fetch, *reads[ri][:3]): ri
+                        for ri in failed}
                 try:
-                    futs = {rpool.submit(shard_reader, *reads[ri][:3]): ri
-                            for ri in failed}
-                    for fut in as_completed(futs):
-                        ri = futs[fut]
-                        data = None if fut.exception() else fut.result()
-                        if data is not None and len(data) == reads[ri][2]:
-                            blobs[ri] = data
-                            self._bump("remote_shard_reads")
-                        else:
-                            still.append(ri)
+                    if hedge_s is None:
+                        for fut in as_completed(futs):
+                            collect(fut, futs[fut])
+                    else:
+                        done, not_done = _futures_wait(set(futs),
+                                                       timeout=hedge_s)
+                        for fut in done:
+                            collect(fut, futs[fut])
+                        if not_done:
+                            from seaweedfs_tpu.stats import metrics
+                            metrics.HEDGE_TOTAL.labels("fired").inc()
+                            for fut in not_done:
+                                pending[fut] = futs[fut]
+                                still.append(futs[fut])
                 finally:
-                    rpool.shutdown(wait=False, cancel_futures=True)
+                    # when hedging left primaries in flight, do NOT
+                    # cancel them: reconstruction may find too few
+                    # survivors and need to fall back to whichever
+                    # primary eventually answers
+                    rpool.shutdown(wait=False,
+                                   cancel_futures=not pending)
                 rsp.set(missed=len(still))
             failed = still
         # one-shot batched reconstruction of every range still missing
         if failed:
             failed.sort()
             keys = [tuple(reads[ri][:3]) for ri in failed]
-            rebuilt = self._reconstruct_ranges(keys, shard_reader)
+            try:
+                rebuilt = self._reconstruct_ranges(keys, shard_reader)
+            except IOError:
+                if not pending:
+                    raise
+                # the hedge lost its bet — too few survivors to decode —
+                # so the abandoned primary fetches are the only source
+                # left: wait them out (deadline-bounded) and decode
+                # whatever still misses afterwards
+                from seaweedfs_tpu.stats import metrics
+                metrics.HEDGE_TOTAL.labels("primary_rescued").inc()
+                try:
+                    for fut in as_completed(
+                            list(pending),
+                            timeout=resilience.clamp_timeout(30.0)):
+                        ri = pending[fut]
+                        res = None if fut.exception() else fut.result()
+                        data = res[0] if res else None
+                        if data is not None and len(data) == reads[ri][2]:
+                            blobs[ri] = data
+                            self._bump("remote_shard_reads")
+                except (_FutTimeout, TimeoutError):
+                    pass
+                failed = [ri for ri in failed if ri not in blobs]
+                rebuilt = self._reconstruct_ranges(
+                    [tuple(reads[ri][:3]) for ri in failed],
+                    shard_reader) if failed else []
+            else:
+                if pending:
+                    from seaweedfs_tpu.stats import metrics
+                    metrics.HEDGE_TOTAL.labels("hedge_won").inc()
             for ri, data in zip(failed, rebuilt):
                 blobs[ri] = data
         parts: list[bytes | None] = [None] * len(plan)

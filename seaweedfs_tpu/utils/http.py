@@ -11,6 +11,7 @@ import urllib.parse
 from seaweedfs_tpu.stats import heat as _heat
 from seaweedfs_tpu.stats import netflow as _netflow
 from seaweedfs_tpu.stats import trace as _trace
+from seaweedfs_tpu.utils import resilience as _res
 
 
 def aiohttp_trace_config(role: str | None = None):
@@ -42,6 +43,22 @@ def aiohttp_trace_config(role: str | None = None):
             ctx.send_attrs, error)
 
     async def _on_request_start(session, ctx, params) -> None:
+        # chaos hooks: partition / error-rate / latency toward this peer
+        # (no-ops — one module-global truthiness test — unless a fault
+        # is armed, so the steady-state request path pays nothing)
+        from seaweedfs_tpu.maintenance import faults as _faults
+        if _faults.NET_ACTIVE:
+            import aiohttp as _aio
+            import asyncio as _asyncio
+            netloc = f"{params.url.host}:{params.url.port}"
+            try:
+                lat = _faults.check_net(role or "client", netloc)
+            except OSError as e:
+                raise _aio.ClientConnectionError(str(e)) from None
+            if lat > 0:
+                await _asyncio.sleep(lat)
+        # deadline budget: each hop forwards only what remains
+        _res.inject_deadline(params.headers)
         t = _trace.current()
         ctx.send_span = None
         if t is not None:
@@ -197,8 +214,19 @@ class _RawConn:
             out.append("Content-Length: 0\r\n")
         out.append("\r\n")
         req = "".join(out).encode("latin-1")
-        # one sendall for headers+body keeps small uploads to one syscall
-        self.sock.sendall(req + body if body is not None else req)
+        try:
+            # one sendall for headers+body keeps small uploads to one
+            # syscall
+            self.sock.sendall(req + body if body is not None else req)
+        except ConnectionError as e:
+            # the kernel refused the send outright (EPIPE/ECONNRESET on
+            # a connection the peer already closed): the request never
+            # reached the peer application, so even a non-idempotent
+            # replay is safe — PooledHTTP's retry logic keys off this.
+            # A send TIMEOUT deliberately does NOT qualify: bytes may be
+            # partially on the wire.
+            e._weedtpu_send_phase = True  # type: ignore[attr-defined]
+            raise
         version, status, hdrs = self._read_head()
         while status == 100:  # 100-continue: parse the real response
             version, status, hdrs = self._read_head()
@@ -346,18 +374,34 @@ class PooledHTTP:
         if not parked:
             conn.close()
 
+    # methods safe to replay after a mid-flight transport failure (the
+    # peer may have processed the first copy)
+    IDEMPOTENT = frozenset({"GET", "HEAD", "DELETE"})
+
     def request(self, url: str, method: str = "GET", body=None,
                 headers: dict | None = None,
                 timeout: float | None = None) -> tuple[int, dict, bytes]:
         """-> (status, response headers [lowercased keys], body bytes).
         Never raises for HTTP error statuses — only for transport
-        failures."""
+        failures.
+
+        Stale-keep-alive retry policy: a request on a REUSED connection
+        that dies is retried once on a fresh dial — but only when the
+        replay is provably safe: idempotent methods always, anything
+        else only when the send itself failed at the kernel (the request
+        never reached the peer application) AND the process-wide retry
+        budget grants a token, so a dead peer can't turn N writers into
+        a retry storm."""
         u = urllib.parse.urlsplit(url)
         key = (u.scheme, u.netloc)
         path = u.path or "/"
         if u.query:
             path += "?" + u.query
         tmo = self.timeout if timeout is None else timeout
+        # ambient deadline budget: clamp the socket timeout and forward
+        # the remainder to the peer
+        _res.check_deadline(f"{method} {u.netloc}{u.path}")
+        tmo = _res.clamp_timeout(tmo)
         if isinstance(body, (bytearray, memoryview)):
             body = bytes(body)
         elif isinstance(body, str):
@@ -370,18 +414,41 @@ class PooledHTTP:
             _trace.inject(headers)
         _netflow.inject(headers, u.path or "/", self.role)
         _heat.inject(headers)
+        _res.inject_deadline(headers)
         flow_cls = headers.get(_netflow.CLASS_HEADER)
+        # chaos hooks (armed-fault-only) + per-peer circuit breaker: a
+        # tripped peer fast-fails instead of costing every caller its
+        # full connect timeout
+        from seaweedfs_tpu.maintenance import faults as _faults
+        if _faults.NET_ACTIVE:
+            lat = _faults.check_net(self.role, u.netloc)
+            if lat > 0:
+                time.sleep(lat)
+        breaker = _res.breaker_for(u.netloc) if _res.breaker_enabled() \
+            else None
+        if breaker is not None and not breaker.allow():
+            raise ConnectionRefusedError(
+                f"circuit open to {u.netloc} "
+                f"({breaker.failures} consecutive failures)")
         # lazy: stats.metrics imports stats.trace, which this module
         # also imports — binding at call time keeps startup order free
         from seaweedfs_tpu.stats import metrics as _metrics
         last: Exception | None = None
         for attempt in range(2):
-            if attempt:
-                # the retry must DIAL, not pop another idle connection —
-                # a restarted peer leaves every pooled socket stale
-                conn, reused = self._connect(key[0], key[1], tmo), False
-            else:
-                conn, reused = self._get_conn(key, tmo)
+            try:
+                if attempt:
+                    # the retry must DIAL, not pop another idle
+                    # connection — a restarted peer leaves every pooled
+                    # socket stale
+                    conn, reused = self._connect(key[0], key[1], tmo), \
+                        False
+                else:
+                    conn, reused = self._get_conn(key, tmo)
+            except OSError:
+                # a failed DIAL is always a real peer signal
+                if breaker is not None:
+                    breaker.record(False)
+                raise
             (_metrics.HTTP_POOL_REUSE if reused
              else _metrics.HTTP_POOL_DIAL).labels().inc()
             try:
@@ -395,9 +462,29 @@ class PooledHTTP:
                 if isinstance(e, ValueError):
                     e = _BadResponse(str(e))
                 last = e
-                if reused:  # stale idle connection: retry on a fresh one
-                    continue
+                if reused:
+                    # stale idle connection: retry on a fresh dial, but
+                    # only when replay is safe — idempotent methods, or a
+                    # send the kernel rejected outright (never reached
+                    # the peer application), and then only with a retry-
+                    # budget token (non-idempotent replays are exactly
+                    # where a storm multiplies)
+                    if method in self.IDEMPOTENT:
+                        continue
+                    if getattr(e, "_weedtpu_send_phase", False) and \
+                            _res.spend_retry(flow_cls or "data"):
+                        continue
+                if breaker is not None and \
+                        (not reused or breaker.state != "closed"):
+                    # a FRESH connection failing is a real peer signal
+                    # (a stale keep-alive dying is routine churn) — but
+                    # a non-closed breaker must always see the outcome,
+                    # or an in-flight half-open probe dying on a stale
+                    # conn would leave the probe slot dangling
+                    breaker.record(False)
                 raise e from None
+            if breaker is not None:
+                breaker.record(True)
             if keep:
                 self._put_conn(key, conn)
             else:

@@ -1,0 +1,489 @@
+"""Unified resilience layer: deadlines, retry budgets, hedging, breakers.
+
+Five PRs of observability (tracing, SLO burn rates, canary probes, byte
+ledger, heat) can SEE a slow shard fetch eat a whole request, a down
+node trigger a retry storm, or a dead peer stall every fan-out — this
+module is the machinery that stops those failure modes, in one place,
+so every hand-rolled backoff loop and ad-hoc timeout in the tree rides
+the same policy:
+
+- **Deadline budgets** — a per-request time budget carried in a
+  contextvar and propagated cross-process as ``X-Weedtpu-Deadline``
+  (remaining milliseconds, re-stamped at every client hop so each hop
+  sees only what's left).  The server middleware (stats/trace.py)
+  extracts it and aborts the handler with a 504 when it expires;
+  clients clamp their socket timeouts to the remaining budget so a
+  filer→volume→peer chain can never outlive the caller's patience.
+  ``WEEDTPU_DEADLINE_MS`` sets an edge default for data-plane requests
+  that arrive without one (0 = off, the default).
+
+- **Retry budget** — a process-wide token bucket per traffic class
+  (``WEEDTPU_RETRY_BUDGET`` = "rate:burst" tokens/sec, default 2:8).
+  Every retry anywhere must spend a token; a 100%-failing peer then
+  costs bounded extra load instead of a multiplicative storm.  Spends
+  surface as ``weedtpu_retry_total{class,outcome}``.
+
+- **Backoff** — decorrelated-jitter delays (the AWS "decorrelated
+  jitter" curve: sleep = min(cap, uniform(base, 3*prev))), as a
+  stateful ``Backoff`` for daemon loops and a stateless
+  ``backoff_delay`` for per-key retry maps.  One implementation
+  replaces the ~6 hand-rolled exponential loops that predated it.
+
+- **Hedged reads** — a rolling latency window per operation
+  (``LatencyTracker``) whose ``hedge_delay_s`` answers "how long is
+  suspiciously long": the p-``WEEDTPU_HEDGE_PCT`` (default 99) of
+  recent completions, clamped to [``WEEDTPU_HEDGE_MIN_MS``,
+  ``WEEDTPU_HEDGE_MAX_MS``].  The EC degraded-read engine waits that
+  long for remote shard fetches, then launches reconstruction from
+  other survivors and takes whichever finishes first.  ``PCT=0``
+  disables hedging.
+
+- **Circuit breakers** — per-peer consecutive-transport-failure
+  breakers (trip at ``WEEDTPU_BREAKER_THRESHOLD``, half-open probe
+  after ``WEEDTPU_BREAKER_COOLDOWN`` seconds with jitter).  PooledHTTP
+  consults them before dialing, so a partitioned peer costs its first
+  few callers one timeout each and every later caller nothing.  The
+  registry snapshot feeds the master's health surface and the shell's
+  ``chaos.status``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import deque
+from contextvars import ContextVar
+
+DEADLINE_HEADER = "X-Weedtpu-Deadline"  # remaining budget, milliseconds
+
+_rand = random.Random()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+# -- deadlines -----------------------------------------------------------
+
+class DeadlineExceeded(TimeoutError):
+    """Raised when a call would start (or continue) past its budget.
+    A TimeoutError — and therefore an OSError — so every transport
+    error handler in the tree already treats it correctly."""
+
+
+_deadline: ContextVar[float | None] = ContextVar("weedtpu_deadline",
+                                                 default=None)
+
+
+def default_deadline_ms() -> float:
+    """Edge default applied by the server middleware to data-plane
+    requests that arrive without a deadline header (0 = off)."""
+    return _env_float("WEEDTPU_DEADLINE_MS", 0.0)
+
+
+def deadline() -> float | None:
+    """The ambient absolute deadline (time.monotonic() clock), if any."""
+    return _deadline.get()
+
+
+def set_deadline(abs_monotonic: float | None):
+    """Set the ambient deadline; returns the reset token."""
+    return _deadline.set(abs_monotonic)
+
+
+def reset_deadline(token) -> None:
+    _deadline.reset(token)
+
+
+def remaining() -> float | None:
+    """Seconds left in the ambient budget (may be <= 0), or None when
+    no deadline is set."""
+    d = _deadline.get()
+    if d is None:
+        return None
+    return d - time.monotonic()
+
+
+def clamp_timeout(timeout: float, floor: float = 0.001) -> float:
+    """A socket timeout that respects the ambient budget: min(timeout,
+    remaining), floored so a just-expired budget raises from the I/O
+    layer instead of passing 0/negative to the socket."""
+    rem = remaining()
+    if rem is None:
+        return timeout
+    return max(floor, min(timeout, rem))
+
+
+def check_deadline(what: str = "call") -> None:
+    """Raise DeadlineExceeded when the ambient budget is spent."""
+    rem = remaining()
+    if rem is not None and rem <= 0:
+        raise DeadlineExceeded(f"deadline exceeded before {what}")
+
+
+def inject_deadline(headers: dict) -> dict:
+    """Stamp the REMAINING budget (ms) onto outgoing headers — each hop
+    re-stamps, so the receiver sees the budget net of time already
+    spent upstream (clock-skew-free: the wire carries a duration, not
+    a timestamp)."""
+    rem = remaining()
+    if rem is not None:
+        headers[DEADLINE_HEADER] = str(max(1, int(rem * 1000)))
+    return headers
+
+
+def extract_deadline_s(headers) -> float | None:
+    """Parse the incoming deadline header into remaining seconds."""
+    raw = headers.get(DEADLINE_HEADER)
+    if not raw:
+        return None
+    try:
+        return max(0.0, float(raw) / 1000.0)
+    except ValueError:
+        return None
+
+
+# -- retry budget --------------------------------------------------------
+
+class RetryBudget:
+    """Token bucket shared by every retry site in the process, keyed by
+    traffic class: `rate` tokens/s refill up to `burst` per class.  The
+    point is the STORM bound — with N callers retrying against a dead
+    peer, total extra load is rate*t + burst, independent of N."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens: dict[str, tuple[float, float]] = {}  # cls -> (tokens, ts)
+        self._lock = threading.Lock()
+
+    def try_spend(self, cls: str, n: float = 1.0) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            tokens, last = self._tokens.get(cls, (self.burst, now))
+            tokens = min(self.burst, tokens + (now - last) * self.rate)
+            if tokens >= n:
+                self._tokens[cls] = (tokens - n, now)
+                return True
+            self._tokens[cls] = (tokens, now)
+            return False
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {"rate": self.rate, "burst": self.burst,
+                    "classes": {
+                        cls: round(min(self.burst,
+                                       tokens + (now - last) * self.rate), 2)
+                        for cls, (tokens, last) in self._tokens.items()}}
+
+
+_BUDGET: RetryBudget | None = None
+_BUDGET_LOCK = threading.Lock()
+
+
+def retry_budget() -> RetryBudget:
+    global _BUDGET
+    b = _BUDGET
+    if b is None:
+        with _BUDGET_LOCK:
+            b = _BUDGET
+            if b is None:
+                spec = os.environ.get("WEEDTPU_RETRY_BUDGET", "2:8")
+                rate_s, _, burst_s = spec.partition(":")
+                try:
+                    rate = float(rate_s)
+                except ValueError:
+                    rate = 2.0
+                try:
+                    burst = float(burst_s) if burst_s else max(4.0, rate * 4)
+                except ValueError:
+                    burst = 8.0
+                b = _BUDGET = RetryBudget(rate, burst)
+    return b
+
+
+def reset_retry_budget() -> None:
+    """Test hook: re-read WEEDTPU_RETRY_BUDGET on next use."""
+    global _BUDGET
+    with _BUDGET_LOCK:
+        _BUDGET = None
+
+
+def spend_retry(cls: str) -> bool:
+    """One retry permit for traffic class `cls`, booked into
+    weedtpu_retry_total{class,outcome} either way."""
+    ok = retry_budget().try_spend(cls or "default")
+    # lazy: stats.metrics imports utils.http which may import this module
+    from seaweedfs_tpu.stats import metrics as _metrics
+    _metrics.RETRY_TOTAL.labels(cls or "default",
+                                "allowed" if ok else "denied").inc()
+    return ok
+
+
+# -- backoff -------------------------------------------------------------
+
+def backoff_delay(attempt: int, base: float = 0.5, cap: float = 60.0,
+                  rng: random.Random | None = None) -> float:
+    """Stateless decorrelated-ish jitter for per-key retry maps:
+    uniform(base, base * 3**attempt), capped.  attempt counts from 1."""
+    r = rng or _rand
+    hi = min(cap, base * (3.0 ** max(1, attempt)))
+    return min(cap, r.uniform(base, max(base, hi)))
+
+
+class Backoff:
+    """Stateful decorrelated-jitter backoff for daemon loops
+    (sleep_n+1 = min(cap, uniform(base, 3 * sleep_n))); reset() after a
+    success restores the base delay."""
+
+    def __init__(self, base: float = 0.5, cap: float = 60.0,
+                 rng: random.Random | None = None):
+        self.base = base
+        self.cap = cap
+        self._rng = rng or _rand
+        self._sleep = 0.0
+
+    def next(self) -> float:
+        prev = self._sleep or self.base
+        self._sleep = min(self.cap,
+                          self._rng.uniform(self.base, prev * 3.0))
+        return self._sleep
+
+    def reset(self) -> None:
+        self._sleep = 0.0
+
+
+def retry_call(fn, *, attempts: int = 4, base: float = 0.5,
+               cap: float = 30.0, cls: str = "default",
+               retry_on: tuple = (OSError,), giveup=None,
+               sleep=time.sleep):
+    """Run `fn()` with budgeted, deadline-aware, jittered retries.
+
+    The first attempt is free; each RETRY must win a token from the
+    process-wide retry budget (spend_retry) — when the budget is dry the
+    last error raises immediately, which is exactly the storm-damping
+    contract.  `giveup(exc) -> bool` short-circuits errors that will
+    not heal by retrying (4xx-shaped failures).  An ambient deadline
+    bounds the total: no retry starts after it, and no sleep runs past
+    it."""
+    bo = Backoff(base, cap)
+    last: BaseException | None = None
+    for attempt in range(max(1, attempts)):
+        if attempt:
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                break
+            if not spend_retry(cls):
+                break
+            delay = bo.next()
+            if rem is not None:
+                delay = min(delay, max(0.0, rem))
+            sleep(delay)
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            if giveup is not None and giveup(e):
+                raise
+    assert last is not None
+    raise last
+
+
+async def retry_async(fn, *, attempts: int = 4, base: float = 0.5,
+                      cap: float = 30.0, cls: str = "default",
+                      retry_on: tuple = (OSError,), giveup=None):
+    """retry_call for coroutine factories (`fn()` -> awaitable)."""
+    import asyncio
+    bo = Backoff(base, cap)
+    last: BaseException | None = None
+    for attempt in range(max(1, attempts)):
+        if attempt:
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                break
+            if not spend_retry(cls):
+                break
+            delay = bo.next()
+            if rem is not None:
+                delay = min(delay, max(0.0, rem))
+            await asyncio.sleep(delay)
+        try:
+            return await fn()
+        except retry_on as e:
+            last = e
+            if giveup is not None and giveup(e):
+                raise
+    assert last is not None
+    raise last
+
+
+# -- hedging -------------------------------------------------------------
+
+class LatencyTracker:
+    """Bounded rolling window of completion latencies feeding the hedge
+    delay.  Only PRIMARY completions that beat the hedge cutoff should
+    be observed — folding in latencies of fetches the hedge abandoned
+    would teach the tracker that slow is normal and quietly disable
+    hedging exactly when it pays."""
+
+    def __init__(self, window: int = 256):
+        self._lat: deque[float] = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append(seconds)
+
+    def percentile(self, q: float) -> float | None:
+        with self._lock:
+            vals = sorted(self._lat)
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def __len__(self) -> int:
+        return len(self._lat)
+
+
+# recent successful remote EC shard-fetch latencies (ec_volume feeds it)
+SHARD_FETCH = LatencyTracker()
+
+
+def reset_latency_trackers() -> None:
+    """Test hook: forget the shard-fetch latency window."""
+    with SHARD_FETCH._lock:
+        SHARD_FETCH._lat.clear()
+
+
+def hedge_pct() -> float:
+    return _env_float("WEEDTPU_HEDGE_PCT", 99.0)
+
+
+def hedge_delay_s(tracker: LatencyTracker | None = None) -> float | None:
+    """How long to wait for a remote fetch before hedging, or None when
+    hedging is disabled (WEEDTPU_HEDGE_PCT <= 0)."""
+    pct = hedge_pct()
+    if pct <= 0:
+        return None
+    lo = _env_float("WEEDTPU_HEDGE_MIN_MS", 25.0) / 1000.0
+    hi = _env_float("WEEDTPU_HEDGE_MAX_MS", 1000.0) / 1000.0
+    p = (tracker or SHARD_FETCH).percentile(min(1.0, pct / 100.0))
+    if p is None:
+        p = 0.05  # no history yet: a conservative first guess
+    return max(lo, min(hi, p))
+
+
+# -- circuit breakers ----------------------------------------------------
+
+def breaker_enabled() -> bool:
+    return os.environ.get("WEEDTPU_BREAKER", "1") != "0"
+
+
+class CircuitBreaker:
+    """Per-peer breaker: `threshold` CONSECUTIVE transport failures trip
+    it open; after `cooldown` (jittered ±25%) one half-open probe is
+    admitted — success closes, failure re-opens.  HTTP error statuses
+    are NOT failures (the peer answered); only transport-level failures
+    count, so a 500-ing but reachable server keeps taking traffic."""
+
+    __slots__ = ("threshold", "cooldown", "state", "failures",
+                 "_open_until", "_probing", "_probe_at", "_lock", "trips")
+
+    def __init__(self, threshold: float | None = None,
+                 cooldown: float | None = None):
+        self.threshold = int(threshold if threshold is not None else
+                             _env_float("WEEDTPU_BREAKER_THRESHOLD", 5))
+        self.cooldown = (cooldown if cooldown is not None else
+                         _env_float("WEEDTPU_BREAKER_COOLDOWN", 2.0))
+        self.state = "closed"
+        self.failures = 0
+        self.trips = 0
+        self._open_until = 0.0
+        self._probing = False
+        self._probe_at = 0.0
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self.state == "closed":
+                return True
+            now = time.monotonic()
+            if self.state == "open":
+                if now < self._open_until:
+                    return False
+                self.state = "half_open"
+                self._probing = True
+                self._probe_at = now
+                return True
+            # half_open: one probe at a time — but a probe whose caller
+            # died without record()ing (an exception path, a killed
+            # thread) must not wedge the breaker shut forever; after a
+            # cooldown the probe slot is forfeit and the next caller
+            # takes it over
+            if self._probing and now - self._probe_at < self.cooldown:
+                return False
+            self._probing = True
+            self._probe_at = now
+            return True
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            self._probing = False
+            if ok:
+                self.failures = 0
+                self.state = "closed"
+                return
+            self.failures += 1
+            if self.state == "half_open" or self.failures >= self.threshold:
+                self.state = "open"
+                self.trips += 1
+                self._open_until = time.monotonic() + \
+                    self.cooldown * _rand.uniform(0.75, 1.25)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap = {"state": self.state, "failures": self.failures,
+                    "trips": self.trips}
+            if self.state == "open":
+                snap["open_for_s"] = round(
+                    max(0.0, self._open_until - time.monotonic()), 2)
+            return snap
+
+
+_breakers: dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(peer: str) -> CircuitBreaker:
+    br = _breakers.get(peer)
+    if br is None:
+        with _breakers_lock:
+            br = _breakers.get(peer)
+            if br is None:
+                br = _breakers[peer] = CircuitBreaker()
+                # bound: peers are cluster nodes, but the key is caller-
+                # supplied — drop the oldest entry past a sane fleet size
+                while len(_breakers) > 1024:
+                    _breakers.pop(next(iter(_breakers)))
+    return br
+
+
+def breakers_snapshot() -> dict[str, dict]:
+    """Non-closed breakers (plus recently-failing closed ones): the
+    master's health surface and `chaos.status` render this."""
+    with _breakers_lock:
+        items = list(_breakers.items())
+    return {peer: br.snapshot() for peer, br in items
+            if br.state != "closed" or br.failures or br.trips}
+
+
+def reset_breakers() -> None:
+    """Test hook: forget every peer's breaker state."""
+    with _breakers_lock:
+        _breakers.clear()

@@ -40,6 +40,23 @@ def pytest_configure(config):
         "(-m 'not slow')")
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    """Per-peer circuit breakers and the chaos fault registry are
+    process-global (keyed by netloc); without a reset, a test that
+    killed a server could leave its port's breaker open for the next
+    test that happens to draw the same free port."""
+    yield
+    from seaweedfs_tpu.maintenance import faults
+    from seaweedfs_tpu.utils import resilience
+    resilience.reset_breakers()
+    resilience.reset_latency_trackers()
+    faults.clear_net()
+
+
 def reference_fixture(relpath: str) -> pathlib.Path | None:
     """Path to a binary test fixture inside the read-only reference checkout,
     or None when the reference isn't mounted (tests then skip the golden

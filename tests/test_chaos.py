@@ -1,0 +1,658 @@
+"""Chaos-driven scenario matrix + resilience layer (deadlines, retry
+budgets, hedged reads, breakers, partition/crash faults).
+
+Tier-1 keeps one fast smoke scenario (restart during degraded reads),
+the deadline-propagation and retry-storm guarantees, and the resilience
+unit layer; the full workload×fault matrix and the timing-sensitive
+hedging gate run under ``-m slow``."""
+
+import io
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.maintenance import chaos, faults
+from seaweedfs_tpu.maintenance.chaos import (ChaosCluster, FAULTS,
+                                             WORKLOADS, encode_all_volumes,
+                                             free_port, fsck_report,
+                                             run_scenario)
+from seaweedfs_tpu.stats import metrics
+from seaweedfs_tpu.utils import resilience
+
+
+# ---- resilience unit layer ---------------------------------------------
+
+
+def test_deadline_header_roundtrip():
+    tok = resilience.set_deadline(time.monotonic() + 0.5)
+    try:
+        headers: dict = {}
+        resilience.inject_deadline(headers)
+        ms = int(headers[resilience.DEADLINE_HEADER])
+        assert 0 < ms <= 500
+        assert 0.0 < resilience.extract_deadline_s(headers) <= 0.5
+        assert 0.0 < resilience.clamp_timeout(30.0) <= 0.5
+    finally:
+        resilience.reset_deadline(tok)
+    assert resilience.remaining() is None
+    assert resilience.clamp_timeout(30.0) == 30.0
+    assert resilience.extract_deadline_s({}) is None
+
+
+def test_deadline_expiry_raises():
+    tok = resilience.set_deadline(time.monotonic() - 0.01)
+    try:
+        with pytest.raises(resilience.DeadlineExceeded):
+            resilience.check_deadline("unit test")
+        # DeadlineExceeded must walk like the transport errors callers
+        # already handle
+        assert issubclass(resilience.DeadlineExceeded, OSError)
+    finally:
+        resilience.reset_deadline(tok)
+
+
+def test_backoff_decorrelated_jitter_bounds():
+    bo = resilience.Backoff(base=0.1, cap=2.0)
+    prev = 0.1
+    for _ in range(50):
+        d = bo.next()
+        assert 0.1 <= d <= 2.0
+        assert d <= max(prev * 3.0, 0.1) + 1e-9
+        prev = d
+    bo.reset()
+    assert bo.next() <= 0.3 + 1e-9  # back to uniform(base, 3*base)
+    for n in range(1, 10):
+        d = resilience.backoff_delay(n, base=0.5, cap=10.0)
+        assert 0.5 <= d <= 10.0
+
+
+def test_circuit_breaker_trip_halfopen_close():
+    br = resilience.CircuitBreaker(threshold=3, cooldown=0.05)
+    assert br.allow()
+    for _ in range(3):
+        br.record(False)
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.08)
+    assert br.allow()  # half-open probe
+    assert not br.allow()  # only one probe at a time
+    br.record(False)  # probe failed: re-open
+    assert br.state == "open"
+    time.sleep(0.08)
+    assert br.allow()
+    br.record(True)
+    assert br.state == "closed" and br.allow()
+    assert br.snapshot()["trips"] == 2
+
+
+def test_retry_budget_caps_spend(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_RETRY_BUDGET", "1:3")
+    resilience.reset_retry_budget()
+    try:
+        b = resilience.retry_budget()
+        got = sum(1 for _ in range(50) if b.try_spend("t"))
+        assert got <= 4  # burst 3 (+ maybe one refilled token)
+        assert not b.try_spend("t")
+        # other classes have their own bucket
+        assert b.try_spend("other")
+    finally:
+        monkeypatch.delenv("WEEDTPU_RETRY_BUDGET")
+        resilience.reset_retry_budget()
+
+
+def test_retry_call_spends_budget_and_stops(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_RETRY_BUDGET", "0.001:2")
+    resilience.reset_retry_budget()
+    try:
+        calls = []
+
+        def always_fails():
+            calls.append(1)
+            raise ConnectionError("nope")
+
+        allowed0 = metrics.RETRY_TOTAL.labels("storm", "allowed").value
+        denied0 = metrics.RETRY_TOTAL.labels("storm", "denied").value
+        # 10 callers x attempts=5 would be 40 retries unbudgeted; the
+        # 2-token budget caps TOTAL retries across all of them
+        for _ in range(10):
+            with pytest.raises(ConnectionError):
+                resilience.retry_call(always_fails, attempts=5,
+                                      base=0.001, cap=0.002, cls="storm",
+                                      retry_on=(ConnectionError,))
+        retries = len(calls) - 10
+        assert retries <= 3, f"budget failed to cap retries: {retries}"
+        allowed = metrics.RETRY_TOTAL.labels("storm", "allowed").value
+        denied = metrics.RETRY_TOTAL.labels("storm", "denied").value
+        assert allowed - allowed0 == retries
+        assert denied - denied0 >= 7  # every later caller was refused
+    finally:
+        monkeypatch.delenv("WEEDTPU_RETRY_BUDGET")
+        resilience.reset_retry_budget()
+
+
+def test_retry_call_giveup_short_circuits():
+    calls = []
+
+    class Fatal(OSError):
+        pass
+
+    def fails():
+        calls.append(1)
+        raise Fatal("4xx-shaped")
+
+    with pytest.raises(Fatal):
+        resilience.retry_call(fails, attempts=5, base=0.001,
+                              giveup=lambda e: isinstance(e, Fatal))
+    assert len(calls) == 1
+
+
+def test_latency_tracker_and_hedge_delay(monkeypatch):
+    tr = resilience.LatencyTracker()
+    assert tr.percentile(0.99) is None
+    for ms in range(1, 101):
+        tr.observe(ms / 1000.0)
+    p99 = tr.percentile(0.99)
+    assert 0.095 <= p99 <= 0.1
+    monkeypatch.setenv("WEEDTPU_HEDGE_PCT", "99")
+    assert abs(resilience.hedge_delay_s(tr) - p99) < 0.01
+    monkeypatch.setenv("WEEDTPU_HEDGE_PCT", "0")
+    assert resilience.hedge_delay_s(tr) is None
+    monkeypatch.setenv("WEEDTPU_HEDGE_PCT", "99")
+    monkeypatch.setenv("WEEDTPU_HEDGE_MAX_MS", "50")
+    assert resilience.hedge_delay_s(tr) == 0.05
+
+
+# ---- fault registry unit layer -----------------------------------------
+
+
+def test_partition_and_error_rate_hooks():
+    faults.register_node("127.0.0.1:1234", "volume")
+    faults.add_partition("filer", "volume")
+    try:
+        with pytest.raises(ConnectionRefusedError):
+            faults.check_dial("filer", "127.0.0.1:1234")
+        # symmetric: the volume side can't dial the filer role either
+        with pytest.raises(ConnectionRefusedError):
+            faults.check_dial("volume", "filer")
+        faults.check_dial("client", "127.0.0.1:1234")  # unaffected
+    finally:
+        faults.clear_net()
+    faults.check_dial("filer", "127.0.0.1:1234")  # cleared
+    faults.set_peer_error_rate("127.0.0.1:9", 100.0)
+    try:
+        with pytest.raises(ConnectionResetError):
+            faults.maybe_inject_error("127.0.0.1:9")
+    finally:
+        faults.clear_net()
+    faults.set_peer_latency("slowpeer", 40.0)
+    try:
+        assert 0.03 <= faults.dial_latency_s("slowpeer") <= 0.05
+        assert faults.dial_latency_s("otherpeer") == 0.0
+    finally:
+        faults.clear_net()
+
+
+def test_shard_write_error_fault(tmp_path):
+    faults.set_shard_write_error("ENOSPC")
+    try:
+        with pytest.raises(OSError) as ei:
+            faults.check_shard_write(str(tmp_path / "1"))
+        import errno
+        assert ei.value.errno == errno.ENOSPC
+    finally:
+        faults.clear_net()
+    faults.check_shard_write(str(tmp_path / "1"))  # disarmed
+
+
+def test_parse_env_net_directives():
+    parsed = faults.parse_env(
+        "partition:filer:volume;peer_latency:vs1:50:10;"
+        "peer_error:vs1:25;shard_write_error:EIO;clear_net")
+    actions = [p["action"] for p in parsed]
+    assert actions == ["partition", "peer_latency", "peer_error",
+                       "shard_write_error", "clear_net"]
+
+
+# ---- PooledHTTP retry semantics ----------------------------------------
+
+
+class _FlakyServer:
+    """Accepts keep-alive connections, serves `serve_n` good responses
+    per connection, then silently closes — the stale-keep-alive shape
+    PooledHTTP's retry policy is about.  Counts requests by method."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.requests: list[str] = []
+        self._stop = False
+        self.serve_n = 1
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        served = 0
+        buf = b""
+        try:
+            while served < self.serve_n:
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                method = head.split(b" ", 1)[0].decode()
+                cl = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        cl = int(line.split(b":")[1])
+                while len(buf) < cl:
+                    buf += conn.recv(65536)
+                buf = buf[cl:]
+                self.requests.append(method)
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Length: 2\r\n\r\nok")
+                served += 1
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        self.sock.close()
+
+
+def test_pooled_http_retry_idempotent_only():
+    from seaweedfs_tpu.utils.http import PooledHTTP
+    srv = _FlakyServer()
+    pool = PooledHTTP(timeout=5.0)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # GET: first request parks a keep-alive conn; the server has
+        # closed it, so the second GET hits a stale socket and must be
+        # retried on a fresh dial transparently
+        st, _, body = pool.request(f"{base}/a")
+        assert st == 200 and body == b"ok"
+        time.sleep(0.05)  # let the server close the parked conn
+        st, _, body = pool.request(f"{base}/b")
+        assert st == 200 and body == b"ok"
+        assert srv.requests == ["GET", "GET"]
+
+        # POST on a stale conn whose response never arrives (the bytes
+        # MAY have reached the peer): no replay — the error surfaces
+        time.sleep(0.05)
+        with pytest.raises(Exception):
+            pool.request(f"{base}/c", method="POST", body=b"payload")
+        # the POST reached the wire at most once
+        assert srv.requests.count("POST") <= 1
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_pooled_http_breaker_fast_fails(monkeypatch):
+    from seaweedfs_tpu.utils.http import PooledHTTP
+    monkeypatch.setenv("WEEDTPU_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("WEEDTPU_BREAKER_COOLDOWN", "30")
+    resilience.reset_breakers()
+    pool = PooledHTTP(timeout=0.5)
+    port = free_port()  # nothing listens here
+    url = f"http://127.0.0.1:{port}/x"
+    try:
+        for _ in range(3):
+            with pytest.raises(OSError):
+                pool.request(url)
+        # breaker is open now: the failure is instant, not a dial
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionRefusedError, match="circuit open"):
+            pool.request(url)
+        assert time.perf_counter() - t0 < 0.1
+        snap = resilience.breakers_snapshot()
+        assert snap[f"127.0.0.1:{port}"]["state"] == "open"
+    finally:
+        pool.close()
+        resilience.reset_breakers()
+
+
+# ---- deadline propagation (integration) --------------------------------
+
+
+def test_deadline_budget_fast_504(tmp_path):
+    """A filer read with a 200ms budget against 500ms-delayed volume
+    peers 504s fast (not a 30s hang) and books op=timeout in the trace;
+    without a budget the same read succeeds (slowly)."""
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=True)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        payload = b"deadline-test-payload " * 4096  # ~88KB, one chunk
+        st, out, _ = chaos._req(f"http://{c.filer.url}/dl/test.bin",
+                                method="PUT", data=payload)
+        assert st in (200, 201), out
+        # every hop toward a volume server now takes ~500ms
+        faults.set_peer_latency("volume", 500.0)
+        try:
+            t0 = time.perf_counter()
+            st, body, _ = chaos._req(
+                f"http://{c.filer.url}/dl/test.bin",
+                headers={resilience.DEADLINE_HEADER: "200"},
+                timeout=30.0)
+            elapsed = time.perf_counter() - t0
+            assert st == 504, (st, body[:200])
+            assert elapsed < 5.0, f"deadline 504 took {elapsed:.1f}s"
+            assert b"deadline exceeded" in body
+            # the trace booked the timeout on the filer hop
+            st, tr, _ = chaos._req(
+                f"http://{c.filer.url}/debug/traces?limit=200")
+            spans = [s for rec in json.loads(tr)["traces"]
+                     for s in rec["spans"]
+                     if s["name"] == "filer.request"
+                     and (s.get("attrs") or {}).get("op") == "timeout"]
+            assert spans, "no filer.request span with op=timeout"
+            # no budget -> the read still completes, just slowly
+            st, body, _ = chaos._req(
+                f"http://{c.filer.url}/dl/test.bin", timeout=30.0)
+            assert st == 200 and body == payload
+        finally:
+            faults.clear_net()
+    finally:
+        c.stop()
+
+
+def test_retry_storm_capped_under_total_failure(tmp_path, monkeypatch):
+    """100% error-rate fault toward a peer: N concurrent retry_call
+    users generate at most budget-many total retries (no storm)."""
+    from seaweedfs_tpu.utils.http import PooledHTTP
+    monkeypatch.setenv("WEEDTPU_RETRY_BUDGET", "0.001:3")
+    resilience.reset_retry_budget()
+    srv = _FlakyServer()
+    try:
+        faults.set_peer_error_rate(f"127.0.0.1:{srv.port}", 100.0)
+        pool = PooledHTTP(timeout=1.0)
+        dials0 = len(srv.requests)
+        attempts = []
+
+        def one_call():
+            def req():
+                attempts.append(1)
+                return pool.request(f"http://127.0.0.1:{srv.port}/x")
+            try:
+                resilience.retry_call(req, attempts=6, base=0.001,
+                                      cap=0.01, cls="storm2",
+                                      retry_on=(OSError,))
+            except OSError:
+                pass
+
+        threads = [threading.Thread(target=one_call) for _ in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        # 12 callers x 6 attempts = 72 unbudgeted; the 3-token budget
+        # bounds retries to first-attempts + ~burst
+        assert len(attempts) <= 12 + 5, f"retry storm: {len(attempts)}"
+        assert len(srv.requests) == dials0  # injected fault: no dial landed
+        pool.close()
+    finally:
+        faults.clear_net()
+        resilience.reset_retry_budget()
+        srv.close()
+
+
+# ---- partition hardening (aggregator + canary + trace fan-out) ---------
+
+
+def test_partition_degrades_aggregator_and_canary(tmp_path):
+    """A partitioned node costs the aggregator one timeout (not the
+    pool), is marked stale via weedtpu_agg_scrape_age_seconds, the trace
+    fan-out degrades to node_errors, and a canary probe failure during
+    the partition still records its outcome + pinned trace."""
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=False)
+    c.start()
+    # the metrics registry is process-global: restore the canary probe
+    # counters afterwards or this test's deliberate probe FAILURES leak
+    # into later tests' fresh-cluster SLO evaluations as ambient 5xx
+    probe_counts = [(metrics.CANARY_PROBES.labels("blob", cls),
+                     metrics.CANARY_PROBES.labels("blob", cls).value)
+                    for cls in ("2xx", "5xx")]
+    try:
+        c.wait_heartbeats()
+        master = c.leader()
+        agg = master.aggregator
+        agg.scrape_once()
+        assert not agg.errors, agg.errors
+        # partition the master away from every volume server
+        for vs in c.volume_servers:
+            faults.add_partition("master", vs.url)
+        t0 = time.perf_counter()
+        agg.scrape_once()
+        scrape_s = time.perf_counter() - t0
+        assert scrape_s < 15.0, f"partitioned scrape took {scrape_s:.1f}s"
+        assert set(agg.errors) == {vs.url for vs in c.volume_servers}
+        time.sleep(0.4)
+        render = agg.render()
+        for vs in c.volume_servers:
+            assert f'weedtpu_agg_scrape_age_seconds{{node="{vs.url}"}}' \
+                in render
+        # trace fan-out degrades, never raises
+        wf = master.collect_trace("0" * 32)
+        assert set(wf.get("node_errors", {})) == \
+            {vs.url for vs in c.volume_servers}
+        # a canary probe through the partition fails but is RECORDED,
+        # with its pinned trace id ready for the waterfall
+        import asyncio
+        fut = asyncio.run_coroutine_threadsafe(
+            master.canary.run_once(("blob",)), c.loop)
+        fut.result(60)
+        blob = master.canary.state.get("blob")
+        assert blob is not None and blob["outcome"] == "fail", blob
+        assert blob["trace_id"]
+        from seaweedfs_tpu.stats import trace as trace_mod
+        assert blob["trace_id"] in trace_mod.pinned_ids()
+    finally:
+        for child, v0 in probe_counts:
+            child.value = v0
+        faults.clear_net()
+        c.stop()
+
+
+# ---- the fast smoke scenario (tier-1) ----------------------------------
+
+
+def test_smoke_restart_during_degraded_read(tmp_path):
+    """Volume server restarts mid-flight while degraded reads are being
+    served; every read that succeeds is byte-identical, after recovery
+    every read succeeds, and fsck ends clean."""
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=True)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        state = WORKLOADS["degraded_read"][0](c)
+        encode_all_volumes(c)
+        # drop shards on vs0 so reads reconstruct (degraded path)
+        vs = c.volume_servers[0]
+        for vid in chaos._ec_vids_on(vs):
+            ev = vs.store.get_ec_volume(vid)
+            for sid in ev.shard_ids()[:2]:
+                faults.delete_shard(vs.store, vid, sid)
+        c.submit(vs._heartbeat_once())
+
+        stop = threading.Event()
+        wrong: list[str] = []
+
+        def reader():
+            import hashlib
+            client = c.client()
+            fids = list(state["blobs"])
+            i = 0
+            while not stop.is_set():
+                fid = fids[i % len(fids)]
+                i += 1
+                try:
+                    got = client.download(fid)
+                except Exception:
+                    continue  # failing during the restart is allowed
+                if hashlib.sha256(got).hexdigest() != state["blobs"][fid]:
+                    wrong.append(fid)  # wrong BYTES never are
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        c.restart_volume_server(1, downtime=0.3)
+        time.sleep(0.5)
+        stop.set()
+        t.join(10)
+        assert not wrong, f"reads returned wrong bytes: {wrong}"
+        c.wait_heartbeats()
+        WORKLOADS["degraded_read"][1](c, state)  # all blobs, byte-identical
+        rep = fsck_report(c)
+        assert rep.get("ok") is True, rep.get("states")
+    finally:
+        c.stop()
+
+
+# ---- chaos.status + fsck gate ------------------------------------------
+
+
+def test_chaos_status_and_fsck_gate(tmp_path):
+    """chaos.status summarizes breakers/faults/budget; fsck -json flips
+    ok:false (nonzero rc) when corruption is quarantined, and back to
+    ok:true after the heal."""
+    from seaweedfs_tpu.shell.commands import run_command
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=True)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        state = WORKLOADS["degraded_read"][0](c)
+        encode_all_volumes(c)
+        rep = fsck_report(c)
+        assert rep["ok"] is True and rep["rc"] == 0
+
+        # silent corruption: scrub quarantines it -> fsck must fail
+        vs = c.volume_servers[0]
+        vids = chaos._ec_vids_on(vs)
+        assert vids
+        ev = vs.store.get_ec_volume(vids[0])
+        faults.flip_bit(vs.store, vids[0], ev.shard_ids()[0], offset=1 << 14)
+        c.scrub_all()
+        rep = fsck_report(c)
+        assert rep["ok"] is False and rep["rc"] == 1, rep.get("states")
+
+        # chaos.status shows the armed fault + budget + breaker summary
+        faults.add_partition("filer", "volume")
+        env = c.shell_env()
+        out = io.StringIO()
+        run_command(env, "chaos.status", out)
+        text = out.getvalue()
+        assert "retry budget" in text
+        assert "partition filer<->volume" in text
+        faults.clear_net()
+
+        # heal and re-verify the gate goes green
+        chaos.heal_until_clean(c)
+        c.scrub_all()
+        rep = fsck_report(c)
+        assert rep["ok"] is True, rep.get("states")
+        WORKLOADS["degraded_read"][1](c, state)
+    finally:
+        c.stop()
+
+
+# ---- hedged reads gate (timing-sensitive -> slow) ----------------------
+
+
+@pytest.mark.slow
+def test_hedged_reads_cut_degraded_p99(tmp_path, monkeypatch):
+    """With one slow shard peer, hedged reads reconstruct from local
+    survivors after the hedge delay: degraded-read p99 drops >= 1.2x vs
+    hedging disabled."""
+    import numpy as np
+    from seaweedfs_tpu.client import WeedClient
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=False)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.leader().url)
+        rng = np.random.default_rng(7)
+        blobs = {}
+        for i in range(24):
+            data = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+            blobs[client.upload(data)] = data
+        vid = int(next(iter(blobs)).partition(",")[0])
+        time.sleep(0.7)
+        # shared deterministic topology (maintenance/chaos.py): all
+        # shards on vs0 except 0+1, which live behind a 350ms-slow peer
+        # — 12 local survivors make reconstruction the winning hedge
+        p99_off, p99_on = chaos.hedge_ratio_arms(c, blobs, vid)
+        ratio = p99_off / max(p99_on, 1e-6)
+        assert ratio >= 1.2, \
+            f"hedge p99 {p99_on * 1000:.0f}ms vs no-hedge " \
+            f"{p99_off * 1000:.0f}ms (ratio {ratio:.2f} < 1.2)"
+        fired = metrics.HEDGE_TOTAL.labels("fired").value
+        assert fired > 0, "hedge never fired"
+    finally:
+        c.stop()
+
+
+# ---- the full scenario matrix (slow) -----------------------------------
+
+
+def _cluster_for(tmp_path, workload: str, fault: str) -> ChaosCluster:
+    return ChaosCluster(
+        tmp_path, n_volume_servers=2,
+        n_masters=3 if fault == "master_failover" else 1,
+        with_filer=True,
+        with_s3=workload == "s3_multipart",
+        with_mq=workload == "mq")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,fault",
+                         [(w, f) for w in WORKLOADS for f in FAULTS])
+def test_chaos_matrix(tmp_path, workload, fault):
+    c = _cluster_for(tmp_path, workload, fault).start()
+    try:
+        c.wait_heartbeats()
+        report = run_scenario(c, workload, fault)
+        assert report["workload"] == workload
+    finally:
+        c.stop()
+
+
+@pytest.mark.slow
+def test_disk_fault_encode_fails_clean(tmp_path):
+    """shard_write_error makes EC encode fail like a dying disk; the
+    volume keeps serving from its .dat and a later encode succeeds."""
+    c = ChaosCluster(tmp_path, n_volume_servers=1, with_filer=True)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        state = WORKLOADS["degraded_read"][0](c)
+        vs = c.volume_servers[0]
+        vids = sorted({vid for loc in vs.store.locations
+                       for vid in loc.volumes})
+        faults.set_shard_write_error("EIO")
+        st, out, _ = chaos._req(
+            f"http://{vs.url}/admin/ec/generate", method="POST",
+            data=json.dumps({"volume": vids[0]}).encode(),
+            headers={"Content-Type": "application/json"}, timeout=120.0)
+        assert st >= 500, (st, out)
+        faults.clear_net()
+        WORKLOADS["degraded_read"][1](c, state)  # reads fine off the .dat
+        encode_all_volumes(c)  # disarmed: encode succeeds now
+        WORKLOADS["degraded_read"][1](c, state)
+        rep = fsck_report(c)
+        assert rep["ok"] is True, rep.get("states")
+    finally:
+        c.stop()

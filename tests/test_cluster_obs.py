@@ -85,7 +85,11 @@ def _rule(slo, name):
     return next(r for r in slo["rules"] if r["name"] == name)
 
 
-def test_cluster_slo_flips_under_delay_faults(obs_cluster):
+def test_cluster_slo_flips_under_delay_faults(obs_cluster, monkeypatch):
+    # this test measures the SLO plane SEEING slow reads; hedged reads
+    # (utils/resilience.py) would reconstruct around the delayed peer
+    # and erase the very latency the rule must flip on
+    monkeypatch.setenv("WEEDTPU_HEDGE_PCT", "0")
     c = obs_cluster
     client, payloads = _upload_and_encode_all(c)
 
