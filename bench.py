@@ -479,10 +479,100 @@ def _try(extra: dict, key: str, fn, *args, **kw) -> None:
         print(f"bench: {key} failed: {e}", file=sys.stderr)
 
 
+def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
+    """Bench trajectory tracking: append this run's headline metrics to
+    bench_history.jsonl (bootstrapping the file from the committed
+    BENCH_r*.json rounds on first run, marked imported) and emit a
+    bench_regression gate — nonzero exit — when a TRAJECTORY_GATED
+    metric drops more than 10% below the best prior round.
+
+    Comparisons are same-backend, against rounds this recorder wrote
+    (imported rounds are trajectory context only), and against the best
+    of only the most recent TRAJECTORY_LOOKBACK such rounds: the
+    pre-history rounds were measured under shifting harness conditions —
+    r04's 336 GB/s outlier against the ~110 steady state would poison a
+    best-of-all-time gate permanently — and a bounded lookback means a
+    recorded outlier ages out instead of ratcheting the bar forever."""
+    import glob as _glob
+    repo = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(repo, "bench_history.jsonl")
+    entries: list[dict] = []
+    bootstrap = not os.path.exists(path)
+    if bootstrap:
+        for fp in sorted(_glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+            try:
+                with open(fp) as f:
+                    parsed = json.load(f).get("parsed") or {}
+            except (OSError, ValueError):
+                continue
+            if not parsed.get("value"):
+                continue
+            mets = {"ec_encode_rs10_4": parsed["value"]}
+            for k, v in (parsed.get("extra") or {}).items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    mets[k] = v
+            entries.append({"round": os.path.basename(fp),
+                            "backend": parsed.get("backend"),
+                            "metrics": mets, "imported": True})
+    else:
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        entries.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError as e:
+            print(f"bench: cannot read {path}: {e}", file=sys.stderr)
+    mets_now = {"ec_encode_rs10_4": round(gbps, 3)}
+    for k, v in extra.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            mets_now[k] = v
+    comparable = [e for e in entries if not e.get("imported")
+                  and e.get("backend") == backend]
+    comparable = comparable[-TRAJECTORY_LOOKBACK:]
+    regressions: dict = {}
+    for m in TRAJECTORY_GATED:
+        now_v = mets_now.get(m)
+        if now_v is None:
+            # the metric legitimately did not run on this backend/host;
+            # a measured 0.0 still compares (and gates) below
+            continue
+        best = max((e.get("metrics", {}).get(m) or 0.0
+                    for e in comparable), default=0.0)
+        if best > 0 and now_v < TRAJECTORY_TOL * best:
+            regressions[m] = {"value": now_v, "best_prior": best,
+                              "ratio": round(now_v / best, 3)}
+    extra["bench_rounds_prior"] = len(entries)
+    if regressions:
+        extra["bench_regression"] = regressions
+        for m, r in regressions.items():
+            print(f"bench: REGRESSION — {m} = {r['value']} is "
+                  f"{r['ratio']:.2f}x the best prior {backend} round "
+                  f"({r['best_prior']}); >10% trajectory drop. Failing "
+                  f"the bench run.", file=sys.stderr)
+    entry = {"n": len(entries) + 1,
+             "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+             "backend": backend, "metrics": mets_now}
+    if extra.get("bench_regression"):
+        entry["regressed"] = sorted(regressions)
+    try:
+        with open(path, "w" if bootstrap else "a") as f:
+            rows = entries + [entry] if bootstrap else [entry]
+            for row in rows:
+                f.write(json.dumps(row, separators=(",", ":")) + "\n")
+    except OSError as e:
+        print(f"bench: cannot append {path}: {e}", file=sys.stderr)
+
+
 def _emit(gbps: float, backend: str, baseline: float | None,
           extra: dict) -> None:
     base_kind = "measured-avx2-refshape" if baseline else "klauspost-readme"
     base = baseline or KLAUSPOST_AVX2_GBPS
+    try:
+        _record_trajectory(gbps, backend, extra)
+    except Exception as e:  # trajectory bookkeeping must not eat the run
+        print(f"bench: trajectory recording failed: {e}", file=sys.stderr)
     print(json.dumps({
         "metric": "ec_encode_rs10_4",
         "value": round(gbps, 2),
@@ -541,7 +631,8 @@ def main() -> None:
                _bench_trace_overhead, _bench_profile_overhead,
                _bench_heal_time, _bench_scrub_overhead,
                _bench_flow_canary_overhead, _bench_heat_overhead,
-               _bench_serving_knee, _bench_chaos):
+               _bench_history_overhead, _bench_serving_knee,
+               _bench_chaos):
         try:
             fn(extra)
         except Exception as e:
@@ -671,8 +762,10 @@ def _exit_code(extra: dict) -> int:
              "scrub_overhead_regression",
              "flow_canary_overhead_regression",
              "heat_overhead_regression",
+             "history_overhead_regression",
              "repair_interference_regression",
              "chaos_scenario_failed",
+             "bench_regression",
              "gated_bench_failed")
     return 1 if any(extra.get(g) for g in gates) else 0
 
@@ -702,6 +795,18 @@ PROFILE_OVERHEAD_TOL = 0.95
 # blob reads with the workload heat sketches updating per request must
 # keep >= 0.97x the untracked rate (ISSUE 8 acceptance bar)
 HEAT_OVERHEAD_TOL = 0.97
+# blob reads while the master's aggregator records every scrape into the
+# history store + evaluates alerts + re-forecasts capacity must keep
+# >= 0.97x the recording-off rate (ISSUE 10 acceptance bar)
+HISTORY_OVERHEAD_TOL = 0.97
+# bench trajectory: a gated headline metric dropping more than 10% below
+# the best prior recorded round (same backend) fails the run
+TRAJECTORY_TOL = 0.90
+TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1")
+# ...comparing against the best of only the last N recorded same-backend
+# rounds, so one cache-hot outlier round ages out of the bar instead of
+# ratcheting it forever
+TRAJECTORY_LOOKBACK = 5
 # foreground read p99 while the repair planner rebuilds lost shards must
 # stay within 1.5x the idle p99 (ISSUE 9 acceptance bar; the 1709.05365
 # measurement: online repair/encode interference with foreground traffic)
@@ -2095,6 +2200,125 @@ def _bench_heat_overhead(extra: dict, n: int = 1200, size: int = 1024,
               f"tracking run at {ratio:.3f}x the untracked rate (median "
               f"of interleaved pairs); the heat sketches exceed their "
               f"3% budget. Failing the bench run.", file=sys.stderr)
+
+
+def _bench_history_overhead(extra: dict, n: int = 1200, size: int = 1024,
+                            concurrency: int = 16, pairs: int = 7) -> None:
+    """History-plane tax on the hottest path: blob reads while the
+    master's aggregator scrapes the fleet every 0.2s, with the history
+    store recording each tick + alert evaluation + capacity forecasting
+    ON (WEEDTPU_HISTORY=1, the default) vs fully OFF (=0), interleaved
+    pairs over the same blobs.  The store reads the env per record call
+    (0.5s TTL), so flipping it between reps retargets the live master.
+    Median ratio below HISTORY_OVERHEAD_TOL (foreground must keep >=
+    0.97x) fails the run (history_overhead_regression + nonzero exit)."""
+    import asyncio
+    import concurrent.futures
+    import threading
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    old = {k: os.environ.get(k)
+           for k in ("WEEDTPU_HISTORY", "WEEDTPU_AGG_INTERVAL")}
+    os.environ["WEEDTPU_AGG_INTERVAL"] = "0.2"
+    best_on = best_off = float("inf")
+    ratios: list[float] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-hist-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs = VolumeServer([d], master.url, port=free_port(),
+                              heartbeat_interval=0.2)
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                client = WeedClient(master.url)
+                payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+                with concurrent.futures.ThreadPoolExecutor(
+                        concurrency) as ex:
+                    fids = list(ex.map(
+                        lambda i: client.upload(payload, name=f"hs{i}"),
+                        range(n)))
+
+                def rep(recording: str) -> float:
+                    os.environ["WEEDTPU_HISTORY"] = recording
+                    # the store caches the env switch for up to 0.5s;
+                    # let the flip take effect before timing the arm
+                    time.sleep(0.6)
+                    t0 = time.perf_counter()
+                    with concurrent.futures.ThreadPoolExecutor(
+                            concurrency) as ex:
+                        for data in ex.map(client.download, fids):
+                            assert len(data) == size
+                    return time.perf_counter() - t0
+
+                for i in range(pairs):
+                    if i % 2 == 0:
+                        t_off = rep("0")
+                        t_on = rep("1")
+                    else:
+                        t_on = rep("1")
+                        t_off = rep("0")
+                    if i == 0:
+                        continue  # warm connections / page cache
+                    best_on = min(best_on, t_on)
+                    best_off = min(best_off, t_off)
+                    ratios.append(t_off / t_on)
+                # the ON arms must have really recorded — otherwise both
+                # arms measured the recording-off path and the gate
+                # would pass vacuously over a broken history plane
+                if master.history.series_count() == 0 or \
+                        master.history.ticks == 0:
+                    raise RuntimeError(
+                        "history recording never engaged during the ON "
+                        "arms (0 series/ticks) — overhead gate is "
+                        "meaningless")
+                extra["history_series"] = master.history.series_count()
+                client.close()
+            finally:
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["blob_read_rps_history"] = round(n / best_on, 1)
+    extra["blob_read_rps_unhistory"] = round(n / best_off, 1)
+    extra["history_overhead_ratio"] = round(ratio, 3)
+    if ratio < HISTORY_OVERHEAD_TOL:
+        extra["history_overhead_regression"] = True
+        print(f"bench: REGRESSION — blob reads with history recording "
+              f"run at {ratio:.3f}x the recording-off rate (median of "
+              f"interleaved pairs); the history plane exceeds its 3% "
+              f"budget. Failing the bench run.", file=sys.stderr)
 
 
 def _bench_serving_knee(extra: dict, n_blobs: int = 400,

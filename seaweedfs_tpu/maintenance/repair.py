@@ -253,16 +253,44 @@ class RepairPlanner:
                 return url
         return None
 
+    def _capacity_boost(self, infos) -> None:
+        """Forward-looking urgency input from the capacity forecaster
+        (stats/history.py): a repair whose survivors sit on a disk
+        predicted to fill within WEEDTPU_FORECAST_URGENT_S moves up the
+        queue — rebuild it while the bytes still have somewhere to go,
+        instead of discovering the full disk mid-copy."""
+        fc = getattr(self.master, "forecaster", None)
+        if fc is None:
+            return
+        try:
+            urgent = fc.filling_nodes(
+                _env_float("WEEDTPU_FORECAST_URGENT_S", 21600.0))
+        except Exception:
+            return
+        if not urgent:
+            return
+        for info in infos:
+            if info["kind"] == "ec":
+                nodes = {url for locs in
+                         info.get("shard_locations", {}).values()
+                         for url in locs}
+            else:
+                nodes = set(info.get("replicas", []))
+            if nodes & urgent:
+                info["urgency"] += 1
+                info["capacity_urgent"] = True
+
     async def tick(self) -> list[dict]:
         """One planning pass: launch repair tasks for the most urgent
         repairable volumes, bounded by the token bucket and per-node
         caps.  Returns the actions launched (not their outcomes — await
         wait_idle() for those)."""
         led = self.ledger()
-        cands = sorted(
-            (i for i in led.values()
-             if i["state"] in ("degraded", "corrupt", "under_replicated")),
-            key=lambda i: -i["urgency"])
+        cands = [i for i in led.values()
+                 if i["state"] in ("degraded", "corrupt",
+                                   "under_replicated")]
+        self._capacity_boost(cands)
+        cands.sort(key=lambda i: -i["urgency"])
         now = time.monotonic()
         actions: list[dict] = []
         for info in cands:

@@ -21,8 +21,8 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
-from seaweedfs_tpu.stats import (aggregate, heat, metrics, netflow, profile,
-                                 trace)
+from seaweedfs_tpu.stats import (aggregate, heat, history, metrics, netflow,
+                                 profile, trace)
 from seaweedfs_tpu.stats.canary import CanaryProber
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import types as t
@@ -121,6 +121,9 @@ class MasterServer:
             web.get("/cluster/trace/{tid}", self.handle_cluster_trace),
             web.get("/cluster/traces", self.handle_cluster_traces),
             web.get("/cluster/canary", self.handle_cluster_canary),
+            web.get("/cluster/history", self.handle_cluster_history),
+            web.get("/cluster/alerts", self.handle_cluster_alerts),
+            web.get("/cluster/dashboard", self.handle_cluster_dashboard),
             web.get("/", self.handle_ui),
         ])
         netflow.install(self.app, "master")
@@ -150,6 +153,16 @@ class MasterServer:
         # is read directly.
         self.aggregator = aggregate.ClusterAggregator(
             self._agg_nodes, local=(self.url, metrics.REGISTRY))
+        # historical telemetry plane (stats/history.py): every scrape tick
+        # lands in the fixed-memory multi-resolution store, then the
+        # capacity forecaster re-regresses fill rates and the alert-rule
+        # engine re-evaluates — all on the aggregator's thread, so the
+        # retention plane can never outpace federation
+        self.history = history.HistoryStore()
+        self.alerts = history.AlertEngine(self.history,
+                                          pin_fn=trace.pin_trace)
+        self.forecaster = history.CapacityForecaster(self.history)
+        self.aggregator.observers.append(self._on_scrape)
         # flight recorder: always-on canary probes through every gateway
         # path (stats/canary.py), feeding the SLO engine and pinning
         # their trace ids for ready-made failure waterfalls
@@ -346,6 +359,106 @@ class MasterServer:
                 await self.maintenance.tick()
             except Exception:
                 log.warning("repair tick failed", exc_info=True)
+
+    def _on_scrape(self, ts: float, per_node: dict) -> None:
+        """Aggregator scrape observer: record the tick into history, then
+        forecast and evaluate alerts over the updated store (runs on the
+        aggregator thread; each stage is independent so one failing must
+        not starve the others)."""
+        try:
+            self.history.record(ts, per_node)
+        except Exception:
+            log.warning("history record failed", exc_info=True)
+        try:
+            self.forecaster.update(
+                ts, volume_size_limit=self.topo.volume_size_limit)
+        except Exception:
+            log.warning("capacity forecast failed", exc_info=True)
+        try:
+            self.alerts.evaluate(ts)
+        except Exception:
+            log.warning("alert evaluation failed", exc_info=True)
+
+    # -- historical telemetry plane --------------------------------------
+
+    async def handle_cluster_history(self, req: web.Request
+                                     ) -> web.Response:
+        """/cluster/history?series=&labels=&range=&step=&agg=: aligned
+        range vectors out of the master's embedded multi-resolution
+        store.  ``labels`` is ``k=v`` comma-separated; ``agg`` one of
+        min/max/last/sum/avg/rate or pNN (histogram quantile over time);
+        ``range``/``step`` in seconds.  ?refresh=1 scrapes (and thereby
+        records) once before answering.  Loopback-gated like the other
+        operator surfaces: it names nodes, data dirs, and trace ids,
+        and refresh can trigger fleet fan-outs."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        series = req.query.get("series", "").strip()
+        if not series:
+            return web.json_response(
+                {"error": "series required", "status": self.history.status()},
+                status=400)
+        labels: dict[str, str] = {}
+        for part in req.query.get("labels", "").split(","):
+            k, sep, v = part.partition("=")
+            if sep and k.strip():
+                labels[k.strip()] = v.strip()
+        try:
+            range_s = float(req.query.get("range", "600"))
+            step = float(req.query.get("step", "0")) or None
+        except ValueError:
+            return web.json_response({"error": "bad range/step"},
+                                     status=400)
+        if req.query.get("refresh"):
+            try:
+                await asyncio.to_thread(self.aggregator.scrape_once)
+            except Exception:
+                log.warning("history refresh pull failed", exc_info=True)
+        agg = req.query.get("agg") or None
+        result = await asyncio.to_thread(
+            self.history.query, series, labels, range_s, step, agg)
+        return web.json_response(result)
+
+    async def handle_cluster_alerts(self, req: web.Request
+                                    ) -> web.Response:
+        """/cluster/alerts: the alert-rule engine's per-rule, per-group
+        state (ok/pending/firing with hysteresis timestamps and pinned
+        exemplar trace ids).  ?refresh=1 runs a scrape tick — which
+        records history and re-evaluates — before answering, the
+        deterministic hook tests drive.  Loopback-gated (exemplar trace
+        ids + refresh-triggered fleet fan-outs)."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        if req.query.get("refresh"):
+            try:
+                await asyncio.to_thread(self.aggregator.scrape_once)
+            except Exception:
+                log.warning("alerts refresh pull failed", exc_info=True)
+        elif self.aggregator.interval > 0 and \
+                time.time() - self.alerts.last_eval > \
+                max(3 * self.aggregator.interval, 5.0):
+            # the scrape observer is the usual evaluator — but the rule
+            # watching for a DEAD federation plane must not share its
+            # failure domain: a stale last_eval means the aggregator
+            # stopped ticking, so re-evaluate on read (absence rules
+            # then fire from whatever the store last held)
+            await asyncio.to_thread(self.alerts.evaluate)
+        return web.json_response(self.alerts.status())
+
+    async def handle_cluster_dashboard(self, req: web.Request
+                                       ) -> web.Response:
+        """/cluster/dashboard: self-contained HTML status page — SLO,
+        alerts, canary latency, net-flow classes, repair backlog, and
+        capacity forecasts as inline SVG sparklines rendered from the
+        history store.  Loopback-gated like every operator surface (it
+        names nodes, dirs, and trace ids)."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        html = await asyncio.to_thread(history.render_dashboard, self)
+        return web.Response(text=html, content_type="text/html")
 
     def _agg_nodes(self) -> dict[str, str]:
         """Every node the aggregator should pull /metrics from: volume
@@ -629,6 +742,14 @@ class MasterServer:
             snap["slo"] = self.aggregator.slo_status()
         except Exception:
             log.warning("slo status failed", exc_info=True)
+        try:
+            # firing alerts + capacity forecasts from the history plane:
+            # both read cached state, never a fleet fan-out
+            snap["alerts"] = self.alerts.status()
+            snap["capacity"] = self.forecaster.snapshot()
+            snap["history"] = self.history.status()
+        except Exception:
+            log.warning("alert status failed", exc_info=True)
         with self._heat_lock:
             cached = self._heat_cache
         if cached is not None:

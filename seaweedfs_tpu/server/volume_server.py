@@ -279,6 +279,27 @@ class VolumeServer:
             len(beat.get("volumes", [])))
         metrics.VOLUME_COUNT_GAUGE.labels("", "ec").set(
             len(beat.get("ec_shards", [])))
+        # capacity inputs for the master's history plane: per-data-dir
+        # disk occupancy + per-volume sizes, refreshed at heartbeat
+        # cadence so the fill-rate regression (stats/history.py
+        # CapacityForecaster) has a live series to fit
+        for loc in self.store.locations:
+            try:
+                st = os.statvfs(loc.directory)
+            except OSError:
+                continue
+            total = float(st.f_frsize * st.f_blocks)
+            free = float(st.f_frsize * st.f_bavail)
+            for kind, v in (("total", total), ("used", total - free),
+                            ("free", free)):
+                metrics.DISK_BYTES.labels(self.url, loc.directory,
+                                          kind).set(v)
+        for v in beat.get("volumes", []):
+            # the vs label keeps replicas apart: the history store sums
+            # same-labeled gauges across nodes, and a replicated volume
+            # must not forecast at 2x its real size
+            metrics.VOLUME_SIZE.labels(str(v["id"]), self.url).set(
+                v["size"])
         beat.update({"id": self.url, "url": self.url,
                      "public_url": self.public_url,
                      "data_center": self.data_center, "rack": self.rack})

@@ -702,6 +702,45 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
     print(f"planner: tokens={pl.get('tokens')} active={pl.get('active')} "
           f"backoffs={len(pl.get('backoffs', {}))}", file=out)
     _print_slo(st.get("slo") or {}, out)
+    _print_alerts(st.get("alerts") or {}, out)
+    from seaweedfs_tpu.stats.history import FORECAST_CAP_S
+    cap = st.get("capacity") or {}
+    soon = [d for d in cap.get("disks", [])
+            if d.get("predicted_full_seconds", FORECAST_CAP_S)
+            < FORECAST_CAP_S]
+    if soon:
+        print("capacity: " + " ".join(
+            f"{d['vs']}:{d['dir']}={_fmt_eta(d['predicted_full_seconds'])}"
+            for d in soon[:5]), file=out)
+
+
+def _fmt_eta(s: float) -> str:
+    for unit, div in (("d", 86400.0), ("h", 3600.0), ("m", 60.0)):
+        if s >= div:
+            return f"{s / div:.1f}{unit}"
+    return f"{s:.0f}s"
+
+
+def _print_alerts(alerts: dict, out) -> None:
+    """Shared alert pretty-printer for maintenance.status /
+    cluster.alerts: one line per rule, firing groups expanded."""
+    if not alerts.get("rules"):
+        return
+    firing = [r for r in alerts["rules"] if r["state"] == "firing"]
+    print(f"alerts: {alerts.get('state', 'ok')} "
+          f"({len(firing)} rule(s) firing)", file=out)
+    for r in alerts["rules"]:
+        if r["state"] == "ok":
+            continue
+        for g in r.get("groups", []):
+            if g["state"] == "ok":
+                continue
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(g.get("labels", {}).items())) or "-"
+            val = "stale" if g.get("stale") else g.get("value")
+            ex = f" trace={g['exemplar']}" if g.get("exemplar") else ""
+            print(f"  {r['name']:24s} {g['state'].upper():8s} {lbl} "
+                  f"value={val}{ex}", file=out)
 
 
 def _print_slo(slo: dict, out) -> None:
@@ -821,6 +860,101 @@ def cmd_cluster_canary(env: CommandEnv, args, out):
         err = f" error={rec['error']}" if rec.get("error") else ""
         print(f"  {path:9s} {rec['outcome']:5s} {rec['ms']:8.1f}ms"
               f"{p99} trace={rec['trace_id']}{err}", file=out)
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _ascii_spark(points: list) -> str:
+    """Unicode sparkline over [ts, value|None] points (gaps become
+    spaces) — the terminal twin of the dashboard's SVG lines."""
+    vals = [v for _, v in points if v is not None]
+    if not vals:
+        return "(no data)"
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        " " if v is None else
+        _SPARK_CHARS[min(len(_SPARK_CHARS) - 1,
+                         int((v - lo) / span * len(_SPARK_CHARS)))]
+        for _, v in points)
+
+
+@command("cluster.history")
+def cmd_cluster_history(env: CommandEnv, args, out):
+    """Range query over the master's embedded history store
+    (/cluster/history).  cluster.history -series NAME [-labels k=v,k2=v2]
+    [-range SECONDS] [-step SECONDS] [-agg min|max|last|sum|avg|rate|p99]
+    [-refresh] [-json].  One sparkline per label set; `-agg p99` reads a
+    histogram family's quantile over time (e.g. -series
+    weedtpu_volume_request_seconds -agg p99).  Runbook: an alert names
+    the series — this shows WHEN it started moving, and cluster.trace
+    shows why."""
+    flags = parse_flags(args)
+    if "series" not in flags:
+        raise RuntimeError("cluster.history requires -series NAME")
+    params = {"series": flags["series"],
+              "range": flags.get("range", "600")}
+    for k in ("labels", "step", "agg"):
+        if k in flags:
+            params[k] = flags[k]
+    if "refresh" in flags:
+        params["refresh"] = "1"
+    res = env.master_get("/cluster/history", **params)
+    if "json" in flags:
+        print(json.dumps(res, separators=(",", ":")), file=out)
+        return
+    print(f"{res['series']} agg={res['agg']} range="
+          f"{int(res['end'] - res['start'] + res['step'])}s "
+          f"step={res['step']:g}s"
+          + (f" res={res['resolution_s']:g}s"
+             if "resolution_s" in res else ""), file=out)
+    for vec in res.get("vectors", []):
+        lbl = ",".join(f"{k}={v}" for k, v in
+                       sorted(vec["labels"].items())) or "(all)"
+        pts = vec["points"]
+        last = next((v for _, v in reversed(pts) if v is not None), None)
+        last_s = "-" if last is None else f"{last:.4g}"
+        print(f"  {lbl:44s} {_ascii_spark(pts)} {last_s}", file=out)
+    if not res.get("vectors"):
+        print("  no matching series (check -series/-labels; the store "
+              "records on aggregator ticks — try -refresh)", file=out)
+
+
+@command("cluster.alerts")
+def cmd_cluster_alerts(env: CommandEnv, args, out):
+    """Alert-rule engine state (/cluster/alerts): per-rule, per-label-set
+    ok/pending/firing with hysteresis timestamps and the pinned exemplar
+    trace of whatever fired.  -refresh runs one scrape+evaluate tick
+    first; -json dumps the raw status.  Runbook: alert fires ->
+    cluster.history -series <its series> (when did it start) ->
+    cluster.trace <exemplar> (why)."""
+    flags = parse_flags(args)
+    params = {"refresh": "1"} if "refresh" in flags else {}
+    st = env.master_get("/cluster/alerts", **params)
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    if not st.get("rules"):
+        print("no alert rules configured (WEEDTPU_ALERT_RULES)", file=out)
+        return
+    print(f"alerts: {st.get('state', 'ok')}", file=out)
+    for r in st["rules"]:
+        n_fire = sum(1 for g in r.get("groups", [])
+                     if g["state"] == "firing")
+        print(f"  {r['name']:24s} {r['state']:8s} [{r['kind']}] "
+              f"series={r['series']} window={r['window_s']:g}s "
+              f"for={r['for_s']:g}s groups={len(r.get('groups', []))} "
+              f"firing={n_fire}", file=out)
+        for g in r.get("groups", []):
+            if g["state"] == "ok":
+                continue
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(g.get("labels", {}).items())) or "-"
+            val = "stale" if g.get("stale") else g.get("value")
+            ex = f" trace={g['exemplar']}" if g.get("exemplar") else ""
+            print(f"    {g['state'].upper():8s} {lbl} value={val}{ex}",
+                  file=out)
 
 
 @command("chaos.status")

@@ -74,12 +74,17 @@ def _fmt_value(v: float) -> str:
     return repr(v)
 
 
+_EXEMPLAR_TID_RE = re.compile(r'trace_id="((?:[^"\\]|\\.)*)"')
+
+
 def parse_exposition(text: str) -> dict[str, dict]:
     """Prometheus text 0.0.4 -> {family: {type, help, samples}} where
     samples is a list of (sample_name, labels dict, float value).
     Histogram ``_bucket``/``_sum``/``_count`` samples file under their
-    family name.  OpenMetrics exemplar suffixes are tolerated and
-    dropped."""
+    family name.  OpenMetrics exemplar trace ids are captured into the
+    family's ``exemplars`` map — {(sample_name, sorted label pairs):
+    trace_id} — so the alert engine can pin the trace behind a
+    triggering series; the suffix is otherwise dropped."""
     fams: dict[str, dict] = {}
 
     def fam(name: str) -> dict:
@@ -101,7 +106,8 @@ def parse_exposition(text: str) -> dict[str, dict]:
             continue
         if line.startswith("#"):
             continue
-        line = line.split(" # ", 1)[0].rstrip()  # exemplar suffix
+        line, sep, exem = line.partition(" # ")  # exemplar suffix
+        line = line.rstrip()
         m = _SAMPLE_RE.match(line)
         if not m:
             continue
@@ -112,14 +118,35 @@ def parse_exposition(text: str) -> dict[str, dict]:
                     fams[name[:-len(suffix)]]["type"] == "histogram":
                 base = name[:-len(suffix)]
                 break
+        if base == name and name.endswith("_total") and \
+                name not in fams and \
+                fams.get(name[:-6], {}).get("type") == "counter":
+            # OpenMetrics names the counter FAMILY without _total and
+            # the samples WITH it.  Normalize to the 0.0.4 convention
+            # (family named like its samples) so an OM node and a
+            # plain-text node — a rolling upgrade — merge into ONE
+            # family instead of duplicate TYPE blocks in the federation
+            meta = fams[name[:-6]]
+            f = fam(name)
+            f["type"] = "counter"
+            if not f["help"]:
+                f["help"] = meta["help"]
         try:
             value = float(value_s)
         except ValueError:
             continue
         labels = {k: _unesc(v)
                   for k, v in _LABEL_RE.findall(labels_raw or "")}
-        fam(base)["samples"].append((name, labels, value))
-    return fams
+        f = fam(base)
+        f["samples"].append((name, labels, value))
+        if sep:
+            em = _EXEMPLAR_TID_RE.search(exem)
+            if em:
+                f.setdefault("exemplars", {})[
+                    (name, _key(labels))] = _unesc(em.group(1))
+    # drop meta-only families (an OM counter's sans-_total TYPE line
+    # whose samples were refiled above): every consumer iterates samples
+    return {name: f for name, f in fams.items() if f["samples"]}
 
 
 def _key(labels: dict, drop: tuple = ()) -> tuple:
@@ -455,6 +482,10 @@ class ClusterAggregator:
         # visibly in /cluster/metrics instead of its last values sitting
         # there silently stale
         self.last_ok: dict[str, float] = {}
+        # post-scrape hooks (ts, {node: families}) — the master wires the
+        # history store / alert engine / capacity forecaster here so the
+        # retention plane ticks exactly as often as federation does
+        self.observers: list = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -496,10 +527,15 @@ class ClusterAggregator:
     # -- scraping -------------------------------------------------------
 
     def _pull_node(self, netloc: str):
-        """-> (families, None) or (None, error string)."""
+        """-> (families, None) or (None, error string).  Negotiates the
+        OpenMetrics rendering so histogram exemplars (trace ids) ride
+        along — the alert engine pins the trace behind a triggering
+        series; a plain-text-only node still parses fine."""
         try:
             status, _, body = self.pool.request(
-                f"{_tls_scheme()}://{netloc}/metrics", timeout=5.0)
+                f"{_tls_scheme()}://{netloc}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+                timeout=5.0)
             if status != 200:
                 return None, f"HTTP {status}"
             return parse_exposition(body.decode("utf-8", "replace")), None
@@ -513,7 +549,8 @@ class ClusterAggregator:
         errors: dict[str, str] = {}
         local_name = self.local[0] if self.local else None
         if self.local:
-            per_node[local_name] = parse_exposition(self.local[1].render())
+            per_node[local_name] = parse_exposition(
+                self.local[1].render(openmetrics=True))
         remote = [(n, loc) for n, loc in nodes.items() if n != local_name]
         if remote:
             # fan the pulls out: a few partitioned nodes each cost a full
@@ -552,7 +589,47 @@ class ClusterAggregator:
                 self.interval, 1.0))
             while len(self.history) > 2 and self.history[0][0] < horizon:
                 self.history.popleft()
+        if self.observers:
+            # the synthesized staleness/up gauges ride along as a pseudo
+            # node so the history store records them like any federated
+            # series (they exist only at render time otherwise)
+            payload = dict(per_node)
+            payload["__aggregator__"] = self._synth_families()
+            for ob in list(self.observers):
+                try:
+                    ob(ts, payload)
+                except Exception as e:  # an observer must not kill scrapes
+                    weedlog.V(1, "aggregate").infof(
+                        "scrape observer failed: %s", e)
         return per_node
+
+    def _synth_families(self) -> dict[str, dict]:
+        """The render()-synthesized per-node gauges in parsed-exposition
+        shape: node up/down and scrape age — with a NEVER-successfully-
+        scraped node reporting +Inf age, not absent/fresh, so staleness
+        rules catch it from its very first failed pull."""
+        with self._lock:
+            per_node = sorted(self.per_node)
+            errors = sorted(self.errors)
+            last_ok = dict(self.last_ok)
+        now = time.time()
+        up = {"type": "gauge", "help": "last /metrics pull succeeded",
+              "samples": [("weedtpu_cluster_node_up", {"node": n}, 1.0)
+                          for n in per_node] +
+                         [("weedtpu_cluster_node_up", {"node": n}, 0.0)
+                          for n in errors]}
+        age_samples = [("weedtpu_agg_scrape_age_seconds", {"node": n},
+                        max(0.0, now - ts))
+                       for n, ts in sorted(last_ok.items())]
+        age_samples += [("weedtpu_agg_scrape_age_seconds", {"node": n},
+                         math.inf)
+                        for n in errors if n not in last_ok]
+        return {"weedtpu_cluster_node_up": up,
+                "weedtpu_agg_scrape_age_seconds": {
+                    "type": "gauge",
+                    "help": "seconds since this node's last successful "
+                            "/metrics pull",
+                    "samples": age_samples}}
 
     def ensure_fresh(self, max_age: float | None = None) -> None:
         age = time.time() - self.last_scrape
@@ -608,6 +685,13 @@ class ClusterAggregator:
             age = max(0.0, now - last_ok[node])
             out.append(f'weedtpu_agg_scrape_age_seconds'
                        f'{{node="{_esc(node)}"}} {round(age, 3)}')
+        # a node that has NEVER been scraped successfully is maximally
+        # stale, not fresh: +Inf (valid exposition) so staleness alerts
+        # and dashboards see it without special-casing absence
+        for node in sorted(errors):
+            if node not in last_ok:
+                out.append(f'weedtpu_agg_scrape_age_seconds'
+                           f'{{node="{_esc(node)}"}} +Inf')
         return "\n".join(out) + "\n"
 
     def slo_status(self) -> dict:

@@ -157,6 +157,15 @@ class CanaryProber:
             lat = self._lat.setdefault(
                 path, deque(maxlen=self.LATENCY_WINDOW))
             lat.append(ms)
+            # rolling-window quantiles as direct gauges: the history
+            # plane records them per tick, so /cluster/dashboard gets
+            # per-path latency trends without histogram-bucket math
+            win = list(lat)
+            for q, qs in ((0.50, "0.5"), (0.99, "0.99")):
+                v = self._quantile(win, q)
+                if v is not None:
+                    metrics.CANARY_LATENCY.labels(path, qs).set(
+                        round(v / 1000.0, 6))
         prev = self.state.get(path, {})
         rec = {"outcome": outcome, "ms": round(ms, 3),
                "trace_id": root.trace_id, "ts": time.time(),

@@ -213,6 +213,16 @@ class Registry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        # optional self-cost gauge: stamped with series_count() on every
+        # render so a dashboard can watch the registry's own cardinality
+        self._series_gauge: "Gauge | None" = None
+
+    def series_count(self) -> int:
+        """Live label sets (children) across every family — the
+        registry's own cardinality, i.e. what each scrape costs."""
+        with self._lock:
+            ms = list(self._metrics.values())
+        return sum(len(m._children) for m in ms)
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
@@ -232,6 +242,8 @@ class Registry:
         return self._register(Histogram(name, help_text, tuple(labels), buckets))
 
     def render(self, openmetrics: bool = False) -> str:
+        if self._series_gauge is not None:
+            self._series_gauge.labels().set(self.series_count())
         with self._lock:
             metrics = list(self._metrics.values())
         lines: list[str] = []
@@ -492,3 +504,48 @@ REPAIR_ACTIONS = REGISTRY.counter(
 VOLUME_HEALTH = REGISTRY.gauge(
     "weedtpu_volume_health", "volumes per health-ledger state (master)",
     ("state",))
+# historical telemetry plane (stats/history.py): disk/volume capacity
+# inputs set by volume servers on each heartbeat, the master's fill-rate
+# forecasts over them, the history store's own bounds, and per-rule
+# firing-alert counts
+DISK_BYTES = REGISTRY.gauge(
+    "weedtpu_disk_bytes",
+    "per-data-dir disk capacity by volume server, directory, and kind "
+    "(total/used/free)", ("vs", "dir", "kind"))
+VOLUME_SIZE = REGISTRY.gauge(
+    "weedtpu_volume_size_bytes",
+    "size of each locally served volume, per hosting server",
+    ("vid", "vs"))
+PREDICTED_FULL = REGISTRY.gauge(
+    "weedtpu_predicted_full_seconds",
+    "seconds until a data dir is predicted to fill (linear fill-rate "
+    "regression over /cluster/history; capped ~10y when not filling)",
+    ("vs", "dir"))
+VOLUME_PREDICTED_FULL = REGISTRY.gauge(
+    "weedtpu_volume_predicted_full_seconds",
+    "seconds until a growing volume is predicted to hit the size limit "
+    "(only volumes actually filling get a series)", ("vid",))
+HISTORY_SERIES = REGISTRY.gauge(
+    "weedtpu_history_series",
+    "series held by the master's history store (bounded by "
+    "WEEDTPU_HISTORY_MAX_SERIES)")
+HISTORY_EVICTED = REGISTRY.counter(
+    "weedtpu_history_evicted_total",
+    "series refused or evicted by the history store's cardinality bound")
+ALERTS_FIRING = REGISTRY.gauge(
+    "weedtpu_alerts_firing", "alert groups currently firing, per rule",
+    ("rule",))
+# canary latency as direct gauges (stats/canary.py sets them after each
+# probe): the dashboard reads per-path p50/p99 trends from history
+# without bucket math
+CANARY_LATENCY = REGISTRY.gauge(
+    "weedtpu_canary_latency_seconds",
+    "canary probe latency quantiles over the rolling window",
+    ("path", "quantile"))
+# registry self-cost: stamped on every render (see Registry.render) so
+# the dashboard — itself fed from these series — can watch what the
+# telemetry plane costs
+METRIC_SERIES = REGISTRY.gauge(
+    "weedtpu_metric_series",
+    "label sets live across all metric families in this registry")
+REGISTRY._series_gauge = METRIC_SERIES

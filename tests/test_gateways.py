@@ -390,10 +390,17 @@ class TestMqBrokerCluster:
             values = {g["key"]: g["value"] for g in got}
             assert values["k3"] == "v3" and values["k59"] == "v59"
 
-            # publishing continues through the survivor
+            # publishing continues through the survivor; same
+            # settle-loop as the post-failover read above — ownership
+            # re-routing can still be replicating the newest appends
             for i in range(60, 80):
                 self._pub(fast1.url, topic, f"k{i}", f"v{i}".encode())
-            got = self._read_all(fast1.peer_brokers, topic, 4)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                got = self._read_all(fast1.peer_brokers, topic, 4)
+                if len(got) == 80:
+                    break
+                time.sleep(0.3)
             assert len(got) == 80
             # committed offsets survived the dead broker too
             st, body, _ = req(f"http://{fast1.url}/offsets/get?group=g1"

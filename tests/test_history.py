@@ -1,0 +1,603 @@
+"""Historical telemetry plane tests (stats/history.py): multi-resolution
+ring rollup math (counter deltas across node restarts, min/max/last per
+resolution), fixed-memory cardinality eviction, alert for-duration
+hysteresis (a flap never fires; sustained does; clearing takes
+clear_for), capacity-forecast regression on a synthetic fill curve,
+scrape-age +Inf for never-scraped nodes, and a 3-node integration test
+where a delay_shard_read fault makes a rate-of-change rule fire on
+/cluster/alerts and maintenance.status within two aggregator ticks."""
+
+import io
+import json
+import math
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.stats import aggregate as ag
+from seaweedfs_tpu.stats import history, metrics
+from tests.test_cluster import Cluster
+from tests.test_cluster_obs import _read_all, _upload_and_encode_all
+from tests.test_maintenance import _get, _post
+
+
+# ---- helpers -----------------------------------------------------------
+
+def _node(counter=None, gauge=None, hist=None):
+    """One node's parsed exposition built from a fresh registry."""
+    reg = metrics.Registry()
+    if counter is not None:
+        reg.counter("weedtpu_h_total", "c", ("op",)).labels(
+            "read").inc(counter)
+    if gauge is not None:
+        reg.gauge("weedtpu_h_gauge", "g", ("who",)).labels(
+            "x").set(gauge)
+    if hist is not None:
+        h = reg.histogram("weedtpu_h_seconds", "h")
+        for v in hist:
+            h.labels().observe(v, trace_id="e" * 32)
+    return ag.parse_exposition(reg.render(openmetrics=True))
+
+
+def _store(res=((0, 8), (10, 8), (60, 8)), max_series=64):
+    return history.HistoryStore(resolutions=list(res),
+                                max_series=max_series)
+
+
+# ---- ring rollups ------------------------------------------------------
+
+def test_ring_rollup_min_max_last_sum_count():
+    r = history._Ring(10, 4)
+    r.append(1003.0, 5.0)
+    r.append(1007.0, 1.0)
+    r.append(1012.0, 9.0)
+    slots = list(r.slots())
+    assert [s[0] for s in slots] == [1000.0, 1010.0]
+    ts, vmin, vmax, vlast, vsum, vcount, vfirst = slots[0]
+    assert (vmin, vmax, vlast, vsum, vcount, vfirst) == \
+        (1.0, 5.0, 1.0, 6.0, 2.0, 5.0)
+    assert slots[1][1:] == (9.0, 9.0, 9.0, 9.0, 1.0, 9.0)
+
+
+def test_ring_fixed_capacity_overwrites_oldest():
+    r = history._Ring(0, 4)
+    for i in range(10):
+        r.append(100.0 + i, float(i))
+    slots = list(r.slots())
+    assert [s[0] for s in slots] == [106.0, 107.0, 108.0, 109.0]
+    # the columns never grow: preallocated fixed arrays
+    assert len(r.ts) == 4 and r.n == 4
+
+
+def test_ring_out_of_order_point_merges_instead_of_corrupting():
+    r = history._Ring(0, 8)
+    r.append(100.0, 1.0)
+    r.append(110.0, 2.0)
+    r.append(105.0, 7.0)  # racing scrape: folds into the open slot
+    slots = list(r.slots())
+    assert [s[0] for s in slots] == [100.0, 110.0]
+    assert slots[1][2] == 7.0  # max saw it
+
+
+# ---- store: counter deltas, restarts, resolutions ----------------------
+
+def test_counter_deltas_per_node_and_across_restart():
+    store = _store()
+    t0 = 1000.0
+    store.record(t0, {"a": _node(counter=100), "b": _node(counter=50)})
+    # first sight contributes 0, not the lifetime total
+    store.record(t0 + 10, {"a": _node(counter=160),
+                           "b": _node(counter=20)})  # b restarted: 20
+    res = store.query("weedtpu_h_total", {"op": "read"}, range_s=40,
+                      step=10, agg="sum", now=t0 + 10)
+    pts = dict((t, v) for t, v in res["vectors"][0]["points"])
+    # first sight contributed no delta: the series is born with its
+    # first observed movement, not with the node's lifetime total
+    assert pts[t0] is None
+    # a: 160-100=60; b reset: counts from zero = 20 (the SLOEngine rule)
+    assert pts[t0 + 10] == 80.0
+    # rate = sum / step
+    res = store.query("weedtpu_h_total", {"op": "read"}, range_s=40,
+                      step=10, agg="rate", now=t0 + 10)
+    assert dict(map(tuple, res["vectors"][0]["points"]))[t0 + 10] == 8.0
+
+
+def test_gauges_sum_across_nodes_and_rollup_aggs():
+    store = _store(res=((0, 4), (10, 8), (60, 8)))
+    t0 = 2000.0
+    vals = [(0, 3.0, 5.0), (2, 1.0, 1.0), (4, 9.0, 2.0), (6, 4.0, 4.0),
+            (11, 8.0, 8.0)]
+    for dt, a, b in vals:
+        store.record(t0 + dt, {"a": _node(gauge=a), "b": _node(gauge=b)})
+    # raw ring holds only the last 4 ticks; the 10s ring rolled all 5 up,
+    # so a range query over everything picks the 10s resolution
+    res = store.query("weedtpu_h_gauge", {"who": "x"}, range_s=30,
+                      step=10, agg="max", now=t0 + 12)
+    assert res["resolution_s"] == 10.0
+    # the 10s slot at t0 folds the first four ticks of summed gauges
+    # (8, 2, 11, 8); the slot at t0+10 holds the last tick (16)
+    by_ts = dict(map(tuple, res["vectors"][0]["points"]))
+    assert by_ts[t0] == 11.0
+    assert by_ts[t0 + 10] == 16.0
+    res = store.query("weedtpu_h_gauge", None, range_s=30, step=10,
+                      agg="min", now=t0 + 12)
+    assert dict(map(tuple, res["vectors"][0]["points"]))[t0] == 2.0
+    res = store.query("weedtpu_h_gauge", None, range_s=30, step=10,
+                      agg="last", now=t0 + 12)
+    assert dict(map(tuple, res["vectors"][0]["points"]))[t0] == 8.0
+    # default agg for gauges is last
+    res = store.query("weedtpu_h_gauge", None, range_s=30, step=10,
+                      now=t0 + 12)
+    assert res["agg"] == "auto"
+    assert dict(map(tuple, res["vectors"][0]["points"]))[t0 + 10] == 16.0
+
+
+def test_histogram_quantile_over_time():
+    store = _store(res=((0, 16),))
+    t0 = 3000.0
+    # each _node() renders a fresh registry, so the two ticks look like
+    # one node whose cumulative histogram grew by 20 fast + 2 slow obs
+    store.record(t0, {"a": _node(hist=[0.004])})
+    store.record(t0 + 10, {"a": _node(hist=[0.004] * 21 + [2.0] * 2)})
+    res = store.query("weedtpu_h_seconds", None, range_s=20, step=20,
+                      agg="p99", now=t0 + 10)
+    pts = [v for _, v in res["vectors"][0]["points"] if v is not None]
+    assert pts, res
+    # p99 of 20x4ms + 2x2s sits in the seconds bucket
+    assert 1.0 <= pts[-1] <= 2.5
+    res50 = store.query("weedtpu_h_seconds", None, range_s=20, step=20,
+                        agg="p50", now=t0 + 10)
+    p50 = [v for _, v in res50["vectors"][0]["points"] if v is not None]
+    assert p50 and p50[-1] <= 0.01
+
+
+def test_cardinality_eviction_and_memory_bound():
+    store = _store(max_series=5)
+    reg = metrics.Registry()
+    g = reg.gauge("weedtpu_card", "g", ("i",))
+    for i in range(12):
+        g.labels(str(i)).set(float(i))
+    before = store.evicted
+    store.record(5000.0, {"a": ag.parse_exposition(reg.render())})
+    assert store.series_count() == 5
+    assert store.evicted == before + 7
+    # the bound is structural: preallocated slots, not "whatever fit"
+    assert store.slot_capacity() == 5 * sum(
+        c for _, c in store.resolutions)
+    # a second tick with the same fleet evicts again but never grows
+    store.record(5010.0, {"a": ag.parse_exposition(reg.render())})
+    assert store.series_count() == 5
+
+
+def test_transient_scrape_gap_keeps_counter_baseline():
+    """A node missing ONE tick (scrape timeout — exactly when incidents
+    happen) books its growth across the gap on return, instead of being
+    re-baselined at first-sight and losing the increments."""
+    store = _store()
+    t0 = 5500.0
+    store.record(t0, {"a": _node(counter=100), "b": _node(counter=100)})
+    store.record(t0 + 10, {"a": _node(counter=110)})  # b's pull failed
+    store.record(t0 + 20, {"a": _node(counter=120),
+                           "b": _node(counter=160)})  # b is back
+    res = store.query("weedtpu_h_total", {"op": "read"}, range_s=40,
+                      step=10, agg="sum", now=t0 + 20)
+    pts = dict(map(tuple, res["vectors"][0]["points"]))
+    # a: 10; b: 60 across the gap — none of b's growth is lost
+    assert pts[t0 + 20] == 70.0
+
+
+def test_disabled_window_does_not_spike_counters_on_reenable(monkeypatch):
+    """While WEEDTPU_HISTORY=0 the per-node counter baselines are
+    dropped, so re-enabling books the first tick as first-sight (delta
+    0) instead of the whole disabled window's growth as one spike."""
+    store = _store()
+    t0 = 6000.0
+    monkeypatch.setenv("WEEDTPU_HISTORY", "1")
+    history._enabled_cache = (0.0, True)
+    store.record(t0, {"a": _node(counter=100)})
+    store.record(t0 + 10, {"a": _node(counter=150)})
+    monkeypatch.setenv("WEEDTPU_HISTORY", "0")
+    history._enabled_cache = (0.0, False)
+    store.record(t0 + 20, {"a": _node(counter=3_600_200)})  # dropped
+    monkeypatch.setenv("WEEDTPU_HISTORY", "1")
+    history._enabled_cache = (0.0, True)
+    store.record(t0 + 30, {"a": _node(counter=3_600_250)})
+    store.record(t0 + 40, {"a": _node(counter=3_600_300)})
+    res = store.query("weedtpu_h_total", {"op": "read"}, range_s=50,
+                      step=10, agg="sum", now=t0 + 40)
+    pts = dict(map(tuple, res["vectors"][0]["points"]))
+    assert pts[t0 + 10] == 50.0
+    assert pts[t0 + 30] in (None, 0.0)  # first-sight after re-enable
+    assert pts[t0 + 40] == 50.0  # and deltas resume normally
+
+
+def test_dead_series_evicted_for_live_newcomer():
+    """At the cap, a series whose fleet series vanished (> EVICT_IDLE_S
+    without a point) yields its slot to a new live series — label churn
+    must not permanently blind the plane."""
+    store = _store(max_series=2)
+    t0 = 7000.0
+
+    def tick(ts, who, v):
+        reg = metrics.Registry()
+        reg.gauge("weedtpu_churn", "g", ("who",)).labels(who).set(v)
+        store.record(ts, {"a": ag.parse_exposition(reg.render())})
+
+    tick(t0, "old1", 1.0)
+    tick(t0, "old2", 1.0)
+    assert store.series_count() == 2
+    # a newcomer while both are fresh is refused
+    tick(t0 + 10, "fresh", 1.0)
+    names = {dict(k[1]).get("who") for k in store._series}
+    assert names == {"old1", "old2"}
+    # after the idle horizon, the stalest dead series is evicted
+    tick(t0 + store.EVICT_IDLE_S + 20, "newcomer", 1.0)
+    names = {dict(k[1]).get("who") for k in store._series}
+    assert "newcomer" in names and len(names) == 2
+    assert store.evicted >= 2
+
+
+def test_gauge_rate_uses_slot_first_not_min():
+    """A gauge that dips and recovers inside one rollup slot is flat:
+    its rate must read 0, not the recovery from the in-slot minimum."""
+    store = _store(res=((10, 8),))
+    t0 = 8000.0
+    for dt, v in ((0, 5.0), (3, 1.0), (6, 5.0)):
+        store.record(t0 + dt, {"a": _node(gauge=v)})
+    recs = store.window_groups("weedtpu_h_gauge", {}, 60, now=t0 + 6)
+    assert recs[0]["first"] == 5.0 and recs[0]["last"] == 5.0
+    assert recs[0]["min"] == 1.0
+
+
+# ---- alert engine ------------------------------------------------------
+
+def _alert_setup(rule_spec, **store_kw):
+    store = _store(**store_kw)
+    rules = history.parse_alert_rules(rule_spec)
+    pinned = []
+    eng = history.AlertEngine(store, rules=rules, pin_fn=pinned.append)
+    return store, eng, pinned
+
+
+def test_alert_rule_parsing_defaults_and_junk():
+    rules = history.parse_alert_rules(
+        "hot=threshold,series=weedtpu_x,agg=max,window=30,op=gt,value=5,"
+        "for=10;junk;noseries=threshold,op=gt;"
+        "gone=absence,series=weedtpu_y,window=45;"
+        "roc=rate,series=weedtpu_z_total,window=20,op=gt,value=0.5,"
+        "for=2,clear_for=7")
+    assert [r["name"] for r in rules] == ["hot", "gone", "roc"]
+    assert rules[0]["for_s"] == 10.0 and rules[0]["clear_for"] == 10.0
+    assert rules[1]["kind"] == "absence" and rules[1]["window"] == 45.0
+    assert rules[2]["clear_for"] == 7.0
+    # defaults come from the built-in rule set
+    names = {r["name"] for r in history.parse_alert_rules(None)}
+    assert {"node_scrape_stale", "scrape_age_absent",
+            "disk_full_soon"} <= names
+
+
+def test_alert_flap_does_not_fire_sustained_does_and_clear_hysteresis():
+    # agg=last so the predicate follows the newest value (agg=max would
+    # deliberately hold a spike true for the whole window)
+    store, eng, pinned = _alert_setup(
+        "hot=threshold,series=weedtpu_h_gauge,agg=last,window=30,op=gt,"
+        "value=10,for=15,clear_for=15")
+    t0 = 10000.0
+
+    def tick(dt, v):
+        store.record(t0 + dt, {"a": _node(gauge=v)})
+        eng.evaluate(t0 + dt)
+        return eng.status()["rules"][0]["state"]
+
+    assert tick(0, 1.0) == "ok"
+    # flap: one hot evaluation, then cold — pending must NOT fire
+    assert tick(10, 99.0) == "pending"
+    assert tick(20, 1.0) == "ok"
+    # sustained: hot for >= for_s fires
+    assert tick(30, 99.0) == "pending"
+    assert tick(40, 99.0) == "pending"  # 10s < 15s held
+    assert tick(46, 99.0) == "firing"   # 16s held
+    # clearing needs clear_for of sustained false
+    assert tick(56, 1.0) == "firing"
+    assert tick(66, 1.0) == "firing"    # 10s cold < 15s
+    assert tick(72, 1.0) == "ok"        # 16s cold: resolved
+    assert pinned == []  # no exemplar on this series
+
+
+def test_alert_fire_pins_exemplar_and_counts_gauge():
+    store, eng, pinned = _alert_setup(
+        "slow=threshold,series=weedtpu_h_seconds_count,agg=sum,"
+        "window=30,op=gt,value=5,for=0")
+    t0 = 20000.0
+    store.record(t0, {"a": _node(hist=[0.004])})
+    store.record(t0 + 10, {"a": _node(hist=[0.004] * 20)})
+    eng.evaluate(t0 + 10)
+    st = eng.status()
+    assert st["rules"][0]["state"] == "firing"
+    # the triggering series' OpenMetrics exemplar got pinned
+    assert pinned == ["e" * 32]
+    assert st["rules"][0]["groups"][0]["exemplar"] == "e" * 32
+
+
+def test_alert_rate_rule_on_counter_and_absence():
+    store, eng, _ = _alert_setup(
+        "roc=rate,series=weedtpu_h_total,label.op=read,window=20,op=gt,"
+        "value=2,for=0;"
+        "dark=absence,series=weedtpu_h_gauge,window=25,for=0")
+    t0 = 30000.0
+    store.record(t0, {"a": _node(counter=0, gauge=1.0)})
+    store.record(t0 + 10, {"a": _node(counter=10, gauge=1.0)})
+    eng.evaluate(t0 + 10)
+    by = {r["name"]: r["state"] for r in eng.status()["rules"]}
+    assert by == {"roc": "ok", "dark": "ok"}  # 10/20 = 0.5 <= 2
+    store.record(t0 + 20, {"a": _node(counter=100, gauge=1.0)})
+    eng.evaluate(t0 + 20)
+    by = {r["name"]: r["state"] for r in eng.status()["rules"]}
+    assert by["roc"] == "firing"  # 90/20 = 4.5 > 2
+    # the gauge stops reporting: absence fires once the window passes
+    store.record(t0 + 60, {"a": _node(counter=100)})
+    eng.evaluate(t0 + 60)
+    assert {r["name"]: r["state"] for r in eng.status()["rules"]}[
+        "dark"] == "firing"
+
+
+# ---- capacity forecasting ----------------------------------------------
+
+def test_forecast_regression_on_synthetic_fill_curve():
+    store = _store(res=((0, 64),), max_series=64)
+    reg = metrics.Registry()
+    disk = reg.gauge("weedtpu_disk_bytes", "d", ("vs", "dir", "kind"))
+    vol = reg.gauge("weedtpu_volume_size_bytes", "v", ("vid", "vs"))
+    t0 = 40000.0
+    total = 1e9
+    for i in range(12):
+        # disk fills at exactly 2 MB/s; volume grows 1 MB/s
+        disk.labels("n1:8080", "/data", "used").set(1e8 + 2e6 * 10 * i)
+        disk.labels("n1:8080", "/data", "total").set(total)
+        vol.labels("7", "n1:8080").set(1e6 * 10 * i)
+        store.record(t0 + 10 * i,
+                     {"n1:8080": ag.parse_exposition(reg.render())})
+    fc = history.CapacityForecaster(store, window=300)
+    limit = 256 * 1024 * 1024
+    fc.update(now=t0 + 110, volume_size_limit=limit)
+    snap = fc.snapshot()
+    d = snap["disks"][0]
+    assert (d["vs"], d["dir"]) == ("n1:8080", "/data")
+    assert d["fill_bps"] == pytest.approx(2e6, rel=0.01)
+    free = total - (1e8 + 2e6 * 110)
+    assert d["predicted_full_seconds"] == pytest.approx(free / 2e6,
+                                                        rel=0.05)
+    # the volume forecast uses the size limit
+    v = snap["volumes"][0]
+    assert v["vid"] == "7"
+    left = limit - 1e6 * 110
+    assert v["predicted_full_seconds"] == pytest.approx(left / 1e6,
+                                                        rel=0.05)
+    # horizon queries feed the repair planner's urgency boost
+    assert fc.filling_nodes(d["predicted_full_seconds"] + 10) == \
+        {"n1:8080"}
+    assert fc.filling_nodes(1.0) == set()
+
+
+def test_forecast_flat_disk_reports_capped_not_absent():
+    store = _store(res=((0, 16),))
+    reg = metrics.Registry()
+    disk = reg.gauge("weedtpu_disk_bytes", "d", ("vs", "dir", "kind"))
+    t0 = 50000.0
+    for i in range(4):
+        disk.labels("n2:8081", "/d0", "used").set(5e8)
+        disk.labels("n2:8081", "/d0", "total").set(1e9)
+        store.record(t0 + 10 * i,
+                     {"n2:8081": ag.parse_exposition(reg.render())})
+    fc = history.CapacityForecaster(store, window=300)
+    fc.update(now=t0 + 30)
+    d = fc.snapshot()["disks"][0]
+    assert d["predicted_full_seconds"] == history.FORECAST_CAP_S
+    assert fc.filling_nodes(86400.0) == set()
+
+
+# ---- scrape-age semantics ----------------------------------------------
+
+def test_never_scraped_node_reports_inf_not_fresh():
+    reg = metrics.Registry()
+    reg.counter("weedtpu_x_total", "c").labels().inc()
+    agg = ag.ClusterAggregator(
+        lambda: {"127.0.0.1:1": "127.0.0.1:1"},
+        local=("m:1", reg), interval=0)
+    seen = []
+    agg.observers.append(lambda ts, pn: seen.append(pn))
+    try:
+        agg.scrape_once()
+        out = agg.render()
+        assert 'weedtpu_agg_scrape_age_seconds{node="127.0.0.1:1"} +Inf' \
+            in out
+        assert 'weedtpu_agg_scrape_age_seconds{node="m:1"} 0' in out
+        # the observer payload carries the synthetic series with inf, so
+        # the default node_scrape_stale threshold rule sees it
+        fams = seen[-1]["__aggregator__"]
+        ages = {lab["node"]: v for _, lab, v in
+                fams["weedtpu_agg_scrape_age_seconds"]["samples"]}
+        assert ages["127.0.0.1:1"] == math.inf
+        assert ages["m:1"] < 5.0
+        store = _store()
+        eng = history.AlertEngine(store, rules=history.parse_alert_rules(
+            "stale=threshold,series=weedtpu_agg_scrape_age_seconds,"
+            "agg=max,window=60,op=gt,value=45,for=0"))
+        store.record(time.time(), seen[-1])
+        eng.evaluate()
+        rule = eng.status()["rules"][0]
+        assert rule["state"] == "firing"
+        firing = [g for g in rule["groups"] if g["state"] == "firing"]
+        assert firing and firing[0]["labels"] == {"node": "127.0.0.1:1"}
+        assert firing[0].get("stale") is True  # +Inf stays out of JSON
+    finally:
+        agg.stop()
+
+
+# ---- 3-node integration ------------------------------------------------
+
+@pytest.fixture()
+def hist_cluster(tmp_path, monkeypatch):
+    """3 volume servers, EC everywhere, deterministic history: no
+    background aggregation (ticks driven via /cluster/alerts?refresh=1),
+    a rate-of-change rule on read-seconds-spent tight enough that the
+    injected 100ms shard-read delay blows it, tiny hysteresis so the
+    test sees both edges."""
+    monkeypatch.setenv("WEEDTPU_EC_CODEC", "numpy")
+    monkeypatch.setenv("WEEDTPU_SCRUB_MBPS", "0")
+    monkeypatch.setenv("WEEDTPU_REPAIR_INTERVAL", "3600")
+    monkeypatch.setenv("WEEDTPU_AGG_INTERVAL", "0")
+    monkeypatch.setenv("WEEDTPU_HEDGE_PCT", "0")
+    monkeypatch.setenv(
+        "WEEDTPU_ALERT_RULES",
+        "read_time_burn=rate,series=weedtpu_volume_request_seconds_sum,"
+        "label.type=read,window=8,op=gt,value=0.8,for=0,clear_for=0.3;"
+        "node_scrape_stale=threshold,"
+        "series=weedtpu_agg_scrape_age_seconds,agg=max,window=60,"
+        "op=gt,value=45,for=0")
+    c = Cluster(tmp_path, n_volume_servers=3).start()
+    c.wait_heartbeats()
+    yield c
+    c.stop()
+
+
+def _alerts(master_url, refresh=True):
+    qs = "?refresh=1" if refresh else ""
+    return _get(master_url, f"/cluster/alerts{qs}", timeout=60)
+
+
+def _alert_rule(st, name):
+    return next(r for r in st["rules"] if r["name"] == name)
+
+
+def test_cluster_alerts_fire_on_delay_fault_and_clear(hist_cluster):
+    c = hist_cluster
+    client, payloads = _upload_and_encode_all(c)
+
+    # -- healthy phase: baseline tick, fast reads, rule ok ---------------
+    _alerts(c.master.url)
+    _read_all(client, payloads)
+    st = _alerts(c.master.url)
+    assert _alert_rule(st, "read_time_burn")["state"] == "ok", st
+    assert _alert_rule(st, "node_scrape_stale")["state"] == "ok"
+
+    # -- fault phase: every peer shard fetch stalls 100ms ----------------
+    for vs in c.volume_servers:
+        _post(vs.url, "/admin/faults", {"faults": [
+            {"action": "delay_shard_read", "ms": 100}]})
+    _read_all(client, payloads)  # most needles live on a peer shard
+    # fires within two aggregator ticks of the fault biting
+    st = _alerts(c.master.url)
+    if _alert_rule(st, "read_time_burn")["state"] != "firing":
+        st = _alerts(c.master.url)
+    rule = _alert_rule(st, "read_time_burn")
+    assert rule["state"] == "firing", rule
+    group = next(g for g in rule["groups"] if g["state"] == "firing")
+    assert group["labels"].get("type") == "read"
+    assert group["value"] > 0.8
+
+    # -- the firing alert surfaces in maintenance.status + the shell -----
+    mst = _get(c.master.url, "/maintenance/status")
+    m_rule = _alert_rule(mst["alerts"], "read_time_burn")
+    assert m_rule["state"] == "firing"
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    env = CommandEnv(c.master.url)
+    out = io.StringIO()
+    run_command(env, "cluster.alerts", out)
+    text = out.getvalue()
+    assert "read_time_burn" in text and "FIRING" in text, text
+    out = io.StringIO()
+    run_command(env, "maintenance.status", out)
+    assert "alerts:" in out.getvalue(), out.getvalue()
+
+    # -- recovery: drop the fault; fast reads; clears with hysteresis ----
+    for vs in c.volume_servers:
+        _post(vs.url, "/admin/faults", {"faults": [
+            {"action": "delay_shard_read", "ms": 0}]})
+    # quiet ticks only: the rule watches read-seconds-per-second, so
+    # continuously re-reading the whole set would keep feeding it
+    deadline = time.time() + 30
+    state = "firing"
+    while time.time() < deadline:
+        time.sleep(0.4)
+        state = _alert_rule(_alerts(c.master.url),
+                            "read_time_burn")["state"]
+        if state == "ok":
+            break
+    assert state == "ok", state
+
+
+def test_cluster_history_endpoint_and_dashboard(hist_cluster):
+    c = hist_cluster
+    client, payloads = _upload_and_encode_all(c, n=8)
+    for _ in range(3):
+        _read_all(client, payloads)
+        c.master.aggregator.scrape_once()
+        time.sleep(0.25)
+
+    # -- range vectors over the read counters ----------------------------
+    h = _get(c.master.url,
+             "/cluster/history?series=weedtpu_volume_request_total"
+             "&labels=type=read&agg=sum&range=60&step=5", timeout=60)
+    assert h["vectors"], h
+    vals = [v for _, v in h["vectors"][0]["points"] if v is not None]
+    assert vals and sum(vals) > 0
+    # quantile-over-time from the merged histogram buckets
+    q = _get(c.master.url,
+             "/cluster/history?series=weedtpu_volume_request_seconds"
+             "&labels=type=read&agg=p99&range=60&step=60", timeout=60)
+    assert q["vectors"]
+    # 3 resolutions configured and reported
+    assert len(c.master.history.resolutions) >= 3
+    assert "resolution_s" in h
+    # series=... is required
+    req = urllib.request.Request(
+        f"http://{c.master.url}/cluster/history")
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+    # -- predicted_full_seconds appears for every disk -------------------
+    with urllib.request.urlopen(
+            f"http://{c.master.url}/cluster/metrics?refresh=1",
+            timeout=60) as r:
+        fed = r.read().decode()
+    for vs in c.volume_servers:
+        want = f'weedtpu_predicted_full_seconds{{'
+        assert any(f'vs="{vs.url}"' in line for line in fed.splitlines()
+                   if line.startswith(want)), vs.url
+    assert "weedtpu_metric_series" in fed
+
+    # -- the dashboard renders self-contained SVG from history -----------
+    with urllib.request.urlopen(
+            f"http://{c.master.url}/cluster/dashboard", timeout=60) as r:
+        dash = r.read().decode()
+    assert "<svg" in dash and "Capacity forecasts" in dash
+    assert "src=" not in dash and "http://" not in dash.replace(
+        f"http://{c.master.url}", "")  # zero external assets
+    # shell twin renders sparklines over the same store
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    env = CommandEnv(c.master.url)
+    out = io.StringIO()
+    run_command(env, "cluster.history -series weedtpu_volume_request_total"
+                     " -labels type=read -agg sum -range 60 -step 5", out)
+    assert "weedtpu_volume_request_total" in out.getvalue()
+
+
+def test_history_store_memory_is_bounded_in_live_master(hist_cluster):
+    c = hist_cluster
+    store = c.master.history
+    for _ in range(3):
+        c.master.aggregator.scrape_once()
+    assert 0 < store.series_count() <= store.max_series
+    status = store.status()
+    assert status["slot_capacity"] == store.max_series * sum(
+        cap for _, cap in store.resolutions)
+    # every ring is preallocated at its fixed capacity
+    with store._lock:
+        s = next(iter(store._series.values()))
+    assert [len(r.ts) for r in s.rings] == \
+        [cap for _, cap in store.resolutions]

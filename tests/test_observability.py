@@ -200,6 +200,32 @@ def test_registry_wide_metric_conventions():
         for label in m.label_names:
             assert re.fullmatch(r"[a-z][a-z0-9_]*", label), \
                 f"{name}: bad label name {label!r}"
+        # label-cardinality bound: a family drifting toward the
+        # MAX_CHILDREN collapse is leaking label values (fids, paths,
+        # tenant ids); catch it at half the hard cap, while __other__
+        # folding has not yet corrupted the data
+        bound = m.MAX_CHILDREN // 2
+        assert len(m._children) <= bound, \
+            f"{name}: {len(m._children)} label sets exceed the " \
+            f"cardinality bound {bound}"
+
+
+def test_metric_series_self_gauge_tracks_registry_cost():
+    """Rendering the global registry stamps weedtpu_metric_series with
+    its own live series count, so the dashboard (fed from these very
+    series) can watch what the telemetry plane costs."""
+    text = metrics.REGISTRY.render()
+    m = re.search(r"^weedtpu_metric_series (\d+)", text, re.M)
+    assert m, "self-gauge missing from exposition"
+    count = int(m.group(1))
+    assert count > 0
+    # matches reality at render time (rendering itself may add a child)
+    assert abs(count - metrics.REGISTRY.series_count()) <= 2
+    # registering a new label set moves the next render
+    metrics.MASTER_ASSIGN_COUNTER.labels("self-gauge-probe").inc()
+    text2 = metrics.REGISTRY.render()
+    m2 = re.search(r"^weedtpu_metric_series (\d+)", text2, re.M)
+    assert int(m2.group(1)) >= count
 
 
 def test_cardinality_collapses_to_other():
