@@ -543,14 +543,26 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
         if best > 0 and now_v < TRAJECTORY_TOL * best:
             regressions[m] = {"value": now_v, "best_prior": best,
                               "ratio": round(now_v / best, 3)}
+    for m in TRAJECTORY_GATED_MIN:
+        # lower-is-better (e.g. repair_network_ratio): gate on RISING
+        # >10% above the best (minimum) prior recorded round
+        now_v = mets_now.get(m)
+        if now_v is None:
+            continue
+        priors = [e.get("metrics", {}).get(m) for e in comparable
+                  if e.get("metrics", {}).get(m)]
+        best = min(priors, default=0.0)
+        if best > 0 and now_v > best / TRAJECTORY_TOL:
+            regressions[m] = {"value": now_v, "best_prior": best,
+                              "ratio": round(now_v / best, 3)}
     extra["bench_rounds_prior"] = len(entries)
     if regressions:
         extra["bench_regression"] = regressions
         for m, r in regressions.items():
             print(f"bench: REGRESSION — {m} = {r['value']} is "
                   f"{r['ratio']:.2f}x the best prior {backend} round "
-                  f"({r['best_prior']}); >10% trajectory drop. Failing "
-                  f"the bench run.", file=sys.stderr)
+                  f"({r['best_prior']}); >10% off the trajectory best. "
+                  f"Failing the bench run.", file=sys.stderr)
     entry = {"n": len(entries) + 1,
              "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
              "backend": backend, "metrics": mets_now}
@@ -764,6 +776,7 @@ def _exit_code(extra: dict) -> int:
              "heat_overhead_regression",
              "history_overhead_regression",
              "repair_interference_regression",
+             "repair_ratio_regression",
              "chaos_scenario_failed",
              "bench_regression",
              "gated_bench_failed")
@@ -803,10 +816,17 @@ HISTORY_OVERHEAD_TOL = 0.97
 # the best prior recorded round (same backend) fails the run
 TRAJECTORY_TOL = 0.90
 TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1")
+# lower-is-better trajectory gates: the metric failing when it RISES
+# more than 10% above the best (minimum) prior recorded round
+TRAJECTORY_GATED_MIN = ("repair_network_ratio",)
 # ...comparing against the best of only the last N recorded same-backend
 # rounds, so one cache-hot outlier round ages out of the bar instead of
 # ratcheting it forever
 TRAJECTORY_LOOKBACK = 5
+# reduced-read recovery (ISSUE 11 acceptance bar): the planner-driven
+# heal must move <= 0.6x the repair bytes of the naive shell-rebuild
+# walk over the same loss pattern
+REPAIR_RATIO_TOL = 0.6
 # foreground read p99 while the repair planner rebuilds lost shards must
 # stay within 1.5x the idle p99 (ISSUE 9 acceptance bar; the 1709.05365
 # measurement: online repair/encode interference with foreground traffic)
@@ -1577,6 +1597,17 @@ def _bench_heal_time(extra: dict, n_volumes: int = 4,
         # the baseline ROADMAP item 1's reduced-read decode must beat
         extra["repair_network_bytes"] = int(repair_bytes["heal"])
         extra["repair_network_bytes_naive"] = int(repair_bytes["naive"])
+        if repair_bytes["naive"] > 0:
+            net_ratio = repair_bytes["heal"] / repair_bytes["naive"]
+            extra["repair_network_ratio"] = round(net_ratio, 3)
+            if net_ratio > REPAIR_RATIO_TOL:
+                extra["repair_ratio_regression"] = True
+                print(f"bench: REGRESSION — reduced-read heal moved "
+                      f"{net_ratio:.2f}x the naive rebuild's repair "
+                      f"bytes (must be <= {REPAIR_RATIO_TOL}x: "
+                      f"{repair_bytes['heal']:.0f}B vs "
+                      f"{repair_bytes['naive']:.0f}B). Failing the "
+                      f"bench run.", file=sys.stderr)
         if not healed:
             extra["heal_time_regression"] = True
             print("bench: REGRESSION — automatic healing never converged "

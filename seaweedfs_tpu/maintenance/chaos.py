@@ -74,9 +74,14 @@ class ChaosCluster:
                  with_s3: bool = False, with_mq: bool = False,
                  replication: str = "000",
                  volume_size_limit: int = 64 * 1024 * 1024,
-                 heartbeat_interval: float = 0.3):
+                 heartbeat_interval: float = 0.3,
+                 racks: list[str] | None = None):
         self.tmp = tmp_path
         self.n = n_volume_servers
+        # rack label per volume server (None = all on the default rack):
+        # the rack-scoped chaos cells and the locality-aware repair
+        # planner key off these
+        self.racks = racks
         self.n_masters = n_masters
         self.with_filer = with_filer
         self.with_s3 = with_s3
@@ -211,9 +216,11 @@ class ChaosCluster:
 
     def _start_volume_server(self, i: int) -> None:
         from seaweedfs_tpu.server.volume_server import VolumeServer
+        rack = self.racks[i] if self.racks else ""
         vs = VolumeServer([str(self.tmp / f"vs{i}")], self.master_urls,
                           "127.0.0.1", self.vs_ports[i], max_volumes=20,
-                          heartbeat_interval=self.heartbeat_interval)
+                          heartbeat_interval=self.heartbeat_interval,
+                          rack=rack)
         self.submit(vs.start())
         self.volume_servers[i] = vs
 
@@ -679,6 +686,67 @@ def _fault_restart_mid_repair(c: ChaosCluster, ctx: dict) -> None:
     heal_until_clean(c, timeout=90.0)
 
 
+def repair_recv_bytes() -> float:
+    """Process-wide class=repair received bytes (stats/netflow): the
+    fleet-scale repair-traffic number the reduced-read path minimizes."""
+    from seaweedfs_tpu.stats import netflow
+    return netflow.class_total("recv", "repair")
+
+
+def shards_on_rack(c: ChaosCluster, vid: int, rack: str) -> list[tuple]:
+    """(server, shard_id) pairs of `vid`'s shards living on `rack`."""
+    out = []
+    for i, vs in enumerate(c.volume_servers):
+        if vs is None or (c.racks[i] if c.racks else "") != rack:
+            continue
+        ev = vs.store.get_ec_volume(vid)
+        if ev is not None:
+            out.extend((vs, sid) for sid in ev.shard_ids())
+    return out
+
+
+def _fault_rack_loss(c: ChaosCluster, ctx: dict) -> None:
+    """Correlated rack-scoped loss: two shards of every EC volume die
+    TOGETHER on one rack (the mass-restart / rack-power shape of the
+    1309.0186 study), then the planner heals.  On a rack-labeled
+    cluster the survivor selection must route repair pulls same-rack
+    first and keep cross-rack bytes inside the budget; on a label-less
+    cluster this degrades to correlated two-shard loss."""
+    victim_rack = (c.racks[-1] if c.racks else "")
+    vids = sorted({vid for vs in c.volume_servers if vs is not None
+                   for vid in _ec_vids_on(vs)})
+    for vid in vids:
+        for svr, sid in shards_on_rack(c, vid, victim_rack)[:2]:
+            faults.delete_shard(svr.store, vid, sid)
+    for vs in c.volume_servers:
+        if vs is not None:
+            c.submit(vs._heartbeat_once())
+    time.sleep(2 * c.heartbeat_interval)
+    heal_until_clean(c)
+
+
+def _fault_helper_death_mid_rebuild(c: ChaosCluster, ctx: dict) -> None:
+    """Lose shards on node 0, launch the repair, and kill the node most
+    likely serving partial-sum fetches while the rebuild is in flight.
+    The reduced path must re-plan around the dead helper (or back off
+    and converge on a later tick), and no partial `.ecXX.tmp` may
+    survive anywhere."""
+    vs = c.volume_servers[0]
+    for vid in _ec_vids_on(vs):
+        ev = vs.store.get_ec_volume(vid)
+        for sid in ev.shard_ids()[:2]:
+            faults.delete_shard(vs.store, vid, sid)
+    c.submit(vs._heartbeat_once())
+    time.sleep(2 * c.heartbeat_interval)
+    c.drive_repair(wait=False)  # launch, don't wait
+    c.restart_volume_server(1, downtime=0.4)
+    heal_until_clean(c, timeout=90.0)
+    # a helper death mid-transfer must never leave a partial shard
+    leftovers = [str(p) for i in range(c.n)
+                 for p in (c.tmp / f"vs{i}").glob("*.ec??.tmp")]
+    assert not leftovers, f"partial shards left behind: {leftovers}"
+
+
 def _fault_partition(c: ChaosCluster, ctx: dict) -> None:
     """Partition every GATEWAY (client/shell/filer — and thereby s3 and
     MQ, which read through the filer) from node 1: reads must fail over
@@ -704,6 +772,8 @@ FAULTS = {
     "restart_mid_repair": _fault_restart_mid_repair,
     "partition": _fault_partition,
     "master_failover": _fault_master_failover,
+    "rack_loss": _fault_rack_loss,
+    "helper_death_mid_rebuild": _fault_helper_death_mid_rebuild,
 }
 
 MATRIX = [(w, f) for w in WORKLOADS for f in FAULTS]

@@ -66,11 +66,16 @@ class TokenBucket:
         self._last = time.monotonic()
 
     def try_acquire(self, n: float = 1.0) -> bool:
+        # a request larger than burst (one production-sized shard can
+        # exceed the whole cross-rack budget) is admitted once the
+        # bucket is FULL and drives tokens negative: the long-run rate
+        # stays bounded by `rate` paying off the debt, instead of the
+        # request starving forever behind an unreachable threshold
         now = time.monotonic()
         self.tokens = min(self.burst, self.tokens +
                           (now - self._last) * self.rate)
         self._last = now
-        if self.tokens >= n:
+        if self.tokens >= min(n, self.burst):
             self.tokens -= n
             return True
         return False
@@ -85,6 +90,8 @@ def build_ledger(topo, scrub_reports: dict) -> dict[int, dict]:
                     for sid, nodes in per.items() if nodes}
               for vid, per in topo.ec_shard_locations.items()}
         ec_cols = dict(topo.ec_collections)
+        ec_sizes = dict(topo.ec_shard_sizes)
+        node_loc = {n.url: (n.dc, n.rack) for n in topo.nodes.values()}
         normal: dict[int, dict] = {}
         for node in topo.nodes.values():
             for vid, v in node.volumes.items():
@@ -102,6 +109,10 @@ def build_ledger(topo, scrub_reports: dict) -> dict[int, dict]:
             "vid": vid, "kind": "ec", "collection": ec_cols.get(vid, ""),
             "shards_present": present, "shards_missing": missing,
             "shard_locations": shards,
+            "shard_size": ec_sizes.get(vid, 0),
+            "node_locality": {url: list(node_loc[url])
+                              for nodes in shards.values()
+                              for url in nodes if url in node_loc},
         }
         corrupt: list[dict] = []
         last_scrub = None
@@ -174,7 +185,9 @@ class RepairPlanner:
 
     def __init__(self, master, *, node_concurrency: int | None = None,
                  rate: float | None = None, burst: float | None = None,
-                 backoff_base: float = 2.0, backoff_max: float = 300.0):
+                 backoff_base: float = 2.0, backoff_max: float = 300.0,
+                 xrack_rate: float | None = None,
+                 xrack_burst: float | None = None):
         self.master = master
         self.node_concurrency = node_concurrency if node_concurrency \
             else int(_env_float("WEEDTPU_REPAIR_CONCURRENCY", 2))
@@ -183,6 +196,19 @@ class RepairPlanner:
             else _env_float("WEEDTPU_REPAIR_RATE", 1.0),
             burst if burst is not None
             else _env_float("WEEDTPU_REPAIR_BURST", 4.0))
+        # cross-rack repair-byte budget (bytes/s + burst): repairs whose
+        # survivor plan must pull partials across racks acquire their
+        # ESTIMATED cross-rack bytes here before launching; when the
+        # bucket runs dry the remaining (lower-urgency — candidates are
+        # urgency-ordered) repairs wait for a later tick instead of
+        # melting the inter-rack fabric (the 1309.0186 failure mode)
+        self.xrack_bucket = TokenBucket(
+            xrack_rate if xrack_rate is not None
+            else _env_float("WEEDTPU_REPAIR_XRACK_BUDGET",
+                            256 * 1024 * 1024),
+            xrack_burst if xrack_burst is not None
+            else _env_float("WEEDTPU_REPAIR_XRACK_BURST",
+                            1024 * 1024 * 1024))
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         # vid -> {node -> last scrub report}
@@ -192,6 +218,14 @@ class RepairPlanner:
         self._backoff: dict[int, tuple[int, float]] = {}
         self._tasks: set[asyncio.Task] = set()
         self.history: list[dict] = []
+        # survivor-selection audit trail: one record per EC repair with
+        # the chosen rebuilder/helpers, locality classes, and estimated
+        # vs actual repair bytes — surfaced in /maintenance/status
+        self.decisions: list[dict] = []
+        # repairs deferred by an exhausted cross-rack budget last tick
+        self.waiting_xrack: list[int] = []
+        # cumulative repair bytes by locality class (reduced path)
+        self.locality_bytes: dict[str, int] = {}
 
     # -- scrub intake ---------------------------------------------------
 
@@ -232,6 +266,14 @@ class RepairPlanner:
                                                       1)}
                          for v, (f, ts) in self._backoff.items()},
             "history": self.history[-20:],
+            "xrack": {
+                "budget_bytes_per_s": self.xrack_bucket.rate,
+                "burst_bytes": self.xrack_bucket.burst,
+                "tokens": round(self.xrack_bucket.tokens),
+                "waiting": sorted(self.waiting_xrack),
+            },
+            "decisions": self.decisions[-10:],
+            "repair_bytes_by_locality": dict(self.locality_bytes),
         }
 
     # -- planning -------------------------------------------------------
@@ -252,6 +294,105 @@ class RepairPlanner:
             if url not in have and free[url] > 0:
                 return url
         return None
+
+    def _plan_survivors(self, info: dict,
+                        shards: dict | None = None) -> dict | None:
+        """Locality-aware survivor selection for one degraded EC volume.
+
+        Ranks survivor sources by locality class relative to the chosen
+        rebuilder (same node < same rack < same DC < other DC, labels
+        from the heartbeat topology) and picks the MINIMAL set of helper
+        nodes covering k survivors — with partial-sum aggregation every
+        extra node costs one more shard-range of repair traffic, so
+        fewer, closer nodes is strictly better.  Returns the plan plus
+        exact-or-upper-bound byte estimates for both the reduced path
+        and the naive copy-survivors baseline (the cross-rack budget
+        debits whichever path will run); None when the volume is not a
+        reducible EC repair (nothing missing, < k survivors, or no
+        shard-size report yet)."""
+        from seaweedfs_tpu.topology.topology import locality_class
+        if info.get("kind") != "ec":
+            return None
+        shards = {int(s): list(n) for s, n in
+                  (shards if shards is not None
+                   else info.get("shard_locations") or {}).items()
+                  if n}
+        missing = [s for s in range(layout.TOTAL_SHARDS)
+                   if s not in shards]
+        if not missing or len(shards) < layout.DATA_SHARDS:
+            return None
+        shard_size = int(info.get("shard_size") or 0)
+        if shard_size <= 0:
+            # no shard-size report (pre-upgrade helpers): every byte
+            # estimate would be 0 and the cross-rack budget silently
+            # bypassed — and such helpers can't serve /admin/ec/partial
+            # anyway, so degrade honestly to the naive path
+            return None
+        node_loc = info.get("node_locality") or {}
+        counts: dict[str, int] = {}
+        for nodes in shards.values():
+            for url in nodes:
+                counts[url] = counts.get(url, 0) + 1
+        rebuilder = max(counts, key=counts.get)
+        rdc, rrack = node_loc.get(rebuilder, ("", ""))
+
+        def loc_of(url: str) -> int:
+            dc, rack = node_loc.get(url, ("", ""))
+            return locality_class(rdc, rrack, dc, rack,
+                                  same_node=url == rebuilder)
+
+        local = sorted(s for s, nodes in shards.items()
+                       if rebuilder in nodes)
+        from seaweedfs_tpu.topology.topology import locality_name
+        remote_by_node: dict[str, list[int]] = {}
+        naive_xrack = 0
+        naive_by_loc: dict[str, int] = {}
+        for sid, nodes in sorted(shards.items()):
+            if rebuilder in nodes:
+                continue
+            best = min(nodes, key=loc_of)
+            remote_by_node.setdefault(best, []).append(sid)
+            # the naive baseline copies EVERY survivor not already on
+            # the rebuilder, from its first listed location
+            src_loc = loc_of(nodes[0])
+            if src_loc >= 2:
+                naive_xrack += shard_size
+            src = locality_name(src_loc)
+            naive_by_loc[src] = naive_by_loc.get(src, 0) + shard_size
+        ordered = sorted(remote_by_node.items(),
+                         key=lambda kv: (loc_of(kv[0]), -len(kv[1]),
+                                         kv[0]))
+        need = layout.DATA_SHARDS - len(local)
+        groups: list[dict] = []
+        have = 0
+        for url, sids in ordered:
+            if have >= need:
+                break
+            groups.append({"node": url, "shards": sorted(sids),
+                           "locality": loc_of(url),
+                           "shard_size": shard_size})
+            have += len(sids)
+        if len(local) + have < layout.DATA_SHARDS:
+            return None
+        n_lost = len(missing)
+        est_remote = n_lost * shard_size * len(groups)
+        est_xrack = n_lost * shard_size * sum(
+            1 for g in groups if g["locality"] >= 2)
+        return {
+            "rebuilder": rebuilder, "lost": missing, "groups": groups,
+            "local_shards": local, "shard_size": shard_size,
+            "est_remote_bytes": est_remote,
+            "est_xrack_bytes": est_xrack,
+            "naive_remote_bytes":
+                (len(shards) - len(local)) * shard_size,
+            "naive_xrack_bytes": naive_xrack,
+            "naive_by_locality": naive_by_loc,
+            "locality_classes": {g["node"]: g["locality"]
+                                 for g in groups},
+        }
+
+    def _reduced_enabled(self) -> bool:
+        return os.environ.get("WEEDTPU_REPAIR_REDUCED", "1") != "0"
 
     def _capacity_boost(self, infos) -> None:
         """Forward-looking urgency input from the capacity forecaster
@@ -290,9 +431,14 @@ class RepairPlanner:
                  if i["state"] in ("degraded", "corrupt",
                                    "under_replicated")]
         self._capacity_boost(cands)
-        cands.sort(key=lambda i: -i["urgency"])
+        # urgency first (shards lost), then fewest survivors: the volume
+        # closest to k survivors is one failure from data loss and must
+        # reach the front of the queue — and of the cross-rack budget
+        cands.sort(key=lambda i: (-i["urgency"],
+                                  len(i.get("shards_present", ()))))
         now = time.monotonic()
         actions: list[dict] = []
+        waiting_xrack: list[int] = []
         for info in cands:
             vid = info["vid"]
             if vid in self._active_vids:
@@ -310,7 +456,28 @@ class RepairPlanner:
                 continue
             if self._active_nodes.get(node, 0) >= self.node_concurrency:
                 continue
+            # cross-rack budget: debit the estimated cross-rack bytes of
+            # whichever path will run BEFORE launching; a repair the
+            # bucket cannot cover waits for a later tick (refill), while
+            # zero-cross-rack repairs further down the queue still run
+            plan = self._plan_survivors(info)
+            if plan is not None:
+                info["_plan"] = plan
+                est_x = plan["est_xrack_bytes"] if self._reduced_enabled() \
+                    else plan["naive_xrack_bytes"]
+                if est_x > 0 and not self.xrack_bucket.try_acquire(est_x):
+                    waiting_xrack.append(vid)
+                    continue
             if not self.bucket.try_acquire():
+                if plan is not None:
+                    # refund the cross-rack debit of a repair that never
+                    # launched (clamped at burst like any refill)
+                    self.xrack_bucket.tokens = min(
+                        self.xrack_bucket.burst,
+                        self.xrack_bucket.tokens +
+                        (plan["est_xrack_bytes"]
+                         if self._reduced_enabled()
+                         else plan["naive_xrack_bytes"]))
                 break  # rate-limited: later ticks pick up the rest
             self._active_vids.add(vid)
             self._active_nodes[node] = self._active_nodes.get(node, 0) + 1
@@ -320,6 +487,7 @@ class RepairPlanner:
             actions.append({"vid": vid, "kind": info["kind"],
                             "state": info["state"], "node": node,
                             "urgency": info["urgency"]})
+        self.waiting_xrack = waiting_xrack
         return actions
 
     async def wait_idle(self) -> None:
@@ -455,12 +623,74 @@ class RepairPlanner:
             raise RuntimeError(
                 f"only {len(present)} shards survive, need "
                 f"{layout.DATA_SHARDS}")
+        collection = info.get("collection", "")
+        # survivor plan: the tick's (budget-debited) plan when the purge
+        # loop above didn't change the shard map, else a fresh one
+        plan = info.get("_plan")
+        if plan is None or resolved or \
+                sorted(plan["lost"]) != sorted(missing):
+            plan = self._plan_survivors(info, shards=shards)
+        # reduced pays whenever bytes would otherwise cross the network
+        # (helper partials needed, or naive would copy survivors the
+        # rebuilder doesn't even need).  With EVERY survivor already
+        # local the plain rebuild moves zero repair bytes too and keeps
+        # the faster native zero-copy decode path.
+        if plan is not None and self._reduced_enabled() and \
+                (plan["groups"] or plan["naive_remote_bytes"] > 0):
+            rebuilder = plan["rebuilder"]
+            with trace.span("repair.survivors", vid=vid,
+                            rebuilder=rebuilder,
+                            lost=",".join(map(str, missing)),
+                            helpers=",".join(
+                                f"{g['node']}:{g['locality']}"
+                                for g in plan["groups"]),
+                            est_remote_bytes=plan["est_remote_bytes"],
+                            est_xrack_bytes=plan["est_xrack_bytes"]):
+                try:
+                    resp = await self._post(
+                        rebuilder, "/admin/ec/rebuild",
+                        {"volume": vid,
+                         "reduced": {"lost": missing,
+                                     "groups": plan["groups"],
+                                     "shard_size": plan["shard_size"]}})
+                except Exception as e:
+                    # graceful degradation: the survivor-copy path below
+                    # still heals (at naive cost); record why we fell back
+                    log.warning("reduced rebuild of volume %d on %s "
+                                "failed (%s); falling back to survivor "
+                                "copies", vid, rebuilder, e)
+                    self._record_decision(plan, vid, mode="naive_fallback",
+                                          error=str(e))
+                    # the tick debited only the reduced estimate; the
+                    # survivor-copy path below moves naive-level
+                    # cross-rack bytes, so force the shortfall into the
+                    # bucket as debt — a cluster-wide fallback storm must
+                    # still be throttled at the bytes it actually moves
+                    self.xrack_bucket.tokens -= max(
+                        0.0, plan["naive_xrack_bytes"]
+                        - plan["est_xrack_bytes"])
+                    plan = None  # the tail must not record this twice
+                else:
+                    with trace.span("repair.mount", vid=vid,
+                                    node=rebuilder):
+                        await self._post(rebuilder, "/admin/ec/mount",
+                                         {"volume": vid,
+                                          "collection": collection})
+                    self._record_decision(plan, vid, mode="reduced",
+                                          result=resp)
+                    log.info("repair: volume %d reduced-rebuilt shards "
+                             "%s on %s (%d remote bytes, %d replans, "
+                             "purged %d corrupt)", vid, missing,
+                             rebuilder,
+                             sum((resp.get("helper_bytes") or {})
+                                 .values()),
+                             resp.get("replans", 0), len(resolved))
+                    return resolved
         counts: dict[str, int] = {}
         for nodes in shards.values():
             for url in nodes:
                 counts[url] = counts.get(url, 0) + 1
         rebuilder = max(counts, key=counts.get)
-        collection = info.get("collection", "")
         borrowed: list[int] = []
         for sid, nodes in sorted(shards.items()):
             if rebuilder in nodes:
@@ -482,10 +712,51 @@ class RepairPlanner:
         with trace.span("repair.mount", vid=vid, node=rebuilder):
             await self._post(rebuilder, "/admin/ec/mount",
                              {"volume": vid, "collection": collection})
+        if plan is not None:
+            self._record_decision(plan, vid, mode="naive")
         log.info("repair: volume %d rebuilt shards %s on %s "
                  "(purged %d corrupt)", vid, missing, rebuilder,
                  len(resolved))
         return resolved
+
+    def _record_decision(self, plan: dict, vid: int, mode: str,
+                         result: dict | None = None,
+                         error: str | None = None) -> None:
+        """One survivor-selection audit record (surfaced in
+        /maintenance/status) + the repair-byte-by-locality ledger."""
+        from seaweedfs_tpu.stats import metrics as _metrics
+        rec = {"ts": round(time.time(), 3), "vid": vid, "mode": mode,
+               "rebuilder": plan["rebuilder"], "lost": plan["lost"],
+               "helpers": [{"node": g["node"], "shards": g["shards"],
+                            "locality": g["locality"]}
+                           for g in plan["groups"]],
+               "est_remote_bytes": plan["est_remote_bytes"],
+               "est_xrack_bytes": plan["est_xrack_bytes"],
+               "naive_remote_bytes": plan["naive_remote_bytes"]}
+        if error:
+            rec["error"] = error
+        by_loc: dict[str, int] = {}
+        if result is not None:
+            rec["actual_bytes"] = sum(
+                (result.get("helper_bytes") or {}).values())
+            rec["replans"] = result.get("replans", 0)
+            by_loc = dict(result.get("by_locality") or {})
+        elif mode in ("naive", "naive_fallback"):
+            # the naive path copies EVERY off-rebuilder survivor (not
+            # just the reduced plan's minimal helper groups); attribute
+            # them by each copy's first-listed source (estimate: the
+            # copy handler doesn't report per-source bytes)
+            by_loc = dict(plan.get("naive_by_locality") or {})
+        for name, n in by_loc.items():
+            self.locality_bytes[name] = \
+                self.locality_bytes.get(name, 0) + n
+            if result is None:
+                # reduced-path bytes were already metered at the
+                # rebuilder's fetch hop; the master only books the
+                # naive-copy estimate nobody else measures
+                _metrics.REPAIR_BYTES.labels(name).inc(n)
+        self.decisions.append(rec)
+        del self.decisions[:-50]
 
     async def _replicate_volume(self, vid: int, info: dict,
                                 target: str) -> None:

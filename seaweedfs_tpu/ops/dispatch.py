@@ -100,6 +100,56 @@ def parity_mismatch(codec, data: np.ndarray,
             for r, stored in parity_rows.items()}
 
 
+# device-side matrix applies for the reduced-read repair plane
+# (ops/regen.py): the coefficient matrices are tiny ([1, j] slices of a
+# decode matrix) but arbitrary, so device backends pre-lift each one to
+# its bit-matrix via the codec's matrix_apply factory and cache it —
+# repair plans reuse the same few windows for a whole shard
+_APPLY_CACHE: dict = {}
+_APPLY_CACHE_MAX = 64
+
+
+def apply_matrix(codec, C: np.ndarray, stack: np.ndarray) -> np.ndarray:
+    """out[r, n] = C[r, j] @ stack[j, n] over GF(2^8) through the same
+    backend seam as encode/reconstruct — the partial-sum kernel of the
+    reduced-read repair path (profiled as `repair_partial`)."""
+    C = np.ascontiguousarray(C, dtype=np.uint8)
+    nbytes = stack.nbytes
+    NativeRSCodec, RSCode = _host_classes()
+    if isinstance(codec, NativeRSCodec):
+        from seaweedfs_tpu import native
+        with trace.span("codec.apply_matrix", backend="host",
+                        bytes=nbytes), \
+                KERNELS.timed("repair_partial", nbytes=nbytes):
+            return native.gf_matmul(C, np.ascontiguousarray(stack))
+    factory = getattr(codec, "_factory", None)
+    if isinstance(codec, RSCode) or factory is None:
+        from seaweedfs_tpu.ops import gf
+        with trace.span("codec.apply_matrix", backend="host",
+                        bytes=nbytes), \
+                KERNELS.timed("repair_partial", nbytes=nbytes):
+            return gf.gf_matmul(C, stack)
+    key = (id(codec), C.shape, C.tobytes())
+    mat = _APPLY_CACHE.get(key)
+    if mat is None:
+        if len(_APPLY_CACHE) >= _APPLY_CACHE_MAX:
+            _APPLY_CACHE.clear()
+        mat = _APPLY_CACHE[key] = factory(C)
+    import jax.numpy as jnp
+    with trace.span("codec.apply_matrix", backend="device", bytes=nbytes):
+        t0 = time.perf_counter()
+        dev = jnp.asarray(stack)
+        t1 = time.perf_counter()
+        out = mat(dev)
+        t2 = time.perf_counter()
+        host = np.asarray(out)
+        KERNELS.record("repair_partial", "device",
+                       wall_s=t2 - t1, h2d_s=t1 - t0, h2d_bytes=nbytes,
+                       d2h_s=time.perf_counter() - t2,
+                       d2h_bytes=host.nbytes, nbytes=nbytes)
+        return host
+
+
 def reconstruct_batch(codec, shards: dict[int, np.ndarray],
                       wanted: list[int]) -> dict[int, np.ndarray]:
     """Rebuild `wanted` shard rows from >=k survivor rows (host bytes

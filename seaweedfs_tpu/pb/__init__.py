@@ -83,7 +83,8 @@ def heartbeat_to_bytes(beat: dict) -> bytes:
     for e in beat.get("ec_shards", []):
         hb.ec_shards.add(id=int(e.get("id", 0)),
                          collection=e.get("collection", "") or "",
-                         shards=[int(s) for s in e.get("shard_ids", [])])
+                         shards=[int(s) for s in e.get("shard_ids", [])],
+                         shard_size=int(e.get("shard_size", 0)))
     return hb.SerializeToString()
 
 
@@ -111,5 +112,6 @@ def heartbeat_from_bytes(raw: bytes) -> dict:
         "ec_shards": [{
             "id": e.id, "collection": e.collection,
             "shard_ids": list(e.shards),
+            "shard_size": e.shard_size,
         } for e in hb.ec_shards],
     }

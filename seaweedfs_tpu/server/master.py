@@ -1088,7 +1088,10 @@ class MasterServer:
             return web.json_response({"error": "not an ec volume"}, status=404)
         return web.json_response({
             "volumeId": vid,
-            "shards": {str(sid): [{"url": n.url, "publicUrl": n.public_url}
+            # dc/rack ride along so readers can rank candidates by
+            # locality (same-rack survivor fetches before cross-rack)
+            "shards": {str(sid): [{"url": n.url, "publicUrl": n.public_url,
+                                   "dc": n.dc, "rack": n.rack}
                                   for n in nodes]
                        for sid, nodes in shards.items()},
         })

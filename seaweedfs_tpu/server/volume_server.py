@@ -39,6 +39,11 @@ log = logging.getLogger("volume")
 EC_FILE_EXTS = [layout.to_ext(i) for i in range(layout.TOTAL_SHARDS)] + \
     [".ecx", ".ecj", ".vif"]
 
+
+def _topo_locality_name(cls: int) -> str:
+    from seaweedfs_tpu.topology.topology import locality_name
+    return locality_name(cls)
+
 try:
     from aiohttp.http_writer import StreamWriter as _AioSW
     from aiohttp.http_writer import _serialize_headers as _ser_headers
@@ -156,6 +161,7 @@ class VolumeServer:
             web.post("/admin/ec/copy", self.handle_ec_copy),
             web.post("/admin/ec/to_volume", self.handle_ec_to_volume),
             web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
+            web.post("/admin/ec/partial", self.handle_ec_partial),
             web.get("/admin/ec/probe_read", self.handle_ec_probe_read),
             web.get("/admin/file", self.handle_file_pull),
             web.post("/admin/query", self.handle_query),
@@ -755,11 +761,27 @@ class VolumeServer:
                     self._ec_loc_cache.setdefault(vid, (now + 1.0, {}))
                     self._ec_loc_evict_locked()
                 raise
+            # nearest-first candidate order (the planner's locality
+            # ranking): degraded reads and survivor gathering try
+            # same-rack peers before crossing racks/DCs
+            for locs in shards.values():
+                locs.sort(key=self._loc_rank)
             ttl = 10.0 if shards else 1.0
             with self._ec_loc_lock:
                 self._ec_loc_cache[vid] = (now + ttl, shards)
                 self._ec_loc_evict_locked()
             return shards
+
+    def _loc_rank(self, loc) -> int:
+        """Locality class of a shard-location record relative to this
+        server (0 self, 1 same rack, 2 same DC, 3 remote DC).  Accepts a
+        bare url string (older/minimal masters) as label-less."""
+        if not isinstance(loc, dict):
+            loc = {"url": loc}
+        from seaweedfs_tpu.topology.topology import locality_class
+        return locality_class(self.data_center, self.rack,
+                              loc.get("dc", ""), loc.get("rack", ""),
+                              same_node=loc.get("url") == self.url)
 
     def _ec_loc_evict_locked(self) -> None:
         """Bound the location cache AND its lock table (insertion order ==
@@ -872,6 +894,23 @@ class VolumeServer:
             except OSError:
                 return None
             return None
+
+        def locality_rank(shard_id: int) -> int:
+            """Best locality class among a shard's remote locations —
+            the EC read engine sorts survivor fan-outs with this so
+            same-rack helpers are tried before cross-rack ones."""
+            try:
+                locs = self._ec_shard_locations(vid).get(str(shard_id), [])
+            except Exception:
+                return 3
+            # _loc_rank accepts bare url strings (older/minimal
+            # masters); mirror that here or the sort dies in its
+            # advisory try/except and silently disables the ordering
+            return min((self._loc_rank(l) for l in locs
+                        if (l.get("url") if isinstance(l, dict) else l)
+                        != self.url), default=3)
+
+        read.locality_rank = locality_rank
         return read
 
     # -- admin: volumes --------------------------------------------------
@@ -1129,16 +1168,40 @@ class VolumeServer:
         if self._ec_jobs.get(vid, {}).get("state") == "running":
             return web.json_response({"error": "ec job already running"},
                                      status=409)
+        reduced = body.get("reduced")
         present = [i for i in range(layout.TOTAL_SHARDS)
                    if os.path.exists(base + layout.to_ext(i))]
         total = (os.path.getsize(base + layout.to_ext(present[0]))
                  * layout.DATA_SHARDS) if present else 0
         stages: dict = {}
-        job = {"state": "running", "kind": "rebuild", "bytes_done": 0,
-               "total": total, "cancel": False, "error": None,
-               "started": time.time(), "stages": stages}
+        job = {"state": "running",
+               "kind": "rebuild_reduced" if reduced else "rebuild",
+               "bytes_done": 0, "total": total, "cancel": False,
+               "error": None, "started": time.time(), "stages": stages}
         self._ec_jobs[vid] = job
         try:
+            if reduced:
+                # reduced-read path: no survivor copies land here — each
+                # helper node ships XOR-combinable partials instead
+                # (storage/ec/ec_files.rebuild_ec_reduced)
+                lost = sorted(int(s) for s in reduced.get("lost", []))
+                groups = [g for g in (reduced.get("groups") or [])
+                          if g.get("node") and g["node"] != self.url]
+                if reduced.get("shard_size"):
+                    for g in groups:
+                        g.setdefault("shard_size",
+                                     reduced["shard_size"])
+                result = await asyncio.to_thread(
+                    ec_files.rebuild_ec_reduced, base, lost, groups,
+                    self._partial_fetcher(vid),
+                    d=reduced.get("d"),
+                    progress=lambda n: job.__setitem__("bytes_done", n),
+                    cancel=lambda: job["cancel"],
+                    stats=stages)
+                job["state"] = "done"
+                job["bytes_done"] = job["total"]
+                await self._heartbeat_once()
+                return web.json_response(result)
             rebuilt = await asyncio.to_thread(
                 ec_files.rebuild_ec_files, base,
                 progress=lambda n: job.__setitem__("bytes_done", n),
@@ -1653,6 +1716,165 @@ class VolumeServer:
             return web.json_response({"error": "shard not local"}, status=404)
         return web.Response(body=data,
                             content_type="application/octet-stream")
+
+    async def handle_ec_partial(self, req: web.Request) -> web.Response:
+        """Reduced-read repair helper hop: compute the XOR-combinable
+        partial product coeff @ local_shard_ranges over GF(2^8) (through
+        the same ops/dispatch codec seam as encode) and return the raw
+        [f, size] bytes.  A rebuilder pulling partials from d helpers
+        ships f x range per helper NODE instead of full survivor shards
+        — the repair-bandwidth floor of the aggregated decode.
+        Quarantined (scrub-verdicted) ranges read as unreadable, so a
+        corrupt survivor can never leak into a rebuilt shard: the
+        rebuilder re-plans around the 409."""
+        if self._fault_delay_shard_read > 0:
+            await asyncio.sleep(self._fault_delay_shard_read)
+        import numpy as np
+        try:
+            body = await req.json()
+            vid = int(body["volume"])
+            sids = [int(s) for s in body["shards"]]
+            offset, size = int(body["offset"]), int(body["size"])
+            coeff = np.asarray(body["coeff"], dtype=np.uint8)
+        except (KeyError, TypeError, ValueError):
+            return web.json_response({"error": "bad partial request"},
+                                     status=400)
+        # len(sids) x size bounds the rows compute() stacks in memory:
+        # the legitimate rebuilder never asks for more than its batch
+        # size per hop, and without the shard-count cap (and duplicate
+        # check) one malformed request could pread an unbounded
+        # multiple of `size` and OOM the server
+        if not sids or len(sids) > layout.TOTAL_SHARDS or \
+                len(set(sids)) != len(sids) or \
+                size <= 0 or size > ec_files.DEFAULT_BATCH or \
+                coeff.ndim != 2 or coeff.shape[1] != len(sids) or \
+                coeff.shape[0] > layout.PARITY_SHARDS:
+            return web.json_response({"error": "bad partial shape"},
+                                     status=400)
+        base = self._ec_base(vid)
+        if base is None:
+            return web.json_response({"error": "no shards here"},
+                                     status=404)
+        ev = self.store.get_ec_volume(vid)
+
+        def compute() -> bytes:
+            rows = []
+            for sid in sids:
+                data = None
+                if ev is not None:
+                    # honors the quarantine: corrupt ranges read as None
+                    data = ev._read_local(sid, offset, size)
+                else:
+                    p = base + layout.to_ext(sid)
+                    try:
+                        fd = os.open(p, os.O_RDONLY)
+                        try:
+                            data = os.pread(fd, size, offset)
+                        finally:
+                            os.close(fd)
+                    except OSError:
+                        data = None
+                if data is None or len(data) != size:
+                    raise KeyError(sid)
+                rows.append(np.frombuffer(data, dtype=np.uint8))
+            from seaweedfs_tpu.ops import dispatch
+            codec = ec_files._get_codec()
+            return dispatch.apply_matrix(codec, coeff,
+                                         np.stack(rows)).tobytes()
+
+        try:
+            with trace.span("volume.ec_partial", vid=vid,
+                            shards=",".join(map(str, sids)),
+                            bytes=size * len(sids)):
+                out = await asyncio.to_thread(compute)
+        except KeyError as e:
+            return web.json_response(
+                {"error": f"shard {e.args[0]} unreadable or quarantined"},
+                status=409)
+        return web.Response(body=out,
+                            content_type="application/octet-stream")
+
+    def _partial_fetcher(self, vid: int):
+        """Client side of /admin/ec/partial for the reduced rebuild:
+        runs on executor threads, so the trace context, traffic class,
+        and deadline are captured HERE.  Rides the resilience layer —
+        per-peer breakers, deadline-clamped socket timeouts — and maps
+        every failure to regen.HelperDied so the rebuild re-plans with a
+        substitute survivor instead of aborting."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+        from seaweedfs_tpu.maintenance import faults as _faults
+        from seaweedfs_tpu.ops import regen
+        tctx = trace.current()
+        flow_cls = netflow.current_class() or "repair"
+        dl = resilience.deadline()
+
+        def fetch(group, sids, coeff, offset, size) -> bytes:
+            node = group.node
+            breaker = resilience.breaker_for(node) \
+                if resilience.breaker_enabled() else None
+            if breaker is not None and not breaker.allow():
+                raise regen.HelperDied(node, tuple(sids))
+            try:
+                if _faults.NET_ACTIVE:
+                    lat = _faults.check_net("volume", node)
+                    if lat > 0:
+                        time.sleep(lat)
+            except OSError as e:
+                raise regen.HelperDied(node, tuple(sids)) from e
+            tmo = 60.0
+            if dl is not None:
+                tmo = min(tmo, dl - time.monotonic())
+                if tmo <= 0.01:
+                    raise regen.HelperDied(node, tuple(sids))
+            payload = _json.dumps({
+                "volume": vid, "shards": list(sids),
+                "coeff": coeff.tolist(), "offset": offset,
+                "size": size}).encode()
+            try:
+                with trace.span("repair.partial_fetch", parent=tctx,
+                                vid=vid, peer=node,
+                                shards=",".join(map(str, sids)),
+                                bytes=coeff.shape[0] * size,
+                                locality=group.locality) as sp:
+                    r = urllib.request.Request(
+                        f"{_tls_scheme()}://{node}/admin/ec/partial",
+                        data=payload,
+                        headers={"Content-Type": "application/json"})
+                    hdr_ctx = sp.trace or tctx
+                    if hdr_ctx is not None:
+                        r.add_header(trace.TRACE_HEADER,
+                                     trace.format_header(hdr_ctx))
+                    r.add_header(netflow.CLASS_HEADER, flow_cls)
+                    r.add_header(netflow.ROLE_HEADER, "volume")
+                    if dl is not None:
+                        r.add_header(
+                            resilience.DEADLINE_HEADER,
+                            str(max(1, int((dl - time.monotonic())
+                                           * 1000))))
+                    with urllib.request.urlopen(r, timeout=tmo) as rr:
+                        data = rr.read()
+            except urllib.error.HTTPError as e:
+                # the peer ANSWERED (quarantined survivor, shard moved):
+                # a content miss, not a transport failure — re-plan
+                # without this helper, but don't ding its breaker
+                if breaker is not None:
+                    breaker.record(True)
+                raise regen.HelperDied(node, tuple(sids)) from e
+            except OSError as e:
+                if breaker is not None and \
+                        (dl is None or dl - time.monotonic() > 0.05):
+                    breaker.record(False)
+                raise regen.HelperDied(node, tuple(sids)) from e
+            if breaker is not None:
+                breaker.record(True)
+            netflow.account("recv", flow_cls, "volume", len(data))
+            metrics.REPAIR_BYTES.labels(
+                _topo_locality_name(group.locality)).inc(len(data))
+            return data
+
+        return fetch
 
     async def handle_ec_probe_read(self, req: web.Request) -> web.Response:
         """Canary degraded-read probe (stats/canary.py): read one REAL

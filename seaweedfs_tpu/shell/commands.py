@@ -701,6 +701,7 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
     pl = st.get("planner", {})
     print(f"planner: tokens={pl.get('tokens')} active={pl.get('active')} "
           f"backoffs={len(pl.get('backoffs', {}))}", file=out)
+    _print_repair_plane(pl, out)
     _print_slo(st.get("slo") or {}, out)
     _print_alerts(st.get("alerts") or {}, out)
     from seaweedfs_tpu.stats.history import FORECAST_CAP_S
@@ -712,6 +713,46 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
         print("capacity: " + " ".join(
             f"{d['vs']}:{d['dir']}={_fmt_eta(d['predicted_full_seconds'])}"
             for d in soon[:5]), file=out)
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if n >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n:.0f}B"
+
+
+def _print_repair_plane(pl: dict, out) -> None:
+    """Reduced-read repair plane lines shared by maintenance.status and
+    chaos.status: cross-rack budget state, repair bytes by locality
+    class (the cluster.heat-style one-liner), and the last
+    survivor-selection decisions."""
+    xr = pl.get("xrack") or {}
+    if xr:
+        waiting = xr.get("waiting") or []
+        print(f"xrack budget: {_fmt_bytes(xr.get('tokens', 0))} of "
+              f"{_fmt_bytes(xr.get('burst_bytes', 0))} "
+              f"(+{_fmt_bytes(xr.get('budget_bytes_per_s', 0))}/s)"
+              + (f" waiting={waiting}" if waiting else ""), file=out)
+    by_loc = pl.get("repair_bytes_by_locality") or {}
+    if by_loc:
+        print("repair bytes: " + " ".join(
+            f"{name}={_fmt_bytes(by_loc[name])}"
+            for name in ("node", "rack", "dc", "remote")
+            if name in by_loc), file=out)
+    for d in (pl.get("decisions") or [])[-3:]:
+        helpers = " ".join(
+            f"{h['node']}(loc{h['locality']}x{len(h['shards'])})"
+            for h in d.get("helpers", []))
+        actual = d.get("actual_bytes")
+        print(f"  repair vid={d['vid']} {d['mode']:14s} "
+              f"lost={d.get('lost')} via {helpers or '-'} "
+              f"est={_fmt_bytes(d.get('est_remote_bytes', 0))}"
+              + (f" actual={_fmt_bytes(actual)}"
+                 if actual is not None else "")
+              + (f" replans={d['replans']}" if d.get("replans") else "")
+              + (f" naive={_fmt_bytes(d.get('naive_remote_bytes', 0))}"),
+              file=out)
 
 
 def _fmt_eta(s: float) -> str:
@@ -973,10 +1014,15 @@ def cmd_chaos_status(env: CommandEnv, args, out):
         canary = env.master_get("/cluster/canary")
     except RuntimeError:
         canary = {}
+    pl = st.get("planner") or {}
     if "json" in flags:
         print(json.dumps({"resilience": res,
                           "states": st.get("states", {}),
-                          "canary": canary.get("paths", {})},
+                          "canary": canary.get("paths", {}),
+                          "xrack": pl.get("xrack", {}),
+                          "decisions": pl.get("decisions", []),
+                          "repair_bytes_by_locality":
+                              pl.get("repair_bytes_by_locality", {})},
                          separators=(",", ":")), file=out)
         return
     breakers = res.get("breakers") or {}
@@ -1007,6 +1053,7 @@ def cmd_chaos_status(env: CommandEnv, args, out):
         armed.append(f"shard_write_error={faults['shard_write_error']}")
     print("faults: " + ("; ".join(armed) if armed else "none armed"),
           file=out)
+    _print_repair_plane(pl, out)
     states = st.get("states", {})
     if any(v for k, v in states.items() if k != "healthy"):
         print("volume states: " + " ".join(

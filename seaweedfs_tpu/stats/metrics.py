@@ -501,6 +501,11 @@ SCRUB_CORRUPTIONS = REGISTRY.counter(
 REPAIR_ACTIONS = REGISTRY.counter(
     "weedtpu_repair_actions_total",
     "automatic repair executions by outcome", ("kind", "outcome"))
+REPAIR_BYTES = REGISTRY.counter(
+    "weedtpu_repair_bytes_total",
+    "repair bytes moved by locality class of the source "
+    "(node/rack/dc/remote; reduced-path partials measured, naive "
+    "survivor copies estimated)", ("locality",))
 VOLUME_HEALTH = REGISTRY.gauge(
     "weedtpu_volume_health", "volumes per health-ledger state (master)",
     ("state",))

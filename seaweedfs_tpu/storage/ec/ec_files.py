@@ -1136,6 +1136,140 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     return missing
 
 
+def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
+                       fetch_partial, d: int | None = None,
+                       batch_size: int = DEFAULT_BATCH,
+                       align: int | None = None,
+                       progress=None, cancel=None,
+                       stats: dict | None = None) -> dict:
+    """Reduced-read rebuild of `lost` shards: instead of copying k full
+    survivor shards here, each remote helper node ships XOR-combinable
+    partial products (ops/regen.py) — repair bandwidth per remote node
+    drops to one shard-range per lost shard, byte-identical output.
+
+    `groups` lists the REMOTE helper nodes: {"node": url,
+    "shards": [ids], "locality": class}; the local survivor group is
+    discovered from the files next to `base` (each rebuilt shard joins
+    it for the next pass).  `fetch_partial(node, shards, coeff_rows,
+    offset, size) -> bytes` is the server layer's HTTP hop; transport
+    failures raise regen.HelperDied and trigger re-planning with a
+    substitute survivor.  Lost shards build under `.tmp` names and
+    commit by rename per shard, so a helper death / crash never leaves
+    a partial shard visible.  Returns accounting: measured helper
+    bytes per node + locality class, the plans' predictions, and the
+    naive-baseline cost the savings are judged against."""
+    from seaweedfs_tpu.ops import regen
+
+    # chaos hook: fail like a dying disk BEFORE tmp shard files exist
+    from seaweedfs_tpu.maintenance import faults as _faults
+    _faults.check_shard_write(base)
+
+    codec = _get_codec()
+    code = getattr(codec, "code", codec)  # RSCode is its own metadata
+
+    lost = sorted(set(lost))
+    local_fds: dict[int, int] = {}
+    stats = stats if stats is not None else {}
+    stats.setdefault("mode", "reduced")
+    _flow_token = _netflow.set_class(_netflow.current_class() or "repair")
+    t_wall = time.perf_counter()
+    try:
+        shard_size = 0
+        for i in range(layout.TOTAL_SHARDS):
+            p_ = base + layout.to_ext(i)
+            if i not in lost and os.path.exists(p_):
+                local_fds[i] = os.open(p_, os.O_RDONLY)
+                shard_size = max(shard_size, os.path.getsize(p_))
+        if shard_size == 0:
+            for g in groups:
+                if g.get("shard_size"):
+                    shard_size = int(g["shard_size"])
+                    break
+        if shard_size <= 0:
+            raise ValueError(f"cannot size shards of {base}")
+        stats["bytes"] = shard_size * len(lost)
+
+        def read_local(sid: int, off: int, n: int) -> bytes | None:
+            fd = local_fds.get(sid)
+            if fd is None:
+                return None
+            try:
+                return os.pread(fd, n, off)
+            except OSError:
+                return None
+
+        remote_groups = [
+            regen.HelperGroup(node=g["node"],
+                              shards=tuple(int(s) for s in g["shards"]
+                                           if int(s) not in lost),
+                              locality=int(g.get("locality", 3)))
+            for g in groups if g.get("shards")]
+        done = 0
+        predicted: dict = {"per_node": {}, "by_locality": {},
+                           "remote": 0, "local": 0}
+        for sid in lost:
+            tmp = base + layout.to_ext(sid) + ".tmp"
+            out_fd = os.open(tmp, os.O_RDWR | os.O_CREAT, 0o644)
+            committed = False
+            try:
+                def sink(off: int, row: np.ndarray,
+                         fd: int = out_fd) -> None:
+                    _pwrite_all(fd, np.ascontiguousarray(row), off)
+
+                local_group = regen.HelperGroup(
+                    node="", shards=tuple(sorted(local_fds)), locality=0)
+                with _Timer(stats, "reconstruct_s"):
+                    plan = regen.repair_shard(
+                        code, codec, sid,
+                        [local_group] + remote_groups, shard_size,
+                        read_local, fetch_partial, sink,
+                        d=d, batch_size=batch_size,
+                        align=align or regen.DEFAULT_SEG_ALIGN,
+                        cancel=cancel, stats=stats)
+                os.ftruncate(out_fd, shard_size)
+                os.close(out_fd)
+                out_fd = -1
+                os.replace(tmp, base + layout.to_ext(sid))
+                committed = True
+                pred = plan.predicted_bytes()
+                for key in ("remote", "local"):
+                    predicted[key] += pred[key]
+                for dim in ("per_node", "by_locality"):
+                    for k_, v in pred[dim].items():
+                        predicted[dim][k_] = predicted[dim].get(k_, 0) + v
+                predicted["naive_remote"] = \
+                    predicted.get("naive_remote", 0) + \
+                    plan.naive_remote_bytes(len(local_group.shards))
+                # the rebuilt shard is a local survivor for the next pass
+                local_fds[sid] = os.open(base + layout.to_ext(sid),
+                                         os.O_RDONLY)
+                done += shard_size
+                if progress is not None:
+                    progress(done)
+            finally:
+                if out_fd >= 0:
+                    os.close(out_fd)
+                if not committed:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+        stats["wall_s"] = time.perf_counter() - t_wall
+        return {"rebuilt": lost, "shard_size": shard_size,
+                "helper_bytes": stats.get("helper_bytes", {}),
+                "by_locality": stats.get("by_locality", {}),
+                "predicted": predicted,
+                "replans": stats.get("replans", 0),
+                "dead_helpers": stats.get("dead_helpers", [])}
+    finally:
+        _netflow.reset(_flow_token)
+        for fd in local_fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
 def write_dat_file(base: str, dat_size: int,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE) -> None:

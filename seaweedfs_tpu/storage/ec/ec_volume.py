@@ -330,6 +330,16 @@ class EcVolume:
             need = k - len(results)
             remote = [i for i in range(layout.TOTAL_SHARDS)
                       if i not in exclude and i not in results]
+            # same-rack-first: when the reader exposes the planner's
+            # locality ranking (volume_server._shard_reader), submission
+            # order biases the first-k-responders race toward nearby
+            # survivors — hedging and the k-early-exit stay untouched
+            rank = getattr(shard_reader, "locality_rank", None)
+            if rank is not None and len(remote) > 1:
+                try:
+                    remote.sort(key=lambda sid: (rank(sid), sid))
+                except Exception:
+                    pass  # ranking is advisory, never load-bearing
 
             def read_remote(sid: int) -> bytes | None:
                 parts = []
@@ -630,6 +640,9 @@ class EcVolume:
                     return None
                 return inner(sid, off, size)
 
+            rank = getattr(inner, "locality_rank", None)
+            if rank is not None:
+                skipping_reader.locality_rank = rank
             return view.read_needle(needle_id, skipping_reader, mode)
         with trace.span("ec.plan", needle=f"{needle_id:x}") as psp:
             dat_offset, size = self.find_needle(needle_id)

@@ -228,6 +228,9 @@ class Store:
                     "id": vid,
                     "collection": loc.collections.get(vid, ""),
                     "shard_ids": ev.shard_ids(),
+                    # repair-byte estimates (planner cross-rack budget)
+                    # need the shard file size, which only we know
+                    "shard_size": ev.shard_size,
                 })
         return {"volumes": vols, "ec_shards": ec_shards,
                 "max_volume_count": max_slots - staged,

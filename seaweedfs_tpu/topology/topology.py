@@ -16,6 +16,31 @@ from dataclasses import dataclass, field
 
 from seaweedfs_tpu.storage import types as t
 
+# locality classes relative to a reference node, the shared ranking the
+# repair planner, degraded-read fan-out, and repair-byte accounting all
+# use: 0 same node, 1 same rack, 2 same DC / other rack, 3 other DC
+LOCALITY_NAMES = ("node", "rack", "dc", "remote")
+
+
+def locality_name(cls: int) -> str:
+    """Clamped class -> label, the one spelling every repair-byte
+    ledger (planner decisions, rebuilder metrics, shell summaries)
+    attributes by."""
+    return LOCALITY_NAMES[min(max(int(cls), 0), 3)]
+
+
+def locality_class(dc_a: str, rack_a: str, dc_b: str, rack_b: str,
+                   same_node: bool = False) -> int:
+    """Network distance class between two placements.  Empty labels
+    normalize to the heartbeat defaults so a label-less deployment
+    compares as one rack."""
+    if same_node:
+        return 0
+    if (dc_a or "DefaultDataCenter") != (dc_b or "DefaultDataCenter"):
+        return 3
+    return 1 if (rack_a or "DefaultRack") == (rack_b or "DefaultRack") \
+        else 2
+
 
 @dataclass
 class VolumeState:
@@ -102,6 +127,9 @@ class Topology:
         self.layouts: dict[tuple[str, str, str], VolumeLayout] = {}
         self.ec_shard_locations: dict[int, dict[int, list[DataNode]]] = {}
         self.ec_collections: dict[int, str] = {}
+        # heartbeat-reported shard file size per EC volume: the repair
+        # planner's repair-byte estimates (cross-rack budget) need it
+        self.ec_shard_sizes: dict[int, int] = {}
         self.max_volume_id = 0
         # volume-location delta hook (streamed vid-map updates, reference:
         # master_grpc_server.go broadcastToClients): called with each vid
@@ -185,6 +213,8 @@ class Topology:
             for e in beat.get("ec_shards", []):
                 vid = e["id"]
                 self.ec_collections[vid] = e.get("collection", "")
+                if e.get("shard_size"):
+                    self.ec_shard_sizes[vid] = int(e["shard_size"])
                 per_vid = self.ec_shard_locations.setdefault(vid, {})
                 for sid in e["shard_ids"]:
                     nodes = per_vid.setdefault(sid, [])

@@ -522,6 +522,101 @@ def test_smoke_restart_during_degraded_read(tmp_path):
         c.stop()
 
 
+# ---- reduced-read repair under correlated failure (tier-1) -------------
+
+
+def test_rack_loss_reduced_repair_traffic(tmp_path, monkeypatch):
+    """Correlated rack-scoped loss (2 shards die together on one rack)
+    on a rack-labeled cluster: the reduced-read heal moves <= 0.6x the
+    repair bytes of the naive survivor-copy heal over the SAME loss
+    shape, readback stays byte-identical, fsck ends clean, and the
+    planner's survivor-selection decisions + cross-rack budget state
+    surface in /maintenance/status."""
+    c = ChaosCluster(tmp_path, n_volume_servers=3, with_filer=True,
+                     racks=["r0", "r0", "r1"])
+    c.start()
+    try:
+        c.wait_heartbeats()
+        state = WORKLOADS["degraded_read"][0](c)
+        encode_all_volumes(c)
+
+        def lose_rack_pair() -> int:
+            vids = sorted({vid for vs in c.volume_servers
+                           if vs is not None
+                           for vid in chaos._ec_vids_on(vs)})
+            lost = 0
+            for vid in vids:
+                for svr, sid in chaos.shards_on_rack(c, vid, "r1")[:2]:
+                    faults.delete_shard(svr.store, vid, sid)
+                    lost += 1
+            for vs in c.volume_servers:
+                if vs is not None:
+                    c.submit(vs._heartbeat_once())
+            time.sleep(2 * c.heartbeat_interval + 0.2)
+            return lost
+
+        # reduced arm first (fresh, even shard layout)
+        monkeypatch.setenv("WEEDTPU_REPAIR_REDUCED", "1")
+        lost_reduced = lose_rack_pair()
+        assert lost_reduced >= 2, "rack r1 held too few shards to lose"
+        b0 = chaos.repair_recv_bytes()
+        chaos.heal_until_clean(c)
+        reduced = chaos.repair_recv_bytes() - b0
+        WORKLOADS["degraded_read"][1](c, state)  # byte-identical
+
+        st, body, _ = chaos._req(
+            f"http://{c.leader().url}/maintenance/status")
+        assert st == 200
+        planner = json.loads(body)["planner"]
+        modes = [d["mode"] for d in planner["decisions"]]
+        assert "reduced" in modes, modes
+        red_dec = [d for d in planner["decisions"]
+                   if d["mode"] == "reduced"][-1]
+        assert red_dec["helpers"], red_dec
+        assert all("locality" in h for h in red_dec["helpers"])
+        assert red_dec.get("actual_bytes", 0) > 0
+        assert "xrack" in planner and \
+            planner["xrack"]["burst_bytes"] > 0
+
+        # naive arm over the same correlated-loss shape
+        monkeypatch.setenv("WEEDTPU_REPAIR_REDUCED", "0")
+        lost_naive = lose_rack_pair()
+        assert lost_naive >= 2
+        b0 = chaos.repair_recv_bytes()
+        chaos.heal_until_clean(c)
+        naive = chaos.repair_recv_bytes() - b0
+        WORKLOADS["degraded_read"][1](c, state)
+
+        # scale to equal losses before comparing (layout drift can vary
+        # the per-arm loss count by a shard or two)
+        ratio = (reduced / max(lost_reduced, 1)) / \
+            max(naive / max(lost_naive, 1), 1e-9)
+        assert ratio <= 0.6, \
+            f"reduced heal moved {ratio:.2f}x naive repair bytes " \
+            f"({reduced}B/{lost_reduced} vs {naive}B/{lost_naive})"
+
+        rep = fsck_report(c)
+        assert rep.get("ok") is True, rep.get("states")
+    finally:
+        c.stop()
+
+
+def test_helper_death_mid_rebuild_replans(tmp_path):
+    """A helper node dies while serving partial-sum fetches mid-rebuild:
+    the repair re-plans (or backs off and retries) to convergence,
+    readback is byte-identical, fsck is clean, and no partial .tmp
+    shard survives anywhere (asserted inside the fault cell)."""
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=True)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        report = run_scenario(c, "degraded_read",
+                              "helper_death_mid_rebuild")
+        assert report["fault"] == "helper_death_mid_rebuild"
+    finally:
+        c.stop()
+
+
 # ---- chaos.status + fsck gate ------------------------------------------
 
 
@@ -557,6 +652,7 @@ def test_chaos_status_and_fsck_gate(tmp_path):
         text = out.getvalue()
         assert "retry budget" in text
         assert "partition filer<->volume" in text
+        assert "xrack budget" in text  # reduced-repair plane state
         faults.clear_net()
 
         # heal and re-verify the gate goes green
@@ -609,12 +705,14 @@ def test_hedged_reads_cut_degraded_p99(tmp_path, monkeypatch):
 
 
 def _cluster_for(tmp_path, workload: str, fault: str) -> ChaosCluster:
+    racks = ["r0", "r0", "r1"] if fault == "rack_loss" else None
     return ChaosCluster(
-        tmp_path, n_volume_servers=2,
+        tmp_path, n_volume_servers=3 if racks else 2,
         n_masters=3 if fault == "master_failover" else 1,
         with_filer=True,
         with_s3=workload == "s3_multipart",
-        with_mq=workload == "mq")
+        with_mq=workload == "mq",
+        racks=racks)
 
 
 @pytest.mark.slow

@@ -128,6 +128,19 @@ def _degraded_topology(n_vols: int, missing: int = 2) -> Topology:
     return topo
 
 
+def test_token_bucket_oversized_request_admits_at_full():
+    """A request larger than the whole burst (one production-sized
+    shard can exceed the cross-rack budget) must not starve forever: a
+    FULL bucket admits it, driving tokens negative so the debt pays off
+    at `rate` and the long-run byte rate stays bounded."""
+    b = TokenBucket(rate=0.0, burst=1024.0)
+    assert b.try_acquire(4096.0)      # full bucket admits the oversized
+    assert b.tokens == -3072.0        # ... at the price of debt
+    assert not b.try_acquire(1.0)     # which throttles what follows
+    b.tokens = 1023.0                 # near-full is NOT full enough
+    assert not b.try_acquire(4096.0)
+
+
 def test_token_bucket_caps_concurrent_rebuilds():
     """The planner launches at most `burst` repairs per tick when the
     refill rate is zero — re-protection traffic is throttled."""
@@ -175,6 +188,127 @@ def test_ledger_urgency_orders_by_shards_lost():
     # below k survivors: critical, not repairable
     topo2 = _degraded_topology(1, missing=6)
     assert build_ledger(topo2, {})[1]["state"] == "critical"
+
+
+def _rack_topology(nodes: list[tuple], vids: dict[int, dict[str, list[int]]],
+                   shard_size: int = 4096) -> Topology:
+    """nodes: (url, dc, rack); vids: vid -> {url: shard_ids}."""
+    topo = Topology()
+    for url, dc, rack in nodes:
+        beat = {"max_volume_count": 50, "volumes": [],
+                "ec_shards": [{"id": vid, "collection": "",
+                               "shard_ids": per[url],
+                               "shard_size": shard_size}
+                              for vid, per in vids.items()
+                              if per.get(url)]}
+        topo.register_heartbeat(node_id=url, url=url, public_url="",
+                                dc=dc, rack=rack, beat=beat)
+    return topo
+
+
+def test_plan_survivors_prefers_same_rack_minimal_groups():
+    """Survivor selection: the rebuilder is the node with most shards,
+    helpers come same-rack-first, and the group set is MINIMAL — a
+    same-rack node that covers k alone keeps cross-rack estimates at
+    zero even though a cross-rack node also holds survivors."""
+    topo = _rack_topology(
+        [("a", "dc1", "r0"), ("b", "dc1", "r0"), ("c", "dc1", "r1")],
+        {1: {"a": list(range(0, 6)), "b": list(range(6, 10)),
+             "c": [10, 11]}})
+    led = build_ledger(topo, {})
+    info = led[1]
+    assert info["shards_missing"] == [12, 13]
+    assert info["shard_size"] == 4096
+    planner = RepairPlanner(
+        _types.SimpleNamespace(topo=topo, _session=None))
+    plan = planner._plan_survivors(info)
+    assert plan["rebuilder"] == "a"
+    assert [g["node"] for g in plan["groups"]] == ["b"]  # same rack only
+    assert plan["groups"][0]["locality"] == 1
+    assert plan["est_xrack_bytes"] == 0
+    # 2 lost shards x 1 remote helper node x shard_size
+    assert plan["est_remote_bytes"] == 2 * 4096
+    # the naive baseline would copy every off-rebuilder survivor
+    assert plan["naive_remote_bytes"] == 6 * 4096
+
+
+def test_xrack_budget_defers_lower_urgency_repairs():
+    """Cross-rack budget enforcement: with a burst that covers only the
+    most urgent volume's estimate, the lower-urgency repair WAITS (shows
+    in status.xrack.waiting) instead of launching, and launches once the
+    bucket refills."""
+    size = 4096
+    topo = _rack_topology(
+        [("a", "dc1", "r0"), ("c", "dc1", "r1")],
+        {1: {"a": list(range(0, 6)), "c": list(range(6, 12))},    # -2
+         2: {"a": list(range(0, 6)), "c": list(range(6, 13))}},   # -1
+        shard_size=size)
+    master = _types.SimpleNamespace(topo=topo, _session=None)
+    # burst covers vid1's 2-lost cross-rack estimate plus half of vid2's
+    planner = RepairPlanner(master, rate=0.0, burst=10.0,
+                            node_concurrency=100,
+                            xrack_rate=0.0, xrack_burst=2.5 * size)
+    launched: list[int] = []
+
+    async def fake_run_one(info, node):
+        launched.append(info["vid"])
+        planner._active_vids.discard(info["vid"])
+
+    planner._run_one = fake_run_one
+    planner.bucket.tokens = 10.0
+
+    actions = asyncio.run(planner.tick())
+    assert [a["vid"] for a in actions] == [1]  # most at-risk first
+    assert planner.waiting_xrack == [2]
+    assert planner.status()["xrack"]["waiting"] == [2]
+
+    # refilled bucket: the deferred repair launches on the next tick
+    # (vid1 relaunches too — the fake executor never healed it)
+    planner.xrack_bucket.tokens = planner.xrack_bucket.burst = 10 * size
+    actions = asyncio.run(planner.tick())
+    assert 2 in {a["vid"] for a in actions}
+    assert planner.waiting_xrack == []
+
+
+def test_naive_fallback_debits_xrack_shortfall():
+    """When the reduced rebuild fails and the planner degrades to
+    survivor copies, the (much larger) naive cross-rack cost is forced
+    into the budget as debt — a cluster-wide fallback storm must not
+    spend naive-level bytes against a reduced-level debit."""
+    size = 4096
+    topo = _rack_topology(
+        [("a", "dc1", "r0"), ("c", "dc1", "r1")],
+        {1: {"a": list(range(0, 6)), "c": list(range(6, 12))}},
+        shard_size=size)
+    planner = RepairPlanner(
+        _types.SimpleNamespace(topo=topo, _session=None),
+        rate=0.0, burst=10.0, node_concurrency=100,
+        xrack_rate=0.0, xrack_burst=100.0 * size)
+    info = build_ledger(topo, {})[1]
+    plan = planner._plan_survivors(info)
+    assert plan["est_xrack_bytes"] < plan["naive_xrack_bytes"]
+
+    async def fake_post(url, path, body):
+        if path == "/admin/ec/rebuild" and "reduced" in body:
+            raise RuntimeError("helpers exhausted")
+        return {}
+
+    planner._post = fake_post
+    before = planner.xrack_bucket.tokens
+    asyncio.run(planner._repair_ec(1, info))
+    assert before - planner.xrack_bucket.tokens == \
+        plan["naive_xrack_bytes"] - plan["est_xrack_bytes"]
+
+
+def test_locality_class_ranking():
+    from seaweedfs_tpu.topology.topology import locality_class
+    assert locality_class("dc1", "r0", "dc1", "r0", same_node=True) == 0
+    assert locality_class("dc1", "r0", "dc1", "r0") == 1
+    assert locality_class("dc1", "r0", "dc1", "r1") == 2
+    assert locality_class("dc1", "r0", "dc2", "r0") == 3
+    # label-less deployments compare as one rack
+    assert locality_class("", "", "", "") == 1
+    assert locality_class("", "DefaultRack", "", "") == 1
 
 
 def _post(url, path, body, timeout=120):
