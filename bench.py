@@ -26,7 +26,20 @@ Extra metrics (all in the `extra` field of the one JSON line):
                                 (BASELINE's multi-volume + all-to-all shard
                                 placement config) — DEGENERATE single-chip
                                 placement here; the 8-way sharded shape runs
-                                in dryrun_multichip
+                                in dryrun_multichip.  Gated: must stay
+                                >= BATCH_PLACE_TOL x the single-call kernel
+                                (batch_place_regression, nonzero exit)
+  ec_encode_tile{,_config}      the Pallas tile re-tune sweep: every
+                                SWEEP_TILES candidate measured on THIS
+                                chip, winner pinned via WEEDTPU_EC_TILE
+                                for every codec built afterwards
+  fleet_convert_gbps            e2e multi-volume conversion through the
+                                interleaved device-resident stream
+                                (ops/fleet_convert), total volume bytes /
+                                wall; BYTE-VERIFIED per volume against the
+                                numpy reference (fleet_convert_failed gate
+                                on mismatch), tunnel-bound + tagged on
+                                this TPU harness
   ec_encode_e2e_host_1g         file -> 14 shard files through write_ec_files
                                 on the host codec at 1GiB (the primary e2e
                                 number; GFNI+AVX512 when the host has it,
@@ -272,9 +285,57 @@ def _bench_encode_kernel(k: int, m: int, n: int, on_tpu: bool,
     parity_fn = codec.encode_parity
     rng = np.random.default_rng(0)
     data = jnp.asarray(rng.integers(0, 256, (k, n), dtype=np.uint8))
+    # mesh codecs H2D with their own column sharding so the chained loop
+    # carry starts (and stays) sharded — an unsharded carry would pay a
+    # reshard every iteration and measure the resharder, not the codec
+    place = getattr(codec, "place_columns", None)
+    if place is not None:
+        data = place(data)
     return _bench_chained(
         lambda x: jnp.concatenate([x[m:], parity_fn(x)], axis=0),
         data, on_tpu, noop_rows=m, iters=iters)
+
+
+def _bench_tile_sweep(extra: dict, n: int, on_tpu: bool,
+                      iters: int = 12) -> None:
+    """Re-tune the fused Pallas kernel's byte-column tile on THIS chip +
+    runtime: measure every SWEEP_TILES candidate at the primary depth and
+    pin the winner via WEEDTPU_EC_TILE so every codec constructed after
+    this (the primary metric, the mesh paths, the fleet pipeline) runs
+    the measured-best shape.  The whole sweep lands in the bench JSON —
+    the r04->r05 collapse (336 -> 108 GB/s) shipped precisely because the
+    tile was a constant nobody re-measured."""
+    if not on_tpu:
+        return  # the XLA path has no tile; CPU pallas is the emulator
+    if os.environ.get("WEEDTPU_EC_TILE"):
+        extra["ec_encode_tile_config"] = {
+            "chosen": int(os.environ["WEEDTPU_EC_TILE"]),
+            "pinned": True}
+        return
+    from seaweedfs_tpu.models import rs
+    from seaweedfs_tpu.ops import pallas_gf
+    sweep: dict = {}
+    best_t, best_v = None, 0.0
+    for t in pallas_gf.SWEEP_TILES:
+        if n % t:
+            continue
+
+        def factory(k, m, _on, t=t):
+            return pallas_gf.PallasRSCodec(rs.get_code(k, m), tile=t)
+
+        try:
+            v = _bench_encode_kernel(10, 4, n, True, iters=iters,
+                                     codec_factory=factory)
+        except Exception as e:  # e.g. a tile whose VMEM blocks don't fit
+            sweep[str(t)] = f"failed: {e.__class__.__name__}"
+            continue
+        sweep[str(t)] = round(v, 2)
+        if v > best_v:
+            best_t, best_v = t, v
+    if best_t is not None:
+        os.environ["WEEDTPU_EC_TILE"] = str(best_t)
+        extra["ec_encode_tile"] = best_t
+    extra["ec_encode_tile_config"] = {"chosen": best_t, "sweep": sweep}
 
 
 def _mesh_codec_factory(k: int, m: int, on_tpu: bool):
@@ -419,6 +480,77 @@ def _bench_e2e(size: int, batch: int, codec_env: str | None,
                 os.environ.pop("WEEDTPU_EC_PIPELINE", None)
             else:
                 os.environ["WEEDTPU_EC_PIPELINE"] = old_pipe
+
+
+def _bench_fleet_convert(extra: dict, kind: str | None = None,
+                         vol_mb: int = 32, n_vols: int = 4,
+                         reps: int = 2, tag_tunnel: bool = False) -> None:
+    """e2e fleet conversion: N volumes -> N shard sets through ONE
+    interleaved device-resident stream (ops/fleet_convert).  Records
+    `fleet_convert_gbps` (total volume bytes / wall) plus per-stage
+    attribution, and BYTE-VERIFIES the first stripe row of every volume
+    against the numpy reference codec — a fast wrong conversion must
+    fail the run (fleet_convert_failed), not win the trajectory."""
+    from seaweedfs_tpu.models import rs
+    from seaweedfs_tpu.ops import fleet_convert
+    from seaweedfs_tpu.storage.ec import layout
+    size = vol_mb * 1024 * 1024
+    rng = np.random.default_rng(5)
+    with tempfile.TemporaryDirectory(prefix="weedtpu-fleet-") as d:
+        bases = []
+        for i in range(n_vols):
+            base = os.path.join(d, f"v{i}")
+            rng.integers(0, 256, size, dtype=np.uint8).tofile(base + ".dat")
+            bases.append(base)
+        codec = fleet_convert.fleet_codec(kind)
+        best = float("inf")
+        best_stats: dict = {}
+        for _ in range(reps):
+            # recycle committed shards back to .tmp names between reps so
+            # steady-state reps overwrite warm inodes (same rationale as
+            # _bench_e2e: measure the pipeline, not the page allocator)
+            for base in bases:
+                for i in range(layout.TOTAL_SHARDS):
+                    f = base + layout.to_ext(i)
+                    if os.path.exists(f):
+                        os.replace(f, f + ".tmp")
+            stats: dict = {}
+            t0 = time.perf_counter()
+            fleet_convert.convert_volumes(bases, codec=codec, stats=stats)
+            el = time.perf_counter() - t0
+            if el < best:
+                best, best_stats = el, stats
+        # byte-identity spot check: first stripe row of every volume vs
+        # the numpy reference
+        code = rs.get_code(layout.DATA_SHARDS, layout.PARITY_SHARDS)
+        sb = layout.SMALL_BLOCK_SIZE
+        row = layout.DATA_SHARDS * sb
+        for base in bases:
+            with open(base + ".dat", "rb") as f:
+                head = np.frombuffer(f.read(row), np.uint8)
+            if head.size < row:  # sub-row volume: the layout zero-pads
+                head = np.concatenate(
+                    [head, np.zeros(row - head.size, np.uint8)])
+            par = code.encode_numpy(
+                head.reshape(layout.DATA_SHARDS, sb))[layout.DATA_SHARDS:]
+            for pi in range(layout.PARITY_SHARDS):
+                with open(base + layout.to_ext(
+                        layout.DATA_SHARDS + pi), "rb") as f:
+                    got = np.frombuffer(f.read(sb), np.uint8)
+                if not np.array_equal(got, par[pi]):
+                    extra["fleet_convert_failed"] = True
+                    print(f"bench: fleet conversion NOT byte-identical "
+                          f"to the numpy reference ({base} parity {pi}). "
+                          f"Failing the bench run.", file=sys.stderr)
+                    return
+        extra["fleet_convert_gbps"] = round(n_vols * size / 1e9 / best, 3)
+        extra["fleet_convert_verified"] = True
+        if tag_tunnel:
+            extra["fleet_convert_tunnel_bound"] = True
+        detail = {k_: (round(v, 4) if isinstance(v, float) else v)
+                  for k_, v in best_stats.items()
+                  if isinstance(v, (int, float, str))}
+        extra["fleet_convert_detail"] = detail
 
 
 def _native_kernel_gbps(k: int, m: int, impl: int | None = None) -> float:
@@ -670,6 +802,16 @@ def main() -> None:
                      _native_rebuild_gbps, 10, 4, 1)
                 _try(extra, "ec_rebuild_rs10_4_m4",
                      _native_rebuild_gbps, 10, 4, 4)
+                try:
+                    # fleet conversion on the host codec: the interleaved
+                    # multi-volume pipeline is still the production CPU
+                    # path (no jax import on this branch)
+                    _bench_fleet_convert(extra, "cpp")
+                except Exception as e:
+                    print(f"bench: _bench_fleet_convert failed: {e}",
+                          file=sys.stderr)
+                    extra.setdefault("gated_bench_failed", []).append(
+                        "_bench_fleet_convert")
                 _emit(gbps, "cpu-native", baseline, extra)
                 return _exit_code(extra)
 
@@ -699,6 +841,14 @@ def main() -> None:
         total, tile = 640 * 1024 * 1024, 32768
         return max(tile, total // (k * tile) * tile)
 
+    # re-tune the Pallas tile on this chip first: the winner is pinned
+    # via WEEDTPU_EC_TILE, so the primary metric (and every codec built
+    # after it — mesh, batch, fleet) runs the measured-best config
+    try:
+        _bench_tile_sweep(extra, _n_for(10), on_tpu)
+    except Exception as e:
+        print(f"bench: tile sweep failed: {e}", file=sys.stderr)
+
     gbps = _bench_encode_kernel(10, 4, _n_for(10), on_tpu, iters=60)
 
     for k, m in RS_SWEEP:
@@ -713,6 +863,34 @@ def main() -> None:
          _mesh_codec_factory)
     _try(extra, "ec_encode_batch4_place",
          _bench_batch_place, 10, 4, 4, _n_for(10) // 4, on_tpu, 60)
+    # batch placement runs the same bytes through the same kernel plus a
+    # shard-spread all_to_all — it must never UNDERPERFORM the unsharded
+    # call (the r05 regression: 56.5 vs 108.7 GB/s sailed through ungated)
+    b4 = extra.get("ec_encode_batch4_place")
+    if b4 is not None and gbps > 0:
+        ratio = b4 / gbps
+        extra["batch_place_ratio"] = round(ratio, 3)
+        if ratio < BATCH_PLACE_TOL:
+            extra["batch_place_regression"] = True
+            print(f"bench: REGRESSION — ec_encode_batch4_place runs at "
+                  f"{ratio:.2f}x the single-call kernel (must be >= "
+                  f"{BATCH_PLACE_TOL}). Failing the bench run.",
+                  file=sys.stderr)
+
+    # fleet conversion e2e: device codec on this backend (single-chip
+    # unit batches through the fused batch kernel; a >1-device attach
+    # rides the unit-sharded mesh).  Tunnel-bound on this harness like
+    # every d2h-heavy TPU e2e — sized down and tagged there.
+    try:
+        if on_tpu:
+            _bench_fleet_convert(extra, None, vol_mb=2, n_vols=4, reps=1,
+                                 tag_tunnel=True)
+        else:
+            _bench_fleet_convert(extra, None)
+    except Exception as e:
+        print(f"bench: _bench_fleet_convert failed: {e}", file=sys.stderr)
+        extra.setdefault("gated_bench_failed", []).append(
+            "_bench_fleet_convert")
 
     # xprof trace of one warm encode batch (WEEDTPU_JAX_PROFILE=dir):
     # proves the kernel timeline the way the reference's pprof profiles do
@@ -778,6 +956,8 @@ def _exit_code(extra: dict) -> int:
              "repair_interference_regression",
              "repair_ratio_regression",
              "chaos_scenario_failed",
+             "batch_place_regression",
+             "fleet_convert_failed",
              "bench_regression",
              "gated_bench_failed")
     return 1 if any(extra.get(g) for g in gates) else 0
@@ -815,7 +995,13 @@ HISTORY_OVERHEAD_TOL = 0.97
 # bench trajectory: a gated headline metric dropping more than 10% below
 # the best prior recorded round (same backend) fails the run
 TRAJECTORY_TOL = 0.90
-TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1")
+# mesh + fleet joined the gate in round 12: r05 MEASURED the 83.7 GB/s
+# mesh regression but nothing failed, so it shipped
+TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1",
+                    "ec_encode_rs10_4_mesh", "fleet_convert_gbps")
+# batch placement must stay within this fraction of the unsharded
+# single-call kernel at equal bytes (satellite gate, ISSUE 12)
+BATCH_PLACE_TOL = 0.90
 # lower-is-better trajectory gates: the metric failing when it RISES
 # more than 10% above the best (minimum) prior recorded round
 TRAJECTORY_GATED_MIN = ("repair_network_ratio",)

@@ -35,11 +35,13 @@ import re
 import socket
 import threading
 import time
+import os
 import urllib.error
 import urllib.parse
 import urllib.request
 
 from seaweedfs_tpu.maintenance import faults
+from seaweedfs_tpu.storage.ec import layout as _eclayout
 
 __all__ = ["ChaosCluster", "WORKLOADS", "FAULTS", "MATRIX",
            "run_scenario", "fsck_report", "encode_all_volumes"]
@@ -747,6 +749,63 @@ def _fault_helper_death_mid_rebuild(c: ChaosCluster, ctx: dict) -> None:
     assert not leftovers, f"partial shards left behind: {leftovers}"
 
 
+def _fault_convert_mid_failure(c: ChaosCluster, ctx: dict) -> None:
+    """Kill a volume server mid-fleet-conversion: the scheduler's node
+    call dies, its volumes are RE-QUEUED (never dropped), and once the
+    node returns the conversion converges.  Clean-abort contract: the
+    tmp+rename commit means a killed conversion can never leave a
+    partial `.ecXX` set visible — after convergence every converted
+    volume has all 14 shards, and run_scenario's byte-identical
+    readback + fsck close the loop."""
+    import asyncio as _aio
+    vs = c.volume_servers[0]
+    vids = sorted({vid for loc in vs.store.locations
+                   for vid in loc.volumes})
+    assert vids, "workload left no plain volumes to convert"
+    for vid in vids:
+        v = vs.store.get_volume(vid)
+        if v is not None:
+            v.nm.flush()
+    leader = c.leader()
+    sched = leader.convert
+    sched.enqueue(vids)
+    # fire the paced tick and kill the node while the batch is in flight
+    fut = _aio.run_coroutine_threadsafe(sched.tick(), c.loop)
+    c.restart_volume_server(0, downtime=0.5)
+    try:
+        fut.result(120)
+    except Exception:
+        pass  # the tick itself survives; failures land in the history
+    st = sched.status()
+    requeued = set(st["queued"]) | {int(v) for v in st["backoffs"]}
+    converted_early = sched.converted
+    if not converted_early:
+        # the kill landed mid-conversion: every volume must be re-queued
+        assert requeued.issuperset(vids), (requeued, vids)
+    c.wait_heartbeats()
+    # node is back: expire the backoffs and tick until the queue drains
+    deadline = time.monotonic() + 90.0
+    while time.monotonic() < deadline:
+        sched._backoff = {v: (f, 0.0)
+                          for v, (f, _) in sched._backoff.items()}
+        _aio.run_coroutine_threadsafe(sched.tick(), c.loop).result(120)
+        if not sched.queued and not sched.active:
+            break
+        time.sleep(0.3)
+    assert not sched.queued, sched.status()
+    vs = c.volume_servers[0]  # the restarted instance
+    for vid in vids:
+        v = vs.store.get_volume(vid)
+        assert v is not None, vid
+        shards = [i for i in range(_eclayout.TOTAL_SHARDS)
+                  if os.path.exists(v._base + _eclayout.to_ext(i))]
+        # all-or-nothing: a partial committed set would mean the
+        # tmp+rename contract broke
+        assert len(shards) == _eclayout.TOTAL_SHARDS, \
+            f"volume {vid}: partial/absent shard set {shards}"
+    time.sleep(2 * c.heartbeat_interval + 0.2)  # shard heartbeats land
+
+
 def _fault_partition(c: ChaosCluster, ctx: dict) -> None:
     """Partition every GATEWAY (client/shell/filer — and thereby s3 and
     MQ, which read through the filer) from node 1: reads must fail over
@@ -765,8 +824,14 @@ def _fault_master_failover(c: ChaosCluster, ctx: dict) -> None:
     c.fail_over_master()
 
 
+# faults that drive their own EC encode (the fault IS the conversion
+# under failure): run_scenario must not pre-encode the workload's
+# volumes for these — it would leave them nothing to convert
+SELF_ENCODING_FAULTS = frozenset({"convert_mid_failure"})
+
 FAULTS = {
     "shard_loss": _fault_shard_loss,
+    "convert_mid_failure": _fault_convert_mid_failure,
     "bit_rot": _fault_bit_rot,
     "slow_peer": _fault_slow_peer,
     "restart_mid_repair": _fault_restart_mid_repair,
@@ -788,7 +853,7 @@ def run_scenario(c: ChaosCluster, workload: str, fault: str,
     prepare, verify = WORKLOADS[workload]
     t0 = time.monotonic()
     state = prepare(c)
-    if encode:
+    if encode and fault not in SELF_ENCODING_FAULTS:
         encode_all_volumes(c)
     verify(c, state)  # the pre-fault baseline must hold before we break it
     ctx: dict = {}

@@ -31,6 +31,17 @@ class RSCodecBase:
         """[k, n] data -> [m, n] parity (systematic: data shards unchanged)."""
         return self._parity(data)
 
+    def encode_parity_batch(self, units: jax.Array) -> jax.Array:
+        """[U, k, n] unit batch -> [U, m, n] parity in ONE device dispatch
+        — the fleet-conversion fast path.  Backends whose matrix apply
+        has a fused batch kernel (Pallas grid over units, XLA vmap) use
+        it; anything else falls back to per-unit applies."""
+        batched = getattr(self._parity, "apply_batch", None)
+        if batched is not None:
+            return batched(units)
+        return jnp.stack([self._parity(units[u])
+                          for u in range(units.shape[0])], axis=0)
+
     def encode(self, data: jax.Array) -> jax.Array:
         """[k, n] data -> [k+m, n] shards."""
         return jnp.concatenate([data, self.encode_parity(data)], axis=0)

@@ -82,6 +82,78 @@ def materialize(parity, kernel: str = "encode_parity") -> np.ndarray:
         return out
 
 
+def dispatch_parity_batch(codec, units, placed=None):
+    """Dispatch a [U, k, B] unit batch -> [U, m, B] parity in ONE kernel
+    launch — the fleet-conversion hot path (ops/fleet_convert.py).
+
+    `placed`, when given, is the already-device-resident (and, on a mesh,
+    unit-sharded) twin of the host batch `units`: the pipeline H2Ds
+    through the encoder's matched in_sharding up front so the dispatch
+    never reshards.  Host backends loop eagerly per unit (they have no
+    batch geometry to win; the pipeline's value there is the interleaved
+    I/O).  Device dispatches return un-materialised; `unit_parity_shards`
+    is the streaming sync point."""
+    NativeRSCodec, RSCode = _host_classes()
+    nbytes = units.nbytes
+    if isinstance(codec, (NativeRSCodec, RSCode)):
+        with trace.span("codec.dispatch_parity_batch", backend="host",
+                        bytes=nbytes), \
+                KERNELS.timed("fleet_encode", nbytes=nbytes):
+            if isinstance(codec, NativeRSCodec):
+                return np.stack([codec.encode_parity(units[u])
+                                 for u in range(units.shape[0])], axis=0)
+            return np.stack([codec.encode_numpy(units[u])[codec.k:]
+                             for u in range(units.shape[0])], axis=0)
+    import jax.numpy as jnp
+    with trace.span("codec.dispatch_parity_batch", backend="device",
+                    bytes=nbytes):
+        t0 = time.perf_counter()
+        if placed is None:
+            place = getattr(codec, "place", None)
+            placed = place(units) if place is not None \
+                else jnp.asarray(units)
+        t1 = time.perf_counter()
+        out = codec.encode_parity_batch(placed)
+        KERNELS.record("fleet_encode", "device",
+                       wall_s=time.perf_counter() - t1,
+                       h2d_s=t1 - t0, h2d_bytes=nbytes, nbytes=nbytes)
+        return out
+
+
+def unit_parity_shards(parity, kernel: str = "fleet_encode"):
+    """Streaming sync point of a batched dispatch: yield
+    (unit_start, unit_stop, np.ndarray) per device-local block as each
+    block's D2H completes — on a mesh the drain hands shards to their
+    writers as they come off each chip instead of waiting for a full
+    gather.  Host arrays yield one block immediately."""
+    if isinstance(parity, np.ndarray):
+        yield 0, parity.shape[0], parity
+        return
+    nbytes = getattr(parity, "nbytes", 0)
+    with trace.span("codec.d2h", bytes=nbytes, streamed=True):
+        t0 = time.perf_counter()
+        if hasattr(parity, "block_until_ready"):
+            parity.block_until_ready()
+        t1 = time.perf_counter()
+        KERNELS.record(kernel, "device", calls=0, device_s=t1 - t0)
+        shards = getattr(parity, "addressable_shards", None)
+        if not shards:
+            out = np.asarray(parity)
+            KERNELS.record(kernel, "device", calls=0,
+                           d2h_s=time.perf_counter() - t1,
+                           d2h_bytes=out.nbytes)
+            yield 0, out.shape[0], out
+            return
+        for sh in sorted(shards, key=lambda s: s.index[0].start or 0):
+            start = sh.index[0].start or 0
+            t2 = time.perf_counter()
+            data = np.asarray(sh.data)
+            KERNELS.record(kernel, "device", calls=0,
+                           d2h_s=time.perf_counter() - t2,
+                           d2h_bytes=data.nbytes)
+            yield int(start), int(start) + data.shape[0], data
+
+
 def parity_mismatch(codec, data: np.ndarray,
                     parity_rows: dict[int, np.ndarray]
                     ) -> dict[int, np.ndarray]:

@@ -1,0 +1,420 @@
+"""Batched multi-volume EC conversion: one device-resident stream.
+
+`write_ec_files` converts ONE volume well: its pipeline overlaps read /
+encode / write, but between volumes the device drains and the writers
+idle — fleet-wide cold-volume conversion (the consumer the autopilot
+demote path feeds) runs as N serial encodes.  This module interleaves N
+volumes' column units into ONE stream of unit batches:
+
+    readers     stage units round-robin across volumes into pooled
+                [U, k, B] host batches (data shards go straight to each
+                volume's writer pool by in-kernel copy_file_range — they
+                never touch the device)
+    dispatch    H2D through the encoder's matched in_sharding (on a mesh
+                each chip pulls exactly its U/D units) and launches ONE
+                batched parity kernel per batch (pallas grid over units;
+                ops/dispatch.dispatch_parity_batch)
+    drain       streams parity off the device PER DEVICE SHARD as each
+                block's D2H lands (dispatch.unit_parity_shards) and fans
+                rows to the owning volume's writers — no full gather
+    writers     per-volume _ShardWriterPool; a volume whose last unit
+                drains is finalized (truncate to shard size, .vif,
+                tmp -> rename commit) while the stream keeps feeding the
+                other volumes
+
+Double buffering falls out of the pooled batches: H2D + kernel for batch
+N+1 runs while batch N is still draining D2H + writes.  Failure/cancel
+anywhere aborts the WHOLE run cleanly: uncommitted volumes keep their
+previous valid shard set (same .tmp recycle + rename-on-success contract
+as write_ec_files), committed volumes stay committed.
+
+Knobs: WEEDTPU_CONVERT_UNITS (units per device batch, default 4; rounded
+up to an even mesh split), WEEDTPU_CONVERT_DEPTH (in-flight batches,
+default 2 = double buffered).  The master-side pacing of fleet runs
+lives in maintenance/convert.py; this module is the data plane.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from seaweedfs_tpu.ops.dispatch import (dispatch_parity_batch,
+                                        unit_parity_shards)
+from seaweedfs_tpu.stats import netflow as _netflow
+from seaweedfs_tpu.storage.ec import layout
+from seaweedfs_tpu.storage.ec.ec_files import (
+    DEFAULT_BATCH, EncodeCancelled, _iter_units, _map_readonly,
+    _ShardFlusher, _ShardWriterPool, _Timer, _unit_coverage, _unit_steps,
+    overlap_fraction, write_vif)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+@functools.lru_cache(maxsize=4)
+def _fleet_unit_encoder(k: int, m: int):
+    from seaweedfs_tpu.models import rs
+    from seaweedfs_tpu.parallel import mesh as pmesh
+    return pmesh.FleetUnitEncoder(rs.get_code(k, m))
+
+
+def fleet_codec(kind: str | None = None):
+    """The codec a fleet conversion rides: with more than one attached
+    device (a real slice, or the virtual CPU mesh in tests) the
+    unit-sharded FleetUnitEncoder; otherwise whatever WEEDTPU_EC_CODEC
+    resolves to — every backend now takes `dispatch_parity_batch`."""
+    from seaweedfs_tpu.storage.ec.ec_files import _get_codec
+    kind = kind or os.environ.get("WEEDTPU_CONVERT_CODEC")
+    if kind:
+        if kind in ("mesh", "fleet"):
+            return _fleet_unit_encoder(layout.DATA_SHARDS,
+                                       layout.PARITY_SHARDS)
+        return _get_codec(kind)
+    try:
+        import jax
+        if len(jax.devices()) > 1:
+            return _fleet_unit_encoder(layout.DATA_SHARDS,
+                                       layout.PARITY_SHARDS)
+    except Exception:
+        pass
+    return _get_codec()
+
+
+class _VolumeJob:
+    """One volume mid-conversion: source map, recycled .tmp shard fds,
+    its writer pool, and completion accounting."""
+
+    def __init__(self, base: str, dat_path: str | None, large_block: int,
+                 small_block: int, batch_size: int, stats: dict | None):
+        self.base = base
+        self.dat_path = dat_path or base + ".dat"
+        self.dat_size = os.path.getsize(self.dat_path)
+        self.large_block = large_block
+        self.small_block = small_block
+        self.shard_size = layout.shard_file_size(
+            self.dat_size, large_block, small_block)
+        self.tmp_paths = [base + layout.to_ext(i) + ".tmp"
+                          for i in range(layout.TOTAL_SHARDS)]
+        self.out_fds = [os.open(p, os.O_RDWR | os.O_CREAT, 0o644)
+                        for p in self.tmp_paths]
+        self.highwater = [0] * layout.TOTAL_SHARDS
+        self.dat_f = open(self.dat_path, "rb")
+        self.mm = None
+        self.view: np.ndarray | None = None
+        if self.dat_size:
+            self.mm = _map_readonly(self.dat_f.fileno(), self.dat_size)
+            self.view = np.frombuffer(self.mm, dtype=np.uint8)
+        k = layout.DATA_SHARDS
+        self.writers = _ShardWriterPool(
+            self.out_fds, self.highwater, stats,
+            stage_key=lambda i: "write_data_s" if i < k
+            else "write_parity_s")
+        # two submission batchers, one per producer thread: the reader
+        # ships data-shard copies, the drain ships parity rows — a
+        # _ShardFlusher is single-producer (its per-shard job lists and
+        # accumulator are unlocked)
+        self.data_flusher = _ShardFlusher(self.writers, layout.TOTAL_SHARDS)
+        self.parity_flusher = _ShardFlusher(self.writers,
+                                            layout.TOTAL_SHARDS)
+        self.units = _iter_units(self.dat_size, large_block, small_block,
+                                 batch_size)
+        self.units_read = 0
+        self.units_total: int | None = None  # set when the iterator ends
+        self.units_drained = 0   # written by the drain thread only
+        self.units_skipped = 0   # written by the reader thread only
+        self.done_bytes = 0
+        self.committed = False
+        self._stats = stats
+
+    def next_unit(self):
+        try:
+            u = next(self.units)
+            self.units_read += 1
+            return u
+        except StopIteration:
+            self.units_total = self.units_read
+            return None
+
+    def drained_all(self) -> bool:
+        # drained is drain-thread-owned, skipped reader-thread-owned: two
+        # counters so the threads never race one += on the same field
+        return self.units_total is not None and \
+            self.units_drained + self.units_skipped >= self.units_total
+
+    def finalize(self) -> None:
+        """All units drained: barrier on the writers, cut shards to size,
+        commit by rename.  Runs on the drain thread while the stream
+        keeps feeding other volumes."""
+        self.data_flusher.flush()
+        self.parity_flusher.flush()
+        self.writers.close()
+        if self.writers.errors:
+            raise self.writers.errors[0]
+        for fd, hw in zip(self.out_fds, self.highwater):
+            os.ftruncate(fd, min(hw, self.shard_size))
+            if hw < self.shard_size:
+                os.ftruncate(fd, self.shard_size)
+        for fd in self.out_fds:
+            os.close(fd)
+        self.out_fds = []
+        write_vif(self.base, self.dat_size)
+        for i, p in enumerate(self.tmp_paths):
+            os.replace(p, self.base + layout.to_ext(i))
+        self.committed = True
+        if self._stats is not None:
+            # callers that must react per-volume (the volume server's
+            # freeze bookkeeping) see commits even when a LATER volume
+            # fails the run
+            self._stats.setdefault("committed_bases", []).append(self.base)
+
+    def abort(self) -> None:
+        """Failure path: drop fds and every .tmp so no partial shard set
+        is ever visible; a previous valid shard set stays untouched."""
+        try:
+            self.writers.close()
+        except Exception:
+            pass
+        for fd in self.out_fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self.out_fds = []
+        if not self.committed:
+            for p in self.tmp_paths:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    def release(self) -> None:
+        if self.view is not None:
+            self.view = None
+        if self.mm is not None:
+            try:
+                self.mm.close()
+            except BufferError:
+                pass
+            self.mm = None
+        self.dat_f.close()
+
+
+def convert_volumes(bases: list[str], *,
+                    large_block: int = layout.LARGE_BLOCK_SIZE,
+                    small_block: int = layout.SMALL_BLOCK_SIZE,
+                    batch_size: int = DEFAULT_BATCH,
+                    codec=None, unit_batch: int | None = None,
+                    progress=None, cancel=None,
+                    stats: dict | None = None) -> dict:
+    """Convert `bases` (.dat volumes) into EC shard sets through one
+    interleaved device-resident stream.  Returns per-volume accounting.
+
+    `progress(bytes_done)` sees TOTAL volume bytes consumed across the
+    fleet; `cancel()` aborts the whole run (uncommitted volumes roll
+    back).  `stats` receives the usual per-stage wall-second attribution
+    plus units/volumes counters."""
+    if not bases:
+        return {"volumes": {}, "bytes": 0}
+    codec = codec if codec is not None else fleet_codec()
+
+    # chaos hook: an armed shard_write_error fault fails the conversion
+    # like a dying disk — before any tmp shard file exists
+    from seaweedfs_tpu.maintenance import faults as _faults
+    for base in bases:
+        _faults.check_shard_write(base)
+
+    k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
+    depth = max(1, _env_int("WEEDTPU_CONVERT_DEPTH", 2))
+    U = max(1, _env_int("WEEDTPU_CONVERT_UNITS", 4))
+    slots = getattr(codec, "unit_slots", None)
+    if slots is not None:  # round to an even mesh split
+        U = slots(U)
+
+    stats = stats if stats is not None else {}
+    stats["mode"] = "fleet"
+    stats["unit_batch"] = U
+    # class=convert on THIS thread and (contextvars are per-thread) re-
+    # stamped inside each pipeline thread, so any hop made on the
+    # conversion's behalf — wherever it runs — books as convert
+    flow_cls = _netflow.current_class() or "convert"
+    _flow_token = _netflow.set_class(flow_cls)
+    t_wall = time.perf_counter()
+    jobs = [_VolumeJob(b, None, large_block, small_block, batch_size,
+                       stats) for b in bases]
+    stats["bytes"] = sum(j.dat_size for j in jobs)
+
+    # one staging width covers every job (ragged tails zero-fill): pooled
+    # [U, k, W] batches, depth+1 so H2D/kernel of batch N+1 overlaps the
+    # D2H/writes of batch N
+    W = max(_unit_steps(j.dat_size, large_block, small_block,
+                        batch_size)[1] for j in jobs)
+    pool: queue.Queue = queue.Queue()
+    for _ in range(depth + 1):
+        pool.put(np.empty((U, k, W), dtype=np.uint8))
+    q_read: queue.Queue = queue.Queue(maxsize=depth)
+    q_disp: queue.Queue = queue.Queue()
+    errors: list[BaseException] = []
+    done_total = 0
+
+    def reader() -> None:
+        """Round-robin units across volumes into staged unit batches."""
+        nonlocal done_total
+        active = list(jobs)
+        _netflow.set_class(flow_cls)
+        try:
+            while active and not errors:
+                if cancel is not None and cancel():
+                    raise EncodeCancelled("fleet conversion cancelled")
+                with _Timer(stats, "stall_s"):
+                    buf = pool.get()
+                metas = []
+                with _Timer(stats, "read_s"):
+                    while len(metas) < U and active:
+                        job = active[len(metas) % len(active)]
+                        unit = job.next_unit()
+                        if unit is None:
+                            active.remove(job)
+                            continue
+                        row_start, block, col, step, shard_off = unit
+                        nz, tail = _unit_coverage(
+                            job.dat_size, row_start, block, col, step)
+                        if nz == 0:
+                            # a trailing column unit wholly beyond the
+                            # .dat: nothing to encode or write
+                            job.units_skipped += 1
+                            continue
+                        # data shards: in-kernel copies on the volume's
+                        # own writers — they never ride the device
+                        for j in range(nz):
+                            off = row_start + j * block + col
+                            n = step if j < nz - 1 else tail
+                            job.data_flusher.copy(j, job.dat_f.fileno(), off,
+                                             shard_off, n,
+                                             src_view=job.view)
+                        slot = buf[len(metas)]
+                        for j in range(k):
+                            off = row_start + j * block + col
+                            n = max(0, min(step, job.dat_size - off))
+                            if n > 0:
+                                np.copyto(slot[j, :n],
+                                          job.view[off:off + n])
+                            if n < W:
+                                slot[j, max(n, 0):] = 0
+                        metas.append((job, shard_off, step))
+                        done_total += (nz - 1) * step + tail
+                        job.done_bytes += (nz - 1) * step + tail
+                        job.data_flusher.account(step)
+                    if progress is not None:
+                        progress(done_total)
+                if metas:
+                    q_read.put((buf, metas))
+                else:
+                    pool.put(buf)
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            q_read.put(None)
+
+    def drain() -> None:
+        """Materialise parity per device shard and fan rows out; finalize
+        each volume the moment its last unit lands."""
+        failed = False
+        _netflow.set_class(flow_cls)
+        while True:
+            item = q_disp.get()
+            if item is None:
+                return
+            buf, metas, parity = item
+            if failed or errors:
+                pool.put(buf)
+                continue
+            try:
+                with _Timer(stats, "d2h_s"):
+                    blocks = list(unit_parity_shards(parity))
+                pool.put(buf)  # device done with the staging memory
+                for a, b, block in blocks:
+                    for u in range(a, min(b, len(metas))):
+                        job, shard_off, step = metas[u]
+                        rows = block[u - a]
+                        for i in range(m):
+                            job.parity_flusher.put(k + i, rows[i, :step],
+                                                   shard_off)
+                        job.parity_flusher.account(step)
+                        job.units_drained += 1
+                        if job.drained_all():
+                            job.finalize()
+            except BaseException as e:
+                errors.append(e)
+                failed = True
+                continue
+
+    t_r = threading.Thread(target=reader, name="fleet-reader", daemon=True)
+    t_d = threading.Thread(target=drain, name="fleet-drain", daemon=True)
+    t_r.start()
+    t_d.start()
+    try:
+        while True:
+            item = q_read.get()
+            if item is None:
+                break
+            buf, metas = item
+            if errors:
+                pool.put(buf)
+                continue
+            try:
+                with _Timer(stats, "encode_s"):
+                    parity = dispatch_parity_batch(codec, buf)
+                q_disp.put((buf, metas, parity))
+            except BaseException as e:
+                errors.append(e)
+                pool.put(buf)
+    finally:
+        q_disp.put(None)
+        t_d.join()
+        while t_r.is_alive():  # unblock a reader stuck on a full q_read
+            try:
+                item = q_read.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if item is not None:
+                pool.put(item[0])
+        t_r.join()
+        # empty volumes never enter the stream; commit them here, and on
+        # any error roll every uncommitted volume back
+        for job in jobs:
+            try:
+                if not errors and not job.committed and job.drained_all():
+                    job.finalize()
+            except BaseException as e:
+                errors.append(e)
+        for job in jobs:
+            if errors and not job.committed:
+                job.abort()
+            job.release()
+        _netflow.reset(_flow_token)
+    if errors:
+        raise errors[0]
+    for job in jobs:
+        if job.writers.errors:
+            raise job.writers.errors[0]
+    stats["wall_s"] = time.perf_counter() - t_wall
+    stats["volumes"] = len(jobs)
+    stats["units"] = sum(j.units_read for j in jobs)
+    frac = overlap_fraction(stats)
+    if frac is not None:
+        stats["overlap_frac"] = frac
+    return {"volumes": {j.base: {"bytes": j.dat_size,
+                                 "shard_size": j.shard_size}
+                        for j in jobs},
+            "bytes": stats["bytes"], "units": stats["units"],
+            "wall_s": round(stats["wall_s"], 4)}

@@ -69,6 +69,18 @@ def bitsliced_apply_body(bitmat: jax.Array, data: jax.Array) -> jax.Array:
 _bitsliced_apply = jax.jit(bitsliced_apply_body)
 
 
+def bitsliced_apply_batch_body(bitmat: jax.Array, data: jax.Array
+                               ) -> jax.Array:
+    """[U, k, n] unit batch -> [U, m, n]: units are independent stripes,
+    so the batch is one vmap of the bit-sliced apply (XLA batches the
+    MXU dot over the leading dim).  Un-jitted, shared with the per-device
+    shard_map bodies in parallel/mesh.py."""
+    return jax.vmap(bitsliced_apply_body, in_axes=(None, 0))(bitmat, data)
+
+
+_bitsliced_apply_batch = jax.jit(bitsliced_apply_batch_body)
+
+
 class JaxGFMatrix:
     """A fixed GF(2^8) matrix, pre-lifted to its bit-matrix, applied on TPU."""
 
@@ -80,6 +92,10 @@ class JaxGFMatrix:
     def __call__(self, data: jax.Array) -> jax.Array:
         """data [k, n] uint8 -> [m, n] uint8 product over GF(2^8)."""
         return _bitsliced_apply(self.bitmat, data)
+
+    def apply_batch(self, data: jax.Array) -> jax.Array:
+        """data [U, k, n] -> [U, m, n] in one dispatch."""
+        return _bitsliced_apply_batch(self.bitmat, data)
 
 
 class JaxRSCodec(codec_base.RSCodecBase):

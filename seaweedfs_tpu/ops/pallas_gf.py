@@ -41,7 +41,32 @@ from seaweedfs_tpu.ops import codec_base, gf
 DEFAULT_TILE = 32768  # interpreter/CPU default: small pads for small inputs
 TPU_TILE = 131072  # measured best on v5e (round-5 sweep: ~+25% over 32K;
 #                    256K regresses — xbits VMEM block passes 16MB)
+# candidate byte-column tiles for the bench re-tune sweep
+# (bench._bench_tile_sweep): the r04->r05 swing (336 -> 108 GB/s) showed
+# the best tile is a property of the chip + runtime, not the repo, so
+# every TPU bench run re-measures and records its choice instead of
+# trusting a constant picked under different weather
+SWEEP_TILES = (32768, 65536, 131072, 262144)
 PLANE_PAD = 16  # sublane alignment for each bit-plane block
+
+
+def resolved_tile(tile: int | None = None) -> int:
+    """The tile a codec will actually use: explicit argument, else the
+    WEEDTPU_EC_TILE env override (how the bench sweep's winning config —
+    and an operator pinning a known-good shape — reaches every codec
+    constructed afterwards), else the backend default."""
+    if tile is not None:
+        return tile
+    import os
+    env = os.environ.get("WEEDTPU_EC_TILE")
+    if env:
+        try:
+            t = int(env)
+            if t > 0:
+                return t
+        except ValueError:
+            pass
+    return TPU_TILE if jax.default_backend() == "tpu" else DEFAULT_TILE
 
 
 def gf_matrix_to_bitmatrix_planemajor(C: np.ndarray, kpad: int | None = None) -> np.ndarray:
@@ -63,20 +88,25 @@ def gf_matrix_to_bitmatrix_planemajor(C: np.ndarray, kpad: int | None = None) ->
     return out
 
 
-def _gf_apply_kernel(bitmat_ref, x_ref, o_ref, *, k: int, m: int, kpad: int):
-    x = x_ref[:]  # [k, TN] uint8
+def _gf_body(bitmat, x, *, k: int, m: int, kpad: int):
+    """The fused unpack -> MXU dot -> repack body on VMEM-resident arrays:
+    x is one [k, TN] uint8 tile, bitmat the [8m, 8*kpad] plane-major lift."""
     zpad = jnp.zeros((kpad - k, x.shape[1]), jnp.int8)
     planes = []
     for s in range(8):
         p = ((x & jnp.uint8(1 << s)) != 0).astype(jnp.int8)
         planes.append(p if kpad == k else jnp.concatenate([p, zpad], axis=0))
     xbits = jnp.concatenate(planes, axis=0)  # [8*kpad, TN] int8 0/1
-    acc = jnp.dot(bitmat_ref[:], xbits, preferred_element_type=jnp.int32)
+    acc = jnp.dot(bitmat, xbits, preferred_element_type=jnp.int32)
     acc = acc & 1  # [8m, TN] parity bits, plane-major
     byte = acc[0:m]
     for r in range(1, 8):
         byte = byte | (acc[r * m : (r + 1) * m] << r)
-    o_ref[:] = byte.astype(jnp.uint8)
+    return byte.astype(jnp.uint8)
+
+
+def _gf_apply_kernel(bitmat_ref, x_ref, o_ref, *, k: int, m: int, kpad: int):
+    o_ref[:] = _gf_body(bitmat_ref[:], x_ref[:], k=k, m=m, kpad=kpad)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "m", "kpad", "tile", "interpret"))
@@ -101,6 +131,42 @@ def _gf_apply(bitmat: jax.Array, data: jax.Array, k: int, m: int, kpad: int,
     )(bitmat, data)
 
 
+def _gf_apply_batch_kernel(bitmat_ref, x_ref, o_ref, *, k: int, m: int,
+                           kpad: int):
+    # block shapes carry a leading unit-batch dim of 1; squeeze it through
+    # the same fused body
+    o_ref[0] = _gf_body(bitmat_ref[:], x_ref[0], k=k, m=m, kpad=kpad)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "kpad", "tile",
+                                             "interpret"))
+def _gf_apply_batch(bitmat: jax.Array, data: jax.Array, k: int, m: int,
+                    kpad: int, tile: int, interpret: bool) -> jax.Array:
+    """Unit-batch geometry: [U, k, n] -> [U, m, n] in ONE pallas_call with
+    a (U, n//tile) grid — the fleet-conversion stream encodes a whole
+    interleaved multi-volume unit batch per dispatch instead of paying a
+    kernel launch (and a host round-trip through the dispatch seam) per
+    unit.  Both grid axes are parallel: units are independent stripes and
+    the GF matmul is column-local."""
+    U, _, n = data.shape
+    assert n % tile == 0, (n, tile)
+    kernel = functools.partial(_gf_apply_batch_kernel, k=k, m=m, kpad=kpad)
+    return pl.pallas_call(
+        kernel,
+        grid=(U, n // tile),
+        in_specs=[
+            pl.BlockSpec((8 * m, 8 * kpad), lambda u, i: (0, 0)),
+            pl.BlockSpec((1, k, tile), lambda u, i: (u, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, m, tile), lambda u, i: (u, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((U, m, n), jnp.uint8),
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(bitmat, data)
+
+
 class PallasGFMatrix:
     """Fixed GF(2^8) matrix applied via the fused kernel.
 
@@ -110,12 +176,12 @@ class PallasGFMatrix:
     tile-multiples).
     """
 
-    def __init__(self, C: np.ndarray, tile: int = DEFAULT_TILE,
+    def __init__(self, C: np.ndarray, tile: int | None = None,
                  interpret: bool | None = None):
         self.C = np.asarray(C, dtype=np.uint8)
         self.m, self.k = self.C.shape
         self.kpad = max(PLANE_PAD, -(-self.k // PLANE_PAD) * PLANE_PAD)
-        self.tile = tile
+        self.tile = resolved_tile(tile)
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         self.interpret = interpret
@@ -132,15 +198,28 @@ class PallasGFMatrix:
                         self.tile, self.interpret)
         return out[:, :n] if pad else out
 
+    def apply_batch(self, data: jax.Array) -> jax.Array:
+        """[U, k, n] unit batch -> [U, m, n] parity in one kernel launch
+        (grid over units x column tiles)."""
+        U, k, n = data.shape
+        assert k == self.k, (k, self.k)
+        pad = (-n) % self.tile
+        if pad:
+            data = jnp.pad(data, ((0, 0), (0, 0), (0, pad)))
+        out = _gf_apply_batch(self.bitmat, data, self.k, self.m, self.kpad,
+                              self.tile, self.interpret)
+        return out[:, :, :n] if pad else out
+
 
 class PallasRSCodec(codec_base.RSCodecBase):
     """Fused-kernel RS codec: `RSCodecBase` over `PallasGFMatrix` applies."""
 
-    def __init__(self, code, tile: int = DEFAULT_TILE, interpret: bool | None = None):
+    def __init__(self, code, tile: int | None = None,
+                 interpret: bool | None = None):
         super().__init__(
             code, lambda C: PallasGFMatrix(C, tile, interpret))
-        self.tile = tile
-        self.interpret = interpret
+        self.tile = self._parity.tile
+        self.interpret = self._parity.interpret
 
 
 @functools.lru_cache(maxsize=16)
@@ -152,9 +231,8 @@ def _get_codec_cached(k: int, m: int, construction: str,
 
 def get_codec(k: int, m: int, construction: str = "vandermonde",
               tile: int | None = None) -> PallasRSCodec:
-    """tile=None resolves per backend: the big TPU tile for real chips,
-    the small default under the (CPU) interpreter where column padding
-    to the tile width is pure waste."""
-    if tile is None:
-        tile = TPU_TILE if jax.default_backend() == "tpu" else DEFAULT_TILE
-    return _get_codec_cached(k, m, construction, tile)
+    """tile=None resolves via WEEDTPU_EC_TILE (the bench sweep's recorded
+    winner) and then per backend: the big TPU tile for real chips, the
+    small default under the (CPU) interpreter where column padding to
+    the tile width is pure waste."""
+    return _get_codec_cached(k, m, construction, resolved_tile(tile))

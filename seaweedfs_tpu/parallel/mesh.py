@@ -2,16 +2,32 @@
 
 SeaweedFS scales EC by spreading the 14 shard *files* of each volume across
 volume servers (weed/shell/command_ec_encode.go:164-270 spreadEcShards +
-balancedEcDistribution). The TPU-native analogue has two axes:
+balancedEcDistribution). The TPU-native analogue has three axes:
 
 - **column parallelism** ("sequence parallel" of this system): the byte
   columns of one stripe matrix [k, n] shard over devices; parity is
   column-local so encode needs NO collectives — each chip crunches its slice.
+- **unit parallelism** (the fleet-encode shape): a batch of independent
+  [k, B] column units — interleaved from many volumes by the conversion
+  pipeline (ops/fleet_convert.py) — shards over devices on the unit axis.
+  Parity is unit-local, so this too needs NO collectives, and unlike column
+  sharding there is no per-chip tile-width loss: every chip runs the fused
+  kernel at its preferred tile on whole units.  `FleetUnitEncoder` keeps
+  in/out shardings matched call-to-call so device-resident outputs never
+  reshard between unit batches, and donates the input buffer on real chips
+  so XLA reuses it instead of copying.
 - **volume/shard placement** ("data parallel" + all-to-all): a batch of
   volumes [V, k, n] shards over devices on V; after local encode, one
   `all_to_all` over ICI re-distributes so device d holds shard-group d of
   *every* volume — the shard-spread step of ec.encode, but riding ICI
   instead of 14 gRPC copies.
+
+Per-device compute dispatches through ONE body seam (`_ApplyKernel`):
+the fused Pallas kernel on real TPU chips (ops/pallas_gf — the 336 GB/s
+r04 path), the XLA bit-sliced matmul everywhere else (CPU test meshes,
+interpreters).  Before round 6 the mesh paths always used the XLA body,
+which is why `ec_encode_rs10_4_mesh` trailed the single-chip Pallas
+number even before any sharding overhead.
 
 Everything is `shard_map` over a `jax.sharding.Mesh`, so it runs identically
 on a real multi-chip slice and on the virtual CPU mesh used in tests.
@@ -47,6 +63,79 @@ def make_mesh(n_devices: int | None = None,
     return Mesh(arr, axis_names)
 
 
+def resolve_kernel(kernel: str = "auto") -> str:
+    """Per-device compute body: the fused Pallas kernel only on real TPU
+    chips (under the CPU interpreter it would benchmark the emulator);
+    the XLA bit-sliced path — byte-identical by construction — elsewhere."""
+    if kernel == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return kernel
+
+
+class _ApplyKernel:
+    """The per-device GF(2^8) matrix-apply seam of the mesh encoders.
+
+    `lift(C)` pre-lifts a GF matrix to the bit-matrix layout its body
+    expects (bit-major for XLA, plane-major + sublane-padded for Pallas);
+    `body(bm, x2)` / `batch_body(bm, x3)` apply it to a local [k, n] /
+    [U, k, n] block inside shard_map.  Both bodies are un-jitted — they
+    inline into the enclosing jit(shard_map) — and both tolerate
+    non-tile-aligned column counts (the Pallas body pads internally)."""
+
+    def __init__(self, kernel: str = "auto", tile: int | None = None):
+        self.kind = resolve_kernel(kernel)
+        if self.kind == "pallas":
+            from seaweedfs_tpu.ops import pallas_gf
+            self._pg = pallas_gf
+            self.tile = pallas_gf.resolved_tile(tile)
+        else:
+            self._pg = None
+            self.tile = 0
+
+    def lift(self, C: np.ndarray) -> jax.Array:
+        if self._pg is not None:
+            kpad = self._kpad(C.shape[1])
+            return jnp.asarray(
+                self._pg.gf_matrix_to_bitmatrix_planemajor(C, kpad),
+                dtype=jnp.int8)
+        return jnp.asarray(gf.gf_matrix_to_bitmatrix(C), dtype=jnp.int8)
+
+    def _kpad(self, k: int) -> int:
+        pp = self._pg.PLANE_PAD
+        return max(pp, -(-k // pp) * pp)
+
+    def body(self, bm: jax.Array, x: jax.Array) -> jax.Array:
+        if self._pg is None:
+            return gfmat_jax.bitsliced_apply_body(bm, x)
+        k, n = x.shape
+        m = bm.shape[0] // 8
+        pad = (-n) % self.tile
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad)))
+        out = self._pg._gf_apply(bm, x, k, m, self._kpad(k), self.tile,
+                                 False)
+        return out[:, :n] if pad else out
+
+    def batch_body(self, bm: jax.Array, x: jax.Array) -> jax.Array:
+        if self._pg is None:
+            return gfmat_jax.bitsliced_apply_batch_body(bm, x)
+        U, k, n = x.shape
+        m = bm.shape[0] // 8
+        pad = (-n) % self.tile
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+        out = self._pg._gf_apply_batch(bm, x, k, m, self._kpad(k),
+                                       self.tile, False)
+        return out[:, :, :n] if pad else out
+
+
+def _donate_argnums() -> tuple[int, ...]:
+    """Donate the data operand on real chips (XLA aliases the buffer, the
+    copy disappears); CPU backends don't implement donation and would
+    just log a warning per call."""
+    return (1,) if jax.default_backend() == "tpu" else ()
+
+
 class ShardedRSEncoder:
     """RS(k, m) encode/rebuild over a device mesh.
 
@@ -57,16 +146,17 @@ class ShardedRSEncoder:
     """
 
     def __init__(self, code, mesh: Mesh, col_axis: str = "data",
-                 vol_axis: str | None = None):
+                 vol_axis: str | None = None, kernel: str = "auto",
+                 tile: int | None = None):
         self.code = code
         self.k, self.m, self.n_shards = code.k, code.m, code.n
         self.mesh = mesh
         self.col_axis = col_axis
         self.vol_axis = vol_axis
-        self.parity_bits = jnp.asarray(
-            gf.gf_matrix_to_bitmatrix(code.parity_matrix), dtype=jnp.int8)
+        self.kernel = _ApplyKernel(kernel, tile)
+        self.parity_bits = self.kernel.lift(code.parity_matrix)
 
-        apply_body = gfmat_jax.bitsliced_apply_body
+        apply_body = self.kernel.body
 
         self._encode = jax.jit(shard_map(
             lambda bm, x: jnp.concatenate([x, apply_body(bm, x)], axis=0),
@@ -86,10 +176,20 @@ class ShardedRSEncoder:
             S = -(-self.n_shards // D) * D
             self._placement_groups = S
             pad_rows = S - self.n_shards
+            batch_body = self.kernel.batch_body
 
             def _enc_place(bm, vols):  # vols: [Vl, k, nl]
-                par = jax.vmap(lambda v: apply_body(bm, v))(vols)
+                # ONE batched kernel launch for all local volumes (the
+                # fused Pallas grid on TPU) — half the r05 batch4
+                # regression was a vmap of the slower XLA body here
+                par = batch_body(bm, vols)
                 shards = jnp.concatenate([vols, par], axis=1)  # [Vl, k+m, nl]
+                if D == 1:
+                    # degenerate placement (1-way vol axis): every shard
+                    # group already lives here, and the row pad +
+                    # all_to_all below would be pure whole-batch HBM
+                    # copies — the other half of the r05 regression
+                    return shards
                 if pad_rows:
                     shards = jnp.pad(shards, ((0, 0), (0, pad_rows), (0, 0)))
                 # all_to_all over the volume axis: split shard rows into D
@@ -98,10 +198,14 @@ class ShardedRSEncoder:
                 return jax.lax.all_to_all(
                     shards, vol_axis, split_axis=1, concat_axis=0, tiled=True)
 
+            # donated volume batch: the concat+all_to_all reuses the input
+            # buffer instead of holding both alive (fleet batches are
+            # ~160MB per depth step on the production config)
             self._encode_place = jax.jit(shard_map(
                 _enc_place,
                 mesh=mesh, in_specs=(P(), P(vol_axis, None, col_axis)),
-                out_specs=P(None, vol_axis, col_axis)))
+                out_specs=P(None, vol_axis, col_axis)),
+                donate_argnums=_donate_argnums())
 
     # -- column-parallel single volume ---------------------------------
 
@@ -120,6 +224,14 @@ class ShardedRSEncoder:
         out = self._apply_cols(self.parity_bits, data)
         return out[:, :n] if pad else out
 
+    def place_columns(self, arr) -> jax.Array:
+        """H2D an array with columns already sharded over `col_axis`, so
+        the first encode doesn't pay a gather+reshard: each device pulls
+        only its slice from the host buffer.  This is the in_sharding
+        `encode`/`encode_parity` expect — committed here, never reshard."""
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, P(None, self.col_axis)))
+
     def reconstruct(self, shards: dict[int, jax.Array],
                     wanted: list[int] | None = None) -> dict[int, jax.Array]:
         """Column-parallel rebuild of missing shards from >= k survivors.
@@ -131,7 +243,7 @@ class ShardedRSEncoder:
         if not wanted:
             return {}
         D = self.code.decode_matrix(present, wanted)
-        dbits = jnp.asarray(gf.gf_matrix_to_bitmatrix(D), dtype=jnp.int8)
+        dbits = self.kernel.lift(D)
         stack = jnp.stack([shards[i] for i in present[: self.k]], axis=0)
         n = stack.shape[1]
         ndev = self.mesh.shape[self.col_axis]
@@ -157,6 +269,81 @@ class ShardedRSEncoder:
         as one ICI all_to_all instead of 14 gRPC file copies)."""
         assert self.vol_axis is not None, "construct with vol_axis= for batching"
         return self._encode_place(self.parity_bits, volumes)
+
+
+class FleetUnitEncoder:
+    """Unit-parallel fleet encode: the mesh shape of the multi-volume
+    conversion pipeline (ops/fleet_convert.py).
+
+    A batch of U independent [k, B] column units — interleaved from N
+    volumes — shards over the mesh on the unit axis.  Each chip encodes
+    its U/D units wholly (parity is unit-local): NO collectives, no
+    cross-chip bytes, so 8 chips process 8x the units of 1 at equal unit
+    size.  The jitted shard_map is built once; its in/out shardings are
+    both P(unit_axis), so a device-resident output (or a staging buffer
+    placed by `place`) feeds the next call without any reshard, and on
+    real chips the input batch is DONATED — XLA writes parity into
+    recycled memory instead of growing the footprint per in-flight batch.
+
+    D2H is per-device: `unit_shards(parity)` yields each device's local
+    [U/D, m, B] block the moment it is fetched, so the conversion drain
+    streams shards to their writers as they come off the device rather
+    than after a full gather.
+    """
+
+    def __init__(self, code, mesh: Mesh | None = None,
+                 unit_axis: str = "unit", kernel: str = "auto",
+                 tile: int | None = None):
+        if mesh is None:
+            mesh = make_mesh(axis_names=(unit_axis,))
+        self.code = code
+        self.k, self.m = code.k, code.m
+        self.mesh = mesh
+        self.unit_axis = unit_axis
+        self.n_devices = mesh.shape[unit_axis]
+        self.kernel = _ApplyKernel(kernel, tile)
+        self.parity_bits = self.kernel.lift(code.parity_matrix)
+        self.in_sharding = NamedSharding(mesh, P(unit_axis))
+        batch_body = self.kernel.batch_body
+        self._encode = jax.jit(shard_map(
+            batch_body,
+            mesh=mesh, in_specs=(P(), P(unit_axis)),
+            out_specs=P(unit_axis)),
+            donate_argnums=_donate_argnums())
+
+    def unit_slots(self, min_units: int) -> int:
+        """Round a desired in-flight unit count up to an even per-device
+        split (shard_map needs one)."""
+        D = self.n_devices
+        return max(D, -(-min_units // D) * D)
+
+    def place(self, host_units: np.ndarray) -> jax.Array:
+        """H2D a [U, k, B] host batch with units sharded over the mesh:
+        each device pulls exactly its U/D units from the host buffer, so
+        no later reshard (this IS the encode's in_sharding)."""
+        assert host_units.shape[0] % self.n_devices == 0, \
+            (host_units.shape, self.n_devices)
+        return jax.device_put(host_units, self.in_sharding)
+
+    def encode_parity_batch(self, units: jax.Array) -> jax.Array:
+        """[U, k, B] (device-resident, unit-sharded) -> [U, m, B] parity,
+        unit-sharded with the SAME spec — device-resident outputs chain
+        into whatever consumes them without moving."""
+        return self._encode(self.parity_bits, units)
+
+    def unit_shards(self, parity: jax.Array):
+        """Yield (u_start, u_stop, np.ndarray) per addressable device
+        shard, in unit order: the streaming D2H of the conversion drain.
+        Plain single-device arrays yield one chunk."""
+        shards = getattr(parity, "addressable_shards", None)
+        if not shards:
+            yield 0, int(parity.shape[0]), np.asarray(parity)
+            return
+        for sh in sorted(shards, key=lambda s: s.index[0].start or 0):
+            idx = sh.index[0]
+            start = idx.start or 0
+            data = np.asarray(sh.data)
+            yield int(start), int(start) + data.shape[0], data
 
 
 def shard_columns(mesh: Mesh, arr: jax.Array, axis: str = "data") -> jax.Array:

@@ -107,6 +107,8 @@ class MasterServer:
             web.post("/maintenance/scrub_report",
                      self.handle_scrub_report),
             web.post("/maintenance/tick", self.handle_maintenance_tick),
+            web.route("*", "/maintenance/convert",
+                      self.handle_maintenance_convert),
             web.post("/raft/peers/add", self.handle_raft_peer_add),
             web.post("/raft/peers/remove", self.handle_raft_peer_remove),
             web.get("/raft/status", self.handle_raft_status),
@@ -147,6 +149,12 @@ class MasterServer:
         from seaweedfs_tpu.maintenance.repair import RepairPlanner
         self.maintenance = RepairPlanner(self)
         self._repair_task: asyncio.Task | None = None
+        # fleet EC conversion scheduler (maintenance/convert.py): paced
+        # background multi-volume encode, ticked in the same background
+        # loop right after the repair planner (repair outranks it)
+        from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+        self.convert = ConvertScheduler(self)
+        self._convert_task: asyncio.Task | None = None
         # observability plane: fleet /metrics federation + the SLO
         # burn-rate engine (stats/aggregate.py).  Pulls every known
         # node's exposition over PooledHTTP; this master's own registry
@@ -211,6 +219,8 @@ class MasterServer:
             self._expire_task.cancel()
         if self._repair_task:
             self._repair_task.cancel()
+        if self._convert_task:
+            self._convert_task.cancel()
         # wake /cluster/stream subscribers so their handlers return and
         # runner.cleanup() doesn't wait out its shutdown timeout on them
         for q in list(self._vid_subscribers):
@@ -359,6 +369,21 @@ class MasterServer:
                 await self.maintenance.tick()
             except Exception:
                 log.warning("repair tick failed", exc_info=True)
+            # conversion rides the same cadence but runs as its OWN task
+            # (never overlapping itself): a node batch can hold its HTTP
+            # call open for minutes, and awaiting it inline would starve
+            # the repair tick above — inverting the repair-outranks-
+            # conversion priority exactly when loss recovery is urgent
+            t = self._convert_task
+            if t is None or t.done():
+                self._convert_task = asyncio.create_task(
+                    self._convert_tick_once())
+
+    async def _convert_tick_once(self) -> None:
+        try:
+            await self.convert.tick()
+        except Exception:
+            log.warning("convert tick failed", exc_info=True)
 
     def _on_scrape(self, ts: float, per_node: dict) -> None:
         """Aggregator scrape observer: record the tick into history, then
@@ -722,7 +747,8 @@ class MasterServer:
         snap = {"volumes": {str(vid): info
                             for vid, info in sorted(led.items())},
                 "states": counts,
-                "planner": self.maintenance.status()}
+                "planner": self.maintenance.status(),
+                "convert": self.convert.status()}
         # resilience plane: per-peer breaker states feed the health
         # ledger (a tripped breaker is a node the data path has already
         # given up on — often minutes before the heartbeat horizon says
@@ -800,6 +826,28 @@ class MasterServer:
         if body.get("wait"):
             await self.maintenance.wait_idle()
         return web.json_response({"actions": actions})
+
+    async def handle_maintenance_convert(self, req: web.Request
+                                         ) -> web.Response:
+        """Fleet-conversion scheduler surface: GET returns scheduler
+        state; POST {"volumes": [vids]} queues volumes, {"tick": true}
+        forces one deterministic paced tick (tests and the chaos driver
+        use it instead of sleeping on the background loop)."""
+        if req.method == "GET":
+            return web.json_response(self.convert.status())
+        if not self.is_leader:
+            return self._not_leader_response()
+        try:
+            body = await req.json()
+        except ValueError:
+            body = {}
+        accepted = self.convert.enqueue(body.get("volumes") or [])
+        actions = []
+        if body.get("tick"):
+            actions = await self.convert.tick()
+        return web.json_response({"accepted": accepted,
+                                  "actions": actions,
+                                  "status": self.convert.status()})
 
     async def handle_vacuum_toggle(self, req: web.Request) -> web.Response:
         """Pause/resume the automatic vacuum scan (reference: shell

@@ -152,6 +152,8 @@ class VolumeServer:
                      self.handle_tier_download),
             web.get("/admin/volume/needles", self.handle_volume_needles),
             web.post("/admin/ec/generate", self.handle_ec_generate),
+            web.post("/admin/ec/fleet_convert",
+                     self.handle_ec_fleet_convert),
             web.get("/admin/ec/progress", self.handle_ec_progress),
             web.post("/admin/ec/cancel", self.handle_ec_cancel),
             web.post("/admin/ec/rebuild", self.handle_ec_rebuild),
@@ -1129,6 +1131,110 @@ class VolumeServer:
         job["state"] = "done"
         job["bytes_done"] = job["total"]
         return web.json_response({"shards": list(range(layout.TOTAL_SHARDS))})
+
+    async def handle_ec_fleet_convert(self, req: web.Request
+                                      ) -> web.Response:
+        """Batched multi-volume EC conversion (ops/fleet_convert): the
+        listed local volumes' units interleave into ONE device-resident
+        encode stream instead of N serial /admin/ec/generate rounds.
+        Driven by the master's conversion scheduler (maintenance/convert)
+        as paced background work; every network hop made on its behalf
+        books netflow class=convert.  Participating volumes are frozen
+        read-only for the conversion (shell ec.encode's readonly step —
+        a write landing after the .dat snapshot would be missing from
+        the EC set); failure or cancel thaws them, success keeps the
+        freeze.  Each volume registers under the shared per-vid job
+        table, so /admin/ec/progress observes it and /admin/ec/cancel on
+        ANY participating vid aborts the whole run (uncommitted volumes
+        roll back to their previous state)."""
+        body = await req.json()
+        vids: list[int] = []
+        for v_ in (body.get("volumes") or [])[:64]:  # bounded fan-in
+            try:
+                vid = int(v_)
+            except (TypeError, ValueError):
+                continue
+            if vid not in vids:
+                vids.append(vid)
+        vols, skipped = [], {}
+        for vid in vids:
+            v = self.store.get_volume(vid)
+            if v is None:
+                skipped[str(vid)] = "not found"
+            elif self._ec_jobs.get(vid, {}).get("state") == "running":
+                skipped[str(vid)] = "ec job already running"
+            else:
+                vols.append((vid, v))
+        if not vols:
+            return web.json_response(
+                {"error": "no convertible volumes here",
+                 "skipped": skipped}, status=404)
+        # freeze writes for the duration (the same contract as shell
+        # ec.encode's readonly step): a needle appended after the .dat
+        # snapshot would be silently absent from the committed EC set.
+        # A failed/cancelled conversion thaws; success keeps the freeze —
+        # the shard set is now the durable copy of record.
+        was_writable = [(v, v.read_only) for _, v in vols]
+        for v, _ in was_writable:
+            v.read_only = True
+        total = sum(os.path.getsize(v._base + ".dat") for _, v in vols)
+        stages: dict = {}
+        shared = {"state": "running", "kind": "fleet_convert",
+                  "bytes_done": 0, "total": total, "cancel": False,
+                  "error": None, "started": time.time(),
+                  "volumes": [vid for vid, _ in vols], "stages": stages}
+        for vid, _ in vols:
+            self._ec_jobs[vid] = shared
+
+        def run():
+            for _, v in vols:
+                v.flush()  # buffered .dat AND .idx — the mmap'd snapshot
+                #            must hold every committed needle
+            from seaweedfs_tpu.ops import fleet_convert as _fleet
+            rep = _fleet.convert_volumes(
+                [v._base for _, v in vols],
+                progress=lambda n: shared.__setitem__("bytes_done", n),
+                cancel=lambda: shared["cancel"],
+                stats=stages)
+            for _, v in vols:
+                ec_files.write_sorted_ecx(v._base + ".idx")
+            metrics.EC_ENCODE_BYTES.labels("fleet").inc(total)
+            return rep
+
+        def settle_failed():
+            """Volumes whose shard set committed before the run died stay
+            frozen (the EC set is their copy of record) and get the .ecx
+            the success path would have written; only uncommitted ones —
+            whose .tmp shards were rolled back — thaw."""
+            committed = set(stages.get("committed_bases") or [])
+            for v, ro in was_writable:
+                if v._base in committed:
+                    try:
+                        ec_files.write_sorted_ecx(v._base + ".idx")
+                    except OSError:
+                        log.warning("post-abort .ecx write failed for %s",
+                                    v._base, exc_info=True)
+                else:
+                    v.read_only = ro
+
+        try:
+            report = await asyncio.to_thread(run)
+        except ec_files.EncodeCancelled:
+            shared["state"] = "cancelled"
+            settle_failed()
+            return web.json_response({"error": "cancelled"}, status=409)
+        except Exception as e:
+            shared["state"] = "failed"
+            shared["error"] = str(e)
+            settle_failed()
+            raise
+        shared["state"] = "done"
+        shared["bytes_done"] = total
+        await self._heartbeat_once()  # the new shard sets reach the topo
+        return web.json_response(
+            {"converted": [vid for vid, _ in vols], "skipped": skipped,
+             "bytes": report["bytes"], "units": report["units"],
+             "wall_s": report["wall_s"]})
 
     async def handle_ec_progress(self, req: web.Request) -> web.Response:
         """Observability for a long-running encode (weak spot the reference

@@ -22,10 +22,13 @@ WHO moved WHICH bytes.  This module is that ledger:
   did volume servers exchange with each other for repair this window".
 
 Classes: ``data`` (foreground client payload), ``replication`` (replica
-fan-out), ``repair`` (rebuild/survivor movement), ``scrub`` (syndrome
-verification reads), ``readahead`` (speculative prefetch), ``internal``
-(metrics/heartbeat/control).  Unlabeled traffic classifies by path:
-cluster-internal surfaces are ``internal``, everything else ``data``.
+fan-out), ``repair`` (rebuild/survivor movement), ``convert``
+(fleet EC conversion — repair-adjacent background encode traffic, kept
+distinct so interference alerts can tell planned conversion from loss
+recovery), ``scrub`` (syndrome verification reads), ``readahead``
+(speculative prefetch), ``internal`` (metrics/heartbeat/control).
+Unlabeled traffic classifies by path: cluster-internal surfaces are
+``internal``, everything else ``data``.
 
 ``WEEDTPU_NETFLOW=0`` disables the accounting (read per call so the
 bench can flip it between interleaved reps).
@@ -39,7 +42,7 @@ from contextvars import ContextVar
 CLASS_HEADER = "X-Weedtpu-Class"
 ROLE_HEADER = "X-Weedtpu-Role"
 
-CLASSES = frozenset({"data", "replication", "repair", "scrub",
+CLASSES = frozenset({"data", "replication", "repair", "convert", "scrub",
                      "readahead", "internal"})
 
 # cluster-internal surfaces (monitoring pulls, heartbeats, raft, debug,

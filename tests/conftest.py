@@ -57,6 +57,35 @@ def _reset_resilience_state():
     faults.clear_net()
 
 
+@pytest.fixture(scope="session")
+def device_mesh_devices():
+    """The ONE backend-selection seam for every sharding test: under
+    tier-1 (JAX_PLATFORMS=cpu — forced above) this is the virtual
+    8-device CPU mesh; on a machine with real accelerators attached and
+    the force lifted, the real devices.  It ASSERTS instead of skipping:
+    a CPU run that silently skipped the sharding suite is exactly how a
+    mesh regression would ship."""
+    devs = jax.devices()
+    assert len(devs) >= 8, (
+        f"sharding suite needs >= 8 devices, got {len(devs)} — the "
+        f"conftest XLA_FLAGS force failed; do NOT skip mesh tests")
+    return devs
+
+
+@pytest.fixture(scope="session")
+def unit_mesh(device_mesh_devices):
+    """8-way 1D mesh on the unit axis (FleetUnitEncoder shape)."""
+    from seaweedfs_tpu.parallel import mesh as pmesh
+    return pmesh.make_mesh(8, ("unit",))
+
+
+@pytest.fixture(scope="session")
+def column_mesh(device_mesh_devices):
+    """8-way 1D mesh on the byte-column axis (ShardedRSEncoder shape)."""
+    from seaweedfs_tpu.parallel import mesh as pmesh
+    return pmesh.make_mesh(8, ("data",))
+
+
 def reference_fixture(relpath: str) -> pathlib.Path | None:
     """Path to a binary test fixture inside the read-only reference checkout,
     or None when the reference isn't mounted (tests then skip the golden

@@ -1,0 +1,391 @@
+"""Fleet conversion: the interleaved multi-volume device-resident encode
+stream (ops/fleet_convert), its clean-abort contract, and the master-side
+paced scheduler (maintenance/convert)."""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.maintenance import faults
+from seaweedfs_tpu.models import rs
+from seaweedfs_tpu.ops import fleet_convert
+from seaweedfs_tpu.stats import netflow
+from seaweedfs_tpu.storage.ec import ec_files, layout
+
+
+def _make_volumes(tmp_path, sizes, seed=7):
+    rng = np.random.default_rng(seed)
+    bases, payloads = [], []
+    for i, sz in enumerate(sizes):
+        base = str(tmp_path / f"{i + 1}")
+        data = rng.integers(0, 256, sz, dtype=np.uint8).tobytes()
+        with open(base + ".dat", "wb") as f:
+            f.write(data)
+        bases.append(base)
+        payloads.append(data)
+    return bases, payloads
+
+
+def _shard_bytes(base):
+    out = {}
+    for i in range(layout.TOTAL_SHARDS):
+        p = base + layout.to_ext(i)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                out[i] = f.read()
+    return out
+
+
+def test_convert_volumes_byte_identity(tmp_path, unit_mesh):
+    """Interleaved fleet conversion over the unit-sharded CPU mesh is
+    byte-identical to an independent numpy-codec write_ec_files run for
+    every volume — ragged tails included — and commits .vif sidecars."""
+    sizes = [200_000, 137_777, 95_001]
+    bases, payloads = _make_volumes(tmp_path, sizes)
+    from seaweedfs_tpu.parallel import mesh as pmesh
+    codec = pmesh.FleetUnitEncoder(rs.get_code(10, 4), unit_mesh)
+    stats: dict = {}
+    rep = fleet_convert.convert_volumes(
+        bases, large_block=10_000, small_block=100, batch_size=1000,
+        codec=codec, stats=stats)
+    assert rep["bytes"] == sum(sizes)
+    assert stats["mode"] == "fleet" and stats["unit_batch"] % 8 == 0
+    for base, data in zip(bases, payloads):
+        ref = str(tmp_path / ("ref_" + os.path.basename(base)))
+        with open(ref + ".dat", "wb") as f:
+            f.write(data)
+        os.environ["WEEDTPU_EC_CODEC"] = "numpy"
+        try:
+            ec_files.write_ec_files(ref, large_block=10_000,
+                                    small_block=100)
+        finally:
+            del os.environ["WEEDTPU_EC_CODEC"]
+        got, want = _shard_bytes(base), _shard_bytes(ref)
+        assert sorted(got) == list(range(layout.TOTAL_SHARDS))
+        for i in range(layout.TOTAL_SHARDS):
+            assert got[i] == want[i], (base, i)
+        assert ec_files.read_vif(base)["dat_file_size"] == len(data)
+
+
+def test_convert_books_class_convert(tmp_path):
+    """The whole conversion runs under netflow class=convert, so any
+    network hop made on its behalf books repair-adjacent bytes."""
+    bases, _ = _make_volumes(tmp_path, [50_000])
+    seen = []
+    fleet_convert.convert_volumes(
+        bases, large_block=10_000, small_block=100, batch_size=1000,
+        progress=lambda n: seen.append(netflow.current_class()))
+    assert seen and set(seen) == {"convert"}
+
+
+def test_convert_cancel_clean_abort(tmp_path):
+    """Cancel mid-stream: EncodeCancelled, NO partial .ecXX visible, no
+    .tmp litter, and a previous valid shard set survives untouched."""
+    bases, _ = _make_volumes(tmp_path, [300_000, 280_000], seed=9)
+    # volume 0 already has a valid shard set from an earlier encode
+    os.environ["WEEDTPU_EC_CODEC"] = "numpy"
+    try:
+        ec_files.write_ec_files(bases[0], large_block=10_000,
+                                small_block=100)
+    finally:
+        del os.environ["WEEDTPU_EC_CODEC"]
+    before = _shard_bytes(bases[0])
+    calls = []
+
+    def cancel():
+        calls.append(1)
+        return len(calls) > 2  # abort a couple of units in
+
+    with pytest.raises(ec_files.EncodeCancelled):
+        fleet_convert.convert_volumes(
+            bases, large_block=10_000, small_block=100, batch_size=1000,
+            cancel=cancel)
+    # the old set is byte-identical, the fresh volume has nothing visible
+    assert _shard_bytes(bases[0]) == before
+    assert _shard_bytes(bases[1]) == {}
+    for base in bases:
+        assert not [p for p in os.listdir(tmp_path)
+                    if p.endswith(".tmp")], os.listdir(tmp_path)
+
+
+def test_convert_shard_write_fault_aborts(tmp_path):
+    """An armed shard_write_error fault (the chaos disk-death shape)
+    fails the conversion before any tmp shard exists."""
+    bases, _ = _make_volumes(tmp_path, [40_000])
+    faults.set_shard_write_error("EIO")
+    try:
+        with pytest.raises(OSError):
+            fleet_convert.convert_volumes(
+                bases, large_block=10_000, small_block=100,
+                batch_size=1000)
+    finally:
+        faults.clear_net()
+    assert _shard_bytes(bases[0]) == {}
+    assert not [p for p in os.listdir(tmp_path) if ".ec" in p]
+
+
+# -- master-side scheduler ------------------------------------------------
+
+class _StubNode:
+    def __init__(self, vids):
+        self.volumes = {v: object() for v in vids}
+
+
+class _StubTopo:
+    def __init__(self, placement):
+        import threading
+        self._lock = threading.Lock()
+        self.nodes = {url: _StubNode(vids)
+                      for url, vids in placement.items()}
+
+
+class _StubResp:
+    def __init__(self, status=200, payload=None):
+        self.status = status
+        self._payload = payload or {}
+
+    async def json(self):
+        return self._payload
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class _StubSession:
+    """Records fleet_convert POSTs; `fail` raises like a dead node."""
+
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def post(self, url, json=None, timeout=None):
+        self.calls.append((url, json))
+        if self.fail:
+            raise OSError("connection refused")
+        return _StubResp(payload={"converted": json["volumes"],
+                                  "bytes": 1, "wall_s": 0.1})
+
+
+class _StubAlerts:
+    def __init__(self, firing=()):
+        self._firing = firing
+
+    def status(self):
+        return {"rules": [{"name": n, "state": "firing"}
+                          for n in self._firing]}
+
+
+class _StubMaintenance:
+    def __init__(self, active_nodes=None):
+        self._active_nodes = dict(active_nodes or {})
+
+
+class _StubMaster:
+    def __init__(self, placement, firing=(), active_nodes=None,
+                 fail=False):
+        self.topo = _StubTopo(placement)
+        self.alerts = _StubAlerts(firing)
+        self.maintenance = _StubMaintenance(active_nodes)
+        self._session = _StubSession(fail=fail)
+
+
+def _tick(sched):
+    return asyncio.run(sched.tick())
+
+
+def test_scheduler_groups_paces_and_converts():
+    from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+    master = _StubMaster({"n1:80": [1, 2, 3], "n2:80": [7]})
+    sched = ConvertScheduler(master, rate=100.0, burst=100.0,
+                             node_batch=2)
+    assert sched.enqueue([1, 2, 3, 7, 7, "x"]) == [1, 2, 3, 7]
+    actions = _tick(sched)
+    # node_batch caps n1 at 2 volumes per call; 3 stays queued
+    by_node = {a["node"]: a for a in actions}
+    assert sorted(by_node) == ["n1:80", "n2:80"]
+    assert by_node["n1:80"]["volumes"] == [1, 2]
+    assert by_node["n1:80"]["outcome"] == "ok"
+    assert sched.queued == [3] and sched.converted == 3
+    assert _tick(sched)[0]["volumes"] == [3]
+    assert not sched.queued and not sched.active
+
+
+def test_scheduler_requeues_on_node_failure():
+    from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+    master = _StubMaster({"n1:80": [5, 6]}, fail=True)
+    sched = ConvertScheduler(master, rate=100.0, burst=100.0)
+    sched.enqueue([5, 6])
+    actions = _tick(sched)
+    assert actions and actions[0]["outcome"].startswith("error")
+    # RE-QUEUED with backoff, never dropped
+    assert sorted(sched.queued) == [5, 6]
+    st = sched.status()
+    assert st["backoffs"]["5"]["failures"] == 1
+    # while backing off, nothing launches
+    assert _tick(sched) == []
+    # node recovers, backoff expires -> converted on the next tick
+    master._session.fail = False
+    sched._backoff = {v: (f, 0.0) for v, (f, _) in sched._backoff.items()}
+    actions = _tick(sched)
+    assert actions[0]["outcome"] == "ok"
+    assert sched.converted == 2 and not sched.queued
+
+
+def test_scheduler_pauses_on_interference_alert():
+    from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+    master = _StubMaster({"n1:80": [4]},
+                         firing=("repair_interference_p99",))
+    sched = ConvertScheduler(master, rate=100.0, burst=100.0)
+    sched.enqueue([4])
+    assert _tick(sched) == []
+    assert sched.status()["paused"] == "repair_interference_p99"
+    assert sched.queued == [4]  # still queued, resumes when it clears
+    master.alerts._firing = ()
+    assert _tick(sched)[0]["outcome"] == "ok"
+    assert sched.status()["paused"] is None
+
+
+def test_scheduler_yields_to_active_repair_and_drops_unplaceable():
+    from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+    master = _StubMaster({"n1:80": [8]}, active_nodes={"n1:80": 1})
+    sched = ConvertScheduler(master, rate=100.0, burst=100.0)
+    sched.enqueue([8, 99])  # 99 lives nowhere (already EC / deleted)
+    assert _tick(sched) == []
+    assert sched.queued == [8]  # deferred behind the repair, not lost
+    assert any(h.get("outcome") == "unplaceable" and h["vid"] == 99
+               for h in sched.history)
+    master.maintenance._active_nodes = {}
+    assert _tick(sched)[0]["outcome"] == "ok"
+
+
+def test_cluster_fleet_convert_end_to_end(tmp_path):
+    """Full plane: blobs land in real volumes, the master scheduler
+    paces a /admin/ec/fleet_convert batch to the owning node, shard sets
+    commit (all 14 + .ecx + .vif, never a partial subset), convert bytes
+    book on the netflow ledger, and readback stays byte-identical."""
+    from tests.test_cluster import Cluster
+    from seaweedfs_tpu.client import WeedClient
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.master.url)
+        rng = np.random.default_rng(0xFEE7)
+        blobs = {}
+        for i in range(12):
+            data = rng.integers(0, 256, int(rng.integers(5_000, 40_000)),
+                                dtype=np.uint8).tobytes()
+            blobs[client.upload(data, name=f"f{i}.bin")] = data
+        vs = c.volume_servers[0]
+        vids = sorted({vid for loc in vs.store.locations
+                       for vid in loc.volumes})
+        assert vids
+        for v in vids:
+            vs.store.get_volume(v).nm.flush()
+        recv0 = netflow.class_total("recv", "convert")
+        res = c.submit(asyncio.wait_for(_enqueue_and_tick(
+            c.master, vids), 60))
+        assert res["accepted"] == vids
+        assert all(a["outcome"] == "ok" for a in res["actions"]), res
+        st = c.master.convert.status()
+        assert st["converted"] == len(vids) and not st["queued"]
+        for v in vids:
+            base = vs.store.get_volume(v)._base
+            got = _shard_bytes(base)
+            assert sorted(got) == list(range(layout.TOTAL_SHARDS)), v
+            assert os.path.exists(base + ".ecx")
+            assert ec_files.read_vif(base) is not None
+        # the orchestration hop booked as class=convert on the ledger
+        assert netflow.class_total("recv", "convert") > recv0
+        for fid, data in blobs.items():
+            assert client.download(fid) == data
+    finally:
+        c.stop()
+
+
+async def _enqueue_and_tick(master, vids):
+    accepted = master.convert.enqueue(vids)
+    actions = await master.convert.tick()
+    return {"accepted": accepted, "actions": actions}
+
+
+def test_fleet_convert_partial_failure_settles_freeze(tmp_path,
+                                                      monkeypatch):
+    """A run that dies after SOME volumes committed keeps those frozen
+    read-only with their .ecx (the EC set is their copy of record) and
+    thaws only the rolled-back ones — a thawed-but-committed volume
+    would take writes the shard set silently lacks."""
+    import urllib.request
+    from tests.test_cluster import Cluster
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.ops import fleet_convert as fc
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.master.url)
+        rng = np.random.default_rng(11)
+        for i in range(10):
+            client.upload(rng.integers(0, 256, 20_000,
+                                       dtype=np.uint8).tobytes(),
+                          name=f"x{i}.bin")
+        vs = c.volume_servers[0]
+        # a second volume via an assign in another collection, so the
+        # batch spans a committed volume AND a rolled-back one
+        with urllib.request.urlopen(
+                f"http://{c.master.url}/dir/assign?collection=cx",
+                timeout=10) as r:
+            a = json.load(r)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{a['url']}/{a['fid']}",
+            data=rng.integers(0, 256, 30_000, dtype=np.uint8).tobytes(),
+            method="PUT"), timeout=10).read()
+        vids = sorted({vid for loc in vs.store.locations
+                       for vid in loc.volumes})
+        assert len(vids) >= 2
+        for v in vids:
+            vs.store.get_volume(v).nm.flush()
+
+        real = fc.convert_volumes
+
+        def first_commits_then_dies(bases, **kw):
+            real(bases[:1], **kw)
+            raise RuntimeError("disk died after the first commit")
+
+        monkeypatch.setattr(fc, "convert_volumes",
+                            first_commits_then_dies)
+        req = urllib.request.Request(
+            f"http://{vs.url}/admin/ec/fleet_convert",
+            data=json.dumps({"volumes": vids}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 500
+        committed, rest = vids[0], vids[1:]
+        v0 = vs.store.get_volume(committed)
+        assert v0.read_only  # stays frozen: shards are the copy of record
+        assert sorted(_shard_bytes(v0._base)) == \
+            list(range(layout.TOTAL_SHARDS))
+        assert os.path.exists(v0._base + ".ecx")
+        for vid in rest:
+            v = vs.store.get_volume(vid)
+            assert not v.read_only  # rolled back -> thawed, writable
+            assert _shard_bytes(v._base) == {}
+    finally:
+        c.stop()
+
+
+def test_scheduler_token_bucket_paces():
+    from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+    master = _StubMaster({"n1:80": [1, 2, 3, 4]})
+    sched = ConvertScheduler(master, rate=0.0001, burst=2.0, node_batch=4)
+    sched.enqueue([1, 2, 3, 4])
+    actions = _tick(sched)
+    # burst grants exactly 2; the rest wait for tokens, still queued
+    assert actions[0]["volumes"] == [1, 2]
+    assert sorted(sched.queued) == [3, 4]

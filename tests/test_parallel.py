@@ -10,9 +10,10 @@ from seaweedfs_tpu.parallel import mesh as pmesh
 
 
 @pytest.fixture(scope="module")
-def mesh8():
-    assert len(jax.devices()) >= 8, "conftest should provide 8 cpu devices"
-    return pmesh.make_mesh(8, ("data",))
+def mesh8(column_mesh):
+    # backend selection lives in ONE conftest fixture (asserting, never
+    # skipping) so a JAX_PLATFORMS=cpu run can't silently drop the suite
+    return column_mesh
 
 
 def test_column_sharded_encode_matches_numpy(mesh8):
@@ -55,6 +56,61 @@ def test_batch_encode_with_shard_placement(mesh8):
     # the shard dim is sharded over 'vol': device d holds rows [2d, 2d+2)
     shardings = out.sharding
     assert shardings.spec == jax.sharding.PartitionSpec(None, "vol", "col")
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4)])
+def test_sharded_encode_byte_identity_rs_sweep(column_mesh, unit_mesh,
+                                               k, m):
+    """Mesh output == single-chip codec output == numpy reference, for
+    every production RS geometry, through BOTH mesh shapes (column-
+    sharded and unit-sharded), including a ragged final unit whose
+    column count divides neither the mesh nor the kernel tile."""
+    from seaweedfs_tpu.ops import gfmat_jax
+    code = rs.get_code(k, m)
+    enc = pmesh.ShardedRSEncoder(code, column_mesh)
+    fleet = pmesh.FleetUnitEncoder(code, unit_mesh)
+    single = gfmat_jax.get_codec(k, m)
+    rng = np.random.default_rng(17 * k + m)
+    # 8 * 384 + 5: the trailing 5 columns force the shard_map pad path
+    for n in (8 * 384, 8 * 384 + 5):
+        data = rng.integers(0, 256, (k, n), dtype=np.uint8)
+        want = code.encode_numpy(data)[k:]
+        got_single = np.asarray(single.encode_parity(jnp.asarray(data)))
+        # even column counts pre-shard (the no-reshard fast path); the
+        # ragged tail exercises encode_parity's internal pad-to-mesh
+        dev = pmesh.shard_columns(column_mesh, jnp.asarray(data)) \
+            if n % 8 == 0 else jnp.asarray(data)
+        got_mesh = np.asarray(enc.encode_parity(dev))
+        assert np.array_equal(got_single, want), (k, m, n, "single")
+        assert np.array_equal(got_mesh, want), (k, m, n, "mesh")
+        # unit-sharded fleet shape: U units of this stripe, last ragged
+        U = fleet.unit_slots(8)
+        units = rng.integers(0, 256, (U, k, n), dtype=np.uint8)
+        par = fleet.encode_parity_batch(fleet.place(units))
+        assert par.sharding.spec == jax.sharding.PartitionSpec("unit")
+        got = np.zeros((U, m, n), dtype=np.uint8)
+        for a, b, arr in fleet.unit_shards(par):
+            got[a:b] = arr
+        want_u = np.stack([code.encode_numpy(units[u])[k:]
+                           for u in range(U)])
+        assert np.array_equal(got, want_u), (k, m, n, "fleet")
+
+
+def test_fleet_encoder_matched_shardings_chain(unit_mesh):
+    """Consecutive unit batches keep identical in/out shardings: the
+    output of call N carries the same PartitionSpec the encoder places
+    inputs with, so a device-resident chain never reshards."""
+    code = rs.get_code(10, 4)
+    fleet = pmesh.FleetUnitEncoder(code, unit_mesh)
+    rng = np.random.default_rng(3)
+    spec = jax.sharding.PartitionSpec("unit")
+    for _ in range(3):
+        units = fleet.place(
+            rng.integers(0, 256, (8, 10, 512), dtype=np.uint8))
+        assert units.sharding.spec == spec
+        par = fleet.encode_parity_batch(units)
+        assert par.sharding.spec == spec
+        assert par.sharding == fleet.in_sharding
 
 
 def test_ec_files_mesh_codec_roundtrip(tmp_path, monkeypatch):
