@@ -335,6 +335,16 @@ def _bench_tile_sweep(extra: dict, n: int, on_tpu: bool,
     if best_t is not None:
         os.environ["WEEDTPU_EC_TILE"] = str(best_t)
         extra["ec_encode_tile"] = best_t
+        # persist winner + sweep table + chip fingerprint: resolved_tile
+        # honours a matching pin on later plain runs, and the tile-drift
+        # sentinel (stats/pipeline.py) re-validates it in the background
+        try:
+            pin_path = pallas_gf.save_tile_pin(best_t, best_v, sweep)
+            extra["ec_encode_tile_pin"] = pin_path
+            from seaweedfs_tpu.stats import profile as _profile
+            _profile.set_ceiling("device", best_v)
+        except Exception as e:
+            print(f"bench: tile pin persist failed: {e}", file=sys.stderr)
     extra["ec_encode_tile_config"] = {"chosen": best_t, "sweep": sweep}
 
 
@@ -551,6 +561,13 @@ def _bench_fleet_convert(extra: dict, kind: str | None = None,
                   for k_, v in best_stats.items()
                   if isinstance(v, (int, float, str))}
         extra["fleet_convert_detail"] = detail
+        # flat numeric stage keys land in bench_history.jsonl (the
+        # nested detail dict does not): the per-stage breakdown becomes
+        # a round-over-round series, not a bench-day printout
+        for k_, v in best_stats.items():
+            if k_.endswith("_s") and k_ != "wall_s" and \
+                    isinstance(v, (int, float)):
+                extra[f"fleet_convert_stage_{k_[:-2]}"] = round(v, 4)
 
 
 def _native_kernel_gbps(k: int, m: int, impl: int | None = None) -> float:
@@ -611,6 +628,41 @@ def _try(extra: dict, key: str, fn, *args, **kw) -> None:
         print(f"bench: {key} failed: {e}", file=sys.stderr)
 
 
+def _bench_config(backend: str) -> dict:
+    """This round's measurement config: backend + resolved Pallas tile +
+    chip fingerprint.  Stamped into every bench_history.jsonl entry so
+    the trajectory gate compares like-for-like — a CPU-fallback round
+    (or a different chip generation under the same backend string) must
+    not masquerade as a regression against TPU numbers."""
+    cfg: dict = {"backend": backend}
+    tile = os.environ.get("WEEDTPU_EC_TILE")
+    if tile:
+        try:
+            cfg["tile"] = int(tile)
+        except ValueError:
+            pass
+    if "jax" in sys.modules:  # the cpu-native path never imports jax
+        try:
+            from seaweedfs_tpu.ops import pallas_gf
+            cfg["fingerprint"] = pallas_gf.chip_fingerprint()
+        except Exception:
+            pass
+    return cfg
+
+
+def _record_roofline(extra: dict) -> None:
+    """Flatten the run's per-kernel roofline fractions (achieved GB/s /
+    measured resource ceiling, stats/profile.py) into numeric extra
+    keys, so they land in bench_history.jsonl next to the headline
+    metrics and 'encode went D2H-bound' is visible round over round."""
+    from seaweedfs_tpu.stats import profile as _profile
+    snap = _profile.roofline_snapshot()
+    for row in snap["rows"]:
+        frac = row.get("ceiling_frac")
+        if frac is not None:
+            extra[f"roofline_{row['resource']}_{row['kernel']}"] = frac
+
+
 def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
     """Bench trajectory tracking: append this run's headline metrics to
     bench_history.jsonl (bootstrapping the file from the committed
@@ -660,8 +712,19 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
     for k, v in extra.items():
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             mets_now[k] = v
-    comparable = [e for e in entries if not e.get("imported")
-                  and e.get("backend") == backend]
+    cfg = _bench_config(backend)
+    fp_now = cfg.get("fingerprint")
+
+    def like_for_like(e: dict) -> bool:
+        """Same backend AND same chip fingerprint where both recorded
+        one — rounds predating config stamps stay comparable by backend
+        alone (excluding them would drop every existing prior)."""
+        if e.get("imported") or e.get("backend") != backend:
+            return False
+        fp = (e.get("config") or {}).get("fingerprint")
+        return fp is None or fp_now is None or fp == fp_now
+
+    comparable = [e for e in entries if like_for_like(e)]
     comparable = comparable[-TRAJECTORY_LOOKBACK:]
     regressions: dict = {}
     for m in TRAJECTORY_GATED:
@@ -697,7 +760,7 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
                   f"Failing the bench run.", file=sys.stderr)
     entry = {"n": len(entries) + 1,
              "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-             "backend": backend, "metrics": mets_now}
+             "backend": backend, "config": cfg, "metrics": mets_now}
     if extra.get("bench_regression"):
         entry["regressed"] = sorted(regressions)
     try:
@@ -713,6 +776,10 @@ def _emit(gbps: float, backend: str, baseline: float | None,
           extra: dict) -> None:
     base_kind = "measured-avx2-refshape" if baseline else "klauspost-readme"
     base = baseline or KLAUSPOST_AVX2_GBPS
+    try:
+        _record_roofline(extra)
+    except Exception as e:  # roofline stamping must not eat the run
+        print(f"bench: roofline recording failed: {e}", file=sys.stderr)
     try:
         _record_trajectory(gbps, backend, extra)
     except Exception as e:  # trajectory bookkeeping must not eat the run
@@ -775,8 +842,8 @@ def main() -> None:
                _bench_trace_overhead, _bench_profile_overhead,
                _bench_heal_time, _bench_scrub_overhead,
                _bench_flow_canary_overhead, _bench_heat_overhead,
-               _bench_history_overhead, _bench_serving_knee,
-               _bench_chaos):
+               _bench_history_overhead, _bench_perf_obs_overhead,
+               _bench_serving_knee, _bench_chaos):
         try:
             fn(extra)
         except Exception as e:
@@ -953,6 +1020,7 @@ def _exit_code(extra: dict) -> int:
              "flow_canary_overhead_regression",
              "heat_overhead_regression",
              "history_overhead_regression",
+             "perf_obs_overhead_regression",
              "repair_interference_regression",
              "repair_ratio_regression",
              "chaos_scenario_failed",
@@ -992,6 +1060,10 @@ HEAT_OVERHEAD_TOL = 0.97
 # history store + evaluates alerts + re-forecasts capacity must keep
 # >= 0.97x the recording-off rate (ISSUE 10 acceptance bar)
 HISTORY_OVERHEAD_TOL = 0.97
+# encodes with the performance observatory (pipeline stage accounting +
+# roofline export) on must keep >= 0.97x the observatory-off rate
+# (ISSUE 13 acceptance bar)
+PERF_OBS_OVERHEAD_TOL = 0.97
 # bench trajectory: a gated headline metric dropping more than 10% below
 # the best prior recorded round (same backend) fails the run
 TRAJECTORY_TOL = 0.90
@@ -1060,6 +1132,10 @@ def _bench_e2e_host(extra: dict) -> None:
         # _bench_e2e_ceiling
         extra["ec_encode_e2e_ceiling_frac"] = round(ceil["frac"], 3)
         extra["ec_encode_e2e_paired_1g"] = round(ceil["encode_gbps"], 3)
+        # the measured host I/O ceiling feeds the disk roofline rows
+        # (stats/profile.py): shard_write fractions become queryable
+        from seaweedfs_tpu.stats import profile as _profile
+        _profile.set_ceiling("disk", ceil["ceiling_gbps"])
     except Exception as e:
         print(f"bench: ec_encode_e2e_ceiling_1g failed: {e}",
               file=sys.stderr)
@@ -2875,6 +2951,106 @@ def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:
     return {"ceiling_gbps": size / 1e9 / best_null,
             "encode_gbps": size / 1e9 / best_enc,
             "frac": ratios[len(ratios) // 2]}
+
+
+def _bench_perf_obs_overhead(extra: dict, n_needles: int = 64,
+                             reads: int = 1600, blocks: int = 6) -> None:
+    """Performance-observatory tax on its hottest per-op path: EC needle
+    reads through the batched read engine (every read brackets the
+    ec_read flow account's local_pread stage CM; a degraded fraction
+    adds the reconstruct stage) with WEEDTPU_PERF_OBS=1 vs =0 over the
+    same warm volume.  An encode-based A/B was tried first and
+    rejected: a 96MB shard-write run swings ±15% pair-to-pair on this
+    host (disk-bound), drowning a 3% budget; page-cache reads amortize
+    over thousands of ops like the other overhead gates.  Arms run in
+    counterbalanced ABBA blocks (off-on-on-off, then on-off-off-on) so
+    linear host drift cancels within every block, and each block's
+    ratio sums two arms per side.  perf_obs_enabled() caches the env
+    ~0.5s; each flip expires the cache directly rather than sleeping.  Median block
+    ratio below PERF_OBS_OVERHEAD_TOL (>= 0.97x) fails the run
+    (perf_obs_overhead_regression + nonzero exit)."""
+    from seaweedfs_tpu.stats import pipeline as _pipeline
+    from seaweedfs_tpu.storage import needle as ndl
+    from seaweedfs_tpu.storage.ec import ec_files, ec_volume, layout
+    from seaweedfs_tpu.storage.volume import Volume
+    large, small = 10000, 100
+    old = {k: os.environ.get(k)
+           for k in ("WEEDTPU_PERF_OBS", "WEEDTPU_EC_CODEC")}
+    os.environ["WEEDTPU_EC_CODEC"] = "numpy"
+    ratios: list[float] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-pobs-") as d:
+            vol = Volume(d, "", 3)
+            rng = np.random.default_rng(7)
+            blobs: dict[int, bytes] = {}
+            for i in range(1, n_needles + 1):
+                data = rng.integers(0, 256, int(rng.integers(200, 4000)),
+                                    dtype=np.uint8).tobytes()
+                vol.append_needle(ndl.Needle(cookie=0x9, id=i, data=data))
+                blobs[i] = data
+            vol.close()
+            base = os.path.join(d, "3")
+            ec_files.write_ec_files(base, large_block=large,
+                                    small_block=small,
+                                    batch_size=small * 10)
+            ec_files.write_sorted_ecx(base + ".idx")
+            os.remove(base + layout.to_ext(2))  # a degraded slice too
+            ev = ec_volume.EcVolume(base, large, small)
+            nids = sorted(blobs)
+
+            def rep(obs: str) -> float:
+                if os.environ.get("WEEDTPU_PERF_OBS") != obs:
+                    os.environ["WEEDTPU_PERF_OBS"] = obs
+                    # expire the enabled() cache in place: sleeping out
+                    # its 0.5s TTL costs ~8-10s of wall per bench run
+                    _pipeline._enabled_cache = (0.0, obs != "0")
+                t0 = time.perf_counter()
+                for j in range(reads):
+                    nid = nids[j % len(nids)]
+                    assert ev.read_needle(nid).data == blobs[nid]
+                return time.perf_counter() - t0
+
+            _pipeline.reset()
+            try:
+                rep("1")
+                rep("0")  # warm page cache / recon LRU / code paths
+                for i in range(blocks):
+                    seq = ("0", "1", "1", "0") if i % 2 == 0 \
+                        else ("1", "0", "0", "1")
+                    t = {"0": 0.0, "1": 0.0}
+                    for obs in seq:
+                        t[obs] += rep(obs)
+                    ratios.append(t["0"] / t["1"])
+            finally:
+                ev.close()
+            # the ON arms must have really booked flow occupancy —
+            # otherwise both arms measured the observatory-off path and
+            # the gate passes vacuously over a broken plane
+            flows = [s for s in _pipeline.jobs_snapshot()
+                     if s["kind"] == "ec_read"]
+            if not flows or not flows[0]["stages"].get(
+                    "local_pread", {}).get("busy_s"):
+                raise RuntimeError(
+                    "observatory never engaged during the ON arms — "
+                    "overhead gate is meaningless")
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["perf_obs_overhead_ratio"] = round(ratio, 3)
+    if ratio < PERF_OBS_OVERHEAD_TOL:
+        extra["perf_obs_overhead_regression"] = True
+        print(f"bench: REGRESSION — EC reads with the performance "
+              f"observatory on run at {ratio:.3f}x the observatory-off "
+              f"rate (median of interleaved pairs); the instrumentation "
+              f"exceeds its 3% budget. Failing the bench run.",
+              file=sys.stderr)
 
 
 def _bench_pipeline_ratio(size: int, batch: int, reps: int = 5) -> float:

@@ -108,15 +108,25 @@ def dispatch_parity_batch(codec, units, placed=None):
     with trace.span("codec.dispatch_parity_batch", backend="device",
                     bytes=nbytes):
         t0 = time.perf_counter()
+        # the H2D is booked exactly once: by the mesh place() seam when
+        # one exists (whether the caller pre-placed or we place here),
+        # else by this record — double-booking would inflate the
+        # fleet_encode h2d roofline row 2x
+        booked_by_place = placed is not None
         if placed is None:
             place = getattr(codec, "place", None)
-            placed = place(units) if place is not None \
-                else jnp.asarray(units)
+            if place is not None:
+                placed = place(units)
+                booked_by_place = True
+            else:
+                placed = jnp.asarray(units)
         t1 = time.perf_counter()
         out = codec.encode_parity_batch(placed)
         KERNELS.record("fleet_encode", "device",
                        wall_s=time.perf_counter() - t1,
-                       h2d_s=t1 - t0, h2d_bytes=nbytes, nbytes=nbytes)
+                       h2d_s=0.0 if booked_by_place else t1 - t0,
+                       h2d_bytes=0.0 if booked_by_place else nbytes,
+                       nbytes=nbytes)
         return out
 
 

@@ -47,11 +47,12 @@ import numpy as np
 from seaweedfs_tpu.ops.dispatch import (dispatch_parity_batch,
                                         unit_parity_shards)
 from seaweedfs_tpu.stats import netflow as _netflow
+from seaweedfs_tpu.stats import pipeline as _pipeline
 from seaweedfs_tpu.storage.ec import layout
 from seaweedfs_tpu.storage.ec.ec_files import (
-    DEFAULT_BATCH, EncodeCancelled, _iter_units, _map_readonly,
-    _ShardFlusher, _ShardWriterPool, _Timer, _unit_coverage, _unit_steps,
-    overlap_fraction, write_vif)
+    DEFAULT_BATCH, EncodeCancelled, _book_stage_bytes, _iter_units,
+    _map_readonly, _ShardFlusher, _ShardWriterPool, _Timer,
+    _unit_coverage, _unit_steps, overlap_fraction, write_vif)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -360,6 +361,8 @@ def convert_volumes(bases: list[str], *,
 
     t_r = threading.Thread(target=reader, name="fleet-reader", daemon=True)
     t_d = threading.Thread(target=drain, name="fleet-drain", daemon=True)
+    pjob = _pipeline.track("fleet_convert", stats, stats["bytes"],
+                           meta={"volumes": len(jobs), "unit_batch": U})
     t_r.start()
     t_d.start()
     try:
@@ -367,6 +370,11 @@ def convert_volumes(bases: list[str], *,
             item = q_read.get()
             if item is None:
                 break
+            # stage-queue depths at the consume site: a persistently full
+            # q_read means the dispatch (encode) stage is the bound, a
+            # deep q_disp means the drain/writers are
+            pjob.queue("q_read", q_read.qsize(), depth)
+            pjob.queue("q_disp", q_disp.qsize())
             buf, metas = item
             if errors:
                 pool.put(buf)
@@ -402,12 +410,23 @@ def convert_volumes(bases: list[str], *,
                 job.abort()
             job.release()
         _netflow.reset(_flow_token)
+        stats["wall_s"] = time.perf_counter() - t_wall
+        # analytic stage bytes (the layout fixes them; zero hot-path
+        # cost): the occupancy timeline gets achieved GB/s per stage.
+        # Only COMMITTED volumes' bytes count — an aborted half-run must
+        # not credit the full planned bytes and report achieved GB/s
+        # (even ceiling_frac > 1) the hardware never moved
+        done_jobs = [j for j in jobs if j.committed]
+        _book_stage_bytes(pjob, stats,
+                          sum(j.dat_size for j in done_jobs),
+                          layout.PARITY_SHARDS *
+                          sum(j.shard_size for j in done_jobs))
+        pjob.finish(errors[0] if errors else None)
     if errors:
         raise errors[0]
     for job in jobs:
         if job.writers.errors:
             raise job.writers.errors[0]
-    stats["wall_s"] = time.perf_counter() - t_wall
     stats["volumes"] = len(jobs)
     stats["units"] = sum(j.units_read for j in jobs)
     frac = overlap_fraction(stats)

@@ -28,6 +28,7 @@ Kernel-shape notes (why it looks the way it does):
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +55,10 @@ def resolved_tile(tile: int | None = None) -> int:
     """The tile a codec will actually use: explicit argument, else the
     WEEDTPU_EC_TILE env override (how the bench sweep's winning config —
     and an operator pinning a known-good shape — reaches every codec
-    constructed afterwards), else the backend default."""
+    constructed afterwards), else the persisted tile pin from the last
+    bench sweep when its backend/chip fingerprint matches THIS runtime
+    (a pin measured on different hardware must not leak in), else the
+    backend default."""
     if tile is not None:
         return tile
     import os
@@ -66,7 +70,153 @@ def resolved_tile(tile: int | None = None) -> int:
                 return t
         except ValueError:
             pass
+    pin = load_tile_pin()
+    if pin and pin.get("tile") and \
+            pin.get("fingerprint") == chip_fingerprint():
+        return int(pin["tile"])
     return TPU_TILE if jax.default_backend() == "tpu" else DEFAULT_TILE
+
+
+# -- tile pin: the bench sweep's winner, persisted with provenance --------
+#
+# The r04->r05 collapse (336 -> 108 GB/s) was a pinned tile constant
+# nobody re-measured.  The sweep now records its winner + the measured
+# sweep table + a backend/chip fingerprint; resolved_tile() honours a
+# matching pin, and the tile-drift sentinel (stats/pipeline.py)
+# re-validates it in the background so a pin that stops winning fires
+# an alert instead of shipping a silent 3x loss.
+
+_fingerprint: str | None = None
+
+
+def chip_fingerprint() -> str:
+    """backend:device-kind:device-count — what a tile measurement is a
+    property of.  A pin recorded under a different fingerprint is
+    provenance-only (never applied, never alerted against).  Memoized:
+    the device set is fixed per process, and resolved_tile() consults
+    this from codec-lookup paths."""
+    global _fingerprint
+    if _fingerprint is not None:
+        return _fingerprint
+    try:
+        devs = jax.devices()
+        kind = devs[0].device_kind if devs else "none"
+        _fingerprint = f"{jax.default_backend()}:{kind}:{len(devs)}"
+        return _fingerprint
+    except Exception:
+        return "unknown"
+
+
+def pin_path(path: str | None = None) -> str:
+    import os
+    return path or os.environ.get("WEEDTPU_TILE_PIN") or \
+        os.path.join(os.path.expanduser("~"), ".weedtpu_tile_pin.json")
+
+
+def save_tile_pin(tile: int, gbps: float, sweep: dict | None = None,
+                  path: str | None = None) -> str:
+    """Persist the sweep winner (atomically: tmp + rename) for
+    resolved_tile() and the drift sentinel.  Returns the path written."""
+    import json
+    import os
+    p = pin_path(path)
+    rec = {"tile": int(tile), "gbps": round(float(gbps), 3),
+           "fingerprint": chip_fingerprint(),
+           "ts": time.time()}
+    if sweep:
+        rec["sweep"] = {str(k): v for k, v in sweep.items()}
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, p)
+    return p
+
+
+_pin_cache: dict[str, tuple[tuple, dict | None]] = {}
+
+
+def load_tile_pin(path: str | None = None) -> dict | None:
+    """Read the persisted pin, cached by (mtime, size, inode) — this
+    rides resolved_tile() and therefore codec-lookup hot paths (the
+    degraded-read engine constructs codecs per reconstruct batch), so
+    a stat() must be the steady-state cost, not open+json.load.  A
+    save_tile_pin/direct rewrite changes the stat key and refreshes."""
+    import json
+    import os
+    p = pin_path(path)
+    try:
+        st = os.stat(p)
+    except OSError:
+        _pin_cache.pop(p, None)
+        return None
+    key = (st.st_mtime_ns, st.st_size, st.st_ino)
+    hit = _pin_cache.get(p)
+    if hit is not None and hit[0] == key:
+        rec = hit[1]
+        return dict(rec) if rec is not None else None
+    try:
+        with open(p) as f:
+            rec = json.load(f)
+    except OSError:
+        # raced away between stat and open: don't cache, re-stat next
+        return None
+    except ValueError:
+        # a corrupt pin caches as None under its stat key — hot-path
+        # callers must not re-parse the same broken bytes per lookup
+        rec = None
+    rec = rec if isinstance(rec, dict) and rec.get("tile") else None
+    _pin_cache[p] = (key, rec)
+    # callers may annotate/mutate the verdict they build from this —
+    # hand out a copy so the cache stays pristine
+    return dict(rec) if rec is not None else None
+
+
+def micro_sweep(k: int = 10, m: int = 4, n: int | None = None,
+                iters: int = 3,
+                ensure_tile: int | None = None) -> dict[int, float]:
+    """Cheap re-measure of every SWEEP_TILES candidate on this chip:
+    {tile: GB/s}.  One LCM-of-tiles column width (~256K columns, a few
+    MB per candidate) and a handful of iterations — enough to rank
+    tiles, deliberately far from bench depth; the sentinel compares
+    candidates against each other under identical conditions, so the
+    absolute numbers need not match the bench's."""
+    from seaweedfs_tpu.models import rs
+    code = rs.get_code(k, m)
+    # the sentinel passes its pinned tile: a pin outside SWEEP_TILES
+    # (tiny CPU sweeps, a later-release re-tune of the candidate set,
+    # an operator pin) must still be a measured candidate with n a
+    # multiple of it, or the sweep can never validate the very pin it
+    # watches — permanent sweep_failed silently disarms tile_pin_stale
+    tiles = sorted(set(SWEEP_TILES) |
+                   ({int(ensure_tile)} if ensure_tile else set()))
+    if n is None:
+        n = max(SWEEP_TILES)
+        if jax.default_backend() != "tpu":
+            n = min(SWEEP_TILES)  # the interpreter is the emulator: tiny
+        if ensure_tile:
+            t = int(ensure_tile)
+            if t > n:
+                n = t
+            elif n % t:
+                n = (n // t) * t  # other candidates may drop out
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (k, n), dtype=np.uint8))
+    out: dict[int, float] = {}
+    for t in tiles:
+        if n % t:
+            continue
+        try:
+            codec = PallasRSCodec(code, tile=t)
+            codec.encode_parity(data).block_until_ready()  # compile/warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                codec.encode_parity(data).block_until_ready()
+            el = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue  # a tile whose VMEM blocks don't fit just drops out
+        if el > 0:
+            out[t] = k * n / 1e9 / el
+    return out
 
 
 def gf_matrix_to_bitmatrix_planemajor(C: np.ndarray, kpad: int | None = None) -> np.ndarray:

@@ -35,6 +35,8 @@ on a real multi-chip slice and on the virtual CPU mesh used in tests.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -45,6 +47,17 @@ except ImportError:  # older jax: not yet re-exported at top level
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from seaweedfs_tpu.ops import gf, gfmat_jax
+
+
+def _book_h2d(nbytes: float, secs: float,
+              kernel: str = "encode_parity") -> None:
+    """Book a mesh place() H2D into the kernel profile.  The pre-placed
+    paths bypass ops/dispatch's single-dispatch seam (which deliberately
+    skips re-booking a placed batch), so without this the device-link
+    totals — and the h2d roofline row — understate fleet traffic."""
+    from seaweedfs_tpu.stats.profile import KERNELS
+    KERNELS.record(kernel, "device", calls=0,
+                   h2d_s=secs, h2d_bytes=nbytes)
 
 
 def make_mesh(n_devices: int | None = None,
@@ -229,8 +242,11 @@ class ShardedRSEncoder:
         the first encode doesn't pay a gather+reshard: each device pulls
         only its slice from the host buffer.  This is the in_sharding
         `encode`/`encode_parity` expect — committed here, never reshard."""
-        return jax.device_put(
+        t0 = time.perf_counter()
+        out = jax.device_put(
             arr, NamedSharding(self.mesh, P(None, self.col_axis)))
+        _book_h2d(getattr(arr, "nbytes", 0), time.perf_counter() - t0)
+        return out
 
     def reconstruct(self, shards: dict[int, jax.Array],
                     wanted: list[int] | None = None) -> dict[int, jax.Array]:
@@ -323,7 +339,11 @@ class FleetUnitEncoder:
         no later reshard (this IS the encode's in_sharding)."""
         assert host_units.shape[0] % self.n_devices == 0, \
             (host_units.shape, self.n_devices)
-        return jax.device_put(host_units, self.in_sharding)
+        t0 = time.perf_counter()
+        out = jax.device_put(host_units, self.in_sharding)
+        _book_h2d(host_units.nbytes, time.perf_counter() - t0,
+                  kernel="fleet_encode")
+        return out
 
     def encode_parity_batch(self, units: jax.Array) -> jax.Array:
         """[U, k, B] (device-resident, unit-sharded) -> [U, m, B] parity,

@@ -39,7 +39,7 @@ from seaweedfs_tpu.s3.auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ,
                                    decode_aws_chunked)
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
-from seaweedfs_tpu.stats import heat, netflow, trace
+from seaweedfs_tpu.stats import heat, netflow, pipeline, trace
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 
 log = logging.getLogger("s3")
@@ -144,7 +144,10 @@ class S3ApiServer:
         # surface; a bucket literally named "heat" still 403s remotely
         # rather than being shadowed
         self.app.add_routes([web.get("/heat",
-                                     trace.debug_guard(heat.handle_heat))])
+                                     trace.debug_guard(heat.handle_heat)),
+                             web.get("/perf",
+                                     trace.debug_guard(
+                                         pipeline.handle_perf))])
         self.app.add_routes([web.route("*", "/{tail:.*}", self.dispatch)])
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None

@@ -50,7 +50,8 @@ from seaweedfs_tpu.filer.filer_conf import (FilerConf, PathConf,
 from seaweedfs_tpu.filer.filer_deletion import DeletionQueue
 from seaweedfs_tpu.filer.abstract_sql import SqliteStore
 from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFound
-from seaweedfs_tpu.stats import heat, metrics, netflow, profile, trace
+from seaweedfs_tpu.stats import (heat, metrics, netflow, pipeline,
+                                  profile, trace)
 from seaweedfs_tpu.utils.http import aiohttp_trace_config, parse_range
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
@@ -132,6 +133,7 @@ class FilerServer:
             web.get("/__ui__", self.handle_ui),
             web.get("/metrics", self.handle_metrics),
             web.get("/heat", heat.handle_heat),
+            web.get("/perf", pipeline.handle_perf),
             web.route("*", "/{path:.*}", self.handle_path),
         ])
         self.notification = notification  # MessageQueue | None

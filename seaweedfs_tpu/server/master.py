@@ -22,7 +22,7 @@ from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
 from seaweedfs_tpu.stats import (aggregate, heat, history, metrics, netflow,
-                                 profile, trace)
+                                 pipeline, profile, trace)
 from seaweedfs_tpu.stats.canary import CanaryProber
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import types as t
@@ -117,9 +117,11 @@ class MasterServer:
             web.post("/raft/install_snapshot", self.handle_raft_install),
             web.get("/metrics", self.handle_metrics),
             web.get("/heat", heat.handle_heat),
+            web.get("/perf", pipeline.handle_perf),
             web.get("/cluster/metrics", self.handle_cluster_metrics),
             web.get("/cluster/slo", self.handle_cluster_slo),
             web.get("/cluster/heat", self.handle_cluster_heat),
+            web.get("/cluster/perf", self.handle_cluster_perf),
             web.get("/cluster/trace/{tid}", self.handle_cluster_trace),
             web.get("/cluster/traces", self.handle_cluster_traces),
             web.get("/cluster/canary", self.handle_cluster_canary),
@@ -611,6 +613,56 @@ class MasterServer:
         else:
             merged = await asyncio.to_thread(self.cached_heat)
         return web.json_response(merged)
+
+    def collect_perf(self) -> dict:
+        """Fleet performance observatory: every node's /debug/pipeline
+        payload (per-job stage timelines, roofline rows, tile-sentinel
+        verdict) merged into fleet occupancy per (kind, stage), the
+        worst bottleneck verdict per pipeline kind, the fleet's worst
+        roofline offenders, and per-node tile-drift state.  Thread-safe
+        sync function: the handler calls it via to_thread."""
+        import json as _json
+
+        from seaweedfs_tpu.stats import pipeline as _pipeline
+        per_node: list[tuple[str, dict]] = [
+            (self.url, _pipeline.local_snapshot())]
+        errors: dict[str, str] = {}
+        for name, payload, err in self._fan_get("/perf",
+                                                "perf-pull", _json.loads):
+            if err is not None:
+                errors[name] = err
+            else:
+                per_node.append((name, payload))
+        out = _pipeline.aggregate_fleet(per_node)
+        # roofline rows across the deduped nodes, worst offenders first
+        # (same tracker-id dedupe as the jobs: co-hosted servers share
+        # one kernel profile)
+        rows: list[dict] = []
+        seen: set[str] = set()
+        for node, payload in per_node:
+            tid = payload.get("id")
+            if tid is not None:
+                if tid in seen:
+                    continue
+                seen.add(tid)
+            for row in (payload.get("roofline") or {}).get("rows", []):
+                rows.append({"node": node, **row})
+        rows.sort(key=lambda r: -r.get("busy_s", 0.0))
+        out["roofline"] = rows
+        out["offenders"] = _pipeline.roofline_offenders({"rows": rows})
+        if errors:
+            out["node_errors"] = errors
+        return out
+
+    async def handle_cluster_perf(self, req: web.Request) -> web.Response:
+        """/cluster/perf: fleet pipeline occupancy + bottleneck verdicts
+        + roofline offenders + tile-drift state (loopback-gated like the
+        rest of the debug-derived surface — it carries file paths and
+        kernel internals)."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        return web.json_response(await asyncio.to_thread(self.collect_perf))
 
     def collect_trace(self, tid: str) -> dict:
         """One trace id -> a single parent-ordered waterfall stitched

@@ -24,7 +24,8 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security import jwt as sjwt
-from seaweedfs_tpu.stats import heat, metrics, netflow, profile, trace
+from seaweedfs_tpu.stats import (heat, metrics, netflow, pipeline,
+                                  profile, trace)
 from seaweedfs_tpu.utils import resilience
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import needle as ndl
@@ -137,6 +138,7 @@ class VolumeServer:
             web.get("/status", self.handle_status),
             web.get("/metrics", self.handle_metrics),
             web.get("/heat", heat.handle_heat),
+            web.get("/perf", pipeline.handle_perf),
             web.post("/admin/assign_volume", self.handle_assign_volume),
             web.post("/admin/volume/delete", self.handle_volume_delete),
             web.post("/admin/leave", self.handle_leave),
@@ -225,6 +227,12 @@ class VolumeServer:
                     (i + 1) % len(self.master_urls)]
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
+        # tile-drift sentinel (stats/pipeline.py): codec-hosting servers
+        # re-validate the pinned Pallas tile in the background when
+        # WEEDTPU_TILE_SENTINEL_INTERVAL asks for it (process-wide, so
+        # co-hosted servers share one)
+        from seaweedfs_tpu.stats import pipeline as _pipeline
+        _pipeline.ensure_sentinel()
         # test-only fault plan from the environment (maintenance/faults.py)
         from seaweedfs_tpu.maintenance import faults as _faults
         _faults.register_node(self.url, "volume")
@@ -259,6 +267,12 @@ class VolumeServer:
         if self._runner:
             await self._runner.cleanup()
         self.store.close()
+        # retire this instance's capacity series: heartbeats stamped
+        # per-dir/per-volume gauges into the process-global registry,
+        # and a restarted/decommissioned server must not leave them
+        # behind as stale series
+        metrics.DISK_BYTES.remove_matching(vs=self.url)
+        metrics.VOLUME_SIZE.remove_matching(vs=self.url)
 
     async def _heartbeat_loop(self) -> None:
         while True:

@@ -821,6 +821,68 @@ def cmd_cluster_slo(env: CommandEnv, args, out):
               "try -refresh)", file=out)
 
 
+@command("cluster.perf")
+def cmd_cluster_perf(env: CommandEnv, args, out):
+    """Fleet performance observatory (/cluster/perf): per-pipeline stage
+    occupancy, the bottleneck verdict per pipeline kind (the stage whose
+    busy fraction bounds throughput, with its achieved-vs-ceiling
+    fraction when the resource's roofline is measured), the worst
+    roofline offenders fleet-wide, and every node's tile-drift verdict.
+    -top N offender rows (default 5); -json dumps the raw merge.
+    Runbook: a bench trajectory regression names WHAT got slower —
+    this names WHERE (stage + node + distance from the hardware)."""
+    flags = parse_flags(args)
+    st = env.master_get("/cluster/perf")
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    try:
+        top_n = max(1, int(flags.get("top", "5")))
+    except ValueError:
+        top_n = 5
+    print(f"perf: nodes={len(st.get('nodes', []))} "
+          f"running={len(st.get('running', []))}"
+          + (f" node_errors={len(st['node_errors'])}"
+             if st.get("node_errors") else ""), file=out)
+    occ = st.get("occupancy") or {}
+    bns = st.get("bottlenecks") or {}
+    for kind in sorted(occ):
+        bn = bns.get(kind) or {}
+        verdict = ""
+        if bn:
+            verdict = (f"  << bottleneck: {bn.get('stage')} "
+                       f"busy={bn.get('busy_frac', 0):.0%}")
+            if bn.get("ceiling_frac") is not None:
+                verdict += (f" @ {bn['ceiling_frac']:.0%} of "
+                            f"{bn.get('resource')} ceiling")
+        print(f"{kind}:{verdict}", file=out)
+        stages = occ[kind]
+        for stage in sorted(stages,
+                            key=lambda s: -stages[s]["busy_s"]):
+            row = stages[stage]
+            bar = "#" * min(20, int(20 * row["max_busy_frac"]))
+            print(f"  {stage:16s} {row['busy_s']:9.3f}s busy "
+                  f"[{bar:20s}] max={row['max_busy_frac']:.0%} "
+                  f"{row['bytes'] / 1e9:8.3f} GB over "
+                  f"{row['jobs']} jobs", file=out)
+    offenders = (st.get("offenders") or [])[:top_n]
+    if offenders:
+        print("roofline offenders (furthest from their ceiling, "
+              "busiest first):", file=out)
+        for r in offenders:
+            print(f"  {r.get('node', '?'):22s} {r['kernel']:14s} "
+                  f"{r['resource']:6s} {r['achieved_gbps']:9.3f} GB/s "
+                  f"= {r['ceiling_frac']:.0%} of "
+                  f"{r.get('ceiling_gbps', 0):.3f}", file=out)
+    for node, tile in sorted((st.get("tiles") or {}).items()):
+        line = f"tile {node}: {tile.get('state')}"
+        if tile.get("pinned_tile") is not None:
+            line += (f" pinned={tile['pinned_tile']} "
+                     f"best={tile.get('best_tile')} "
+                     f"drift={tile.get('drift', 0):+.1%}")
+        print(line, file=out)
+
+
 @command("cluster.metrics")
 def cmd_cluster_metrics(env: CommandEnv, args, out):
     """Dump the federated cluster exposition (/cluster/metrics): every

@@ -586,7 +586,15 @@ _DEFAULT_ALERT_RULES = (
     # capacity: any data dir predicted to fill within a day (fed by the
     # forecaster's gauges one tick after it computes them)
     "disk_full_soon=threshold,series=weedtpu_predicted_full_seconds,"
-    "agg=min,window=120,op=lt,value=86400,for=60")
+    "agg=min,window=120,op=lt,value=86400,for=60;"
+    # tile-drift sentinel (stats/pipeline.py): the pinned Pallas tile no
+    # longer wins its own micro-sweep by >10% — the r05 failure mode
+    # (336 -> 108 GB/s off a stale pin) pages instead of shipping.  The
+    # rule watches the EXCESS series (best/pinned - 1) rather than the
+    # companion ratio gauge: federated gauges sum across nodes, and a
+    # healthy fleet must sum to zero at any size
+    "tile_pin_stale=threshold,series=weedtpu_tile_drift,"
+    "agg=max,window=120,op=gt,value=0.1,for=30")
 
 
 def parse_alert_rules(spec: str | None = None) -> list[dict]:
@@ -1104,6 +1112,17 @@ alerts: <span class="badge {badge.get(alerts.get('state', ''), '')}">{_h(alerts.
 {sect("Net flow by class (B/s sent)", "<table>" + _spark_row(
     store, "netflow", "weedtpu_net_bytes_total", {"direction": "sent"},
     "rate", rng, step, combine="class") + "</table>")}
+{sect("Pipeline occupancy (busy-s/s by stage; 1.0 = saturated)",
+      "<table>" + _spark_row(
+          store, "pipeline", "weedtpu_pipeline_stage_seconds_total",
+          None, "rate", rng, step, combine="stage") + "</table>")}
+{sect("Roofline fraction (achieved / measured ceiling by resource)",
+      "<table>" + _spark_row(
+          store, "roofline", "weedtpu_roofline_frac", None, "last",
+          rng, step) + "</table>"
+      "<table>" + _spark_row(
+          store, "tile drift", "weedtpu_tile_drift", None, "last",
+          rng, step) + "</table>")}
 {sect("Repair backlog (unhealthy volumes)", "<table>" + _spark_row(
     store, "backlog", "weedtpu_volume_health", None, "max", rng, step)
     + "</table>")}

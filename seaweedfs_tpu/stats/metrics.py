@@ -59,6 +59,20 @@ class _Metric:
                 self._children[values] = child
             return child
 
+    def remove_matching(self, **by_label) -> int:
+        """Drop every child whose labels match the given values.  Servers
+        retire their own per-instance series (disk dirs, hosted volumes)
+        at stop(), so a long-lived process that restarts or decommissions
+        a server does not accumulate stale capacity series forever."""
+        idx = {self.label_names.index(k): str(v)
+               for k, v in by_label.items()}
+        with self._lock:
+            dead = [vals for vals in self._children
+                    if all(vals[i] == v for i, v in idx.items())]
+            for vals in dead:
+                del self._children[vals]
+        return len(dead)
+
     def _pairs(self):
         with self._lock:
             items = list(self._children.items())
@@ -375,8 +389,15 @@ def start_pushing(gateway_url: str, job: str, interval: float = 15.0,
 def scrape_response(req):
     """Shared aiohttp /metrics response with content negotiation: the
     OpenMetrics rendering (exemplars linking latency buckets to trace
-    ids) when the scraper asks for it, Prometheus text 0.0.4 otherwise."""
+    ids) when the scraper asks for it, Prometheus text 0.0.4 otherwise.
+    Roofline fractions are re-derived from the live kernel profile here,
+    so every scrape carries current achieved-vs-ceiling numbers."""
     from aiohttp import web
+    try:
+        from seaweedfs_tpu.stats import profile as _profile
+        _profile.export_roofline()
+    except Exception:  # the observatory must never break a scrape
+        weedlog.V(1, "metrics").infof("roofline export failed")
     if "application/openmetrics-text" in req.headers.get("Accept", ""):
         return web.Response(text=REGISTRY.render(openmetrics=True),
                             content_type="application/openmetrics-text")
@@ -547,6 +568,36 @@ CANARY_LATENCY = REGISTRY.gauge(
     "weedtpu_canary_latency_seconds",
     "canary probe latency quantiles over the rolling window",
     ("path", "quantile"))
+# performance observatory (stats/pipeline.py, stats/profile.py
+# rooflines): per-stage busy seconds whose RATE is stage occupancy
+# (1 busy-second/second == a saturated stage), bytes moved per stage,
+# per-kernel achieved-vs-ceiling fractions, and the tile-drift
+# sentinel's verdict.  weedtpu_tile_drift is the fractional advantage
+# of the best candidate tile over the pinned one (0 = pin still wins)
+# — the default tile_pin_stale alert rule watches IT rather than the
+# ratio because federated gauges sum across nodes, and a healthy fleet
+# must sum to zero at any size.
+PIPELINE_STAGE_SECONDS = REGISTRY.counter(
+    "weedtpu_pipeline_stage_seconds_total",
+    "busy seconds per data-plane pipeline stage (rate == occupancy)",
+    ("kind", "stage"))
+PIPELINE_STAGE_BYTES = REGISTRY.counter(
+    "weedtpu_pipeline_stage_bytes_total",
+    "bytes processed per data-plane pipeline stage", ("kind", "stage"))
+ROOFLINE_FRAC = REGISTRY.gauge(
+    "weedtpu_roofline_frac",
+    "achieved throughput of a kernel as a fraction of the measured "
+    "hardware ceiling of the resource it exercises",
+    ("resource", "kernel"))
+TILE_DRIFT = REGISTRY.gauge(
+    "weedtpu_tile_drift",
+    "fractional throughput advantage of the best candidate Pallas tile "
+    "over the pinned one (0 = pin still optimal; >0.1 fires "
+    "tile_pin_stale)")
+TILE_DRIFT_RATIO = REGISTRY.gauge(
+    "weedtpu_tile_drift_ratio",
+    "best candidate tile throughput / pinned tile throughput from the "
+    "drift sentinel's last micro-sweep")
 # registry self-cost: stamped on every render (see Registry.render) so
 # the dashboard — itself fed from these series — can watch what the
 # telemetry plane costs

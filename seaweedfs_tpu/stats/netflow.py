@@ -54,11 +54,11 @@ INTERNAL_PREFIXES = ("/metrics", "/heartbeat", "/raft", "/debug",
                      "/cluster", "/maintenance", "/admin",
                      "/__meta__", "/__admin__", "/__ui__", "/status")
 
-# exact-path-only internal surfaces: /heat has no sub-paths, and an s3
-# bucket literally named "heat" must keep its OBJECT traffic
-# (/heat/obj) on the data plane — only the sketch endpoint itself is
-# cluster plumbing
-INTERNAL_EXACT = ("/heat",)
+# exact-path-only internal surfaces: /heat and /perf have no sub-paths,
+# and an s3 bucket literally named "heat" must keep its OBJECT traffic
+# (/heat/obj) on the data plane — only the sketch/observatory endpoints
+# themselves are cluster plumbing
+INTERNAL_EXACT = ("/heat", "/perf")
 
 
 def is_internal(path: str) -> bool:

@@ -1,0 +1,731 @@
+"""Pipeline occupancy accounting + the tile-drift sentinel: the data
+plane's performance observatory.
+
+Every overlapped pipeline in the data path (the EC encode/rebuild
+engines in storage/ec/ec_files.py, the multi-volume fleet conversion in
+ops/fleet_convert.py, the EC degraded-read engine) already accumulated
+ad-hoc per-stage wall-second dicts for bench.py — visible only on bench
+day.  The r05 regression (336 -> 108 GB/s, a stale pinned Pallas tile
+nobody re-measured) shipped precisely because production paths had no
+always-on answer to "which stage bounds throughput and how far from the
+hardware roofline are we?".  This module is that answer:
+
+- **PipelineJob** — the shared stage-accounting primitive: per-stage
+  busy seconds (doing work), blocked seconds (backpressured on a
+  downstream ring/queue), bytes, items, and queue-depth high-water
+  marks, wrapped around the existing stats-dict contract so bench.py and
+  /admin/ec/progress keep their keys.  Finished jobs land in a bounded
+  ring; running jobs are observable live.  ``bottleneck()`` attributes
+  the run to the stage whose busy fraction bounds throughput and — when
+  a hardware ceiling for that stage's resource is known
+  (stats/profile.py ceilings) — how close to it the stage ran.
+
+- **FlowAccount** — the continuous twin for long-lived engines (the EC
+  read path): cumulative per-stage busy seconds/bytes whose counter
+  rates ARE stage occupancy (``weedtpu_pipeline_stage_seconds_total``:
+  1 busy-second per second == a saturated stage), so "degraded reads
+  went remote-fetch-bound at 14:05" is a /cluster/history query.
+
+- **TileDriftSentinel** — re-validates the pinned Pallas tile (the
+  bench sweep's winner, persisted with a backend+chip fingerprint by
+  ops/pallas_gf.save_tile_pin) with a cheap background micro-sweep on
+  codec-hosting servers.  ``weedtpu_tile_drift`` reports the fractional
+  advantage of the best candidate over the pin (0 = pin still wins);
+  the default ``tile_pin_stale`` alert rule fires past 0.1 — the r05
+  failure mode becomes a page carrying the sweep table instead of a
+  silent 3x loss.  (The alert watches the *excess* series rather than
+  the companion ``weedtpu_tile_drift_ratio`` because federated gauges
+  sum across nodes: a healthy fleet sums zeros at any size.)
+
+Surfaces: ``/debug/pipeline`` on every server (loopback-gated, mounted
+by trace.debug_routes) renders per-job timelines; master
+``/cluster/perf`` fans it out and aggregates fleet occupancy; the
+``cluster.perf`` shell command and a /cluster/dashboard panel render
+both.  ``WEEDTPU_PERF_OBS=0`` turns the whole plane off (the
+``perf_obs_overhead`` bench gate holds it under 3% of hot-path cost).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import os
+import threading
+import time
+import uuid
+
+# -- knobs ----------------------------------------------------------------
+
+_enabled_cache: tuple[float, bool] = (0.0, True)
+
+
+def perf_obs_enabled() -> bool:
+    """WEEDTPU_PERF_OBS != "0" (default on), cached ~0.5s so hot-path
+    checks cost a tuple compare while flipping the env retargets live
+    servers (the perf_obs_overhead bench relies on that)."""
+    global _enabled_cache
+    now = time.monotonic()
+    ts, val = _enabled_cache
+    if now - ts > 0.5:
+        val = os.environ.get("WEEDTPU_PERF_OBS", "1") != "0"
+        _enabled_cache = (now, val)
+    return val
+
+
+def _jobs_keep() -> int:
+    try:
+        return max(1, int(os.environ.get("WEEDTPU_PERF_OBS_JOBS", "32")))
+    except ValueError:
+        return 32
+
+
+# -- the job registry -----------------------------------------------------
+
+# one id per process: the master's fleet fan-out dedupes co-hosted
+# "nodes" (the all-in-one binary, in-process test clusters) that share
+# this module's registry, exactly like the heat tracker id
+TRACKER_ID = uuid.uuid4().hex
+_seq = itertools.count(1)
+_reg_lock = threading.Lock()
+_active: dict[int, "PipelineJob"] = {}
+_recent: collections.deque = collections.deque(maxlen=_jobs_keep())
+_flows: dict[str, "FlowAccount"] = {}
+
+# stages that are WAITING, not working: excluded from busy fractions and
+# bottleneck attribution (a fully backpressured producer reads as
+# blocked, not as the bottleneck)
+IDLE_STAGES = ("stall", "blocked", "idle")
+
+# stage -> hardware-resource mapping for ceiling attribution
+# (stats/profile.py holds the measured ceilings themselves)
+STAGE_RESOURCE = {
+    "encode": "device", "reconstruct": "device", "d2h": "d2h",
+    "read": "disk", "local_pread": "disk",
+    "write": "disk", "write_data": "disk", "write_parity": "disk",
+    "remote_fetch": "net",
+}
+
+
+class _StageTimer:
+    __slots__ = ("_job", "_stage", "_nbytes", "_items", "_blocked", "_t0")
+
+    def __init__(self, job, stage, nbytes, items, blocked):
+        self._job = job
+        self._stage = stage
+        self._nbytes = nbytes
+        self._items = items
+        self._blocked = blocked
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._job._book(self._stage, time.perf_counter() - self._t0,
+                        self._nbytes, self._items, self._blocked)
+        return False
+
+
+class PipelineJob:
+    """Stage accounting for ONE pipeline run (an encode, a rebuild, a
+    fleet conversion).  Wraps the pipeline's existing stats dict — the
+    ``<stage>_s`` wall-second keys bench.py and /admin/ec/progress
+    already read stay the source of truth for stage TIME (including the
+    writer-pool seconds folded in at close()); this object adds the
+    dimensions a dict of floats can't carry: bytes and items per stage,
+    queue-depth high-water marks, blocked time, liveness, and the
+    registry that makes the run observable at /debug/pipeline while it
+    is still running."""
+
+    def __init__(self, kind: str, stats: dict | None = None,
+                 total_bytes: int = 0, meta: dict | None = None,
+                 register: bool = True):
+        self.kind = kind
+        self.stats = stats if stats is not None else {}
+        self.total_bytes = total_bytes
+        self.meta = meta or {}
+        self.started = time.time()
+        self._t0 = time.perf_counter()
+        self.wall_s: float | None = None
+        self.state = "running"
+        self.error: str | None = None
+        self.job_id = next(_seq)
+        self._lock = threading.Lock()
+        # stage -> [busy_s, blocked_s, bytes, items]
+        self._stages: dict[str, list[float]] = {}
+        # queue -> [last, max, sum, samples, bound]
+        self._queues: dict[str, list[float]] = {}
+        self._registered = register and perf_obs_enabled()
+        if self._registered:
+            with _reg_lock:
+                _active[self.job_id] = self
+
+    # -- accounting ------------------------------------------------------
+
+    def stage(self, name: str, nbytes: float = 0.0,
+              items: float = 1.0) -> _StageTimer:
+        """CM bracketing productive work attributed to `name`."""
+        return _StageTimer(self, name, nbytes, items, False)
+
+    def blocked(self, name: str) -> _StageTimer:
+        """CM bracketing time `name` spent backpressured on a
+        downstream queue/ring — never counted as busy."""
+        return _StageTimer(self, name, 0.0, 0.0, True)
+
+    def _book(self, name: str, secs: float, nbytes: float, items: float,
+              blocked: bool) -> None:
+        with self._lock:
+            row = self._stages.get(name)
+            if row is None:
+                row = self._stages[name] = [0.0, 0.0, 0.0, 0.0]
+            row[1 if blocked else 0] += secs
+            row[2] += nbytes
+            row[3] += items
+
+    def add_bytes(self, name: str, nbytes: float,
+                  items: float = 0.0) -> None:
+        self._book(name, 0.0, nbytes, items, False)
+
+    def queue(self, name: str, depth: int, bound: int = 0) -> None:
+        """Sample a queue's depth (producers call at put/get sites)."""
+        with self._lock:
+            q = self._queues.get(name)
+            if q is None:
+                q = self._queues[name] = [0.0, 0.0, 0.0, 0.0, float(bound)]
+            q[0] = depth
+            if depth > q[1]:
+                q[1] = depth
+            q[2] += depth
+            q[3] += 1
+            if bound:
+                q[4] = float(bound)
+
+    def finish(self, error: BaseException | str | None = None) -> None:
+        """Seal the job: stamp the wall clock, book the cumulative stage
+        seconds/bytes counters, move registry entry active -> recent."""
+        with self._lock:
+            if self.state != "running":
+                return
+            self.wall_s = time.perf_counter() - self._t0
+            self.state = "failed" if error else "done"
+            if error:
+                self.error = str(error) or type(error).__name__
+        if self._registered:
+            with _reg_lock:
+                _active.pop(self.job_id, None)
+                _recent.append(self)
+            try:
+                from seaweedfs_tpu.stats import metrics
+                for stage, row in self.snapshot()["stages"].items():
+                    if row["busy_s"]:
+                        # occupancy-seconds: an N-worker pool's summed
+                        # busy seconds divide by N so the counter RATE
+                        # tops out at 1/s for a saturated stage (the
+                        # "1.0 = saturated" dashboard/README contract)
+                        metrics.PIPELINE_STAGE_SECONDS.labels(
+                            self.kind, stage).inc(
+                                row["busy_s"] / row.get("workers", 1))
+                    if row["bytes"]:
+                        metrics.PIPELINE_STAGE_BYTES.labels(
+                            self.kind, stage).inc(row["bytes"])
+            except Exception:
+                pass  # metric export must never fail the data plane
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        self.finish(exc)
+        return False
+
+    # -- rendering -------------------------------------------------------
+
+    def _stats_stage_seconds(self) -> dict[str, float]:
+        """Stage wall-seconds from the wrapped stats dict (`encode_s`,
+        `write_parity_s`, ... — the writer pool folds its busy seconds
+        there at close()).  `wall_s` is the clock, `stall_s` idle."""
+        out: dict[str, float] = {}
+        for key, v in list(self.stats.items()):
+            if key.endswith("_s") and key != "wall_s" and \
+                    isinstance(v, (int, float)):
+                out[key[:-2]] = float(v)
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            stages_own = {k: list(v) for k, v in self._stages.items()}
+            queues = {k: list(v) for k, v in self._queues.items()}
+            # the stats dict's wall_s (the bench/_Timer contract) is the
+            # canonical clock when the pipeline stamped one — the job's
+            # own bracket includes setup/teardown outside it
+            wall = self.stats.get("wall_s")
+            if not isinstance(wall, (int, float)) or wall <= 0:
+                wall = self.wall_s if self.wall_s is not None \
+                    else time.perf_counter() - self._t0
+            state, error = self.state, self.error
+        merged: dict[str, dict] = {}
+        for name, secs in self._stats_stage_seconds().items():
+            merged[name] = {"busy_s": secs, "blocked_s": 0.0,
+                            "bytes": 0.0, "items": 0.0}
+        for name, (busy, blocked, nbytes, items) in stages_own.items():
+            row = merged.setdefault(
+                name, {"busy_s": 0.0, "blocked_s": 0.0, "bytes": 0.0,
+                       "items": 0.0})
+            # stats-dict seconds win when both booked the same stage
+            # (they are the same measurement, taken by _Timer)
+            if row["busy_s"] == 0.0:
+                row["busy_s"] = busy
+            row["blocked_s"] += blocked
+            row["bytes"] += nbytes
+            row["items"] += items
+        # the stall stage is idle/backpressure time, not work
+        for name in list(merged):
+            if name in IDLE_STAGES:
+                row = merged.pop(name)
+                merged.setdefault(
+                    "_idle", {"busy_s": 0.0, "blocked_s": 0.0,
+                              "bytes": 0.0, "items": 0.0})
+                merged["_idle"]["blocked_s"] += row["busy_s"] + \
+                    row["blocked_s"]
+        idle = merged.pop("_idle", None)
+        wall = max(wall, 1e-9)
+        for name, row in merged.items():
+            # a stage served by N parallel workers (the shard writer
+            # pools publish `<stage>_workers`) accumulates up to N busy
+            # seconds per wall second: busy_frac is OCCUPANCY of the
+            # stage's capacity, not raw seconds over wall — otherwise a
+            # 4-worker 30%-busy pool reads as a 120%-saturated bottleneck
+            w = self.stats.get(f"{name}_workers")
+            if isinstance(w, (int, float)) and w > 1:
+                # may be fractional: a shared pool's threads split
+                # across its stages by busy share.  Keep the float —
+                # finish() divides the exported counter by this value,
+                # and truncating to int would re-inflate the rate
+                row["workers"] = round(float(w), 2)
+            else:
+                w = 1
+            row["busy_frac"] = round(row["busy_s"] / (w * wall), 4)
+            for k in ("busy_s", "blocked_s", "bytes", "items"):
+                row[k] = round(row[k], 6)
+        snap = {
+            "id": self.job_id, "kind": self.kind, "state": state,
+            "started": round(self.started, 3), "wall_s": round(wall, 4),
+            "bytes": self.total_bytes or self.stats.get("bytes", 0),
+            "stages": merged,
+            "queues": {k: {"last": int(q[0]), "max": int(q[1]),
+                           "avg": round(q[2] / q[3], 2) if q[3] else 0.0,
+                           "bound": int(q[4])}
+                       for k, q in queues.items()},
+        }
+        if idle is not None:
+            snap["blocked_s"] = round(idle["blocked_s"], 4)
+        if error:
+            snap["error"] = error
+        if self.meta:
+            snap["meta"] = dict(self.meta)
+        bn = bottleneck(snap)
+        if bn is not None:
+            snap["bottleneck"] = bn
+        return snap
+
+
+class FlowAccount(PipelineJob):
+    """A never-finishing PipelineJob for long-lived engines (the EC
+    degraded-read path): cumulative per-stage busy seconds and bytes,
+    exported incrementally as ``weedtpu_pipeline_stage_seconds_total``
+    so the counter RATE is live stage occupancy.  Registered once per
+    (process, kind)."""
+
+    def __init__(self, kind: str):
+        super().__init__(kind, register=False)
+        self.state = "flow"
+        # per-stage (seconds-counter, bytes-counter) children, resolved
+        # once: a labels() registry lookup per read is measurable tax on
+        # a ~60us page-cache needle read
+        self._children: dict[str, tuple] = {}
+        with _reg_lock:
+            # first registration wins: a racing creator books to the
+            # same (shared) metric counters either way
+            _flows.setdefault(kind, self)
+
+    def _stage_counters(self, name: str) -> tuple | None:
+        pair = self._children.get(name)
+        if pair is None:
+            try:
+                from seaweedfs_tpu.stats import metrics
+                pair = (metrics.PIPELINE_STAGE_SECONDS.labels(
+                            self.kind, name),
+                        metrics.PIPELINE_STAGE_BYTES.labels(
+                            self.kind, name))
+            except Exception:
+                return None
+            self._children[name] = pair
+        return pair
+
+    def _book(self, name, secs, nbytes, items, blocked):
+        super()._book(name, secs, nbytes, items, blocked)
+        if blocked or not perf_obs_enabled():
+            return
+        pair = self._stage_counters(name)
+        if pair is None:
+            return
+        if secs:
+            pair[0].inc(secs)
+        if nbytes:
+            pair[1].inc(nbytes)
+
+    def stage(self, name, nbytes=0.0, items=1.0):
+        if not perf_obs_enabled():
+            return contextlib.nullcontext()
+        return super().stage(name, nbytes, items)
+
+
+def track(kind: str, stats: dict | None = None, total_bytes: int = 0,
+          meta: dict | None = None) -> PipelineJob:
+    """The one-liner pipelines wrap themselves in::
+
+        with pipeline.track("ec_encode", stats, dat_size) as job:
+            ... job.queue("read", q.qsize()) ...
+
+    Returns an unregistered no-op-ish job when the observatory is off
+    (stage CMs still time into the stats dict contract holders, but
+    nothing is retained or exported)."""
+    return PipelineJob(kind, stats, total_bytes, meta)
+
+
+def flow(kind: str) -> FlowAccount:
+    # lock-free fast path: dict.get is atomic under the GIL, and this
+    # rides per-needle-read hot paths (the EC read engine)
+    acct = _flows.get(kind)
+    if acct is not None:
+        return acct
+    FlowAccount(kind)  # registers itself (first registration wins)
+    return _flows[kind]
+
+
+def jobs_snapshot(limit: int | None = None) -> list[dict]:
+    """Recent + running jobs, newest first, plus the continuous flow
+    accounts."""
+    with _reg_lock:
+        jobs = list(_active.values()) + list(_recent)
+        flows = list(_flows.values())
+    out = [j.snapshot() for j in jobs]
+    out.sort(key=lambda s: -s["started"])
+    if limit:
+        out = out[:limit]
+    return out + [f.snapshot() for f in flows]
+
+
+def reset() -> None:
+    """Tests: drop every retained job and flow account."""
+    global _recent
+    with _reg_lock:
+        _active.clear()
+        _recent = collections.deque(maxlen=_jobs_keep())
+        _flows.clear()
+
+
+# -- bottleneck attribution -----------------------------------------------
+
+def bottleneck(snap: dict) -> dict | None:
+    """The stage whose busy fraction bounds this job's throughput, plus
+    its achieved-vs-ceiling fraction when the stage maps to a resource
+    with a measured ceiling (stats/profile.py).  Stages are concurrent
+    (that is the point of the pipelines), so the max busy-FRACTION
+    stage — occupancy of the stage's worker capacity, see snapshot() —
+    IS the throughput bound: the wall clock can never beat the time its
+    most-saturated stage needs.  Busy seconds break busy_frac ties
+    (long-lived flow accounts round their fractions to ~0)."""
+    stages = snap.get("stages") or {}
+    best_name, best = None, None
+    for name, row in stages.items():
+        if name in IDLE_STAGES or row.get("busy_s", 0.0) <= 0:
+            continue
+        key = (row.get("busy_frac", 0.0), row["busy_s"])
+        if best is None or key > best:
+            best_name, best = name, key
+    if best_name is None:
+        return None
+    row = stages[best_name]
+    out = {"stage": best_name,
+           "busy_frac": row.get("busy_frac", 0.0)}
+    if row.get("bytes"):
+        # aggregate stage rate: N workers' summed seconds cover bytes
+        # in busy_s/N of wall time
+        active = row["busy_s"] / row.get("workers", 1)
+        gbps = row["bytes"] / 1e9 / max(active, 1e-9)
+        out["achieved_gbps"] = round(gbps, 3)
+        resource = STAGE_RESOURCE.get(best_name)
+        if resource is not None:
+            from seaweedfs_tpu.stats import profile as _profile
+            ceil = _profile.ceilings().get(resource)
+            if ceil:
+                out["resource"] = resource
+                out["ceiling_gbps"] = round(ceil, 3)
+                out["ceiling_frac"] = round(min(gbps / ceil, 9.99), 3)
+    return out
+
+
+# -- fleet aggregation (master /cluster/perf) ------------------------------
+
+def aggregate_fleet(per_node: list[tuple[str, dict]]) -> dict:
+    """Merge per-node /debug/pipeline payloads into fleet occupancy:
+    per (kind, stage) busy seconds / bytes / max busy fraction across
+    every reporting node, the currently-running jobs, the worst
+    bottleneck verdict per kind, and every node's tile-drift verdict.
+    Payloads from nodes sharing one process (the all-in-one binary,
+    in-process test clusters) carry the same tracker ``id`` and are
+    merged once, not once per node."""
+    occupancy: dict[str, dict[str, dict]] = {}
+    running: list[dict] = []
+    verdicts: dict[str, dict] = {}
+    tiles: dict[str, dict] = {}
+    seen: set[str] = set()
+    nodes: list[str] = []
+    for node, payload in per_node:
+        tid = payload.get("id")
+        if tid is not None and tid in seen:
+            continue
+        if tid is not None:
+            seen.add(tid)
+        nodes.append(node)
+        tile = payload.get("tile")
+        if tile:
+            tiles[node] = tile
+        for job in payload.get("jobs", []):
+            kind = job.get("kind", "?")
+            krow = occupancy.setdefault(kind, {})
+            for stage, row in (job.get("stages") or {}).items():
+                srow = krow.setdefault(
+                    stage, {"busy_s": 0.0, "bytes": 0.0, "jobs": 0,
+                            "max_busy_frac": 0.0})
+                srow["busy_s"] = round(srow["busy_s"] + row["busy_s"], 4)
+                srow["bytes"] += row.get("bytes", 0.0)
+                srow["jobs"] += 1
+                if row.get("busy_frac", 0.0) > srow["max_busy_frac"]:
+                    srow["max_busy_frac"] = row["busy_frac"]
+            if job.get("state") == "running":
+                running.append({"node": node, **job})
+            bn = job.get("bottleneck")
+            if bn:
+                prev = verdicts.get(kind)
+                if prev is None or bn.get("busy_frac", 0.0) > \
+                        prev.get("busy_frac", 0.0):
+                    verdicts[kind] = {"node": node, **bn}
+    return {"nodes": nodes, "occupancy": occupancy,
+            "bottlenecks": verdicts, "running": running, "tiles": tiles}
+
+
+def roofline_offenders(roofline: dict, limit: int = 5) -> list[dict]:
+    """The busiest kernel/resource rows ranked by how far they run from
+    their ceiling — the "what should the next perf round attack" list."""
+    rows = [r for r in roofline.get("rows", [])
+            if r.get("ceiling_frac") is not None and r.get("busy_s", 0.0)]
+    rows.sort(key=lambda r: (r["ceiling_frac"], -r["busy_s"]))
+    return rows[:limit]
+
+
+# -- tile-drift sentinel --------------------------------------------------
+
+class TileDriftSentinel:
+    """Background micro-sweep re-validating the pinned Pallas tile on
+    THIS chip + runtime.  Loads the bench sweep's persisted pin
+    (ops/pallas_gf.load_tile_pin: winning tile + backend/chip
+    fingerprint + the full sweep table), re-measures every candidate
+    cheaply, and reports how much the best candidate now beats the pin:
+
+        weedtpu_tile_drift        best/pinned - 1 (0 = pin still wins)
+        weedtpu_tile_drift_ratio  best/pinned     (the human number)
+
+    The default ``tile_pin_stale`` alert rule (stats/history.py) fires
+    past 10% drift with the sweep table attached to the sentinel status
+    (/debug/pipeline, /cluster/perf).  A pin recorded on a DIFFERENT
+    backend/chip is reported as ``fingerprint_mismatch`` and never
+    measured against — a CPU-fallback host must not page about a TPU
+    pin.  ``measure`` is injectable for tests (and anything that wants
+    a different probe): it returns {tile: gbps}."""
+
+    def __init__(self, interval: float | None = None, measure=None,
+                 pin_path: str | None = None):
+        if interval is None:
+            try:
+                interval = float(os.environ.get(
+                    "WEEDTPU_TILE_SENTINEL_INTERVAL", "0"))
+            except ValueError:
+                interval = 0.0
+        self.interval = interval
+        self.pin_path = pin_path
+        self._measure = measure
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._status: dict = {"state": "idle"}
+
+    # -- one verdict -----------------------------------------------------
+
+    def run_once(self) -> dict:
+        from seaweedfs_tpu.ops import pallas_gf
+        from seaweedfs_tpu.stats import metrics
+        ts = time.time()
+        pin = pallas_gf.load_tile_pin(self.pin_path)
+        if pin is None:
+            st = {"state": "no_pin", "ts": ts}
+        elif pin.get("fingerprint") != pallas_gf.chip_fingerprint():
+            st = {"state": "fingerprint_mismatch", "ts": ts,
+                  "pin": {k: pin.get(k) for k in
+                          ("tile", "gbps", "fingerprint")},
+                  "fingerprint": pallas_gf.chip_fingerprint()}
+        else:
+            try:
+                # the default sweep must size its input so the PINNED
+                # tile measures (CPU sweeps are tiny), else the verdict
+                # degenerates to sweep_failed on the pin it watches
+                measure = self._measure or (
+                    lambda: pallas_gf.micro_sweep(
+                        ensure_tile=int(pin["tile"])))
+                sweep = measure()
+            except Exception as e:
+                st = {"state": "sweep_failed", "ts": ts,
+                      "error": str(e) or type(e).__name__}
+            else:
+                st = self._verdict(pin, sweep, ts)
+        if "drift" in st:
+            metrics.TILE_DRIFT.labels().set(st["drift"])
+            metrics.TILE_DRIFT_RATIO.labels().set(st["ratio"])
+        else:
+            # no measurable verdict (pin deleted, re-swept on other
+            # hardware, sweep failed): zero the gauges so a previously
+            # firing tile_pin_stale can clear instead of latching on
+            # the last stale value until process restart
+            metrics.TILE_DRIFT.labels().set(0.0)
+            metrics.TILE_DRIFT_RATIO.labels().set(1.0)
+        with self._lock:
+            self._status = st
+        return st
+
+    @staticmethod
+    def _verdict(pin: dict, sweep: dict, ts: float) -> dict:
+        pinned_tile = int(pin["tile"])
+        pinned_now = sweep.get(pinned_tile) or \
+            sweep.get(str(pinned_tile)) or 0.0
+        best_tile, best = pinned_tile, pinned_now
+        for t, v in sweep.items():
+            if isinstance(v, (int, float)) and v > best:
+                best_tile, best = int(t), float(v)
+        if pinned_now <= 0:
+            return {"state": "sweep_failed", "ts": ts,
+                    "error": "pinned tile did not measure",
+                    "sweep": {str(k): v for k, v in sweep.items()}}
+        ratio = best / pinned_now
+        drift = max(0.0, ratio - 1.0)
+        return {"state": "stale" if drift > 0.1 else "ok", "ts": ts,
+                "pinned_tile": pinned_tile, "best_tile": best_tile,
+                "pinned_gbps": round(pinned_now, 3),
+                "best_gbps": round(best, 3),
+                "ratio": round(ratio, 4), "drift": round(drift, 4),
+                "pin": {"tile": pin.get("tile"), "gbps": pin.get("gbps"),
+                        "ts": pin.get("ts")},
+                "sweep": {str(k): round(v, 3) if isinstance(v, float)
+                          else v for k, v in sweep.items()}}
+
+    def status(self) -> dict:
+        with self._lock:
+            return dict(self._status)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "TileDriftSentinel":
+        if self.interval <= 0 or self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="weedtpu-tile-sentinel", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception:
+                from seaweedfs_tpu.utils import weedlog
+                weedlog.V(1, "pipeline").infof("tile sentinel tick failed")
+
+    def stop(self, timeout: float = 5.0) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout)
+        self._thread = None
+
+
+_sentinel_lock = threading.Lock()
+_sentinel: TileDriftSentinel | None = None
+
+
+def ensure_sentinel() -> TileDriftSentinel | None:
+    """Idempotently start the process-wide drift sentinel when
+    WEEDTPU_TILE_SENTINEL_INTERVAL asks for one (codec-hosting servers
+    call this at start; co-hosted servers share it)."""
+    global _sentinel
+    with _sentinel_lock:
+        if _sentinel is None:
+            s = TileDriftSentinel()
+            if s.interval <= 0:
+                return None
+            _sentinel = s.start()
+        return _sentinel
+
+
+def sentinel_status() -> dict | None:
+    with _sentinel_lock:
+        s = _sentinel
+    return s.status() if s is not None else None
+
+
+def set_sentinel(s: TileDriftSentinel | None) -> None:
+    """Tests/servers: install (or clear) the process-wide sentinel whose
+    status /debug/pipeline reports."""
+    global _sentinel
+    with _sentinel_lock:
+        _sentinel = s
+
+
+# -- /debug/pipeline -------------------------------------------------------
+
+def local_snapshot(limit: int = 16) -> dict:
+    """Everything this process knows about its own data-plane
+    performance: jobs + flows, the kernel roofline, and the tile
+    sentinel's verdict.  The payload /cluster/perf federates."""
+    from seaweedfs_tpu.stats import profile as _profile
+    out = {"id": TRACKER_ID, "enabled": perf_obs_enabled(),
+           "jobs": jobs_snapshot(limit),
+           "roofline": _profile.roofline_snapshot()}
+    tile = sentinel_status()
+    if tile is not None:
+        out["tile"] = tile
+    return out
+
+
+async def handle_debug_pipeline(req):
+    """``/debug/pipeline[?limit=N]``: per-job stage timelines (busy /
+    blocked / queue depths / bottleneck verdicts), the continuous flow
+    accounts, the per-kernel roofline table, and the tile-drift
+    sentinel's last verdict.  Mounted loopback-gated on every server by
+    trace.debug_routes()."""
+    from aiohttp import web
+    try:
+        limit = int(req.query.get("limit", "16"))
+    except ValueError:
+        limit = 16
+    return web.json_response(local_snapshot(limit))
+
+
+async def handle_perf(req):
+    """``/perf``: the same payload, mounted OPEN on cluster-internal
+    servers (the /heat posture — netflow classifies it internal) so the
+    master's /cluster/perf fan-out works when nodes are not loopback to
+    the master; the public s3 gateway wraps it in the debug guard."""
+    return await handle_debug_pipeline(req)

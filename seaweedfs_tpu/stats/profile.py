@@ -283,6 +283,132 @@ class KernelProfile:
 KERNELS = KernelProfile()
 
 
+# -- roofline accounting --------------------------------------------------
+#
+# Achieved throughput per kernel row, divided by the measured ceiling of
+# the hardware resource it exercises, exported as
+# weedtpu_roofline_frac{resource,kernel} gauges: "encode is now
+# D2H-bound" becomes a queryable series instead of a bench-day
+# discovery.  Ceilings come from (highest precedence first)
+# set_ceiling() calls, the WEEDTPU_CEILINGS env
+# ("resource=GBps,resource=GBps"), and — for the device compute
+# ceiling — the bench tile sweep's persisted pin
+# (ops/pallas_gf.load_tile_pin), which records the winning tile's
+# measured GB/s alongside the backend/chip fingerprint.
+
+_ceilings_lock = threading.Lock()
+_ceilings_set: dict[str, float] = {}
+_ceilings_cache: tuple[float, dict] | None = None
+
+
+def set_ceiling(resource: str, gbps: float,
+                source: str = "measured") -> None:
+    """Record a measured hardware ceiling (GB/s) for a resource
+    (device/h2d/d2h/disk/net).  Bench runs and servers that micro-measure
+    call this; WEEDTPU_CEILINGS overrides nothing set here."""
+    global _ceilings_cache
+    with _ceilings_lock:
+        _ceilings_set[resource] = float(gbps)
+        _ceilings_cache = None
+
+
+def ceilings() -> dict[str, float]:
+    """resource -> GB/s ceiling, merged from set_ceiling() calls, the
+    WEEDTPU_CEILINGS env, and the tile pin's recorded kernel peak
+    (device).  Cached ~5s: the pin file read must not ride hot paths."""
+    global _ceilings_cache
+    now = time.monotonic()
+    with _ceilings_lock:
+        cached = _ceilings_cache
+        if cached is not None and now - cached[0] < 5.0:
+            return dict(cached[1])
+        out: dict[str, float] = {}
+        for part in os.environ.get("WEEDTPU_CEILINGS", "").split(","):
+            k, sep, v = part.partition("=")
+            if sep:
+                try:
+                    gbps = float(v)
+                except ValueError:
+                    continue
+                if gbps > 0:
+                    out[k.strip()] = gbps
+        # only consult the pin where jax is already resident: importing
+        # pallas_gf would otherwise drag the whole jax runtime into
+        # processes that deliberately never load it (the cpu-native
+        # bench path, lean host-codec servers)
+        if "device" not in out and "jax" in sys.modules:
+            try:
+                from seaweedfs_tpu.ops import pallas_gf
+                pin = pallas_gf.load_tile_pin()
+                if pin and pin.get("gbps") and \
+                        pin.get("fingerprint") == \
+                        pallas_gf.chip_fingerprint():
+                    out["device"] = float(pin["gbps"])
+            except Exception:
+                pass
+        out.update(_ceilings_set)
+        _ceilings_cache = (now, out)
+        return dict(out)
+
+
+# which (resource, seconds-field, bytes-field) pairs a kernel row feeds:
+# compute uses the device seconds on device rows and host wall on host
+# rows; the transfer resources read their dedicated columns
+_ROOFLINE_TRANSFERS = (("h2d", "h2d_s", "h2d_bytes"),
+                       ("d2h", "d2h_s", "d2h_bytes"))
+
+
+def roofline_snapshot() -> dict:
+    """Per-kernel achieved GB/s per resource + fraction of the measured
+    ceiling where one is known.  Rows without meaningful time (<1ms
+    accumulated) are skipped — a fraction computed over noise would
+    jitter the gauges."""
+    ceil = ceilings()
+    rows: list[dict] = []
+    for key, r in KERNELS.snapshot().items():
+        kernel, _, backend = key.partition("[")
+        backend = backend.rstrip("]")
+        compute_s = r["device_s"] if backend == "device" else r["wall_s"]
+        candidates = [("device" if backend == "device" else "host",
+                       compute_s, r["bytes"])]
+        for resource, sfield, bfield in _ROOFLINE_TRANSFERS:
+            candidates.append((resource, r[sfield], r[bfield]))
+        if kernel == "shard_write":
+            # the writer pool's disk seconds ride the wall/bytes columns
+            candidates = [("disk", r["wall_s"], r["bytes"])]
+        for resource, secs, nbytes in candidates:
+            if secs < 1e-3 or nbytes <= 0:
+                continue
+            gbps = nbytes / 1e9 / secs
+            row = {"kernel": kernel, "backend": backend,
+                   "resource": resource, "busy_s": round(secs, 4),
+                   "gbytes": round(nbytes / 1e9, 4),
+                   "achieved_gbps": round(gbps, 3)}
+            c = ceil.get(resource)
+            if c:
+                row["ceiling_gbps"] = round(c, 3)
+                row["ceiling_frac"] = round(min(gbps / c, 9.99), 4)
+            rows.append(row)
+    rows.sort(key=lambda r: -r["busy_s"])
+    return {"ceilings": {k: round(v, 3) for k, v in ceil.items()},
+            "rows": rows}
+
+
+def export_roofline() -> None:
+    """Stamp weedtpu_roofline_frac{resource,kernel} from the live kernel
+    profile — called on every /metrics render (stats/metrics.py), so the
+    TSDB/dashboard see the fractions at scrape cadence."""
+    from seaweedfs_tpu.stats import pipeline as _pipeline
+    if not _pipeline.perf_obs_enabled():
+        return
+    from seaweedfs_tpu.stats import metrics as _metrics
+    for row in roofline_snapshot()["rows"]:
+        frac = row.get("ceiling_frac")
+        if frac is not None:
+            _metrics.ROOFLINE_FRAC.labels(
+                row["resource"], row["kernel"]).set(frac)
+
+
 # -- /debug/pprof --------------------------------------------------------
 
 async def handle_debug_pprof(req):

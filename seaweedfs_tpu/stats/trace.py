@@ -737,11 +737,15 @@ def debug_guard(handler):
 
 def debug_routes():
     """Routes every server mounts (before any catch-all), loopback-gated
-    as one unit: /debug/traces, /debug/requests, /debug/pprof."""
+    as one unit: /debug/traces, /debug/requests, /debug/pprof,
+    /debug/pipeline."""
     from aiohttp import web
 
+    from seaweedfs_tpu.stats import pipeline as _pipeline
     from seaweedfs_tpu.stats import profile as _profile
     return [web.get("/debug/traces", debug_guard(handle_debug_traces)),
             web.get("/debug/requests", debug_guard(handle_debug_requests)),
             web.get("/debug/pprof",
-                    debug_guard(_profile.handle_debug_pprof))]
+                    debug_guard(_profile.handle_debug_pprof)),
+            web.get("/debug/pipeline",
+                    debug_guard(_pipeline.handle_debug_pipeline))]

@@ -22,6 +22,7 @@ from __future__ import annotations
 import functools
 import os
 import queue
+import sys
 import threading
 import time
 
@@ -81,6 +82,7 @@ def _get_codec(kind: str | None = None):
 # backend seam (ops/dispatch.py): parity dispatch, the d2h sync point,
 # and reconstruction, without backend imports in this layer
 from seaweedfs_tpu.stats import netflow as _netflow  # noqa: E402
+from seaweedfs_tpu.stats import pipeline as _pipeline  # noqa: E402
 from seaweedfs_tpu.stats import profile as _profile  # noqa: E402
 from seaweedfs_tpu.ops.dispatch import (  # noqa: E402
     dispatch_parity as _dispatch_parity,
@@ -318,8 +320,11 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
     parity of an all-zero row region is zero, so those regions become
     holes (_finalize_shards).  Partially-covered units encode only the
     rows that carry data, against a column-sliced parity matrix."""
-    if stats is not None:
-        stats["bytes"] = dat_size
+    # stage attribution always accumulates (even when the caller brought
+    # no dict): the stats keys feed the pipeline job /debug/pipeline
+    # renders, so a production encode is observable, not just a bench one
+    stats = stats if stats is not None else {}
+    stats["bytes"] = dat_size
     shard_size = layout.shard_file_size(dat_size, large_block, small_block)
     highwater = [0] * layout.TOTAL_SHARDS
     if dat_size == 0:
@@ -335,12 +340,13 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
     # the dedicated dispatch/drain threads edge out the serial loop even
     # on a 2-core host, and wider hosts only widen the gap
     use_serial = native_host and pipe == "serial"
-    if stats is not None:
-        stats["mode"] = "host-serial" if use_serial else "pipelined"
+    stats["mode"] = "host-serial" if use_serial else "pipelined"
 
     t_wall = time.perf_counter()
     import mmap as mmap_mod
-    with open(dat_path, "rb") as datf:
+    with _pipeline.track("ec_encode", stats, dat_size,
+                         meta={"mode": stats["mode"]}) as pjob, \
+            open(dat_path, "rb") as datf:
         dat_fd = datf.fileno()
         mm = _map_readonly(dat_fd, dat_size)
         dat_view = np.frombuffer(mm, dtype=np.uint8)
@@ -354,7 +360,7 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
                 _encode_pipelined(codec, dat_fd, dat_view, dat_size,
                                   large_block, small_block, batch_size,
                                   out_fds, highwater, progress, cancel,
-                                  stats)
+                                  stats, pjob)
         finally:
             del dat_view
             try:
@@ -363,12 +369,31 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
                 # an in-flight exception's traceback frames still hold
                 # views into the map; GC reaps the mapping with them
                 pass
-    if stats is not None:
         stats["wall_s"] = time.perf_counter() - t_wall
         frac = overlap_fraction(stats)
         if frac is not None:
             stats["overlap_frac"] = frac
+        # stage BYTES are analytic (the layout fixes them), booked once:
+        # zero hot-path cost, and the bottleneck verdict gets achieved
+        # GB/s per stage for its ceiling-fraction attribution
+        _book_stage_bytes(pjob, stats, dat_size,
+                          layout.PARITY_SHARDS * shard_size)
     _finalize_shards(out_fds, highwater, shard_size)
+
+
+def _book_stage_bytes(pjob, stats: dict, data_bytes: int,
+                      parity_bytes: int) -> None:
+    """Attribute the run's bytes to whichever stages actually ran (a
+    host-serial encode has no read/d2h stage; booking bytes against a
+    zero-second stage would invent infinite-GB/s rows)."""
+    for key, nbytes in (("read_s", data_bytes), ("encode_s", data_bytes),
+                        ("d2h_s", parity_bytes),
+                        ("write_data_s", data_bytes),
+                        ("write_parity_s", parity_bytes),
+                        ("reconstruct_s", data_bytes),
+                        ("write_s", parity_bytes)):
+        if stats.get(key):
+            pjob.add_bytes(key[:-2], nbytes)
 
 
 def _unit_steps(dat_size: int, large_block: int, small_block: int,
@@ -494,6 +519,7 @@ class _ShardWriterPool:
             queue.Queue(maxsize=(depth or WRITER_DEPTH) * shards_per)
             for _ in range(self._nworkers)]
         self._busy = [0.0] * len(self._fds)
+        self._wbytes = [0] * len(self._fds)
         self.errors: list[BaseException] = []
         self._threads = [
             threading.Thread(target=self._run, args=(w,),
@@ -565,6 +591,7 @@ class _ShardWriterPool:
                             releases.append(item[idx][3])
                             idx += 1
                         _pwritev_all(fd, bufs, off)
+                    self._wbytes[shard] += end - off
                     if self._hw is not None and end > self._hw[shard]:
                         self._hw[shard] = end
                 except BaseException as e:  # surfaced after close
@@ -598,9 +625,36 @@ class _ShardWriterPool:
         for t in self._threads:
             t.join()
         if self._stats is not None:
+            key_busy: dict[str, float] = {}
             for i, busy in enumerate(self._busy):
                 key = self._stage_key(i)
                 self._stats[key] = self._stats.get(key, 0.0) + busy
+                key_busy[key] = key_busy.get(key, 0.0) + busy
+            # stage seconds above are summed across N parallel shard
+            # slots: publish the capacity backing them so occupancy math
+            # (stats/pipeline busy_frac) divides by it instead of
+            # reading a 4-worker 30%-busy pool as a 120%-saturated
+            # stage.  The pool's threads split across its stages IN
+            # PROPORTION TO BUSY SECONDS — write_data and write_parity
+            # share one thread set, and naming each stage the full
+            # thread count would let a fully saturated pool read as two
+            # half-saturated stages and hand the bottleneck verdict to
+            # the wrong stage.  ACCUMULATED, not first-wins —
+            # fleet_convert's per-volume pools all fold into one shared
+            # stats dict, and their concurrent workers are all capacity
+            total_busy = sum(key_busy.values())
+            for key, busy_k in key_busy.items():
+                if key.endswith("_s") and total_busy > 0:
+                    wkey = key[:-2] + "_workers"
+                    self._stats[wkey] = self._stats.get(wkey, 0.0) + \
+                        self._nworkers * (busy_k / total_busy)
+        # the disk-side roofline row: shard writes vs the measured disk
+        # ceiling (stats/profile.roofline_snapshot special-cases this
+        # kernel onto the wall/bytes columns)
+        busy_total, wrote = sum(self._busy), sum(self._wbytes)
+        if busy_total > 0 and wrote > 0:
+            _profile.KERNELS.record("shard_write", "host", calls=0,
+                                    wall_s=busy_total, nbytes=wrote)
 
 
 FLUSH_BYTES = int(os.environ.get("WEEDTPU_EC_FLUSH_BYTES",
@@ -790,7 +844,8 @@ def _encode_serial_host(codec, dat_fd: int, dat_view: np.ndarray,
 def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
                       dat_size: int, large_block: int, small_block: int,
                       batch_size: int, out_fds, highwater,
-                      progress=None, cancel=None, stats=None) -> None:
+                      progress=None, cancel=None, stats=None,
+                      pjob=None) -> None:
     """Overlapped reader -> dispatch -> drain -> shard-writer pipeline.
 
     Stages, each on its own thread(s), all behind bounded queues so a
@@ -949,6 +1004,8 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
             item = q_read.get()
             if item is None:
                 break
+            if pjob is not None:  # stage-queue depth at the consume site
+                pjob.queue("q_read", q_read.qsize(), PIPELINE_DEPTH)
             buf, step, shard_off, coverage = item
             if errors or writers.failed:  # stop dispatching, surface below
                 if buf is not None:
@@ -1017,13 +1074,12 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     codec = _get_codec()
     use = present[: layout.DATA_SHARDS]
     shard_size = os.path.getsize(base + layout.to_ext(use[0]))
-    if stats is not None:
-        stats["bytes"] = shard_size * layout.DATA_SHARDS
+    stats = stats if stats is not None else {}
+    stats["bytes"] = shard_size * layout.DATA_SHARDS
 
     from seaweedfs_tpu.ops.native_codec import NativeRSCodec
     native_host = isinstance(codec, NativeRSCodec)
-    if stats is not None:
-        stats["mode"] = "host-serial" if native_host else "staged"
+    stats["mode"] = "host-serial" if native_host else "staged"
     if native_host:
         from seaweedfs_tpu import native
         dec_mat = codec.code.decode_matrix(list(use), list(missing))
@@ -1033,30 +1089,41 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     # network hop made on this thread while we run — a remote
     # shard_reader for survivors not on local disk — books as repair
     _flow_token = _netflow.set_class(_netflow.current_class() or "repair")
+    pjob = _pipeline.track("ec_rebuild", stats,
+                           shard_size * layout.DATA_SHARDS,
+                           meta={"missing": len(missing)})
     t_wall = time.perf_counter()
     import mmap as mmap_mod
-    ins = {i: open(base + layout.to_ext(i), "rb") for i in use}
+    ins: dict[int, object] = {}
     maps = {}
     views = {}
     tmp_paths = {i: base + layout.to_ext(i) + ".tmp" for i in missing}
-    out_fds = {i: os.open(p_, os.O_RDWR | os.O_CREAT, 0o644)
-               for i, p_ in tmp_paths.items()}
-    # reconstruction writes ride the same per-shard writer pool as the
-    # encode path: rebuilding 4 lost shards streams them to 4 concurrent
-    # workers while the next batch's decode matmul runs.  Pooled output
-    # buffers (countdown-released once every shard writer is done with
-    # its row) keep the decode from racing its own in-flight writes.
-    wpos = {i: r for r, i in enumerate(missing)}
-    writers = _ShardWriterPool([out_fds[i] for i in missing], None, stats,
-                               stage_key=lambda i: "write_s")
-    opool: queue.Queue = queue.Queue()
-    for _ in range(PIPELINE_DEPTH):
-        opool.put(np.empty(
-            (len(missing), min(batch_size, max(shard_size, 1))),
-            dtype=np.uint8))
+    out_fds: dict[int, int] = {}
+    writers = None
     stage = None
     ok = False
+    # setup runs under the same finally that seals the job: a survivor
+    # deleted between the present-list and open (a racing repair), or
+    # ENOSPC on the tmp outputs, must not leak a forever-"running"
+    # ec_rebuild entry on /debug/pipeline
     try:
+        for i in use:
+            ins[i] = open(base + layout.to_ext(i), "rb")
+        for i, p_ in tmp_paths.items():
+            out_fds[i] = os.open(p_, os.O_RDWR | os.O_CREAT, 0o644)
+        # reconstruction writes ride the same per-shard writer pool as the
+        # encode path: rebuilding 4 lost shards streams them to 4 concurrent
+        # workers while the next batch's decode matmul runs.  Pooled output
+        # buffers (countdown-released once every shard writer is done with
+        # its row) keep the decode from racing its own in-flight writes.
+        wpos = {i: r for r, i in enumerate(missing)}
+        writers = _ShardWriterPool([out_fds[i] for i in missing], None,
+                                   stats, stage_key=lambda i: "write_s")
+        opool: queue.Queue = queue.Queue()
+        for _ in range(PIPELINE_DEPTH):
+            opool.put(np.empty(
+                (len(missing), min(batch_size, max(shard_size, 1))),
+                dtype=np.uint8))
         for i, f in ins.items():
             if shard_size:
                 mm = _map_readonly(f.fileno(), shard_size)
@@ -1104,15 +1171,25 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
             raise writers.errors[0]
         for fd in out_fds.values():
             os.ftruncate(fd, shard_size)
-        if stats is not None:
-            stats["wall_s"] = time.perf_counter() - t_wall
-            frac = overlap_fraction(stats)
-            if frac is not None:
-                stats["overlap_frac"] = frac
+        stats["wall_s"] = time.perf_counter() - t_wall
+        frac = overlap_fraction(stats)
+        if frac is not None:
+            stats["overlap_frac"] = frac
+        _book_stage_bytes(pjob, stats,
+                          shard_size * layout.DATA_SHARDS,
+                          shard_size * len(missing))
         ok = True
     finally:
         _netflow.reset(_flow_token)
-        writers.close()  # idempotent; the fds must outlive the workers
+        if writers is not None:
+            writers.close()  # idempotent; the fds must outlive the workers
+        # seal the job only after close() folded the writer-pool busy
+        # seconds into stats — finish() exports the stage counters, and
+        # a failed rebuild must not export zero write-stage occupancy.
+        # The in-flight exception (ENOSPC, vanished survivor) is the
+        # error operators triage from /debug/pipeline, not a generic tag
+        pjob.finish(None if ok else
+                    (sys.exc_info()[1] or "rebuild failed"))
         for f in ins.values():
             f.close()
         for i in list(views):

@@ -38,6 +38,7 @@ from typing import Callable
 import numpy as np
 
 from seaweedfs_tpu.stats import heat, trace
+from seaweedfs_tpu.stats import pipeline as _pipeline
 from seaweedfs_tpu.utils import resilience
 from seaweedfs_tpu.storage import idx as idxf
 from seaweedfs_tpu.storage import needle as ndl
@@ -400,7 +401,10 @@ class EcVolume:
         wanted = sorted({ranges[i][0] for i in todo})
         segs = [(ranges[i][1], ranges[i][2]) for i in todo]
         with trace.span("ec.gather_survivors", shards_lost=len(wanted),
-                        segs=len(segs)):
+                        segs=len(segs)), \
+                _pipeline.flow("ec_read").stage(
+                    "gather_survivors",
+                    nbytes=layout.DATA_SHARDS * sum(s for _, s in segs)):
             rows = self._gather_survivors(set(wanted), segs, shard_reader)
         codec = ec_files._get_codec()
         # one dispatch decodes every wanted shard over the WHOLE
@@ -411,7 +415,9 @@ class EcVolume:
         # per-call orchestration cost this engine exists to amortize
         with trace.span("ec.reconstruct_batch", intervals=len(todo),
                         shards=len(wanted),
-                        bytes=sum(s for _, s in segs)):
+                        bytes=sum(s for _, s in segs)), \
+                _pipeline.flow("ec_read").stage(
+                    "reconstruct", nbytes=sum(s for _, s in segs)):
             rebuilt = ec_files._reconstruct_batch(codec, rows, wanted)
         self._bump("reconstruct_batches")
         self._bump("reconstruct_intervals", len(todo))
@@ -473,7 +479,10 @@ class EcVolume:
             else:
                 probe.append(ri)
         # local reads, concurrent when there is anything to overlap
-        with trace.span("ec.local_pread", reads=len(probe)) as lsp:
+        with trace.span("ec.local_pread", reads=len(probe)) as lsp, \
+                _pipeline.flow("ec_read").stage(
+                    "local_pread",
+                    nbytes=sum(reads[ri][2] for ri in probe)):
             if len(probe) == 1:
                 ri = probe[0]
                 sid, off, size, _ = reads[ri]
@@ -534,7 +543,10 @@ class EcVolume:
 
             with trace.span("ec.remote_fetch", reads=len(failed),
                             hedge_ms=None if hedge_s is None else
-                            round(hedge_s * 1000.0, 1)) as rsp:
+                            round(hedge_s * 1000.0, 1)) as rsp, \
+                    _pipeline.flow("ec_read").stage(
+                        "remote_fetch",
+                        nbytes=sum(reads[ri][2] for ri in failed)):
                 rpool = ThreadPoolExecutor(max_workers=min(8, len(failed)))
                 futs = {rpool.submit(timed_fetch, *reads[ri][:3]): ri
                         for ri in failed}

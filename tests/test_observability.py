@@ -242,6 +242,23 @@ def test_cardinality_collapses_to_other():
     _lint_exposition(text)
 
 
+def test_remove_matching_retires_instance_series():
+    """A stopped server retires its own gauge children (volume_server
+    stop() drops its disk/volume capacity series) without touching other
+    instances' series — the registry-wide cardinality bound depends on
+    restarts not accumulating stale label sets."""
+    reg = metrics.Registry()
+    g = reg.gauge("weedtpu_test_capacity_bytes", "t", ("vs", "dir", "kind"))
+    for vs in ("127.0.0.1:1", "127.0.0.1:2"):
+        for kind in ("total", "used", "free"):
+            g.labels(vs, "/data", kind).set(1.0)
+    assert g.remove_matching(vs="127.0.0.1:1") == 3
+    remaining = {pairs for pairs, _ in g._pairs()}
+    assert len(remaining) == 3
+    assert all(dict(p)["vs"] == "127.0.0.1:2" for p in remaining)
+    assert g.remove_matching(vs="127.0.0.1:1") == 0, "idempotent"
+
+
 def test_openmetrics_counters_get_total_suffix():
     """A negotiating Prometheus parses OpenMetrics strictly: counter
     samples must end in _total with the family named without it."""
