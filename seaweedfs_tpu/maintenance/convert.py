@@ -14,9 +14,15 @@ storm to the serving path — so the scheduler:
 - paces launches through a token bucket (WEEDTPU_CONVERT_RATE volumes/s,
   WEEDTPU_CONVERT_BURST) and never converts on a node the repair planner
   is actively repairing — loss recovery always outranks conversion;
-- PAUSES while any alert named in WEEDTPU_CONVERT_PAUSE_ALERTS fires
-  (default: any rule carrying ``interference`` or ``disk_full`` in its
-  name), the live-signal throttle the ROADMAP names over static buckets;
+- PAUSES while any alert EXACTLY named in WEEDTPU_CONVERT_PAUSE_ALERTS
+  fires (default: ``interference_high,disk_full_soon``; exact-name
+  matching — substring matching let a rule like
+  ``no_interference_baseline`` pause conversion, the same bug class as
+  the internal-path prefix fix).  When the interference governor
+  (stats/interference.py) is active it supersedes the
+  ``interference_high`` pause: continuous rate backoff replaces the
+  binary stop, while capacity pauses (``disk_full_soon``) still halt
+  conversion outright — a full disk is not a pacing problem;
 - books every orchestration byte as netflow class=convert and rides the
   process retry budget (class ``convert``) with decorrelated-jitter
   backoff: a node that dies mid-conversion gets its volumes RE-QUEUED,
@@ -56,9 +62,10 @@ class ConvertScheduler:
         self.node_batch = node_batch if node_batch \
             else int(_env_float("WEEDTPU_CONVERT_BATCH", 4))
         self.pause_alerts = tuple(
-            s for s in os.environ.get("WEEDTPU_CONVERT_PAUSE_ALERTS",
-                                      "interference,disk_full").split(",")
-            if s)
+            s.strip() for s in os.environ.get(
+                "WEEDTPU_CONVERT_PAUSE_ALERTS",
+                "interference_high,disk_full_soon").split(",")
+            if s.strip())
         self.queued: list[int] = []
         self._queued_set: set[int] = set()
         self.active: set[int] = set()
@@ -102,17 +109,29 @@ class ConvertScheduler:
     # -- pacing gates ----------------------------------------------------
 
     def _paused_by_alert(self) -> str | None:
-        """Name of a firing alert that pauses conversion, if any
-        (substring match against WEEDTPU_CONVERT_PAUSE_ALERTS)."""
+        """Name of a firing alert that pauses conversion, if any.
+        EXACT-name matching against WEEDTPU_CONVERT_PAUSE_ALERTS — a
+        rule named ``no_interference_baseline`` must not pause anything
+        (the PR 12 exact-or-slash lesson, applied to alert names).  The
+        interference-pacing rule is skipped while the governor is
+        active: continuous backoff replaces the binary pause."""
         alerts = getattr(self.master, "alerts", None)
         if alerts is None or not self.pause_alerts:
             return None
+        governed: str | None = None
+        gov = getattr(self.master, "governor", None)
+        if gov is not None:
+            from seaweedfs_tpu.stats.interference import governor_enabled
+            if governor_enabled():
+                governed = gov.INTERFERENCE_ALERT
         try:
             for rule in alerts.status().get("rules", []):
                 if rule.get("state") != "firing":
                     continue
                 name = rule.get("name", "")
-                if any(p in name for p in self.pause_alerts):
+                if name == governed:
+                    continue  # the governor paces this one instead
+                if name in self.pause_alerts:
                     return name
         except Exception:
             return None

@@ -32,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import threading
 import time
 
 from seaweedfs_tpu.stats import metrics, netflow, trace
@@ -57,13 +58,24 @@ class TokenBucket:
     """Classic token bucket: `rate` tokens/s refill up to `burst`.  Caps
     how many repairs one tick may launch — re-protection traffic must not
     starve foreground I/O (the 1309.0186 lesson: recovery traffic
-    dominates steady-state load when unthrottled)."""
+    dominates steady-state load when unthrottled).
+
+    Thread-safe: ``try_acquire`` runs on the planner's event loop while
+    ``set_rate`` is called from the interference governor on the
+    aggregator thread (stats/interference.py), so both hold one lock."""
 
     def __init__(self, rate: float, burst: float):
         self.rate = float(rate)
         self.burst = float(burst)
         self.tokens = float(burst)
         self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens +
+                          (now - self._last) * self.rate)
+        self._last = now
 
     def try_acquire(self, n: float = 1.0) -> bool:
         # a request larger than burst (one production-sized shard can
@@ -71,14 +83,34 @@ class TokenBucket:
         # bucket is FULL and drives tokens negative: the long-run rate
         # stays bounded by `rate` paying off the debt, instead of the
         # request starving forever behind an unreachable threshold
-        now = time.monotonic()
-        self.tokens = min(self.burst, self.tokens +
-                          (now - self._last) * self.rate)
-        self._last = now
-        if self.tokens >= min(n, self.burst):
+        with self._lock:
+            self._refill()
+            if self.tokens >= min(n, self.burst):
+                self.tokens -= n
+                return True
+            return False
+
+    def set_rate(self, rate: float) -> None:
+        """Retarget the refill rate live (the governor's seam).  Tokens
+        accrued so far — including negative debt from an oversized
+        admission — are settled at the OLD rate first, so a retune never
+        forgives or inflates debt retroactively."""
+        with self._lock:
+            self._refill()
+            self.rate = max(0.0, float(rate))
+
+    def credit(self, n: float) -> None:
+        """Refund tokens (clamped at burst like any refill) — used when
+        a pre-debited repair never launched."""
+        with self._lock:
+            self.tokens = min(self.burst, self.tokens + n)
+
+    def force_debit(self, n: float) -> None:
+        """Unconditionally take tokens (may go negative): the naive-
+        fallback path moves more bytes than the reduced estimate it
+        debited, and the shortfall must still be paid off."""
+        with self._lock:
             self.tokens -= n
-            return True
-        return False
 
 
 def build_ledger(topo, scrub_reports: dict) -> dict[int, dict]:
@@ -472,12 +504,10 @@ class RepairPlanner:
                 if plan is not None:
                     # refund the cross-rack debit of a repair that never
                     # launched (clamped at burst like any refill)
-                    self.xrack_bucket.tokens = min(
-                        self.xrack_bucket.burst,
-                        self.xrack_bucket.tokens +
-                        (plan["est_xrack_bytes"]
-                         if self._reduced_enabled()
-                         else plan["naive_xrack_bytes"]))
+                    self.xrack_bucket.credit(
+                        plan["est_xrack_bytes"]
+                        if self._reduced_enabled()
+                        else plan["naive_xrack_bytes"])
                 break  # rate-limited: later ticks pick up the rest
             self._active_vids.add(vid)
             self._active_nodes[node] = self._active_nodes.get(node, 0) + 1
@@ -666,9 +696,9 @@ class RepairPlanner:
                     # cross-rack bytes, so force the shortfall into the
                     # bucket as debt — a cluster-wide fallback storm must
                     # still be throttled at the bytes it actually moves
-                    self.xrack_bucket.tokens -= max(
+                    self.xrack_bucket.force_debit(max(
                         0.0, plan["naive_xrack_bytes"]
-                        - plan["est_xrack_bytes"])
+                        - plan["est_xrack_bytes"]))
                     plan = None  # the tail must not record this twice
                 else:
                     with trace.span("repair.mount", vid=vid,

@@ -70,13 +70,22 @@ class RateLimiter:
         self.burst = burst_s
         self._next = time.monotonic()
 
+    def set_rate(self, bytes_per_s: float) -> None:
+        """Retarget the sustained rate live (a float store — atomic
+        under the GIL; the scrub thread reads it per chunk, so a
+        governor push takes effect mid-pass, not next pass)."""
+        self.rate = float(bytes_per_s)
+
     def throttle(self, nbytes: int) -> None:
-        if self.rate <= 0 or nbytes <= 0:
+        # read the rate ONCE: set_rate() flips it from another thread,
+        # and the zero-check must guard the same value we divide by
+        rate = self.rate
+        if rate <= 0 or nbytes <= 0:
             return
         now = time.monotonic()
         # credit at most `burst` seconds of idle time, then advance the
         # schedule by this chunk's transmit time at the target rate
-        self._next = max(self._next, now - self.burst) + nbytes / self.rate
+        self._next = max(self._next, now - self.burst) + nbytes / rate
         delay = self._next - now
         if delay > 0:
             time.sleep(delay)
@@ -206,11 +215,60 @@ class Scrubber:
                                                DEFAULT_WINDOW))
         self.report = report
         self.shard_reader_factory = shard_reader_factory
+        # this node's CONFIGURED rate: governor pushes arrive as a
+        # fraction of it (apply_governed_scale), so a node deliberately
+        # configured slower than the fleet default is scaled, never
+        # overridden upward to someone else's ceiling
+        self.configured_mbps = self.mbps
         self.last_scrub = 0.0
         self.last_summary: dict = {}
         self._stop = threading.Event()
         self._mu = threading.Lock()  # serializes concurrent scrub_once
         self._thread: threading.Thread | None = None
+        # the pass currently in flight keeps its limiter here so a
+        # governor retune (set_mbps) lands mid-pass, not next pass
+        self._limiter: RateLimiter | None = None
+        # operator pause latch: an explicit operator {"mbps": 0} sticks
+        # until an explicit operator resume — the governor's periodic
+        # governed=True re-pushes must never silently un-pause a node
+        # someone stopped mid-incident
+        self.operator_paused = False
+
+    def set_mbps(self, mbps: float, governed: bool = False) -> float:
+        """Retune the sustained scrub rate (pushed via
+        /admin/scrub_rate).  Applies to the active pass immediately and
+        to every later pass.  ``0`` PAUSES scrubbing (the
+        construction-time semantic): future passes skip and the active
+        pass stops at its next volume boundary — the live limiter keeps
+        its previous rate rather than taking 0, because a zero-rate
+        RateLimiter means *unthrottled*, the exact opposite of an
+        operator posting {"mbps": 0} mid-incident.  ``governed`` marks
+        the interference governor's pushes: they respect an operator
+        pause (no-op while latched) and never flip the latch; operator
+        calls (governed=False) set it — 0 latches, >0 releases.
+        Returns the rate in effect."""
+        mbps = max(0.0, float(mbps))
+        if governed:
+            if self.operator_paused:
+                return self.mbps  # the operator's stop wins
+        else:
+            self.operator_paused = mbps <= 0
+            self.configured_mbps = mbps  # new operator baseline
+        self.mbps = mbps
+        lim = self._limiter
+        if lim is not None and self.mbps > 0:
+            lim.set_rate(self.mbps * 1e6)
+        return self.mbps
+
+    def apply_governed_scale(self, scale: float) -> float:
+        """Governor seam: scale THIS node's configured rate by the
+        fleet backoff fraction (0..1].  A node started with
+        WEEDTPU_SCRUB_MBPS=2 in an 8-default fleet governs to 2 x scale
+        — its deliberate config is scaled, never raised to the master's
+        ceiling.  Respects the operator pause latch like any governed
+        push."""
+        scale = max(0.0, min(1.0, float(scale)))
+        return self.set_mbps(self.configured_mbps * scale, governed=True)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -247,15 +305,20 @@ class Scrubber:
         # every remote byte this pass pulls (peer shard reads for the
         # syndrome checks) books as class=scrub — the shard_reader
         # factory captures the ambient class right here on this thread
+        if self.mbps <= 0:
+            # paused (set_mbps(0) or WEEDTPU_SCRUB_MBPS=0): no pass
+            return {"ts": time.time(), "bytes": 0, "volumes": {},
+                    "paused": True}
         with self._mu, netflow.flow("scrub"), \
                 trace.span("scrub.pass", parent=trace.new_root()) \
                 as pass_span:
             limiter = RateLimiter(self.mbps * 1e6)
+            self._limiter = limiter
             vols: dict[str, dict] = {}
             total = 0
             for loc in self.store.locations:
                 for vid, v in list(loc.volumes.items()):
-                    if self._stop.is_set():
+                    if self._stop.is_set() or self.mbps <= 0:
                         break
                     if getattr(v, "backend_kind", "") == "remote" or \
                             getattr(v, "staging", False):
@@ -267,7 +330,7 @@ class Scrubber:
                     vols[str(vid)] = res
                     total += res.get("bytes", 0)
                 for vid, ev in list(loc.ec_volumes.items()):
-                    if self._stop.is_set():
+                    if self._stop.is_set() or self.mbps <= 0:
                         break
                     try:
                         res = self._scrub_ec(vid, ev, limiter)
@@ -276,6 +339,7 @@ class Scrubber:
                     vols[str(vid)] = res
                     total += res.get("bytes", 0)
             pass_span.set(volumes=len(vols), bytes=total)
+            self._limiter = None
             summary = {"ts": time.time(), "bytes": total, "volumes": vols}
             self.last_scrub = summary["ts"]
             self.last_summary = summary

@@ -306,7 +306,15 @@ class BrokerServer:
                 members = (await r.json()).get("Members", {})
                 candidates.update(members.get("broker", []))
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
-            pass
+            # ONE slow/failed registry fetch must not collapse the ring
+            # to {self}: that splits the brain — this broker briefly
+            # owns every partition, accepts appends under its solo
+            # ring, and the divergence reconciles by DROPPING whichever
+            # side's log is shorter.  Keep probing the known ring
+            # instead; genuinely dead peers still drop via the direct
+            # probe below, and the registry re-adds newcomers next
+            # cycle.
+            candidates.update(self.peer_brokers)
 
         async def probe(addr: str) -> str | None:
             if addr == self.url:
@@ -355,6 +363,17 @@ class BrokerServer:
                         self._follower_of(pi) == self.url
                     if mine and peer_next > parts[pi].next_offset:
                         await self._pull_state(peer, name, pi, parts[pi])
+
+    async def _catch_up(self, topic: str, pi: int,
+                        part: LocalPartition) -> None:
+        """Pull this partition's state from every live peer before the
+        first append under fresh ownership; load_snapshot keeps only a
+        log longer than ours, so this is an idempotent fast-forward to
+        the fleet's high-water mark."""
+        for peer in self.peer_brokers:
+            if peer == self.url:
+                continue
+            await self._pull_state(peer, topic, pi, part)
 
     async def _pull_state(self, peer: str, topic: str, pi: int,
                           part: LocalPartition) -> None:
@@ -470,7 +489,19 @@ class BrokerServer:
                     {"error": f"partition {pi} owner unreachable"},
                     status=503)
 
-        epoch = await self._ensure_epoch(str(Topic.parse(topic)), pi)
+        tkey = str(Topic.parse(topic))
+        if (tkey, pi) not in self.own_epoch:
+            # fresh ownership of this partition (the ring changed, or
+            # first publish ever): catch up from peers BEFORE the first
+            # append.  A takeover owner whose local log is short (it was
+            # neither owner nor follower before) would otherwise assign
+            # offsets from ITS next_offset, colliding with the log the
+            # previous owner's follower still holds — and anti-entropy
+            # resolves collisions by keeping the longer (old) log,
+            # silently DROPPING the fresh appends (observed as failover
+            # message loss under ring flap).
+            await self._catch_up(tkey, pi, part)
+        epoch = await self._ensure_epoch(tkey, pi)
         offset = await asyncio.to_thread(part.publish, key, value)
         fenced = await self._replicate_out(topic, pi, part, offset, key,
                                            value, epoch)

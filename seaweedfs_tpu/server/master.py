@@ -21,8 +21,9 @@ import aiohttp
 from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
-from seaweedfs_tpu.stats import (aggregate, heat, history, metrics, netflow,
-                                 pipeline, profile, trace)
+from seaweedfs_tpu.stats import (aggregate, heat, history, interference,
+                                 metrics, netflow, pipeline, profile, trace)
+from seaweedfs_tpu.utils import weedlog
 from seaweedfs_tpu.stats.canary import CanaryProber
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
 from seaweedfs_tpu.storage import types as t
@@ -126,6 +127,8 @@ class MasterServer:
             web.get("/cluster/traces", self.handle_cluster_traces),
             web.get("/cluster/canary", self.handle_cluster_canary),
             web.get("/cluster/history", self.handle_cluster_history),
+            web.get("/cluster/interference",
+                    self.handle_cluster_interference),
             web.get("/cluster/alerts", self.handle_cluster_alerts),
             web.get("/cluster/dashboard", self.handle_cluster_dashboard),
             web.get("/", self.handle_ui),
@@ -172,6 +175,13 @@ class MasterServer:
         self.alerts = history.AlertEngine(self.history,
                                           pin_fn=trace.pin_trace)
         self.forecaster = history.CapacityForecaster(self.history)
+        # interference plane (stats/interference.py): the per-node
+        # foreground-impact index rides the same scrape-observer seam,
+        # and the governor retunes the repair/convert/scrub rate
+        # limiters off it right after — the live-signal throttle that
+        # replaces static token buckets (ROADMAP item 3's follow-on)
+        self.interference = interference.InterferenceObservatory()
+        self.governor = interference.Governor(self, self.interference)
         self.aggregator.observers.append(self._on_scrape)
         # flight recorder: always-on canary probes through every gateway
         # path (stats/canary.py), feeding the SLO engine and pinning
@@ -228,6 +238,7 @@ class MasterServer:
         for q in list(self._vid_subscribers):
             q.put_nowait(None)
         await asyncio.to_thread(self.aggregator.stop)
+        self.interference.close()
         if self._session:
             await self._session.close()
         if self._runner:
@@ -405,6 +416,16 @@ class MasterServer:
             self.alerts.evaluate(ts)
         except Exception:
             log.warning("alert evaluation failed", exc_info=True)
+        try:
+            self.interference.observe(ts, per_node)
+        except Exception as e:
+            weedlog.warning("interference observe failed: %s", e,
+                            name="interference", exc_info=True)
+        try:
+            self.governor.tick(ts)
+        except Exception as e:
+            weedlog.warning("governor tick failed: %s", e,
+                            name="governor", exc_info=True)
 
     # -- historical telemetry plane --------------------------------------
 
@@ -446,6 +467,29 @@ class MasterServer:
         result = await asyncio.to_thread(
             self.history.query, series, labels, range_s, step, agg)
         return web.json_response(result)
+
+    async def handle_cluster_interference(self, req: web.Request
+                                          ) -> web.Response:
+        """/cluster/interference: the per-node foreground-impact index
+        (fractional foreground read-p99 inflation attributable to each
+        background traffic class) plus the governor's current rates and
+        retune decisions with their pinned trace ids.  ?refresh=1 runs
+        one scrape tick first — which observes the fresh deltas and
+        re-ticks the governor — the deterministic hook tests and
+        impatient operators drive.  Loopback-gated like every operator
+        surface (it names nodes and trace ids)."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        if req.query.get("refresh"):
+            try:
+                await asyncio.to_thread(self.aggregator.scrape_once)
+            except Exception:
+                log.warning("interference refresh pull failed",
+                            exc_info=True)
+        return web.json_response({
+            "interference": self.interference.snapshot(),
+            "governor": self.governor.status()})
 
     async def handle_cluster_alerts(self, req: web.Request
                                     ) -> web.Response:
@@ -828,6 +872,14 @@ class MasterServer:
             snap["history"] = self.history.status()
         except Exception:
             log.warning("alert status failed", exc_info=True)
+        try:
+            # interference headline + governed rates (cached state only;
+            # /cluster/interference has the per-node detail)
+            snap["interference"] = {
+                "classes": self.interference.fleet_index(),
+                "governor": self.governor.status()}
+        except Exception:
+            log.warning("interference status failed", exc_info=True)
         with self._heat_lock:
             cached = self._heat_cache
         if cached is not None:

@@ -170,6 +170,7 @@ class VolumeServer:
             web.get("/admin/file", self.handle_file_pull),
             web.post("/admin/query", self.handle_query),
             web.post("/admin/scrub", self.handle_scrub),
+            web.post("/admin/scrub_rate", self.handle_scrub_rate),
             web.post("/admin/faults", self.handle_faults),
             web.route("*", "/{fid:[^/]*,[^/]+}", self.handle_blob),
         ])
@@ -1802,6 +1803,40 @@ class VolumeServer:
         summary = await asyncio.to_thread(s.scrub_once)
         await asyncio.to_thread(self._report_scrub, summary)
         return web.json_response(summary)
+
+    async def handle_scrub_rate(self, req: web.Request) -> web.Response:
+        """Retune the background scrubber's sustained rate live —
+        the master's interference governor pushes here each retune
+        (stats/interference.py), marking itself with ``governed: true``
+        so an operator's explicit {"mbps": 0} pause is never silently
+        un-paused by the governor's periodic re-pushes.  Not
+        loopback-gated: like the other /admin control surfaces this is
+        cluster plumbing the master drives remotely.  Applies mid-pass;
+        a node with scrubbing disabled (WEEDTPU_SCRUB_MBPS=0) reports
+        mbps null."""
+        try:
+            body = await req.json()
+            scale = body.get("scale")
+            mbps = float(scale) if scale is not None \
+                else float(body.get("mbps"))
+        except (ValueError, TypeError, AttributeError):
+            # AttributeError: a valid-JSON non-object body ('[2.5]')
+            # has no .get — still the caller's 400, not our 500
+            return web.json_response({"error": "mbps or scale required"},
+                                     status=400)
+        if self.scrubber is None:
+            return web.json_response({"mbps": None})
+        if scale is not None:
+            # the governor's form: a fraction of THIS node's configured
+            # rate, so heterogeneous per-node WEEDTPU_SCRUB_MBPS values
+            # are scaled, never raised to the master's ceiling
+            out = self.scrubber.apply_governed_scale(mbps)
+        else:
+            out = self.scrubber.set_mbps(
+                mbps, governed=bool(body.get("governed")))
+        return web.json_response(
+            {"mbps": out,
+             "operator_paused": self.scrubber.operator_paused})
 
     async def handle_faults(self, req: web.Request) -> web.Response:
         """Test-only fault injection (maintenance/faults.py): flip bits,

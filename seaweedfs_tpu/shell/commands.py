@@ -713,6 +713,17 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
         print("capacity: " + " ".join(
             f"{d['vs']}:{d['dir']}={_fmt_eta(d['predicted_full_seconds'])}"
             for d in soon[:5]), file=out)
+    itf = st.get("interference") or {}
+    gov = itf.get("governor") or {}
+    if gov:
+        rates = " ".join(
+            f"{n}={t.get('rate'):g}/{t.get('ceiling'):g}"
+            for n, t in sorted((gov.get("targets") or {}).items()))
+        idx = " ".join(f"{c}={r.get('index'):g}" for c, r in
+                       sorted((itf.get("classes") or {}).items()))
+        print(f"governor: {'on' if gov.get('enabled') else 'OFF'} "
+              f"retunes={gov.get('retunes', 0)} {rates}"
+              + (f"  index: {idx}" if idx else ""), file=out)
 
 
 def _fmt_bytes(n: float) -> str:
@@ -1058,6 +1069,65 @@ def cmd_cluster_alerts(env: CommandEnv, args, out):
             ex = f" trace={g['exemplar']}" if g.get("exemplar") else ""
             print(f"    {g['state'].upper():8s} {lbl} value={val}{ex}",
                   file=out)
+
+
+@command("cluster.interference")
+def cmd_cluster_interference(env: CommandEnv, args, out):
+    """Live interference observatory + governor (/cluster/interference):
+    per background traffic class, the fleet foreground-impact index
+    (fractional foreground read-p99 inflation, worst node shown), the
+    governed rates (repair cross-rack budget, conversion pacing, fleet
+    scrub) against their floors/ceilings, and the last retune decisions
+    with their pinned traces.  -refresh runs one scrape+observe+retune
+    tick first; -json dumps raw.  Runbook: interference_high fires ->
+    cluster.interference (which class, which node, is the rate at its
+    floor) -> cluster.trace <retune trace_id> (what the governor did and
+    when)."""
+    flags = parse_flags(args)
+    params = {"refresh": "1"} if "refresh" in flags else {}
+    st = env.master_get("/cluster/interference", **params)
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    obs = st.get("interference") or {}
+    gov = st.get("governor") or {}
+    print(f"interference: {'on' if obs.get('enabled') else 'OFF'} "
+          f"ticks={obs.get('ticks', 0)} · governor: "
+          f"{'on' if gov.get('enabled') else 'OFF'} "
+          f"target={gov.get('target_index')} "
+          f"retunes={gov.get('retunes', 0)}", file=out)
+    classes = obs.get("classes") or {}
+    if classes:
+        for cls, rec in sorted(classes.items()):
+            print(f"  index {cls:12s} {rec.get('index', 0.0):7.4f}  "
+                  f"worst {rec.get('node', '-')}", file=out)
+    else:
+        print("  no impact measured yet (quiet fleet or no baseline)",
+              file=out)
+    for name, t in sorted((gov.get("targets") or {}).items()):
+        at = ""
+        if t.get("rate", 0) <= t.get("floor", 0):
+            at = "  [AT FLOOR]"
+        elif t.get("rate", 0) >= t.get("ceiling", 0):
+            at = "  [at ceiling]"
+        print(f"  rate  {name:12s} {t.get('rate'):>12g} "
+              f"(floor {t.get('floor'):g}, ceiling {t.get('ceiling'):g}, "
+              f"class {t.get('class')}, index {t.get('index')}){at}",
+              file=out)
+    for d in (gov.get("decisions") or [])[-5:]:
+        print(f"  retune {d.get('target'):12s} {d.get('direction'):4s} "
+              f"{d.get('from'):g} -> {d.get('to'):g} "
+              f"index={d.get('index')} trace={d.get('trace_id')}",
+              file=out)
+    nodes = obs.get("nodes") or {}
+    for node, rec in sorted(nodes.items()):
+        busy = {c: v for c, v in (rec.get("index") or {}).items()
+                if v > 0.001}
+        idx = " ".join(f"{c}={v:g}" for c, v in sorted(busy.items())) \
+            or "-"
+        print(f"  node {node}: quiet_p99="
+              f"{rec.get('quiet_p99_ms')}ms last_p99="
+              f"{rec.get('last_p99_ms')}ms index {idx}", file=out)
 
 
 @command("chaos.status")

@@ -599,8 +599,9 @@ class ClusterAggregator:
                 try:
                     ob(ts, payload)
                 except Exception as e:  # an observer must not kill scrapes
-                    weedlog.V(1, "aggregate").infof(
-                        "scrape observer failed: %s", e)
+                    weedlog.warning(
+                        "scrape observer failed: %s", e,
+                        name="aggregate", exc_info=True)
         return per_node
 
     def _synth_families(self) -> dict[str, dict]:

@@ -115,7 +115,8 @@ class CanaryProber:
                 # cluster that is clearly having a bad day
                 delay = bo.next()
                 weedlog.V(1, "canary").infof(
-                    "probe round failed: %s: %s", type(e).__name__, e)
+                    "probe round failed: %s: %s", type(e).__name__, e,
+                    exc_info=True)
 
     # -- probing ---------------------------------------------------------
 

@@ -587,6 +587,15 @@ _DEFAULT_ALERT_RULES = (
     # forecaster's gauges one tick after it computes them)
     "disk_full_soon=threshold,series=weedtpu_predicted_full_seconds,"
     "agg=min,window=120,op=lt,value=86400,for=60;"
+    # interference observatory (stats/interference.py): background work
+    # is costing foreground reads more than 50% p99 inflation on some
+    # node.  The governor reacts at 0.25, so by the time this fires
+    # pacing is already fully engaged; a fire that PERSISTS means
+    # backoff alone is not containing the impact and an operator should
+    # look (runbook: cluster.interference — is the rate [AT FLOOR]? —
+    # then cluster.trace of the latest retune decision)
+    "interference_high=threshold,series=weedtpu_interference_index,"
+    "agg=max,window=120,op=gt,value=0.5,for=30;"
     # tile-drift sentinel (stats/pipeline.py): the pinned Pallas tile no
     # longer wins its own micro-sweep by >10% — the r05 failure mode
     # (336 -> 108 GB/s off a stale pin) pages instead of shipping.  The
@@ -1122,6 +1131,13 @@ alerts: <span class="badge {badge.get(alerts.get('state', ''), '')}">{_h(alerts.
           rng, step) + "</table>"
       "<table>" + _spark_row(
           store, "tile drift", "weedtpu_tile_drift", None, "last",
+          rng, step) + "</table>")}
+{sect("Interference (foreground p99 inflation by class / governed rates)",
+      "<table>" + _spark_row(
+          store, "interference", "weedtpu_interference_index", None,
+          "max", rng, step) + "</table>"
+      "<table>" + _spark_row(
+          store, "governor", "weedtpu_governor_rate", None, "last",
           rng, step) + "</table>")}
 {sect("Repair backlog (unhealthy volumes)", "<table>" + _spark_row(
     store, "backlog", "weedtpu_volume_health", None, "max", rng, step)

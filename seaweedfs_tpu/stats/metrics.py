@@ -598,6 +598,25 @@ TILE_DRIFT_RATIO = REGISTRY.gauge(
     "weedtpu_tile_drift_ratio",
     "best candidate tile throughput / pinned tile throughput from the "
     "drift sentinel's last micro-sweep")
+# interference observatory + governor (stats/interference.py): the
+# foreground-impact index per node and background traffic class, the
+# governed rate per background-work target, and the retune event
+# counter — all recorded by the master's history store so retune
+# decisions are queryable as series after the fact.
+INTERFERENCE_INDEX = REGISTRY.gauge(
+    "weedtpu_interference_index",
+    "fractional foreground read-p99 inflation attributable to a "
+    "background traffic class (per node, EWMA over aggregator ticks; "
+    "0 = no measurable impact, 1.0 = p99 doubled)",
+    ("node", "class"))
+GOVERNOR_RATE = REGISTRY.gauge(
+    "weedtpu_governor_rate",
+    "current governed rate per background-work target (repair_xrack "
+    "bytes/s, convert volumes/s, scrub MB/s)", ("target",))
+GOVERNOR_RETUNES = REGISTRY.counter(
+    "weedtpu_governor_retunes_total",
+    "governor rate-retune decisions by target and direction (up/down)",
+    ("target", "direction"))
 # registry self-cost: stamped on every render (see Registry.render) so
 # the dashboard — itself fed from these series — can watch what the
 # telemetry plane costs

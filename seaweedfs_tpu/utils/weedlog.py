@@ -90,8 +90,8 @@ class _V:
     def __init__(self, logger: logging.Logger):
         self._logger = logger
 
-    def infof(self, fmt: str, *args) -> None:
-        self._logger.debug(fmt, *args, stacklevel=2)
+    def infof(self, fmt: str, *args, exc_info=None) -> None:
+        self._logger.debug(fmt, *args, stacklevel=2, exc_info=exc_info)
 
     info = infof
 
@@ -107,15 +107,20 @@ def V(n: int, name: str = "weed"):
     return _NOOP
 
 
-def info(fmt: str, *args, name: str = "weed") -> None:
+def info(fmt: str, *args, name: str = "weed", exc_info=None) -> None:
     """Always-on INFO line (glog.Infof): not gated by verbosity — used
-    for operator-facing events like slow-request reports."""
-    logging.getLogger(name).info(fmt, *args, stacklevel=2)
+    for operator-facing events like slow-request reports.  ``exc_info``
+    forwards to stdlib logging (True inside an except block appends the
+    traceback — background loops that must survive anything can still
+    say WHERE they failed, the gap PR 6's canary loop hit)."""
+    logging.getLogger(name).info(fmt, *args, stacklevel=2,
+                                 exc_info=exc_info)
 
 
-def warning(fmt: str, *args, name: str = "weed") -> None:
-    """Always-on WARNING line (glog.Warningf)."""
-    logging.getLogger(name).warning(fmt, *args, stacklevel=2)
+def warning(fmt: str, *args, name: str = "weed", exc_info=None) -> None:
+    """Always-on WARNING line (glog.Warningf); ``exc_info`` as info()."""
+    logging.getLogger(name).warning(fmt, *args, stacklevel=2,
+                                    exc_info=exc_info)
 
 
 # rate-limited warnings: key -> [monotonic ts of last emit, suppressed]

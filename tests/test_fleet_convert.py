@@ -239,13 +239,16 @@ def test_scheduler_requeues_on_node_failure():
 
 
 def test_scheduler_pauses_on_interference_alert():
+    # exact-name matching (ISSUE 14): the default pause list names the
+    # actual default rules; a rule merely CONTAINING "interference"
+    # must not pause (tests/test_interference.py covers that edge)
     from seaweedfs_tpu.maintenance.convert import ConvertScheduler
     master = _StubMaster({"n1:80": [4]},
-                         firing=("repair_interference_p99",))
+                         firing=("interference_high",))
     sched = ConvertScheduler(master, rate=100.0, burst=100.0)
     sched.enqueue([4])
     assert _tick(sched) == []
-    assert sched.status()["paused"] == "repair_interference_p99"
+    assert sched.status()["paused"] == "interference_high"
     assert sched.queued == [4]  # still queued, resumes when it clears
     master.alerts._firing = ()
     assert _tick(sched)[0]["outcome"] == "ok"

@@ -338,7 +338,10 @@ class TestMqBrokerCluster:
         c.submit(fast1.start())
         c.submit(fast2.start())
         try:
-            deadline = time.time() + 15
+            # exit-early settle loop: the generous deadline only costs
+            # time when the host is too loaded for 0.3s peer refreshes
+            # to land promptly (observed under a full parallel suite)
+            deadline = time.time() + 40
             while time.time() < deadline and not (
                     len(fast1.peer_brokers) >= 3 and
                     len(fast2.peer_brokers) >= 3):
@@ -373,14 +376,16 @@ class TestMqBrokerCluster:
             # kill fast2; survivors re-route its partitions and still hold
             # every message via replication
             c.submit(fast2.stop())
-            deadline = time.time() + 20
+            deadline = time.time() + 40
             while time.time() < deadline and \
                     fast2.url in fast1.peer_brokers:
                 time.sleep(0.2)
             assert fast2.url not in fast1.peer_brokers
 
-            # give survivors a beat to pull any partitions they took over
-            deadline = time.time() + 15
+            # give survivors a beat to pull any partitions they took
+            # over (generous: the loops exit early on success, and under
+            # a full parallel suite 15s has measurably not been enough)
+            deadline = time.time() + 40
             while time.time() < deadline:
                 got = self._read_all(fast1.peer_brokers, topic, 4)
                 if len(got) == 60:
@@ -395,7 +400,7 @@ class TestMqBrokerCluster:
             # re-routing can still be replicating the newest appends
             for i in range(60, 80):
                 self._pub(fast1.url, topic, f"k{i}", f"v{i}".encode())
-            deadline = time.time() + 15
+            deadline = time.time() + 40
             while time.time() < deadline:
                 got = self._read_all(fast1.peer_brokers, topic, 4)
                 if len(got) == 80:

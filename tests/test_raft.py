@@ -186,11 +186,25 @@ def test_master_ha_cluster(tmp_path):
                 break
             time.sleep(0.05)
         assert leader is not None, "no master leader"
-        # follower tells clients who leads
+        # follower tells clients who leads — settle loop: right at
+        # election convergence the follower has not necessarily seen
+        # the new leader's first heartbeat yet, and a re-election can
+        # still move the crown mid-check
         follower = next(m for m in masters if m is not leader)
-        st = json.load(urllib.request.urlopen(
-            f"http://{follower.url}/cluster/status", timeout=5))
-        assert st["IsLeader"] is False and st["Leader"] == leader.url
+        st: dict = {}
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            st = json.load(urllib.request.urlopen(
+                f"http://{follower.url}/cluster/status", timeout=5))
+            if st["IsLeader"] is False and st["Leader"] == leader.url:
+                break
+            leaders = [m for m in masters if m.is_leader]
+            if len(leaders) == 1 and leaders[0] is not leader:
+                leader = leaders[0]
+                follower = next(m for m in masters if m is not leader)
+            time.sleep(0.2)
+        assert st.get("IsLeader") is False and \
+            st.get("Leader") == leader.url
         # volume server pointed at a FOLLOWER finds the leader
         (tmp_path / "v").mkdir(exist_ok=True)
         vs = VolumeServer([str(tmp_path / "v")], ",".join(peers[::-1]),
