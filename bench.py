@@ -858,7 +858,7 @@ def main() -> None:
                _bench_flow_canary_overhead, _bench_heat_overhead,
                _bench_history_overhead, _bench_perf_obs_overhead,
                _bench_interference_overhead,
-               _bench_serving_knee, _bench_chaos):
+               _bench_serving_knee, _bench_chaos, _bench_autopilot):
         try:
             fn(extra)
         except Exception as e:
@@ -1089,8 +1089,16 @@ INTERFERENCE_OVERHEAD_TOL = 0.97
 TRAJECTORY_TOL = 0.90
 # mesh + fleet joined the gate in round 12: r05 MEASURED the 83.7 GB/s
 # mesh regression but nothing failed, so it shipped
+# autopilot_p99_gate joined in round 15: shifting-Zipf foreground read
+# p99 autopilot-OFF over autopilot-ON, SATURATED at 1.1 before gating —
+# on an idle host the promote pays ~1.2-1.3x but concurrent host load
+# compresses both arms toward parity, so the raw ratio (recorded
+# ungated as autopilot_p99_ratio) would flap the gate; the clamp turns
+# it into "the autopilot must never make foreground p99 WORSE" (a
+# round where ON loses to OFF reads < 1 and fails against the 1.1 bar)
 TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1",
-                    "ec_encode_rs10_4_mesh", "fleet_convert_gbps")
+                    "ec_encode_rs10_4_mesh", "fleet_convert_gbps",
+                    "autopilot_p99_gate")
 # batch placement must stay within this fraction of the unsharded
 # single-call kernel at equal bytes (satellite gate, ISSUE 12)
 BATCH_PLACE_TOL = 0.90
@@ -2150,6 +2158,270 @@ def _bench_chaos(extra: dict, n_volumes: int = 3,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def _bench_autopilot(extra: dict, blobs_per_group: int = 18,
+                     size: int = 24 * 1024) -> None:
+    """Autopilot under a shifting-Zipf open-loop read workload (ISSUE 15):
+
+    autopilot_p99_ratio     foreground read p99 with the autopilot OFF
+                            over p99 with it ON (execute mode), in the
+                            settled window after the hotspot shifts
+                            onto an EC-tiered volume group.  OFF keeps
+                            the hot group on the EC read path forever;
+                            ON detects the sustained-hot volume and
+                            promotes it back to the mmap fast path, so
+                            >1 means the decision layer pays.  Gated
+                            via the bench trajectory (TRAJECTORY_GATED).
+    autopilot_heal_p99_*_ms p99 of the reads that overlapped the
+                            post-shift shard-loss heal, per arm
+                            (repair-interference view; informational —
+                            a single in-process rebuild burst is too
+                            bursty to gate a ratio on)
+    autopilot_promotes      promote actions the ON arm executed (0 would
+                            make the ratio vacuous: recorded + flagged)
+
+    Both arms run the identical schedule: two volume groups sealed to
+    EC up front (the demoted state), Zipf reads hot on group A shifting
+    to group B at half-time, shard loss on a PARKED volume healed
+    synchronously at the shift (the interference phase), then each
+    arm's decision loop runs to quiescence BEFORE the measured window —
+    the gated number compares steady serving paths, not whichever arm a
+    rebuild burst happened to land in.
+    """
+    import asyncio
+    import tempfile as _tf
+    import threading
+
+    from seaweedfs_tpu.maintenance import chaos as _chaos
+    from seaweedfs_tpu.maintenance import faults
+    from seaweedfs_tpu.maintenance.chaos import ChaosCluster
+    from seaweedfs_tpu.utils import resilience
+
+    overrides = {
+        "WEEDTPU_SCRUB_INTERVAL": "3600",
+        "WEEDTPU_REPAIR_INTERVAL": "3600",  # the bench drives ticks
+        "WEEDTPU_AGG_INTERVAL": "0",
+        "WEEDTPU_CONVERT_RATE": "100",
+        "WEEDTPU_CONVERT_BURST": "100",
+    }
+    old_env = {k: os.environ.get(k) for k in overrides}
+    old_mode = os.environ.get("WEEDTPU_AUTOPILOT")
+    os.environ.update(overrides)
+
+    def p99(samples):
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    def run_arm(mode: str):
+        """-> (settled-window read p99 s, promotes executed,
+        heal-phase read p99 s or None)."""
+        os.environ["WEEDTPU_AUTOPILOT"] = mode
+        with _tf.TemporaryDirectory(prefix="weedtpu-ap-") as d:
+            import pathlib
+            c = ChaosCluster(pathlib.Path(d), n_volume_servers=1,
+                             with_filer=False,
+                             heartbeat_interval=0.2).start()
+            try:
+                c.wait_heartbeats()
+                master = c.leader()
+                ap = master.autopilot
+                # bench-speed thresholds; demotes disabled mid-run (the
+                # sealed setup IS the demoted state, and re-demote churn
+                # would measure the scheduler, not the promote payoff)
+                ap.hot_rps = 0.5
+                ap.hot_s = 1.0
+                ap.cooldown_s = 0.0
+                ap.cold_s = 1e9
+                client = c.client()
+                rng = np.random.default_rng(0xA117)
+                groups: list[list[str]] = []
+                payload: dict[str, bytes] = {}
+                for gi, collection in enumerate(("", "tier2", "parked")):
+                    fids = []
+                    for i in range(blobs_per_group):
+                        data = rng.integers(0, 256, size,
+                                            dtype=np.uint8).tobytes()
+                        fid = client.upload(data, name=f"g{gi}-{i}.bin",
+                                            collection=collection)
+                        payload[fid] = data
+                        fids.append(fid)
+                    groups.append(fids)
+                vs = c.volume_servers[0]
+                vids = sorted({vid for loc in vs.store.locations
+                               for vid in loc.volumes})
+                for v in vids:
+                    vs.store.get_volume(v).nm.flush()
+                time.sleep(0.5)
+                # the demoted state, identically in both arms: every
+                # volume sealed to EC (shard set serves, .dat retired)
+                master.convert.enqueue(vids, seal=True)
+                c.submit(asyncio.wait_for(master.convert.tick(), 120))
+                assert master.convert.status()["converted"] == \
+                    len(vids), master.convert.status()
+                time.sleep(0.5)
+                # warm pass: cold-path costs must not skew either arm
+                for fid in payload:
+                    client.download(fid)
+
+                half = 2.5              # hotspot shift time
+                window_s = 5.0          # measured window length
+                lats: list[tuple[float, float]] = []
+                lats_lock = threading.Lock()
+                stop = threading.Event()
+                t0 = time.perf_counter()
+
+                def reader(seed):
+                    from seaweedfs_tpu.client import WeedClient
+                    # one pooled (keep-alive) client per thread: a
+                    # fresh TCP dial per request costs ~10 ms on this
+                    # host and would bury the serving-path difference
+                    cl = WeedClient(master.url)
+                    r = np.random.default_rng(seed)
+                    zipf = r.zipf(1.4, size=4096)
+                    j = 0
+                    mine = []
+                    while not stop.is_set():
+                        now = time.perf_counter() - t0
+                        hot, cold = (groups[0], groups[1]) \
+                            if now < half else (groups[1], groups[0])
+                        grp = hot if r.random() < 0.85 else cold
+                        fid = grp[int(zipf[j % len(zipf)]) % len(grp)]
+                        j += 1
+                        t1 = time.perf_counter()
+                        try:
+                            got = cl.download(fid)
+                        except (OSError, RuntimeError):
+                            continue
+                        if got == payload[fid]:
+                            mine.append((now,
+                                         time.perf_counter() - t1))
+                    cl.close()
+                    with lats_lock:
+                        lats.extend(mine)
+
+                readers = [threading.Thread(target=reader, args=(s,),
+                                            daemon=True)
+                           for s in (11, 12, 13, 14, 15, 16)]
+                for r in readers:
+                    r.start()
+                # phase 1: hotspot on group A until the shift
+                while time.perf_counter() - t0 < half:
+                    master.collect_heat()
+                    c.submit(asyncio.wait_for(master.autopilot.tick(),
+                                              30))
+                    time.sleep(0.3)
+                # the shift: repair interference fires in BOTH arms —
+                # shards lost on the PARKED (never-read) volume, healed
+                # synchronously while the readers hammer the new
+                # hotspot; its p99 is recorded separately below
+                heal_t0 = time.perf_counter() - t0
+                ev_vid = next(
+                    (v for v in vids
+                     if v not in {int(f.partition(",")[0])
+                                  for f in groups[0] + groups[1]}
+                     and vs.store.get_ec_volume(v) is not None), None)
+                if ev_vid is not None:
+                    ev = vs.store.get_ec_volume(ev_vid)
+                    for sid in ev.shard_ids()[:2]:
+                        faults.delete_shard(vs.store, ev_vid, sid)
+                    c.submit(vs._heartbeat_once())
+                    c.drive_repair(wait=True)
+                heal_t1 = time.perf_counter() - t0
+                # run the decision loop to quiescence: the gated window
+                # must compare steady serving paths, so the promote's
+                # detection + decode (ON arm) happens HERE, not inside
+                # the measurement.  The condition is a done promote of a
+                # GROUP B volume specifically — phase 1 may already have
+                # promoted the then-hot group A, which must not satisfy
+                # the wait for the post-shift hotspot
+                b_vids = {int(f.partition(",")[0]) for f in groups[1]}
+                quiesce_deadline = time.perf_counter() + 4.0
+                while time.perf_counter() < quiesce_deadline:
+                    master.collect_heat()
+                    c.submit(asyncio.wait_for(master.autopilot.tick(),
+                                              30))
+                    c.submit(asyncio.wait_for(
+                        master.autopilot.wait_idle(), 60))
+                    if mode != "execute" or any(
+                            p["policy"] == "tiering_promote"
+                            and p["state"] == "done"
+                            and p["vid"] in b_vids
+                            for p in master.autopilot.plans.values()):
+                        break
+                    time.sleep(0.3)
+                settle = time.perf_counter() - t0 + 0.3
+                time.sleep(window_s + 0.3)
+                stop.set()
+                for rt in readers:
+                    rt.join(10)
+                promotes = sum(
+                    1 for p in master.autopilot.plans.values()
+                    if p["policy"] == "tiering_promote"
+                    and p["state"] == "done")
+                heal = [l for ts, l in lats
+                        if heal_t0 <= ts < heal_t1]
+                window = [(ts, l) for ts, l in lats if ts >= settle]
+                client.close()
+                if len(window) < 200:
+                    raise RuntimeError(
+                        f"only {len(window)} settled-window samples")
+                # median of per-second sub-window p99s: still a tail
+                # statistic, but one host stall (GC, scheduler hiccup —
+                # 50-100 ms on this virtualized host) corrupts one
+                # sub-window instead of owning the whole arm's p99;
+                # measured run-to-run spread drops ~3x vs a raw p99
+                buckets: dict[int, list[float]] = {}
+                for ts, l in window:
+                    buckets.setdefault(int(ts - settle), []).append(l)
+                sub = sorted(p99(b) for b in buckets.values()
+                             if len(b) >= 50)
+                if not sub:
+                    raise RuntimeError("no populated sub-windows")
+                return (sub[len(sub) // 2], promotes,
+                        p99(heal) if len(heal) >= 20 else None)
+            finally:
+                c.stop()
+                resilience.reset_breakers()
+                _chaos.faults.clear_net()
+
+    try:
+        p_off, _, heal_off = run_arm("0")
+        p_on, promotes, heal_on = run_arm("execute")
+        extra["autopilot_p99_off_ms"] = round(p_off * 1000.0, 2)
+        extra["autopilot_p99_on_ms"] = round(p_on * 1000.0, 2)
+        if heal_off is not None:
+            extra["autopilot_heal_p99_off_ms"] = round(
+                heal_off * 1000.0, 2)
+        if heal_on is not None:
+            extra["autopilot_heal_p99_on_ms"] = round(
+                heal_on * 1000.0, 2)
+        extra["autopilot_promotes"] = promotes
+        if promotes == 0:
+            # vacuity guard: an ON arm that never promoted measured
+            # nothing — record the miss, do NOT record a fake ratio
+            extra["autopilot_bench_vacuous"] = True
+            print("bench: autopilot ON arm executed zero promotes; "
+                  "autopilot_p99_ratio not recorded", file=sys.stderr)
+        else:
+            ratio = p_off / max(p_on, 1e-9)
+            extra["autopilot_p99_ratio"] = round(ratio, 3)
+            # the TRAJECTORY_GATED twin, saturated at 1.1: host load
+            # compresses both arms toward parity (measured: 1.25 idle
+            # -> 1.03 under a concurrent test suite), so the gate
+            # asserts "never worse than off" rather than chasing the
+            # idle-host margin round over round
+            extra["autopilot_p99_gate"] = round(min(ratio, 1.1), 3)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if old_mode is None:
+            os.environ.pop("WEEDTPU_AUTOPILOT", None)
+        else:
+            os.environ["WEEDTPU_AUTOPILOT"] = old_mode
 
 
 def _bench_flow_canary_overhead(extra: dict, n: int = 1200,

@@ -211,17 +211,28 @@ class WeedClient:
         if self.jwt_read_signer:
             headers["Authorization"] = "Bearer " + self.jwt_read_signer(fid)
         last_err: Exception | None = None
-        for url in self.lookup(vid):
-            try:
-                status, _, body = self._http.request(
-                    f"{_tls_scheme()}://{url}/{fid}", headers=headers,
-                    timeout=self.timeout)
-            except (_hc.HTTPException, OSError) as e:
-                last_err = e
-                continue
-            if status < 300:
-                return body
-            last_err = RuntimeError(f"{url}/{fid}: HTTP {status}")
+        # two passes: the cached locations first, then — when EVERY
+        # cached location failed — one fresh master lookup.  A volume
+        # the autopilot moved or re-tiered between servers answers 404
+        # at its old home for up to a cache TTL; the re-lookup makes
+        # that window invisible instead of an error (the reference
+        # wdclient invalidates and retries the same way).
+        for attempt in range(2):
+            for url in self.lookup(vid):
+                try:
+                    status, _, body = self._http.request(
+                        f"{_tls_scheme()}://{url}/{fid}", headers=headers,
+                        timeout=self.timeout)
+                except (_hc.HTTPException, OSError) as e:
+                    last_err = e
+                    continue
+                if status < 300:
+                    return body
+                last_err = RuntimeError(f"{url}/{fid}: HTTP {status}")
+            if attempt == 0 and vid in self._vid_cache:
+                del self._vid_cache[vid]  # stale route: re-ask the master
+            else:
+                break
         raise RuntimeError(f"download {fid} failed: {last_err or 'no locations'}")
 
     def delete(self, fid: str) -> None:

@@ -806,6 +806,67 @@ def _fault_convert_mid_failure(c: ChaosCluster, ctx: dict) -> None:
     time.sleep(2 * c.heartbeat_interval + 0.2)  # shard heartbeats land
 
 
+def _fault_move_mid_failure(c: ChaosCluster, ctx: dict) -> None:
+    """Kill the TARGET volume server mid-/admin/volume/move: the move
+    must abort cleanly — 500 to the caller, no partial or staged state
+    mounted on either side, the source thawed back to writable and
+    still serving every byte — and the restarted target must boot with
+    NO orphan files (its DiskLocation cleanup deletes crash leftovers).
+    A re-run of the same move must then succeed: the abort left a
+    re-runnable state, which is the whole contract."""
+    import glob as _glob
+    import threading as _threading
+    src = c.volume_servers[0]
+    dst = c.volume_servers[1]
+    vids = sorted({vid for loc in src.store.locations
+                   for vid in loc.volumes})
+    assert vids, "workload left no plain volumes on node 0"
+    vid = vids[0]
+    body = json.dumps({"volume": vid, "target": dst.url}).encode()
+    # stall the source's peer file pulls so the target is reliably
+    # mid-transfer when it dies
+    src._fault_delay_file_pull = 0.6
+    result: dict = {}
+
+    def mover():
+        try:
+            result["status"], result["body"], _ = _req(
+                f"http://{src.url}/admin/volume/move", method="POST",
+                data=body, headers={"Content-Type": "application/json"},
+                timeout=120)
+        except OSError as e:  # the source itself must not die
+            result["error"] = str(e)
+
+    t = _threading.Thread(target=mover, daemon=True)
+    t.start()
+    time.sleep(0.3)  # the target is now inside the staged pull
+    c.restart_volume_server(1, downtime=0.5)
+    t.join(120)
+    src._fault_delay_file_pull = 0.0
+    assert result.get("status") == 500, result  # clean abort, reported
+    v = src.store.get_volume(vid)
+    assert v is not None and not v.read_only  # source thawed + serving
+    dst = c.volume_servers[1]  # the restarted instance
+    assert dst.store.get_volume(vid) is None  # no half-copy mounted
+    for vs in (src, dst):
+        leftovers = [p for loc in vs.store.locations
+                     for pat in ("*.cpd", "*.cpx", "*.staging",
+                                 "*.cptail")
+                     for p in _glob.glob(os.path.join(loc.directory,
+                                                      pat))]
+        assert not leftovers, f"orphan files after abort: {leftovers}"
+    c.wait_heartbeats()
+    # abort left a re-runnable state: the same move now completes
+    status, out, _ = _req(
+        f"http://{src.url}/admin/volume/move", method="POST",
+        data=body, headers={"Content-Type": "application/json"},
+        timeout=120)
+    assert status == 200, out
+    assert src.store.get_volume(vid) is None
+    assert dst.store.get_volume(vid) is not None
+    time.sleep(2 * c.heartbeat_interval + 0.2)  # topology settles
+
+
 def _fault_partition(c: ChaosCluster, ctx: dict) -> None:
     """Partition every GATEWAY (client/shell/filer — and thereby s3 and
     MQ, which read through the filer) from node 1: reads must fail over
@@ -824,14 +885,16 @@ def _fault_master_failover(c: ChaosCluster, ctx: dict) -> None:
     c.fail_over_master()
 
 
-# faults that drive their own EC encode (the fault IS the conversion
-# under failure): run_scenario must not pre-encode the workload's
-# volumes for these — it would leave them nothing to convert
-SELF_ENCODING_FAULTS = frozenset({"convert_mid_failure"})
+# faults that must see PLAIN volumes (their own conversion, or a
+# volume move — both operate on .dat volumes): run_scenario must not
+# pre-encode the workload's volumes for these
+SELF_ENCODING_FAULTS = frozenset({"convert_mid_failure",
+                                  "move_mid_failure"})
 
 FAULTS = {
     "shard_loss": _fault_shard_loss,
     "convert_mid_failure": _fault_convert_mid_failure,
+    "move_mid_failure": _fault_move_mid_failure,
     "bit_rot": _fault_bit_rot,
     "slow_peer": _fault_slow_peer,
     "restart_mid_repair": _fault_restart_mid_repair,

@@ -41,7 +41,7 @@ import time
 
 from seaweedfs_tpu.maintenance.repair import TokenBucket, _env_float
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
-from seaweedfs_tpu.stats import netflow, trace
+from seaweedfs_tpu.stats import metrics, netflow, trace
 from seaweedfs_tpu.utils import resilience
 
 log = logging.getLogger("convert")
@@ -74,17 +74,36 @@ class ConvertScheduler:
         self.converted = 0
         self.failed_final = 0
         self.paused_reason: str | None = None
+        # re-queue bookkeeping, surfaced as weedtpu_convert_requeued_total
+        # and the /maintenance/convert "requeued" block: re-queues were
+        # only visible in logs, and the autopilot must see the parked
+        # backlog to avoid re-planning volumes already waiting here
+        self.requeued_by_reason: dict[str, int] = {}
+        # vids whose conversion should be SEALED on success: mount the
+        # shard set and delete the .dat/.idx, so the EC set SERVES (the
+        # autopilot demote's full tiering semantics; plain conversions
+        # keep the frozen .dat as the fast read path)
+        self._seal: set[int] = set()
+        # seals that converted but then half-failed (mounted, .dat not
+        # deleted — or neither): once the mount landed the ledger reads
+        # the vid as EC, so the autopilot never re-plans it; the tick
+        # retries these until the .dat is gone
+        self._seal_stuck: set[int] = set()
 
     # -- intake ----------------------------------------------------------
 
-    def enqueue(self, vids) -> list[int]:
-        """Queue volumes for conversion (idempotent per vid)."""
+    def enqueue(self, vids, seal: bool = False) -> list[int]:
+        """Queue volumes for conversion (idempotent per vid).  With
+        ``seal=True`` a successful conversion also mounts the shard set
+        and deletes the source .dat — the demote-to-EC tiering step."""
         accepted = []
         for v in vids:
             try:
                 vid = int(v)
             except (TypeError, ValueError):
                 continue
+            if seal:
+                self._seal.add(vid)
             if vid in self._queued_set or vid in self.active:
                 continue
             self.queued.append(vid)
@@ -92,7 +111,8 @@ class ConvertScheduler:
             accepted.append(vid)
         return accepted
 
-    def requeue(self, vids, error: str) -> None:
+    def requeue(self, vids, error: str,
+                reason: str = "node_error") -> None:
         """A node call failed: its volumes go back on the queue with
         per-vid exponential backoff (decorrelated jitter), never lost."""
         now = time.monotonic()
@@ -103,6 +123,9 @@ class ConvertScheduler:
             if vid not in self._queued_set:
                 self.queued.append(vid)
                 self._queued_set.add(vid)
+            metrics.CONVERT_REQUEUED.labels(reason).inc()
+        self.requeued_by_reason[reason] = \
+            self.requeued_by_reason.get(reason, 0) + len(vids)
         log.warning("conversion re-queued %s after: %s",
                     sorted(vids), error)
 
@@ -164,6 +187,17 @@ class ConvertScheduler:
                                   "retry_in_s": round(max(0.0, ts - now),
                                                       1)}
                          for v, (f, ts) in self._backoff.items()},
+            # the re-queue backlog as structured data: total per reason
+            # plus the vids currently parked behind a backoff — the
+            # autopilot reads this (and `queued`/`active` above) so it
+            # never re-plans a volume already in the pipeline
+            "requeued": {
+                "total": sum(self.requeued_by_reason.values()),
+                "by_reason": dict(self.requeued_by_reason),
+                "parked": sorted(self._backoff),
+            },
+            "sealing": sorted(self._seal),
+            "seal_stuck": sorted(self._seal_stuck),
             "history": self.history[-10:],
         }
 
@@ -177,6 +211,7 @@ class ConvertScheduler:
         self.paused_reason = self._paused_by_alert()
         if self.paused_reason:
             return []
+        await self._retry_stuck_seals()
         if not self.queued:
             return []
         repair_active = dict(getattr(self.master.maintenance,
@@ -205,6 +240,7 @@ class ConvertScheduler:
         # volumes with no locatable .dat (already EC, deleted) drop out
         for vid in unplaceable:
             self._drop(vid)
+            self._seal.discard(vid)
             self.history.append({"vid": vid, "outcome": "unplaceable"})
         actions: list[dict] = []
         for node, vids in by_node.items():
@@ -271,10 +307,14 @@ class ConvertScheduler:
             self.converted += len(done)
             for vid in vids:
                 self._backoff.pop(vid, None)
+            sealed = await self._seal_converted(node, done)
+            if sealed:
+                rec["sealed"] = sealed
             missed = [v for v in vids if v not in done]
             if missed:
                 # the node skipped some (busy/not found): try again later
-                self.requeue(missed, f"skipped by {node}")
+                self.requeue(missed, f"skipped by {node}",
+                             reason="skipped")
         except Exception as e:
             rec.update(outcome=f"error: {e}")
             self.requeue(vids, str(e))
@@ -284,3 +324,56 @@ class ConvertScheduler:
         rec["seconds"] = round(time.monotonic() - t0, 3)
         self.history.append(rec)
         return rec
+
+    async def _seal_converted(self, node: str, done: list[int]
+                              ) -> list[int]:
+        """Finish the demote for seal-flagged conversions: mount the
+        committed shard set and delete the source .dat/.idx, so the EC
+        set SERVES (and the disk space comes back).  Runs only AFTER
+        the tmp+rename commit — a seal failure leaves the safe
+        intermediate state (frozen .dat + full shard set), parked on
+        _seal_stuck and retried by later ticks (once the mount landed
+        the ledger reads the vid as EC, so the AUTOPILOT cannot re-plan
+        it — the retry must live here), never a volume with neither
+        copy."""
+        from seaweedfs_tpu.utils.http import post_json
+        sealed: list[int] = []
+        for vid in done:
+            if vid not in self._seal:
+                continue
+            try:
+                with netflow.flow("convert"), \
+                        trace.span("convert.seal", node=node, vid=vid):
+                    for path in ("/admin/ec/mount",
+                                 "/admin/volume/delete"):
+                        await post_json(self.master._session, node,
+                                        path, {"volume": vid},
+                                        timeout=60.0)
+                self._seal.discard(vid)
+                self._seal_stuck.discard(vid)
+                sealed.append(vid)
+            except Exception as e:
+                self._seal_stuck.add(vid)
+                log.warning("seal of converted volume %d on %s failed "
+                            "(stays frozen with its shard set; will "
+                            "retry): %s", vid, node, e)
+        return sealed
+
+    async def _retry_stuck_seals(self) -> None:
+        """Finish seals whose mount/delete hop failed after the
+        conversion committed.  Both steps are idempotent (re-mount of a
+        mounted set is a no-op, delete of a deleted .dat is a no-op),
+        so retrying is always safe; a vid whose node is gone stays
+        parked for the node's return."""
+        for vid in list(self._seal_stuck):
+            if vid in self.active:
+                continue
+            node = self._node_of(vid)
+            if node is None:
+                # .dat already gone (delete succeeded, mount was the
+                # failure — or the node left): nothing further to seal
+                # here once no node reports the plain volume
+                self._seal_stuck.discard(vid)
+                self._seal.discard(vid)
+                continue
+            await self._seal_converted(node, [vid])

@@ -12,6 +12,9 @@ through the loopback-only /admin/faults endpoint:
                                 silent corruption the scrubber must catch
   delay_shard_read:ms           stall every /admin/ec/shard_read response —
                                 a slow peer for degraded-read tests
+  delay_file_pull:ms            stall every /admin/file peer pull — holds a
+                                volume copy/move open so chaos cells can
+                                kill a node mid-transfer
 
 **Process-wide faults** (network + disk) — a module-level registry the
 HTTP stacks and the EC shard writer consult, so an in-process chaos
@@ -231,7 +234,7 @@ def parse_env(spec: str) -> list[dict]:
                             "shard": int(fields[2]),
                             "offset": int(fields[3]),
                             "bit": int(fields[4]) if len(fields) > 4 else 0})
-            elif action == "delay_shard_read":
+            elif action in ("delay_shard_read", "delay_file_pull"):
                 out.append({"action": action, "ms": float(fields[1])})
             elif action in ("partition", "unpartition"):
                 out.append({"action": action, "a": fields[1],

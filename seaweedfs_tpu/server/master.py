@@ -129,6 +129,8 @@ class MasterServer:
             web.get("/cluster/history", self.handle_cluster_history),
             web.get("/cluster/interference",
                     self.handle_cluster_interference),
+            web.route("*", "/cluster/autopilot",
+                      self.handle_cluster_autopilot),
             web.get("/cluster/alerts", self.handle_cluster_alerts),
             web.get("/cluster/dashboard", self.handle_cluster_dashboard),
             web.get("/", self.handle_ui),
@@ -175,6 +177,14 @@ class MasterServer:
         self.alerts = history.AlertEngine(self.history,
                                           pin_fn=trace.pin_trace)
         self.forecaster = history.CapacityForecaster(self.history)
+        # autopilot (maintenance/autopilot.py): the policy engine that
+        # turns heat/forecast/health telemetry into typed, dry-run-able
+        # action plans (tiering, balancing).  Constructed BEFORE the
+        # governor so its per-policy pacing buckets register as
+        # governed targets like repair/convert/scrub.
+        from seaweedfs_tpu.maintenance.autopilot import Autopilot
+        self.autopilot = Autopilot(self)
+        self._autopilot_task: asyncio.Task | None = None
         # interference plane (stats/interference.py): the per-node
         # foreground-impact index rides the same scrape-observer seam,
         # and the governor retunes the repair/convert/scrub rate
@@ -233,6 +243,10 @@ class MasterServer:
             self._repair_task.cancel()
         if self._convert_task:
             self._convert_task.cancel()
+        if self._autopilot_task:
+            self._autopilot_task.cancel()
+        for t in list(self.autopilot._tasks):
+            t.cancel()  # in-flight plan executions die with the master
         # wake /cluster/stream subscribers so their handlers return and
         # runner.cleanup() doesn't wait out its shutdown timeout on them
         for q in list(self._vid_subscribers):
@@ -391,12 +405,25 @@ class MasterServer:
             if t is None or t.done():
                 self._convert_task = asyncio.create_task(
                     self._convert_tick_once())
+            # the autopilot rides the same cadence, also as its own
+            # non-overlapping task: a promote decode or a volume move
+            # can hold its actuator call open for minutes
+            t = self._autopilot_task
+            if t is None or t.done():
+                self._autopilot_task = asyncio.create_task(
+                    self._autopilot_tick_once())
 
     async def _convert_tick_once(self) -> None:
         try:
             await self.convert.tick()
         except Exception:
             log.warning("convert tick failed", exc_info=True)
+
+    async def _autopilot_tick_once(self) -> None:
+        try:
+            await self.autopilot.tick()
+        except Exception:
+            log.warning("autopilot tick failed", exc_info=True)
 
     def _on_scrape(self, ts: float, per_node: dict) -> None:
         """Aggregator scrape observer: record the tick into history, then
@@ -490,6 +517,51 @@ class MasterServer:
         return web.json_response({
             "interference": self.interference.snapshot(),
             "governor": self.governor.status()})
+
+    async def handle_cluster_autopilot(self, req: web.Request
+                                       ) -> web.Response:
+        """/cluster/autopilot: the decision ledger — mode, per-policy
+        pacing buckets, hysteresis clocks, and every plan with its
+        state and pinned trace id.  POST drives the state machine:
+        {"tick": true} runs one deterministic policy pass (tests, the
+        bench, impatient operators), {"approve": "<id>"} executes one
+        plan (the plan-mode runbook step), {"abort": "<id>"} kills a
+        not-yet-executing plan, {"wait": true} blocks until launched
+        executions settle.  Loopback-gated like every operator surface
+        (plans name nodes, volumes, and trace ids)."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        if req.method == "GET":
+            return web.json_response(self.autopilot.status())
+        if req.method != "POST":
+            return web.json_response({"error": "method not allowed"},
+                                     status=405)
+        if not self.is_leader:
+            return self._not_leader_response()
+        try:
+            body = await req.json()
+        except ValueError:
+            body = {}
+        out: dict = {}
+        try:
+            if body.get("approve"):
+                out["approved"] = self.autopilot.serialize_plan(
+                    self.autopilot.approve(str(body["approve"])))
+            if body.get("abort"):
+                out["aborted"] = self.autopilot.serialize_plan(
+                    self.autopilot.abort(str(body["abort"])))
+        except KeyError as e:
+            return web.json_response({"error": f"no plan {e.args[0]}"},
+                                     status=404)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        if body.get("tick"):
+            out["plans"] = await self.autopilot.tick()
+        if body.get("wait"):
+            await self.autopilot.wait_idle()
+        out["status"] = self.autopilot.status()
+        return web.json_response(out)
 
     async def handle_cluster_alerts(self, req: web.Request
                                     ) -> web.Response:
@@ -880,6 +952,12 @@ class MasterServer:
                 "governor": self.governor.status()}
         except Exception:
             log.warning("interference status failed", exc_info=True)
+        try:
+            # autopilot headline (mode, plan-state counts, last plans);
+            # /cluster/autopilot has the full ledger
+            snap["autopilot"] = self.autopilot.headline()
+        except Exception:
+            log.warning("autopilot status failed", exc_info=True)
         with self._heat_lock:
             cached = self._heat_cache
         if cached is not None:
@@ -945,7 +1023,8 @@ class MasterServer:
             body = await req.json()
         except ValueError:
             body = {}
-        accepted = self.convert.enqueue(body.get("volumes") or [])
+        accepted = self.convert.enqueue(body.get("volumes") or [],
+                                        seal=bool(body.get("seal")))
         actions = []
         if body.get("tick"):
             actions = await self.convert.tick()

@@ -149,6 +149,9 @@ class VolumeServer:
             web.post("/admin/volume/unmount", self.handle_volume_unmount),
             web.post("/admin/volume/vacuum", self.handle_vacuum),
             web.post("/admin/volume/copy", self.handle_volume_copy),
+            web.post("/admin/volume/move", self.handle_volume_move),
+            web.post("/admin/volume/unconvert",
+                     self.handle_volume_unconvert),
             web.post("/admin/volume/tier_move", self.handle_tier_move),
             web.post("/admin/volume/tier_download",
                      self.handle_tier_download),
@@ -199,6 +202,12 @@ class VolumeServer:
         # + injected-fault state (maintenance/faults.py, test-only)
         self.scrubber = None
         self._fault_delay_shard_read = 0.0
+        self._fault_delay_file_pull = 0.0
+        # vids with an /admin/volume/move in flight FROM this server: a
+        # second concurrent move of the same volume would stage copies
+        # on two targets and commit both — two live copies of a
+        # single-replica volume silently diverge
+        self._moves_active: set[int] = set()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -240,6 +249,8 @@ class VolumeServer:
         for f in _faults.parse_env(os.environ.get("WEEDTPU_FAULTS", "")):
             if f["action"] == "delay_shard_read":
                 self._fault_delay_shard_read = f["ms"] / 1000.0
+            elif f["action"] == "delay_file_pull":
+                self._fault_delay_file_pull = f["ms"] / 1000.0
             else:
                 try:
                     _faults.apply(self.store, f)
@@ -1472,9 +1483,15 @@ class VolumeServer:
         # a failed copy can't leave a partial .dat that load_existing would
         # mount as a live volume (reference: volume_vacuum.go temp names)
         tmp_ext = {".dat": ".cpd", ".idx": ".cpx"}
+        # CRC32 of each pulled file computed WHILE streaming: the move
+        # orchestrator compares it against the source's own digest, so a
+        # torn transfer (or bit flips in transit) can never commit
+        import zlib as _zlib
+        crcs: dict[str, int] = {}
         try:
             for ext in (".dat", ".idx"):
                 name = os.path.basename(base + ext)
+                crc = 0
                 async with self._session.get(
                         f"{_tls_scheme()}://{source}/admin/file",
                         params={"name": name}) as r:
@@ -1483,10 +1500,15 @@ class VolumeServer:
                             f"pull {name} from {source}: HTTP {r.status}")
                     with open(base + tmp_ext[ext], "wb") as f:
                         async for chunk in r.content.iter_chunked(1 << 20):
+                            # streamed reads bypass the aiohttp trace
+                            # hooks (chunk events fire for buffered
+                            # read()s only): book the bytes explicitly
                             netflow.account("recv",
                                             netflow.current_class(),
                                             "volume", len(chunk))
+                            crc = _zlib.crc32(chunk, crc)
                             f.write(chunk)
+                crcs[ext.lstrip(".")] = crc
             if staging:
                 # marker lands BEFORE the .dat appears: a crash between the
                 # renames can only leave a marked (= never-booted) copy
@@ -1514,7 +1536,240 @@ class VolumeServer:
         loc.collections[vid] = collection
         if not staging:  # staged copies stay invisible until finalize
             await self._heartbeat_once()
-        return web.json_response({"file_count": vol.info().file_count})
+        return web.json_response({"file_count": vol.info().file_count,
+                                  "crc": crcs})
+
+    async def handle_volume_move(self, req: web.Request) -> web.Response:
+        """POST /admin/volume/move {"volume", "target"}: rebalance one
+        volume off this server — the autopilot balancing actuator.
+        Protocol: freeze writes → staged copy to the target → verify the
+        target's streamed CRC against the source .dat → commit (the
+        finalizing catch-up flips the staged copy live) → retire the
+        source copy.  Every byte books as netflow class=rebalance.
+
+        Abortable mid-failure with NO partial state: until the finalize
+        succeeds the target copy is staged (read-only, heartbeat-
+        invisible, .staging-marked on disk) and the source keeps serving
+        reads; any failure deletes the staged copy (best-effort — a
+        KILLED target deletes its own .staging leftovers at boot) and
+        re-thaws the source to its prior writability.  After the
+        finalize the target IS the volume, so the source retires
+        unconditionally — two live copies of a single-replica volume
+        would silently diverge."""
+        body = await req.json()
+        try:
+            vid = int(body["volume"])
+            target = str(body["target"])
+        except (KeyError, TypeError, ValueError):
+            return web.json_response(
+                {"error": "volume and target required"}, status=400)
+        v = self.store.get_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        if target == self.url or not target:
+            return web.json_response({"error": "bad target"}, status=400)
+        # single-flight per vid (handlers run on one event loop, so the
+        # check-and-add is atomic): a concurrent second move would stage
+        # AND commit a second live copy
+        if vid in self._moves_active or getattr(v, "staging", False):
+            return web.json_response({"error": "volume is mid-move"},
+                                     status=409)
+        self._moves_active.add(vid)
+        try:
+            return await self._volume_move(vid, v,
+                                           str(body.get("collection")
+                                               or ""), target)
+        finally:
+            self._moves_active.discard(vid)
+
+    async def _volume_move(self, vid: int, v, collection: str,
+                           target: str) -> web.Response:
+        from seaweedfs_tpu.utils.http import post_json
+        import zlib as _zlib
+        if not collection:
+            for loc in self.store.locations:
+                if vid in loc.volumes:
+                    collection = loc.collections.get(vid, "")
+                    break
+
+        async def post(path: str, pbody: dict,
+                       timeout: float = 600.0) -> dict:
+            return await post_json(self._session, target, path, pbody,
+                                   timeout)
+
+        def dat_crc() -> int:
+            crc = 0
+            with open(v.dat_path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    crc = _zlib.crc32(chunk, crc)
+            return crc
+
+        was_ro = v.read_only
+        copy_body = {"volume": vid, "source": self.url,
+                     "collection": collection, "staging": True}
+        try:
+            with netflow.flow("rebalance"), \
+                    trace.span("volume.move", vid=vid, target=target,
+                               bytes=v.data_size()):
+                # freeze FIRST: against a frozen source the staged copy
+                # is complete the moment its CRC matches — no append
+                # tail to chase, the finalizing catch-up moves 0 bytes
+                v.read_only = True
+                await asyncio.to_thread(v.flush)
+                data = await post("/admin/volume/copy", copy_body)
+                if data.get("incremental"):
+                    # the target already held a live replica: refuse
+                    # WITHOUT the generic abort below — its cleanup
+                    # deletes the target copy, which here would destroy
+                    # a real replica, not our staging leftovers
+                    v.read_only = was_ro
+                    metrics.VOLUME_MOVES.labels("aborted").inc()
+                    return web.json_response(
+                        {"error": f"{target} already holds volume "
+                                  f"{vid}; move refused (that is "
+                                  "volume.fix.replication's job)"},
+                        status=409)
+                remote_crc = (data.get("crc") or {}).get("dat")
+                local_crc = await asyncio.to_thread(dat_crc)
+                if remote_crc != local_crc:
+                    raise RuntimeError(
+                        f"CRC mismatch after copy: source {local_crc} "
+                        f"vs target {remote_crc}")
+                await post("/admin/volume/copy",
+                           dict(copy_body, finalize=True))
+        except Exception as e:
+            try:
+                await post("/admin/volume/delete", {"volume": vid},
+                           timeout=10.0)
+            except Exception:
+                pass  # dead target: its boot cleanup removes the stage
+            v.read_only = was_ro
+            metrics.VOLUME_MOVES.labels("aborted").inc()
+            return web.json_response({"error": str(e)}, status=500)
+        await asyncio.to_thread(self.store.delete_volume, vid)
+        await self._heartbeat_once()
+        metrics.VOLUME_MOVES.labels("ok").inc()
+        return web.json_response({"moved": vid, "target": target,
+                                  "crc": local_crc})
+
+    async def handle_volume_unconvert(self, req: web.Request
+                                      ) -> web.Response:
+        """POST /admin/volume/unconvert {"volume"}: promote an EC volume
+        back to the replicated/mmap fast path — the autopilot tiering
+        promote actuator, reversing the fleet-convert demote.  Decodes
+        the local data shards back into a .dat under a temp name
+        (tmp+rename, the fleet_convert commit contract: a crash
+        mid-decode never leaves a half-written .dat a restart would
+        mount as live data), rebuilds the .idx from the .ecx (replaying
+        .ecj tombstones), mounts, THAWS (the write-freeze the conversion
+        imposed ends here), and retires the local shard set.  When the
+        conversion's frozen .dat is still on disk (the fleet-convert
+        contract keeps the source volume mounted read-only) the decode
+        is skipped outright — the thaw alone promotes.  Registers under
+        the shared per-vid job table so /admin/ec/progress observes a
+        long decode."""
+        body = await req.json()
+        try:
+            vid = int(body["volume"])
+        except (KeyError, TypeError, ValueError):
+            return web.json_response({"error": "volume required"},
+                                     status=400)
+        base = self._ec_base(vid)
+        if base is None or not os.path.exists(base + ".ecx"):
+            return web.json_response({"error": "no ec volume here"},
+                                     status=404)
+        if self._ec_jobs.get(vid, {}).get("state") == "running":
+            return web.json_response({"error": "ec job already running"},
+                                     status=409)
+        existing = self.store.get_volume(vid)
+        job = {"state": "running", "kind": "unconvert", "bytes_done": 0,
+               "total": 0, "cancel": False, "error": None,
+               "started": time.time(), "stages": {}}
+        self._ec_jobs[vid] = job
+
+        def decode() -> bool:
+            if existing is not None and \
+                    os.path.exists(existing._base + ".dat"):
+                return False  # frozen .dat survives: thaw-only promote
+            missing = [i for i in range(layout.DATA_SHARDS)
+                       if not os.path.exists(base + layout.to_ext(i))]
+            if missing:
+                ec_files.rebuild_ec_files(base)
+            dat_size = ec_files.find_dat_file_size(base)
+            job["total"] = dat_size
+            dat_tmp, idx_tmp = base + ".dat.unc", base + ".idx.unc"
+            try:
+                ec_files.write_dat_file(base, dat_size, out_path=dat_tmp)
+                ec_files.write_idx_from_ecx(base + ".ecx", idx_tmp)
+            except BaseException:
+                for p in (dat_tmp, idx_tmp):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                raise
+            # .idx lands first: a .dat whose .idx is missing rebuilds
+            # its map at mount, but an orphan .idx mounts nothing
+            os.replace(idx_tmp, base + ".idx")
+            os.replace(dat_tmp, base + ".dat")
+            job["bytes_done"] = dat_size
+            return True
+
+        try:
+            with trace.span("volume.unconvert", vid=vid):
+                decoded = await asyncio.to_thread(decode)
+        except Exception as e:
+            job["state"] = "failed"
+            job["error"] = str(e)
+            return web.json_response({"error": str(e)}, status=500)
+        loc = next(l for l in self.store.locations
+                   if base.startswith(l.directory))
+        v = existing
+        if v is None:
+            stem = os.path.basename(base)
+            collection = body.get("collection") or \
+                loc.collections.get(vid) or \
+                (stem[: -(len(str(vid)) + 1)]
+                 if stem.endswith(f"_{vid}") else "")
+            from seaweedfs_tpu.storage.volume import Volume
+            try:
+                v = await asyncio.to_thread(Volume, loc.directory,
+                                            collection, vid)
+            except Exception as e:
+                job["state"] = "failed"
+                job["error"] = str(e)
+                return web.json_response({"error": f"load: {e}"},
+                                         status=500)
+            loc.volumes[vid] = v
+            loc.collections[vid] = collection
+        # retire the EC set BEFORE the thaw, .ecx first: load_existing
+        # keys EC mounts on the .ecx, so once it is gone a crash at any
+        # later point boots the plain volume alone — never a writable
+        # .dat NEXT TO a mountable stale shard set the repair planner
+        # would treat as authoritative (ledger rule: shard entry wins)
+        for l in self.store.locations:
+            ev = l.ec_volumes.pop(vid, None)
+            if ev is not None:
+                ev.close()
+        for ext in (".ecx", ".ecj", ".vif"):
+            if os.path.exists(base + ext):
+                os.remove(base + ext)
+        removed = []
+        for i in range(layout.TOTAL_SHARDS):
+            p = base + layout.to_ext(i)
+            if os.path.exists(p):
+                os.remove(p)
+                removed.append(i)
+        v.read_only = False  # the thaw: the mmap fast path serves again
+        job["state"] = "done"
+        await self._heartbeat_once()
+        return web.json_response({"volume": vid, "decoded": decoded,
+                                  "thawed": True,
+                                  "shards_retired": removed})
 
     async def handle_tier_move(self, req: web.Request) -> web.Response:
         """Move a sealed volume's .dat to a remote tier (reference:
@@ -1743,6 +1998,8 @@ class VolumeServer:
     async def handle_file_pull(self, req: web.Request) -> web.StreamResponse:
         """Serve a volume/ec file by basename for peer pulls (source side of
         VolumeEcShardsCopy / VolumeCopy)."""
+        if self._fault_delay_file_pull > 0:
+            await asyncio.sleep(self._fault_delay_file_pull)
         name = req.query.get("name", "")
         if "/" in name or ".." in name:
             return web.json_response({"error": "bad name"}, status=400)
@@ -1850,6 +2107,13 @@ class VolumeServer:
         for f in body.get("faults", []):
             if f.get("action") == "delay_shard_read":
                 self._fault_delay_shard_read = float(f.get("ms", 0)) / 1000.0
+                applied.append(dict(f, ok=True))
+                continue
+            if f.get("action") == "delay_file_pull":
+                # stall peer file pulls (/admin/file) — holds a volume
+                # copy/move open long enough for chaos cells to kill a
+                # node mid-transfer deterministically
+                self._fault_delay_file_pull = float(f.get("ms", 0)) / 1000.0
                 applied.append(dict(f, ok=True))
                 continue
             applied.append(await asyncio.to_thread(

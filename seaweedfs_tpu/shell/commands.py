@@ -1130,6 +1130,69 @@ def cmd_cluster_interference(env: CommandEnv, args, out):
               f"{rec.get('last_p99_ms')}ms index {idx}", file=out)
 
 
+@command("cluster.autopilot")
+def cmd_cluster_autopilot(env: CommandEnv, args, out):
+    """Autopilot decision plane (/cluster/autopilot): mode
+    (plan/execute/off), per-policy pacing buckets, hysteresis clocks,
+    and the plan ledger with states and pinned trace ids.  -tick runs
+    one policy pass first; -approve <id> executes one plan (the
+    plan-mode runbook step); -abort <id> kills a not-yet-executing
+    plan; -wait blocks until launched executions settle; -json dumps
+    raw.  Runbook: cluster.autopilot -> inspect a plan's reason ->
+    cluster.autopilot -approve <id> (or -abort) -> cluster.trace
+    <trace_id> for the full planning+execution waterfall."""
+    flags = parse_flags(args)
+    body = {}
+    if "tick" in flags:
+        body["tick"] = True
+    if "approve" in flags:
+        body["approve"] = flags["approve"]
+    if "abort" in flags:
+        body["abort"] = flags["abort"]
+    if "wait" in flags:
+        body["wait"] = True
+    if body:
+        resp = env.master_post("/cluster/autopilot", body)
+        st = resp.get("status") or {}
+    else:
+        resp = {}
+        st = env.master_get("/cluster/autopilot")
+    if "json" in flags:
+        print(json.dumps(resp or st, separators=(",", ":")), file=out)
+        return
+    counts = st.get("states") or {}
+    print(f"autopilot: mode={st.get('mode')} ticks={st.get('ticks', 0)} "
+          f"actuator_calls={st.get('actuator_calls', 0)} · plans "
+          + " ".join(f"{s}={counts.get(s, 0)}"
+                     for s in ("planned", "approved", "executing",
+                               "done", "aborted")), file=out)
+    for name, b in sorted((st.get("buckets") or {}).items()):
+        print(f"  bucket {name:8s} rate={b.get('rate_per_s'):g}/s "
+              f"burst={b.get('burst'):g} tokens={b.get('tokens'):g}",
+              file=out)
+    hys = st.get("hysteresis") or {}
+    cold = hys.get("cold_tracking") or {}
+    if cold:
+        line = " ".join(f"v{v}:{s:.0f}s" for v, s in
+                        sorted(cold.items())[:8])
+        print(f"  cold-tracking {line}", file=out)
+    for p in (st.get("plans") or [])[-10:]:
+        reason = p.get("reason") or {}
+        why = " ".join(f"{k}={v}" for k, v in sorted(reason.items()))
+        where = p.get("node") or (f"{p.get('source')} -> "
+                                  f"{p.get('target')}"
+                                  if p.get("source") else "")
+        print(f"  {p.get('id'):>6s} {p.get('policy'):16s} "
+              f"vid={p.get('vid')} [{p.get('state')}] {where} {why} "
+              f"trace={p.get('trace_id')}", file=out)
+        if p.get("error"):
+            print(f"         error: {p['error']}", file=out)
+    if resp.get("approved"):
+        print(f"approved {resp['approved']['id']}", file=out)
+    if resp.get("aborted"):
+        print(f"aborted {resp['aborted']['id']}", file=out)
+
+
 @command("chaos.status")
 def cmd_chaos_status(env: CommandEnv, args, out):
     """Resilience-plane status: per-peer circuit-breaker states, the

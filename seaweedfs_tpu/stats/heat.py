@@ -131,10 +131,17 @@ def _cells(key: str, width: int, depth: int) -> list[int]:
 class SpaceSaving:
     """Decayed Space-Saving heavy-hitter summary.
 
-    ``entries`` maps key -> [count, err, aux] where ``aux`` holds
-    decayed per-key sub-counters (bytes, per-op counts) that ride along
-    with the main counter and die with the entry on eviction.  Not
-    thread-safe by itself — HeatTracker serializes access per dimension.
+    ``entries`` maps key -> [count, err, aux, first_seen] where ``aux``
+    holds decayed per-key sub-counters (bytes, per-op counts) that ride
+    along with the main counter and die with the entry on eviction, and
+    ``first_seen`` is the MONOTONE wall timestamp the entry was created
+    at: it is never scaled by decay sweeps (duration is not a count),
+    and an evicted key's replacement starts a fresh clock — the
+    newcomer inherits the victim's count only as an error bound, never
+    its tenure.  ``now - first_seen`` is the sustained-seconds signal
+    autopilot hysteresis keys off, a real measured duration instead of
+    one inferred from decayed estimates.  Not thread-safe by itself —
+    HeatTracker serializes access per dimension.
     """
 
     __slots__ = ("k", "halflife", "entries", "total", "_now", "_last")
@@ -178,16 +185,17 @@ class SpaceSaving:
         ent = self.entries.get(key)
         if ent is None:
             if len(self.entries) < self.k:
-                ent = self.entries[key] = [0.0, 0.0, {}]
+                ent = self.entries[key] = [0.0, 0.0, {}, now]
             elif weight <= 0:
                 return  # not worth an eviction for an annotation
             else:
                 # evict the minimum counter; the newcomer inherits its
                 # count as the error bound (the Space-Saving exchange)
+                # but NOT its tenure — first_seen restarts now
                 victim = min(self.entries, key=lambda q:
                              self.entries[q][0])
                 vcount = self.entries.pop(victim)[0]
-                ent = self.entries[key] = [vcount, vcount, {}]
+                ent = self.entries[key] = [vcount, vcount, {}, now]
         ent[0] += weight
         if aux:
             a = ent[2]
@@ -208,7 +216,7 @@ class SpaceSaving:
         self._decay(now)
         return {"ts": now, "k": self.k, "halflife": self.halflife,
                 "total": self.total, "min": self.min_count(),
-                "entries": [[key, ent[0], ent[1], dict(ent[2])]
+                "entries": [[key, ent[0], ent[1], dict(ent[2]), ent[3]]
                             for key, ent in self.entries.items()]}
 
     @staticmethod
@@ -235,6 +243,11 @@ class SpaceSaving:
         for key in keys:
             est = err = 0.0
             aux: dict[str, float] = {}
+            # fleet first_seen = MIN over the nodes that track the key:
+            # the earliest sighting anywhere is when the key became hot
+            # (absent-node min contributions carry no tenure).  Monotone
+            # under merges — adding a node can only move it earlier.
+            first_seen: float | None = None
             for f, ents, minc in adj:
                 ent = ents.get(key)
                 if ent is None:
@@ -245,7 +258,11 @@ class SpaceSaving:
                 err += ent[2] * f
                 for name, v in (ent[3] or {}).items():
                     aux[name] = aux.get(name, 0.0) + v * f
-            merged.append([key, est, err, aux])
+                if len(ent) > 4 and ent[4] is not None:
+                    fs = float(ent[4])
+                    if first_seen is None or fs < first_seen:
+                        first_seen = fs
+            merged.append([key, est, err, aux, first_seen])
         merged.sort(key=lambda e: e[1], reverse=True)
         return {"ts": now, "k": k, "halflife": halflife, "total": total,
                 "min": 0.0, "entries": merged[:k]}
@@ -407,11 +424,13 @@ def serialize() -> dict:
 
 # -- fleet merge (the master's /cluster/heat) ----------------------------
 
-def _entry_view(ent: list, halflife: float) -> dict:
+def _entry_view(ent: list, halflife: float,
+                now: float | None = None) -> dict:
     """One merged Space-Saving entry -> the operator-facing record.
     RPS/byte-rate invert the decay equilibrium (steady rate r settles at
     r * H/ln2), so they read as recent-rate estimates."""
-    key, est, err, aux = ent
+    key, est, err, aux = ent[:4]
+    first_seen = ent[4] if len(ent) > 4 else None
     rate = LN2 / halflife
     reads = aux.get("read", 0.0)
     writes = aux.get("write", 0.0)
@@ -420,6 +439,12 @@ def _entry_view(ent: list, halflife: float) -> dict:
            "rps": round(est * rate, 3),
            "bytes_rate": round(aux.get("bytes", 0.0) * rate, 1),
            "reads": round(reads, 2), "writes": round(writes, 2)}
+    if first_seen is not None:
+        if now is None:
+            now = time.time()
+        # how long this key has CONTINUOUSLY been tracked — the
+        # autopilot hysteresis signal (flap = eviction = clock reset)
+        rec["sustained_s"] = round(max(0.0, now - first_seen), 1)
     rw = reads + writes
     if rw > 0:
         rec["read_fraction"] = round(reads / rw, 4)
@@ -450,7 +475,8 @@ def merge_serialized(snaps: list[dict], k: int | None = None,
                 "tenant": "tenants"}[dim]
         out[name] = {
             "total_rps": round(merged["total"] * LN2 / halflife, 3),
-            "top": [_entry_view(e, halflife) for e in merged["entries"]],
+            "top": [_entry_view(e, halflife, now)
+                    for e in merged["entries"]],
         }
     return out
 

@@ -66,7 +66,8 @@ from seaweedfs_tpu.utils.resilience import _env_float
 
 # background traffic classes the observatory attributes impact to (the
 # netflow ledger's classes minus data/internal, which ARE the foreground)
-BG_CLASSES = ("repair", "convert", "scrub", "replication", "readahead")
+BG_CLASSES = ("repair", "convert", "rebalance", "scrub", "replication",
+              "readahead")
 
 # foreground signal: the volume servers' serving-path read latency
 FG_FAMILY = "weedtpu_volume_request_seconds"
@@ -351,7 +352,11 @@ class Governor:
       (volumes/s), class ``convert``;
     - ``scrub`` — the fleet scrub rate (MB/s), class ``scrub``, pushed
       to every volume server's ``/admin/scrub_rate`` when it changes
-      (skipped entirely when WEEDTPU_SCRUB_MBPS <= 0: scrub is off).
+      (skipped entirely when WEEDTPU_SCRUB_MBPS <= 0: scrub is off);
+    - ``autopilot_tier`` / ``autopilot_balance`` — the autopilot's
+      per-policy plan buckets (maintenance/autopilot.py), classes
+      ``convert`` and ``rebalance``: placement decisions back off with
+      the same law as the work they schedule.
 
     Control law, per target with index ``i`` and target ``t``
     (WEEDTPU_GOVERNOR_TARGET): ``i > t`` -> rate x t/i (proportional
@@ -384,6 +389,16 @@ class Governor:
         }
         self.classes = {"repair_xrack": "repair", "convert": "convert",
                         "scrub": "scrub"}
+        # the autopilot's per-policy pacing buckets are governed like
+        # any other background work: tiering plans feed the convert
+        # plane, balance moves are their own rebalance class
+        ap = getattr(master, "autopilot", None)
+        if ap is not None:
+            self.ceilings["autopilot_tier"] = ap.buckets["tiering"].rate
+            self.ceilings["autopilot_balance"] = \
+                ap.buckets["balance"].rate
+            self.classes["autopilot_tier"] = "convert"
+            self.classes["autopilot_balance"] = "rebalance"
         self._scrub_rate = self.ceilings["scrub"]
         self._last_push = 0.0
         # a fresh master does not know what rate the fleet's scrubbers
@@ -407,22 +422,31 @@ class Governor:
 
     # -- rate plumbing ---------------------------------------------------
 
-    def _current_rate(self, name: str) -> float:
+    def _bucket(self, name: str):
+        """The governed TokenBucket for a target, None for scrub (whose
+        'rate' is the fleet MB/s pushed over HTTP, not a bucket)."""
         if name == "repair_xrack":
-            return self.master.maintenance.xrack_bucket.rate
+            return self.master.maintenance.xrack_bucket
         if name == "convert":
-            return self.master.convert.bucket.rate
-        return self._scrub_rate
+            return self.master.convert.bucket
+        if name == "autopilot_tier":
+            return self.master.autopilot.buckets["tiering"]
+        if name == "autopilot_balance":
+            return self.master.autopilot.buckets["balance"]
+        return None
+
+    def _current_rate(self, name: str) -> float:
+        b = self._bucket(name)
+        return b.rate if b is not None else self._scrub_rate
 
     def _apply_rate(self, name: str, rate: float) -> None:
         """Apply a bucket rate.  Scrub only records the new fleet rate
         here — the HTTP fan-out happens AFTER the governor lock drops
         (tick()), so status() readers and the scrape cadence never
         block behind a partitioned node's connect timeout."""
-        if name == "repair_xrack":
-            self.master.maintenance.xrack_bucket.set_rate(rate)
-        elif name == "convert":
-            self.master.convert.bucket.set_rate(rate)
+        b = self._bucket(name)
+        if b is not None:
+            b.set_rate(rate)
         else:
             self._scrub_rate = rate
 

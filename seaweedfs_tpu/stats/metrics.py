@@ -617,6 +617,30 @@ GOVERNOR_RETUNES = REGISTRY.counter(
     "weedtpu_governor_retunes_total",
     "governor rate-retune decisions by target and direction (up/down)",
     ("target", "direction"))
+# fleet-conversion scheduler (maintenance/convert.py): volumes put BACK
+# on the queue after a node call failed or skipped them — previously
+# only visible in logs, and the autopilot must see the parked backlog
+# to avoid re-planning volumes already waiting there
+CONVERT_REQUEUED = REGISTRY.counter(
+    "weedtpu_convert_requeued_total",
+    "fleet-conversion volumes re-queued (never dropped) by reason "
+    "(node_error: the node call failed; skipped: the node answered "
+    "but left the volume unconverted)", ("reason",))
+# autopilot decision plane (maintenance/autopilot.py): plans created
+# per policy and executions per policy/outcome, plus the volume-server
+# side of the balancing actuator
+AUTOPILOT_PLANS = REGISTRY.counter(
+    "weedtpu_autopilot_plans_total",
+    "autopilot action plans created, by policy "
+    "(tiering_demote / tiering_promote / balance_move)", ("policy",))
+AUTOPILOT_ACTIONS = REGISTRY.counter(
+    "weedtpu_autopilot_actions_total",
+    "autopilot plan executions by policy and outcome (done/aborted)",
+    ("policy", "outcome"))
+VOLUME_MOVES = REGISTRY.counter(
+    "weedtpu_volume_moves_total",
+    "volume rebalance moves driven through /admin/volume/move on this "
+    "server, by outcome (ok/aborted)", ("outcome",))
 # registry self-cost: stamped on every render (see Registry.render) so
 # the dashboard — itself fed from these series — can watch what the
 # telemetry plane costs

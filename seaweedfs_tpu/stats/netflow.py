@@ -25,8 +25,11 @@ Classes: ``data`` (foreground client payload), ``replication`` (replica
 fan-out), ``repair`` (rebuild/survivor movement), ``convert``
 (fleet EC conversion — repair-adjacent background encode traffic, kept
 distinct so interference alerts can tell planned conversion from loss
-recovery), ``scrub`` (syndrome verification reads), ``readahead``
-(speculative prefetch), ``internal`` (metrics/heartbeat/control).
+recovery), ``rebalance`` (autopilot-planned volume moves between
+servers — placement traffic, not loss recovery, so the governor can
+pace it independently), ``scrub`` (syndrome verification reads),
+``readahead`` (speculative prefetch), ``internal``
+(metrics/heartbeat/control).
 Unlabeled traffic classifies by path: cluster-internal surfaces are
 ``internal``, everything else ``data``.
 
@@ -42,8 +45,8 @@ from contextvars import ContextVar
 CLASS_HEADER = "X-Weedtpu-Class"
 ROLE_HEADER = "X-Weedtpu-Role"
 
-CLASSES = frozenset({"data", "replication", "repair", "convert", "scrub",
-                     "readahead", "internal"})
+CLASSES = frozenset({"data", "replication", "repair", "convert",
+                     "rebalance", "scrub", "readahead", "internal"})
 
 # cluster-internal surfaces (monitoring pulls, heartbeats, raft, debug,
 # maintenance, admin control traffic).  Shared with the trace

@@ -1349,14 +1349,18 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
 
 def write_dat_file(base: str, dat_size: int,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
-                   small_block: int = layout.SMALL_BLOCK_SIZE) -> None:
-    """`.ec00`-`.ec09` -> `<base>.dat` (row-major interleave copy)."""
+                   small_block: int = layout.SMALL_BLOCK_SIZE,
+                   out_path: str | None = None) -> None:
+    """`.ec00`-`.ec09` -> `<base>.dat` (row-major interleave copy).
+    ``out_path`` redirects the output (the un-convert path decodes into
+    a temp name and renames, so a crash mid-decode can never leave a
+    half-written .dat a restart would mount as live data)."""
     rows = layout.n_large_rows(dat_size, large_block, small_block)
     ins = [open(base + layout.to_ext(i), "rb")
            for i in range(layout.DATA_SHARDS)]
     written = 0
     try:
-        with open(base + ".dat", "wb") as dat:
+        with open(out_path or (base + ".dat"), "wb") as dat:
             for r in range(rows):
                 for j in range(layout.DATA_SHARDS):
                     ins[j].seek(r * large_block)

@@ -46,6 +46,19 @@ class DiskLocation:
         self.load_existing()
 
     def load_existing(self) -> None:
+        # crash leftovers from an interrupted copy/move/vacuum/unconvert
+        # are garbage, not data: .cpd/.cpx/.cptail temp pulls and
+        # .unc decode temps never held the only copy of anything, so a
+        # restarted server deletes them instead of letting them pile up
+        # (the move_mid_failure chaos cell asserts a killed move target
+        # comes back with NO orphan files)
+        for ext in ("*.cpd", "*.cpx", "*.cptail", "*.dat.unc",
+                    "*.idx.unc"):
+            for path in glob.glob(os.path.join(self.directory, ext)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
         for path in glob.glob(os.path.join(self.directory, "*.dat")):
             m = _VOL_RE.match(os.path.basename(path))
             if not m:
@@ -53,8 +66,15 @@ class DiskLocation:
             vid = int(m.group("vid"))
             col = m.group("col") or ""
             if os.path.exists(path[: -len(".dat")] + ".staging"):
-                # half-moved copy from a crashed volume.move: never mount
-                # it as live data (shell re-runs the move from scratch)
+                # half-moved copy from a crashed volume move: the source
+                # still holds the live volume, so this copy is garbage —
+                # delete it (a re-run move re-copies from scratch)
+                # rather than merely skipping it forever
+                for ext in (".dat", ".idx", ".staging"):
+                    try:
+                        os.remove(path[: -len(".dat")] + ext)
+                    except OSError:
+                        pass
                 continue
             if vid not in self.volumes:
                 self.volumes[vid] = Volume(self.directory, col, vid,
@@ -132,7 +152,9 @@ class Store:
                 v = loc.volumes.pop(vid, None)
                 if v is not None:
                     v.close()
-                    for ext in (".dat", ".idx"):
+                    # .staging too: deleting a staged (mid-move) copy
+                    # must not leave its marker behind as an orphan
+                    for ext in (".dat", ".idx", ".staging"):
                         p = v._base + ext
                         if os.path.exists(p):
                             os.remove(p)

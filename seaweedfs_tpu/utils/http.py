@@ -113,6 +113,30 @@ def aiohttp_trace_config(role: str | None = None):
     return tc
 
 
+async def post_json(session, node: str, path: str, body: dict,
+                    timeout: float = 600.0) -> dict:
+    """POST a JSON body to a peer's admin surface over an aiohttp
+    session and return the parsed reply; any non-200 raises
+    RuntimeError carrying the peer's error text.  The ONE copy of the
+    'call a peer actuator' convention the autopilot, the volume-move
+    orchestrator, and the conversion sealer share — error formatting
+    and timeouts must not drift between them."""
+    import aiohttp
+
+    from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+    async with session.post(
+            f"{_tls_scheme()}://{node}{path}", json=body,
+            timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+        try:
+            data = await r.json()
+        except Exception:
+            data = {}
+        if r.status != 200:
+            raise RuntimeError(f"{node}{path}: HTTP {r.status} "
+                               f"{data.get('error', '')}".strip())
+        return data
+
+
 class _BadResponse(http.client.HTTPException):
     pass
 

@@ -8,6 +8,7 @@ hardware. Benchmarks (`bench.py`) do NOT import this and run on the real chip.
 import os
 import sys
 import pathlib
+import time
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env may pin a TPU platform
 
@@ -38,6 +39,36 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: timing-sensitive tests excluded from tier-1 "
         "(-m 'not slow')")
+
+
+# -- tier-1 timing guard ---------------------------------------------------
+# The tier-1 gate runs under a hard 870s timeout; a suite that creeps
+# toward it fails suddenly and opaquely one PR later.  When a run
+# exceeds 80% of the budget, print the 10 slowest tests so the
+# offender is named while there is still headroom to fix it.
+
+TIER1_BUDGET_S = 870.0
+_suite_start = time.time()
+_test_durations: list = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _test_durations.append((report.duration, report.nodeid))
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    elapsed = time.time() - _suite_start
+    if elapsed <= 0.8 * TIER1_BUDGET_S or not _test_durations:
+        return
+    tr = terminalreporter
+    tr.write_sep("=", "tier-1 timing guard")
+    tr.write_line(
+        f"suite wall time {elapsed:.0f}s exceeds 80% of the "
+        f"{TIER1_BUDGET_S:.0f}s tier-1 budget — trim before the "
+        f"timeout does it for you. 10 slowest tests:")
+    for dur, nodeid in sorted(_test_durations, reverse=True)[:10]:
+        tr.write_line(f"  {dur:8.2f}s  {nodeid}")
 
 
 import pytest  # noqa: E402

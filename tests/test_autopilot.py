@@ -1,0 +1,526 @@
+"""Autopilot: the policy engine that turns telemetry into actions.
+
+Unit layer: plan state machine, hysteresis clocks, plan-only inertness,
+balancing candidate selection, the convert re-queue surface.  Cluster
+layer (real servers, real files): the tiering round trip
+(demote -> sealed EC -> promote -> byte-identical, writable again) and
+the CRC-verified abort-safe volume move.
+"""
+
+import asyncio
+import glob
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.maintenance.autopilot import Autopilot, autopilot_mode
+from seaweedfs_tpu.storage.ec import layout
+
+
+# -- stubs ----------------------------------------------------------------
+
+class _StubConvert:
+    def __init__(self):
+        self.queued = []
+        self.active = set()
+        self._backoff = {}
+        self.enqueued = []
+
+    def enqueue(self, vids, seal=False):
+        self.enqueued.append((list(vids), seal))
+        self.queued.extend(vids)
+        return list(vids)
+
+
+class _StubMaintenance:
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def ledger(self):
+        return self._ledger
+
+
+class _StubForecaster:
+    def __init__(self, disks=()):
+        self._disks = list(disks)
+
+    def snapshot(self):
+        return {"disks": self._disks, "volumes": []}
+
+
+class _StubNode:
+    def __init__(self, url, volumes, free_slots=4):
+        self.url = url
+        self.volumes = volumes
+        self.free_slots = free_slots
+
+
+class _StubTopo:
+    def __init__(self, nodes):
+        import threading
+        self._lock = threading.Lock()
+        self.nodes = {n.url: n for n in nodes}
+
+
+class _StubVol:
+    def __init__(self, size=1024, replica_placement="000"):
+        self.size = size
+        self.replica_placement = replica_placement
+
+
+class _StubMaster:
+    def __init__(self, ledger=None, heat=None, disks=(), nodes=()):
+        self.maintenance = _StubMaintenance(ledger or {})
+        self.convert = _StubConvert()
+        self.forecaster = _StubForecaster(disks)
+        self.topo = _StubTopo(nodes)
+        self._heat = heat or {}
+
+    def cached_heat(self, max_age=5.0):
+        return self._heat
+
+
+def _heat_view(vol_recs):
+    return {"volumes": {"top": vol_recs}}
+
+
+def _tick(ap):
+    async def run():
+        plans = await ap.tick()
+        await ap.wait_idle()
+        return plans
+    return asyncio.run(run())
+
+
+# -- mode + state machine -------------------------------------------------
+
+def test_mode_parsing(monkeypatch):
+    for raw, want in (("plan", "plan"), ("execute", "execute"),
+                      ("0", "0"), ("off", "0"), ("", "plan"),
+                      ("EXECUTE", "execute"), ("bogus", "plan")):
+        monkeypatch.setenv("WEEDTPU_AUTOPILOT", raw)
+        assert autopilot_mode() == want
+    monkeypatch.delenv("WEEDTPU_AUTOPILOT")
+    assert autopilot_mode() == "plan"  # plan-only is the DEFAULT
+
+
+def test_plan_state_machine(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    m = _StubMaster()
+    ap = Autopilot(m, cold_s=0.0, cooldown_s=0.0)
+
+    async def run():
+        plan = ap._new_plan("tiering_demote", 7, reason={"rps": 0})
+        pid = plan["id"]
+        assert plan["state"] == "planned"
+        assert plan["trace_id"]
+        # abort from planned is legal and terminal
+        assert ap.abort(pid)["state"] == "aborted"
+        with pytest.raises(ValueError):
+            ap.abort(pid)  # terminal states never transition
+        with pytest.raises(ValueError):
+            ap.approve(pid)
+        with pytest.raises(KeyError):
+            ap.approve("nope")
+        # approve -> executes -> done (the demote actuator is the
+        # scheduler enqueue)
+        p2 = ap._new_plan("tiering_demote", 8, reason={})
+        ap.approve(p2["id"])
+        await ap.wait_idle()
+        assert p2["state"] == "done"
+        assert m.convert.enqueued == [([8], True)]
+        with pytest.raises(ValueError):
+            ap.abort(p2["id"])  # done is terminal
+
+    asyncio.run(run())
+    assert ap.actuator_calls == 1  # exactly the one approved demote
+
+
+def test_abort_after_approve_prevents_execution(monkeypatch):
+    """An abort landing between approve() scheduling the execution task
+    and the event loop running it must win: the operator was told the
+    plan died, so the actuators must never fire."""
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    m = _StubMaster()
+    ap = Autopilot(m, cooldown_s=0.0)
+
+    async def run():
+        plan = ap._new_plan("tiering_demote", 5, reason={})
+        ap.approve(plan["id"])   # task scheduled, not yet run
+        ap.abort(plan["id"])     # the operator kills it first
+        await ap.wait_idle()
+        assert plan["state"] == "aborted"
+
+    asyncio.run(run())
+    assert ap.actuator_calls == 0
+    assert m.convert.enqueued == []
+
+
+def test_seal_stuck_retries_until_dat_deleted():
+    """A seal whose /admin/volume/delete hop fails after the mount
+    landed is parked (the ledger now reads the vid as EC, so the
+    autopilot can never re-plan it) and retried by later scheduler
+    ticks until the .dat is actually gone."""
+    from tests.test_fleet_convert import _StubMaster as _ConvStubMaster
+    from tests.test_fleet_convert import _StubResp
+    from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+
+    class _SealSession:
+        def __init__(self):
+            self.fail_deletes = 1
+            self.calls = []
+
+        def post(self, url, json=None, timeout=None):
+            self.calls.append(url)
+            if "fleet_convert" in url:
+                return _StubResp(payload={"converted": json["volumes"],
+                                          "bytes": 1, "wall_s": 0.1})
+            if "volume/delete" in url and self.fail_deletes:
+                self.fail_deletes -= 1
+                raise OSError("delete hop died")
+            return _StubResp(payload={})
+
+    master = _ConvStubMaster({"n1:80": [7]})
+    master._session = _SealSession()
+    sched = ConvertScheduler(master, rate=100.0, burst=100.0)
+    sched.enqueue([7], seal=True)
+    rec = asyncio.run(sched.tick())[0]
+    assert rec["outcome"] == "ok" and "sealed" not in rec
+    assert sched.status()["seal_stuck"] == [7]
+    # next tick retries the seal (mount is idempotent) and finishes
+    asyncio.run(sched.tick())
+    assert sched.status()["seal_stuck"] == []
+    assert sched.status()["sealing"] == []
+    assert master._session.calls.count(
+        "http://n1:80/admin/volume/delete") == 2
+
+
+def test_plan_only_mode_provably_executes_nothing(monkeypatch):
+    """The acceptance gate: in the default plan mode a tick may create
+    plans but must perform ZERO actuator calls — no scheduler enqueue,
+    no HTTP, no state change anywhere."""
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    ledger = {1: {"vid": 1, "kind": "normal", "state": "healthy",
+                  "collection": ""}}
+    m = _StubMaster(ledger=ledger, heat=_heat_view([]),
+                    disks=[{"vs": "n1:80", "dir": "/d",
+                            "predicted_full_seconds": 60.0}],
+                    nodes=[_StubNode("n1:80", {1: _StubVol(),
+                                               2: _StubVol()}),
+                           _StubNode("n2:80", {})])
+    ap = Autopilot(m, cold_rps=10.0, cold_s=0.0, cooldown_s=0.0,
+                   horizon_s=3600.0)
+    plans = _tick(ap)
+    # both policies found work: a cold demote and a filling-disk move
+    assert {p["policy"] for p in plans} == \
+        {"tiering_demote", "balance_move"}
+    assert all(p["state"] == "planned" for p in ap.plans.values())
+    assert ap.actuator_calls == 0
+    assert m.convert.enqueued == []
+    # a second tick re-plans nothing (the vids have live plans)
+    assert _tick(ap) == []
+    assert ap.actuator_calls == 0
+
+
+def test_off_mode_plans_nothing(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "0")
+    m = _StubMaster(ledger={1: {"vid": 1, "kind": "normal",
+                                "state": "healthy"}})
+    ap = Autopilot(m, cold_s=0.0)
+    assert _tick(ap) == []
+    assert not ap.plans and ap.actuator_calls == 0
+
+
+# -- hysteresis -----------------------------------------------------------
+
+def test_cold_clock_resets_on_warm_sighting(monkeypatch):
+    """A flapping volume never demotes: any warm sighting restarts the
+    sustained-cold clock."""
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    ledger = {3: {"vid": 3, "kind": "normal", "state": "healthy",
+                  "collection": ""}}
+    m = _StubMaster(ledger=ledger, heat=_heat_view([]))
+    ap = Autopilot(m, cold_rps=0.5, cold_s=30.0, cooldown_s=0.0)
+    assert _tick(ap) == []          # clock starts, not sustained yet
+    assert 3 in ap._cold_since
+    m._heat = _heat_view([{"key": "3", "rps": 2.0, "sustained_s": 5}])
+    assert _tick(ap) == []          # warm: clock RESETS
+    assert 3 not in ap._cold_since
+    m._heat = _heat_view([])
+    assert _tick(ap) == []          # cold again: clock restarts at now
+    ap._cold_since[3] -= 31.0       # ...and only sustained cold plans
+    plans = _tick(ap)
+    assert [p["policy"] for p in plans] == ["tiering_demote"]
+    assert plans[0]["reason"]["cold_for_s"] >= 30.0
+
+
+def test_promote_requires_sustained_heat_and_cooldown(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    shard_locs = {str(s): ["n1:80"] for s in range(layout.TOTAL_SHARDS)}
+    ledger = {9: {"vid": 9, "kind": "ec", "state": "healthy",
+                  "collection": "", "shard_locations": shard_locs}}
+    m = _StubMaster(ledger=ledger, heat=_heat_view(
+        [{"key": "9", "rps": 50.0, "sustained_s": 3.0}]))
+    ap = Autopilot(m, hot_rps=5.0, hot_s=60.0, cooldown_s=100.0)
+    assert _tick(ap) == []  # hot but not SUSTAINED hot
+    m._heat = _heat_view([{"key": "9", "rps": 50.0,
+                           "sustained_s": 120.0}])
+    plans = _tick(ap)
+    assert [p["policy"] for p in plans] == ["tiering_promote"]
+    assert plans[0]["node"] == "n1:80"
+    # an executed (here: aborted, since n1 is fake) action arms the
+    # cooldown; the volume cannot be re-planned while it holds
+    ap.plans.clear()
+    ap._last_action[9] = (time.time(), "tiering_promote")
+    assert _tick(ap) == []
+
+
+def test_promote_needs_k_shards_on_one_node(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    spread = {str(s): [f"n{s % 3}:80"]
+              for s in range(layout.TOTAL_SHARDS)}
+    ledger = {4: {"vid": 4, "kind": "ec", "state": "healthy",
+                  "shard_locations": spread}}
+    m = _StubMaster(ledger=ledger, heat=_heat_view(
+        [{"key": "4", "rps": 50.0, "sustained_s": 999.0}]))
+    ap = Autopilot(m, hot_rps=1.0, hot_s=0.0, cooldown_s=0.0)
+    assert _tick(ap) == []  # no node can decode locally: no plan
+
+
+def test_demote_skips_convert_backlog(monkeypatch):
+    """Volumes parked in the conversion pipeline (queued, active, or
+    in the re-queue backoff) are never re-planned."""
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    ledger = {5: {"vid": 5, "kind": "normal", "state": "healthy"},
+              6: {"vid": 6, "kind": "normal", "state": "healthy"}}
+    m = _StubMaster(ledger=ledger, heat=_heat_view([]))
+    m.convert._backoff = {5: (2, 0.0)}   # parked after a node death
+    ap = Autopilot(m, cold_rps=1.0, cold_s=0.0, cooldown_s=0.0)
+    plans = _tick(ap)
+    assert [p["vid"] for p in plans] == [6]
+
+
+# -- balancing ------------------------------------------------------------
+
+def test_balancing_moves_coldest_single_copy_volume(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "plan")
+    vols = {1: _StubVol(size=100), 2: _StubVol(size=10_000),
+            3: _StubVol(size=500, replica_placement="001")}
+    m = _StubMaster(
+        ledger={}, heat=_heat_view(
+            [{"key": "1", "rps": 40.0, "sustained_s": 5.0}]),
+        disks=[{"vs": "full:80", "dir": "/d1",
+                "predicted_full_seconds": 120.0}],
+        nodes=[_StubNode("full:80", vols),
+               _StubNode("roomy:80", {}, free_slots=8),
+               _StubNode("alsofull:80", {}, free_slots=2)])
+    # alsofull is filling too: it must never be chosen as a target
+    m.forecaster._disks.append({"vs": "alsofull:80", "dir": "/d",
+                                "predicted_full_seconds": 200.0})
+    ap = Autopilot(m, cold_rps=0.0, horizon_s=3600.0, cooldown_s=0.0)
+    plans = _tick(ap)
+    moves = [p for p in plans if p["policy"] == "balance_move"]
+    assert len(moves) == 1
+    # vid 1 is HOT (stays), vid 3 is replicated (not movable by this
+    # protocol) -> the big cold single-copy volume 2 moves to the
+    # roomy, non-filling node
+    assert moves[0]["vid"] == 2
+    assert moves[0]["source"] == "full:80"
+    assert moves[0]["target"] == "roomy:80"
+    assert moves[0]["reason"]["predicted_full_seconds"] == 120.0
+
+
+# -- cluster layer --------------------------------------------------------
+
+def _post_json(url, body, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def _get_json(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.load(r)
+
+
+def test_autopilot_tiering_round_trip_end_to_end(tmp_path, monkeypatch):
+    """The full tentpole loop on a real cluster: a sustained-cold volume
+    demotes (sealed conversion: shard set serves, .dat retired), stays
+    byte-identical through the EC read path, then — once the reads make
+    it sustained-hot — promotes back (decode + thaw), byte-identical
+    again and WRITABLE, with the shard set retired."""
+    from tests.test_cluster import Cluster
+    from seaweedfs_tpu.client import WeedClient
+    monkeypatch.setenv("WEEDTPU_AUTOPILOT", "execute")
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    try:
+        c.wait_heartbeats()
+        master = c.master
+        ap = master.autopilot
+        # test-speed thresholds (the env defaults are production-scale)
+        ap.cold_rps = 1e9   # everything counts as cold
+        ap.cold_s = 0.0
+        ap.hot_rps = 0.01
+        ap.hot_s = 0.0
+        ap.cooldown_s = 0.0
+        client = WeedClient(master.url)
+        rng = np.random.default_rng(0xA171)
+        blobs = {}
+        for i in range(10):
+            data = rng.integers(0, 256, int(rng.integers(8_000, 30_000)),
+                                dtype=np.uint8).tobytes()
+            blobs[client.upload(data, name=f"t{i}.bin")] = data
+        vs = c.volume_servers[0]
+        vids = sorted({vid for loc in vs.store.locations
+                       for vid in loc.volumes})
+        for v in vids:
+            vs.store.get_volume(v).nm.flush()
+        time.sleep(0.7)  # volume heartbeats land in the topo
+
+        # --- demote: tick plans + auto-approves, scheduler converts --
+        master.collect_heat()
+        out = _post_json(f"http://{master.url}/cluster/autopilot",
+                         {"tick": True, "wait": True})
+        demotes = [p for p in out["plans"]
+                   if p["policy"] == "tiering_demote"]
+        assert {p["vid"] for p in demotes} == set(vids)
+        c.submit(master.convert.tick())
+        st = master.convert.status()
+        assert st["converted"] == len(vids), st
+        for vid in vids:
+            assert vs.store.get_volume(vid) is None      # .dat retired
+            assert vs.store.get_ec_volume(vid) is not None  # EC serves
+            base = vs.store.get_ec_volume(vid).base
+            assert not os.path.exists(base + ".dat")
+        time.sleep(0.7)  # shard heartbeats land
+        for fid, data in blobs.items():
+            assert client.download(fid) == data  # EC read path, intact
+        # the demote plans reached done and the ledger shows it
+        ap_st = _get_json(f"http://{master.url}/cluster/autopilot")
+        done = [p for p in ap_st["plans"]
+                if p["policy"] == "tiering_demote"]
+        assert all(p["state"] == "done" for p in done)
+
+        # --- promote: sustained-hot EC volume returns to mmap path ---
+        for _ in range(3):
+            for fid, data in blobs.items():
+                assert client.download(fid) == data
+        master.collect_heat()
+        out = _post_json(f"http://{master.url}/cluster/autopilot",
+                         {"tick": True, "wait": True})
+        promotes = [p for p in out["plans"]
+                    if p["policy"] == "tiering_promote"]
+        assert {p["vid"] for p in promotes} == set(vids), out["plans"]
+        for vid in vids:
+            v = vs.store.get_volume(vid)
+            assert v is not None and not v.read_only  # thawed, writable
+            assert vs.store.get_ec_volume(vid) is None
+            assert os.path.exists(v._base + ".dat")
+            assert not glob.glob(v._base + ".ec*")  # shard set retired
+        for fid, data in blobs.items():
+            assert client.download(fid) == data  # byte-identical again
+        # round trip is auditable: every plan carries a pinned trace id
+        ap_st = _get_json(f"http://{master.url}/cluster/autopilot")
+        assert all(len(p["trace_id"]) == 32 for p in ap_st["plans"])
+        # the operator surface renders the ledger
+        import io
+        from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+        buf = io.StringIO()
+        run_command(CommandEnv(master.url), "cluster.autopilot", buf)
+        text = buf.getvalue()
+        assert "mode=execute" in text
+        assert "tiering_promote" in text and "tiering_demote" in text
+        client.close()
+    finally:
+        c.stop()
+
+
+def test_volume_move_end_to_end_and_dead_target_abort(tmp_path):
+    """/admin/volume/move: CRC-verified staged move lands the volume on
+    the target byte-identically and retires the source; a move at a
+    dead target aborts cleanly — source unchanged, still serving,
+    writability restored."""
+    from tests.test_cluster import Cluster, free_port
+    from seaweedfs_tpu.client import WeedClient
+    c = Cluster(tmp_path, n_volume_servers=2).start()
+    try:
+        c.wait_heartbeats()
+        client = WeedClient(c.master.url)
+        rng = np.random.default_rng(0xB0B)
+        blobs = {}
+        for i in range(8):
+            data = rng.integers(0, 256, 20_000,
+                                dtype=np.uint8).tobytes()
+            blobs[client.upload(data, name=f"m{i}.bin")] = data
+        vid = int(next(iter(blobs)).partition(",")[0])
+        src = next(vs for vs in c.volume_servers
+                   if vs.store.get_volume(vid) is not None)
+        dst = next(vs for vs in c.volume_servers if vs is not src)
+
+        # --- abort: dead target -> 500, no state change --------------
+        dead = f"127.0.0.1:{free_port()}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(f"http://{src.url}/admin/volume/move",
+                       {"volume": vid, "target": dead})
+        assert ei.value.code == 500
+        v = src.store.get_volume(vid)
+        assert v is not None and not v.read_only  # thawed back
+        for fid, data in blobs.items():
+            assert client.download(fid) == data   # still serving
+
+        # --- the real move -------------------------------------------
+        out = _post_json(f"http://{src.url}/admin/volume/move",
+                         {"volume": vid, "target": dst.url})
+        assert out["moved"] == vid and out["target"] == dst.url
+        assert isinstance(out["crc"], int)
+        assert src.store.get_volume(vid) is None
+        moved = dst.store.get_volume(vid)
+        assert moved is not None and not moved.read_only
+        assert not getattr(moved, "staging", False)
+        # no leftovers on either side
+        for vs in (src, dst):
+            for loc in vs.store.locations:
+                leftovers = [p for pat in
+                             ("*.cpd", "*.cpx", "*.staging", "*.cptail")
+                             for p in glob.glob(
+                                 os.path.join(loc.directory, pat))]
+                assert not leftovers, leftovers
+        time.sleep(0.8)  # both sides' heartbeats reach the master
+        for fid, data in blobs.items():
+            assert client.download(fid) == data  # byte-identical
+        client.close()
+    finally:
+        c.stop()
+
+
+def test_convert_requeue_surface():
+    """The re-queue backlog is observable: counter + /maintenance/convert
+    block, and re-queued volumes never vanish from the queue."""
+    from tests.test_fleet_convert import _StubMaster as _ConvStubMaster
+    from seaweedfs_tpu.maintenance.convert import ConvertScheduler
+    from seaweedfs_tpu.stats import metrics
+
+    def requeued_total():
+        total = 0.0
+        for labels, child in metrics.CONVERT_REQUEUED._pairs():
+            total += child.value
+        return total
+
+    before = requeued_total()
+    master = _ConvStubMaster({"n1:80": [1, 2]}, fail=True)
+    sched = ConvertScheduler(master, rate=100.0, burst=100.0)
+    sched.enqueue([1, 2])
+    assert asyncio.run(sched.tick())[0]["outcome"].startswith("error")
+    st = sched.status()
+    assert st["requeued"]["total"] == 2
+    assert st["requeued"]["by_reason"] == {"node_error": 2}
+    assert sorted(st["requeued"]["parked"]) == [1, 2]
+    assert sorted(st["queued"]) == [1, 2]  # re-queued, never dropped
+    assert requeued_total() - before == 2.0

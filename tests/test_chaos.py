@@ -617,6 +617,23 @@ def test_helper_death_mid_rebuild_replans(tmp_path):
         c.stop()
 
 
+def test_move_mid_failure_aborts_clean(tmp_path):
+    """The autopilot balancing actuator's abort contract: killing the
+    move target mid-transfer leaves no partial state on either side,
+    the source keeps serving byte-identically, the restarted target
+    boots with no orphan files, and the re-run move completes — all
+    asserted inside the fault cell, plus run_scenario's byte-identical
+    readback and clean fsck."""
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=True)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        report = run_scenario(c, "degraded_read", "move_mid_failure")
+        assert report["fault"] == "move_mid_failure"
+    finally:
+        c.stop()
+
+
 # ---- chaos.status + fsck gate ------------------------------------------
 
 

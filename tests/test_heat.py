@@ -42,7 +42,7 @@ def test_space_saving_error_bounds_on_zipf_stream():
     assert ss.total == pytest.approx(total)
     snap = ss.snapshot()
     assert len(snap["entries"]) <= 32
-    for key, est, err, _aux in snap["entries"]:
+    for key, est, err, _aux, _fs in snap["entries"]:
         assert est + 1e-6 >= true[key], (key, est, true[key])
         assert est - err <= true[key] + 1e-6, (key, est, err, true[key])
         assert err <= total / 32 + 1e-6
@@ -100,7 +100,7 @@ def test_sketch_merge_equals_union_stream():
         [s["dims"]["chunk"] for s in snaps], 16, 1e9, now=clock[0])
     union_snap = union.serialize()["dims"]["chunk"]
     uent = {e[0]: e for e in union_snap["entries"]}
-    for key, est, err, _aux in merged["entries"][:5]:
+    for key, est, err, _aux, _fs in merged["entries"][:5]:
         if key in uent:
             u_est, u_err = uent[key][1], uent[key][2]
             # both summaries bound the same true count: the intervals
@@ -124,6 +124,64 @@ def test_merge_decay_aligns_snapshot_clocks():
                                     now=60.0)
     ent = merged["entries"][0]
     assert ent[0] == "acme" and ent[1] == pytest.approx(50.0, rel=1e-6)
+
+
+def test_first_seen_is_monotone_under_decay_and_resets_on_eviction():
+    """The sustained-duration clock must survive decay sweeps untouched
+    (duration is not a count), and an evicted key's replacement must
+    start a FRESH clock — inheriting the victim's tenure would let a
+    flapping key look sustained to autopilot hysteresis."""
+    clock = [100.0]
+    ss = heat.SpaceSaving(k=2, halflife=10.0, now_fn=lambda: clock[0])
+    ss.offer("a", 100.0)
+    ss.offer("b", 50.0)
+    fs_a = ss.entries["a"][3]
+    assert fs_a == 100.0
+    # three half-lives of decay: counts shrink 8x, first_seen unmoved
+    clock[0] += 30.0
+    ss.offer("a", 1.0)
+    assert ss.entries["a"][0] < 100.0
+    assert ss.entries["a"][3] == fs_a
+    # eviction exchange: "c" takes the minimum slot but NOT its tenure
+    clock[0] += 5.0
+    ss.offer("c", 1.0)
+    assert "b" not in ss.entries and "c" in ss.entries
+    assert ss.entries["c"][3] == clock[0]
+    # snapshot round-trips the clock and the view reports sustained_s
+    snap = ss.snapshot()
+    ent = {e[0]: e for e in snap["entries"]}
+    assert ent["a"][4] == fs_a
+    view = heat._entry_view(heat.SpaceSaving.merge(
+        [snap], 2, 10.0, now=clock[0])["entries"][0], 10.0,
+        now=clock[0])
+    assert view["sustained_s"] == pytest.approx(clock[0] - fs_a, abs=0.1)
+
+
+def test_first_seen_merges_as_min_over_nodes():
+    """The fleet first_seen is the EARLIEST sighting on any node (min
+    over nodes tracking the key); a node that never saw the key
+    contributes nothing — its absent-min bound carries no tenure."""
+    clock = [1000.0]
+    now = lambda: clock[0]  # noqa: E731
+    a = heat.SpaceSaving(k=4, halflife=1e9, now_fn=now)
+    a.offer("v9", 5.0)
+    clock[0] += 40.0
+    b = heat.SpaceSaving(k=4, halflife=1e9, now_fn=now)
+    b.offer("v9", 7.0)
+    b.offer("only-b", 3.0)
+    merged = heat.SpaceSaving.merge([a.snapshot(), b.snapshot()],
+                                    4, 1e9, now=clock[0])
+    ents = {e[0]: e for e in merged["entries"]}
+    assert ents["v9"][4] == 1000.0       # min(1000, 1040)
+    assert ents["only-b"][4] == 1040.0   # single-node key keeps its own
+    # merging is idempotent on the min: re-merging the merged summary
+    # with a later-sighted node never moves first_seen later
+    c = heat.SpaceSaving(k=4, halflife=1e9, now_fn=now)
+    clock[0] += 5.0
+    c.offer("v9", 1.0)
+    re = heat.SpaceSaving.merge([merged, c.snapshot()], 4, 1e9,
+                                now=clock[0])
+    assert {e[0]: e for e in re["entries"]}["v9"][4] == 1000.0
 
 
 def test_degraded_annotation_does_not_double_count():

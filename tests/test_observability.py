@@ -965,3 +965,31 @@ def test_every_env_knob_documented_in_readme():
     assert not missing, (
         f"env knobs read in seaweedfs_tpu/ but undocumented in "
         f"README.md: {missing}")
+
+
+def test_every_control_endpoint_documented_in_readme():
+    """Repo lint: every /cluster/* and /admin/* HTTP endpoint the
+    servers register must appear in README.md — an undocumented control
+    endpoint is an actuator nobody can audit (the autopilot's
+    /admin/volume/move made this a hard requirement: an endpoint that
+    can relocate data MUST be findable).  Path params normalize
+    {x} -> <x> to match the README's convention."""
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parent.parent
+    endpoints: set[str] = set()
+    # the server modules are where routes register; client call sites
+    # elsewhere necessarily name a subset of these same paths
+    for sub in ("server", "s3", "mq"):
+        for p in (root / "seaweedfs_tpu" / sub).rglob("*.py"):
+            endpoints |= set(re.findall(
+                r'"(/(?:cluster|admin)/[A-Za-z0-9_/{}.:-]*)"',
+                p.read_text(encoding="utf-8")))
+    assert len(endpoints) > 30, (
+        f"endpoint scan looks broken: {sorted(endpoints)}")
+    readme = (root / "README.md").read_text(encoding="utf-8")
+    missing = sorted(
+        e for e in endpoints
+        if re.sub(r"\{([A-Za-z0-9_:]+)\}", r"<\1>", e) not in readme)
+    assert not missing, (
+        f"HTTP control endpoints registered in the servers but "
+        f"undocumented in README.md: {missing}")
