@@ -628,12 +628,21 @@ def _try(extra: dict, key: str, fn, *args, **kw) -> None:
         print(f"bench: {key} failed: {e}", file=sys.stderr)
 
 
+# the measured host disk ceiling of THIS round, stamped by
+# _bench_e2e_host when the probe runs: {"gbps": ..., "aio": ...} — the
+# ceiling is only meaningful alongside the engine mode it was probed
+# under (a buffered ceiling does not bound an io_uring data path)
+_PROBED_DISK_CEILING: dict = {}
+
+
 def _bench_config(backend: str) -> dict:
     """This round's measurement config: backend + resolved Pallas tile +
-    chip fingerprint.  Stamped into every bench_history.jsonl entry so
-    the trajectory gate compares like-for-like — a CPU-fallback round
-    (or a different chip generation under the same backend string) must
-    not masquerade as a regression against TPU numbers."""
+    chip fingerprint + host aio engine mode (and the disk ceiling probed
+    under it).  Stamped into every bench_history.jsonl entry so the
+    trajectory gate compares like-for-like — a CPU-fallback round (or a
+    different chip generation under the same backend string, or a
+    buffered-fallback round under an io_uring history) must not
+    masquerade as a regression against the real thing."""
     cfg: dict = {"backend": backend}
     tile = os.environ.get("WEEDTPU_EC_TILE")
     if tile:
@@ -647,6 +656,13 @@ def _bench_config(backend: str) -> dict:
             cfg["fingerprint"] = pallas_gf.chip_fingerprint()
         except Exception:
             pass
+    try:
+        from seaweedfs_tpu.storage import aio as _aio
+        cfg["aio"] = _aio.engine_label()
+    except Exception:
+        pass
+    if _PROBED_DISK_CEILING:
+        cfg["disk_ceiling"] = dict(_PROBED_DISK_CEILING)
     return cfg
 
 
@@ -724,6 +740,20 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
         fp = (e.get("config") or {}).get("fingerprint")
         return fp is None or fp_now is None or fp == fp_now
 
+    # host-I/O-bound metrics additionally compare only against rounds
+    # measured under the same aio engine mode (mirroring the fingerprint
+    # rule): a buffered-fallback round must not read as an io_uring
+    # regression — nor set the bar an io_uring round is then judged by.
+    # None-tolerant for the same reason as fingerprint: rounds predating
+    # the stamp stay comparable.
+    aio_now = cfg.get("aio")
+
+    def metric_comparable(e: dict, m: str) -> bool:
+        if not m.startswith(AIO_SCOPED_METRICS):
+            return True
+        a = (e.get("config") or {}).get("aio")
+        return a is None or aio_now is None or a == aio_now
+
     comparable = [e for e in entries if like_for_like(e)]
     comparable = comparable[-TRAJECTORY_LOOKBACK:]
     if not comparable:
@@ -748,7 +778,8 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
             # a measured 0.0 still compares (and gates) below
             continue
         best = max((e.get("metrics", {}).get(m) or 0.0
-                    for e in comparable), default=0.0)
+                    for e in comparable if metric_comparable(e, m)),
+                   default=0.0)
         if best > 0 and now_v < TRAJECTORY_TOL * best:
             regressions[m] = {"value": now_v, "best_prior": best,
                               "ratio": round(now_v / best, 3)}
@@ -759,7 +790,8 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
         if now_v is None:
             continue
         priors = [e.get("metrics", {}).get(m) for e in comparable
-                  if e.get("metrics", {}).get(m)]
+                  if e.get("metrics", {}).get(m)
+                  and metric_comparable(e, m)]
         best = min(priors, default=0.0)
         if best > 0 and now_v > best / TRAJECTORY_TOL:
             regressions[m] = {"value": now_v, "best_prior": best,
@@ -1105,6 +1137,10 @@ BATCH_PLACE_TOL = 0.90
 # lower-is-better trajectory gates: the metric failing when it RISES
 # more than 10% above the best (minimum) prior recorded round
 TRAJECTORY_GATED_MIN = ("repair_network_ratio",)
+# metric prefixes whose numbers are bound by the host I/O engine: these
+# additionally require the prior round's config.aio to match (see
+# _record_trajectory.metric_comparable)
+AIO_SCOPED_METRICS = ("ec_encode_e2e", "fleet_convert", "ec_rebuild_e2e")
 # ...comparing against the best of only the last N recorded same-backend
 # rounds, so one cache-hot outlier round ages out of the bar instead of
 # ratcheting it forever
@@ -1164,6 +1200,9 @@ def _bench_e2e_host(extra: dict) -> None:
         # (stats/profile.py): shard_write fractions become queryable
         from seaweedfs_tpu.stats import profile as _profile
         _profile.set_ceiling("disk", ceil["ceiling_gbps"])
+        from seaweedfs_tpu.storage import aio as _aio
+        _PROBED_DISK_CEILING.update(gbps=round(ceil["ceiling_gbps"], 3),
+                                    aio=_aio.engine_label())
     except Exception as e:
         print(f"bench: ec_encode_e2e_ceiling_1g failed: {e}",
               file=sys.stderr)
@@ -3266,6 +3305,7 @@ def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:
     encode_gbps, frac}: e2e-minus-the-GF-math and how closely the real
     encode tracks it."""
     import mmap as mmap_mod
+    from seaweedfs_tpu.storage import aio as _aio
     from seaweedfs_tpu.storage.ec import ec_files, layout
     k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
     sb = 1024 * 1024
@@ -3288,10 +3328,17 @@ def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:
             try:
                 t0 = time.perf_counter()
                 pool = queue.Queue()
-                for _ in range(ec_files._parity_ring_size(min_step,
-                                                          max_step)):
-                    pool.put(np.empty((m, max_step), dtype=np.uint8))
-                writers = ec_files._ShardWriterPool(fds)
+                # aligned + registered like the real encoder's ring: the
+                # ceiling must ride the same aio engine (O_DIRECT,
+                # registered buffers) as the data path — a buffered
+                # ceiling under an io_uring data path reports a bound the
+                # production writes don't live under
+                pbufs = [_aio.aligned_empty((m, max_step))
+                         for _ in range(ec_files._parity_ring_size(
+                             min_step, max_step))]
+                for pb in pbufs:
+                    pool.put(pb)
+                writers = ec_files._ShardWriterPool(fds, reg_bufs=pbufs)
                 sink = ec_files._make_sink(writers, layout.TOTAL_SHARDS,
                                            min_step)
                 for row_start, block, col, step, shard_off in \

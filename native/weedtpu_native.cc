@@ -225,11 +225,69 @@ static void gf_matmul_avx2_group(const uint8_t* mat, int r0, int nrows, int k,
 // the whole pshufb split-table dance — the encode becomes memory-bound on
 // any GFNI host.  Guarded by runtime CPUID (compiled via target attribute,
 // so the .so still loads and runs on plain-AVX2 machines).
+//
+// Access-pattern tuning: 256-byte column blocks give every output row four
+// independent accumulator chains (gf2p8affineqb is a latency-3 op, so two
+// chains leave the port idle between xors), and large aligned runs stream
+// the parity out with non-temporal stores — parity is written once and
+// read never, so letting it RFO through the cache would cost a read of
+// every destination line and steal bandwidth from the source shards.
+#define WN_GFNI_NT_MIN ((size_t)1 << 22)  // NT pays off only well past LLC
+
 __attribute__((target("gfni,avx512f,avx512bw,avx512vl")))
 static void gf_matmul_gfni_group(const uint8_t* mat, int r0, int nrows, int k,
                                  const uint8_t* const* in_rows,
                                  uint8_t* const* out_rows, size_t n) {
+  int use_nt = n >= WN_GFNI_NT_MIN;
+  for (int r = 0; use_nt && r < nrows; r++)
+    if (((uintptr_t)out_rows[r0 + r]) & 63) use_nt = 0;
   size_t col = 0;
+  for (; col + 256 <= n; col += 256) {
+    __m512i acc[4][4];
+    for (int r = 0; r < nrows; r++)
+      acc[r][0] = acc[r][1] = acc[r][2] = acc[r][3] =
+          _mm512_setzero_si512();
+    for (int j = 0; j < k; j++) {
+      const uint8_t* src = in_rows[j] + col;
+      __m512i v0 = _mm512_loadu_si512((const void*)src);
+      __m512i v1 = _mm512_loadu_si512((const void*)(src + 64));
+      __m512i v2 = _mm512_loadu_si512((const void*)(src + 128));
+      __m512i v3 = _mm512_loadu_si512((const void*)(src + 192));
+      for (int r = 0; r < nrows; r++) {
+        uint8_t c = mat[(size_t)(r0 + r) * k + j];
+        if (c == 0) continue;
+        __m512i A = _mm512_set1_epi64((long long)GF_AFFINE[c]);
+        acc[r][0] = _mm512_xor_si512(
+            acc[r][0], _mm512_gf2p8affine_epi64_epi8(v0, A, 0));
+        acc[r][1] = _mm512_xor_si512(
+            acc[r][1], _mm512_gf2p8affine_epi64_epi8(v1, A, 0));
+        acc[r][2] = _mm512_xor_si512(
+            acc[r][2], _mm512_gf2p8affine_epi64_epi8(v2, A, 0));
+        acc[r][3] = _mm512_xor_si512(
+            acc[r][3], _mm512_gf2p8affine_epi64_epi8(v3, A, 0));
+      }
+    }
+    if (use_nt) {
+      for (int r = 0; r < nrows; r++) {
+        uint8_t* dst = out_rows[r0 + r] + col;
+        _mm512_stream_si512((__m512i*)dst, acc[r][0]);
+        _mm512_stream_si512((__m512i*)(dst + 64), acc[r][1]);
+        _mm512_stream_si512((__m512i*)(dst + 128), acc[r][2]);
+        _mm512_stream_si512((__m512i*)(dst + 192), acc[r][3]);
+      }
+    } else {
+      for (int r = 0; r < nrows; r++) {
+        uint8_t* dst = out_rows[r0 + r] + col;
+        _mm512_storeu_si512((void*)dst, acc[r][0]);
+        _mm512_storeu_si512((void*)(dst + 64), acc[r][1]);
+        _mm512_storeu_si512((void*)(dst + 128), acc[r][2]);
+        _mm512_storeu_si512((void*)(dst + 192), acc[r][3]);
+      }
+    }
+  }
+  if (use_nt) _mm_sfence();  // NT stores are weakly ordered; fence before
+                             // the buffers are handed to the writers
+  // 128-byte remainder block keeps the vector path for mid-size tails
   for (; col + 128 <= n; col += 128) {
     __m512i acc[4][2];
     for (int r = 0; r < nrows; r++)

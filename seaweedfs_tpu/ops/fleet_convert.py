@@ -340,10 +340,26 @@ def convert_volumes(bases: list[str], *,
                 pool.put(buf)
                 continue
             try:
-                with _Timer(stats, "d2h_s"):
-                    blocks = list(unit_parity_shards(parity))
-                pool.put(buf)  # device done with the staging memory
-                for a, b, block in blocks:
+                # stream: each block fans out (and its parity writes
+                # submit) the moment its d2h lands, instead of waiting
+                # for a full gather — write_parity overlaps the d2h of
+                # the blocks still in flight
+                blocks = unit_parity_shards(parity)
+                released = False
+                while True:
+                    with _Timer(stats, "d2h_s"):
+                        item_blk = next(blocks, None)
+                    if item_blk is None:
+                        break
+                    if not released:
+                        # the first yield implies block_until_ready has
+                        # returned: the device is done with the staging
+                        # memory even though later shards are still
+                        # transferring
+                        pool.put(buf)
+                        released = True
+                    a, b, block = item_blk
+                    touched = []
                     for u in range(a, min(b, len(metas))):
                         job, shard_off, step = metas[u]
                         rows = block[u - a]
@@ -354,6 +370,12 @@ def convert_volumes(bases: list[str], *,
                         job.units_drained += 1
                         if job.drained_all():
                             job.finalize()
+                        elif job not in touched:
+                            touched.append(job)
+                    for job in touched:
+                        job.parity_flusher.flush()
+                if not released:
+                    pool.put(buf)
             except BaseException as e:
                 errors.append(e)
                 failed = True

@@ -103,6 +103,9 @@ STAGE_RESOURCE = {
     "encode": "device", "reconstruct": "device", "d2h": "d2h",
     "read": "disk", "local_pread": "disk",
     "write": "disk", "write_data": "disk", "write_parity": "disk",
+    # the aio engine's finer cut of the write stages: ring submission vs
+    # completion reaping (storage/aio.py) — same disk resource
+    "submit": "disk", "complete": "disk",
     "remote_fetch": "net",
 }
 

@@ -434,38 +434,11 @@ def _unit_coverage(dat_size: int, row_start: int, block: int, col: int,
     return nz, tail
 
 
-def _pwrite_all(fd: int, view, off: int) -> None:
-    """pwrite may write short (RLIMIT_FSIZE edge, fs under pressure); a
-    silent short write would commit a shard with a zero gap."""
-    mv = memoryview(view)
-    while len(mv) > 0:
-        n = os.pwrite(fd, mv, off)
-        if n <= 0:
-            raise OSError("pwrite returned 0")
-        mv = mv[n:]
-        off += n
-
-
-def _pwritev_all(fd: int, bufs: list, off: int) -> None:
-    """Vectored pwrite of buffers destined for one contiguous file range:
-    a run of per-unit parity blocks lands in a single syscall instead of
-    one pwrite per unit.  Short writes (possibly mid-iovec) resume."""
-    if not hasattr(os, "pwritev"):
-        for b in bufs:
-            _pwrite_all(fd, b, off)
-            off += memoryview(b).nbytes
-        return
-    mvs = [memoryview(b) for b in bufs]
-    while mvs:
-        n = os.pwritev(fd, mvs, off)
-        if n <= 0:
-            raise OSError("pwritev returned 0")
-        off += n
-        while mvs and n >= len(mvs[0]):
-            n -= len(mvs[0])
-            mvs.pop(0)
-        if mvs and n:
-            mvs[0] = mvs[0][n:]
+# the raw write primitives live with the async engine now; re-exported
+# here because callers (and tests) reach them through this module
+from seaweedfs_tpu.storage.aio import (  # noqa: E402
+    _pwrite_all, _pwritev_all, aligned_empty as _aligned_empty)
+from seaweedfs_tpu.storage import aio as _aio  # noqa: E402
 
 
 def _countdown(n: int, cb):
@@ -504,14 +477,28 @@ class _ShardWriterPool:
     `.errors` after close().  Busy seconds accumulate per SHARD (not per
     worker) and close() folds them into the stats dict under
     stage_key(shard_index), preserving the write_data_s/write_parity_s
-    attribution bench.py reports."""
+    attribution bench.py reports.
+
+    The actual byte-moving rides the host async-I/O engine
+    (storage/aio.py): each worker owns a WriteEngine (io_uring ring with
+    O_DIRECT on aligned runs, degrading to pwritev / buffered per
+    WEEDTPU_AIO).  Release hooks fire only after the engine drains a
+    batch — an async kernel may still be reading a parity buffer long
+    after submission returned.  `reg_bufs` (the parity/output rings) are
+    registered with every worker's ring so aligned writes go out as
+    WRITE_FIXED.  close() folds the engines' submit/complete seconds
+    into stats next to the write stages."""
 
     def __init__(self, fds, highwater=None, stats=None, stage_key=None,
-                 depth: int | None = None, workers: int | None = None):
+                 depth: int | None = None, workers: int | None = None,
+                 reg_bufs=None):
         self._fds = list(fds)
         self._hw = highwater
         self._stats = stats
         self._stage_key = stage_key or (lambda i: "write_s")
+        self._mode = _aio.engine_mode()
+        self._reg = list(reg_bufs) if reg_bufs else None
+        self._engines: list = []
         n = workers if workers else _writer_threads(len(self._fds))
         self._nworkers = max(1, min(len(self._fds), n))
         shards_per = -(-len(self._fds) // self._nworkers)
@@ -556,52 +543,81 @@ class _ShardWriterPool:
 
     def _run(self, w: int) -> None:
         q = self._queues[w]
-        while True:
-            batch = q.get()
-            if batch is None:
-                return
-            shard, item = batch
-            fd = self._fds[shard]
-            t0 = time.perf_counter()
-            idx = 0
-            while idx < len(item):
-                data, cfr, off, release = item[idx]
-                releases = [release]
-                idx += 1
+        eng = _aio.WriteEngine(mode=self._mode, reg=self._reg)
+        self._engines.append(eng)
+        try:
+            while True:
+                batch = q.get()
+                if batch is None:
+                    return
+                shard, item = batch
+                fd = self._fds[shard]
+                t0 = time.perf_counter()
+                # releases fire only after the batch DRAINS: with an
+                # async ring the kernel may still be reading a buffer
+                # long after submission returned, and a recycled parity
+                # buffer mid-read is silent corruption
+                releases: list = []
+                ends: list[tuple[int, int]] = []
+                idx = 0
+                while idx < len(item):
+                    data, cfr, off, release = item[idx]
+                    if release is not None:
+                        releases.append(release)
+                    idx += 1
+                    try:
+                        if self.errors:
+                            continue  # drain without touching the fd
+                        if cfr is not None:
+                            src_fd, src_off, count, src_view = cfr
+                            # in-kernel copies want plain buffered fd
+                            # semantics: barrier the ring, drop O_DIRECT
+                            eng.ensure_buffered(fd)
+                            _copy_range(src_fd, fd, src_off, off, count,
+                                        src_view=src_view)
+                            end = off + count
+                            self._wbytes[shard] += count
+                            if self._hw is not None and \
+                                    end > self._hw[shard]:
+                                self._hw[shard] = end
+                        else:
+                            # merge the run of pwrites targeting
+                            # contiguous offsets into one submission
+                            bufs = [np.ascontiguousarray(data)]
+                            end = off + bufs[0].nbytes
+                            while (idx < len(item)
+                                   and len(bufs) < self._IOV_RUN
+                                   and item[idx][1] is None
+                                   and item[idx][2] == end):
+                                nxt = np.ascontiguousarray(item[idx][0])
+                                bufs.append(nxt)
+                                end += nxt.nbytes
+                                if item[idx][3] is not None:
+                                    releases.append(item[idx][3])
+                                idx += 1
+                            eng.writev(fd, bufs, off)
+                            ends.append((end, end - off))
+                    except BaseException as e:  # surfaced after close
+                        self.errors.append(e)
                 try:
-                    if self.errors:
-                        continue  # drain without touching the fd
-                    if cfr is not None:
-                        src_fd, src_off, count, src_view = cfr
-                        _copy_range(src_fd, fd, src_off, off, count,
-                                    src_view=src_view)
-                        end = off + count
-                    else:
-                        # merge the run of pwrites targeting contiguous
-                        # offsets into one vectored syscall
-                        bufs = [np.ascontiguousarray(data)]
-                        end = off + bufs[0].nbytes
-                        while (idx < len(item)
-                               and len(bufs) < self._IOV_RUN
-                               and item[idx][1] is None
-                               and item[idx][2] == end):
-                            nxt = np.ascontiguousarray(item[idx][0])
-                            bufs.append(nxt)
-                            end += nxt.nbytes
-                            releases.append(item[idx][3])
-                            idx += 1
-                        _pwritev_all(fd, bufs, off)
-                    self._wbytes[shard] += end - off
-                    if self._hw is not None and end > self._hw[shard]:
-                        self._hw[shard] = end
-                except BaseException as e:  # surfaced after close
+                    eng.drain()
+                except BaseException as e:
                     self.errors.append(e)
-                finally:
-                    self._busy[shard] += time.perf_counter() - t0
-                    t0 = time.perf_counter()
-                    for rel in releases:
-                        if rel is not None:
-                            rel()
+                else:
+                    if not self.errors:
+                        for end, n in ends:
+                            self._wbytes[shard] += n
+                            if self._hw is not None and \
+                                    end > self._hw[shard]:
+                                self._hw[shard] = end
+                self._busy[shard] += time.perf_counter() - t0
+                for rel in releases:
+                    rel()
+        finally:
+            try:
+                eng.close()
+            except BaseException as e:
+                self.errors.append(e)
 
     # a bare pool quacks like a _ShardFlusher so producers can submit
     # DIRECTLY when units are big enough that per-job queue hops are
@@ -624,6 +640,28 @@ class _ShardWriterPool:
             q.put(None)
         for t in self._threads:
             t.join()
+        if self._stats is not None:
+            # engine sub-stages: where the write stage's wall actually
+            # went — SQE stamping + submission syscalls vs CQE waits.
+            # These are SUBSETS of the write_* busy seconds (same clock,
+            # finer cut), so overlap_fraction excludes them; the
+            # pipeline snapshot shows them as disk stages with the full
+            # worker capacity behind them
+            sub = sum(e.submit_s for e in self._engines)
+            comp = sum(e.complete_s for e in self._engines)
+            if sub or comp:
+                self._stats["submit_s"] = \
+                    self._stats.get("submit_s", 0.0) + sub
+                self._stats["complete_s"] = \
+                    self._stats.get("complete_s", 0.0) + comp
+                for wkey in ("submit_workers", "complete_workers"):
+                    self._stats[wkey] = self._stats.get(wkey, 0.0) + \
+                        self._nworkers
+            direct = sum(e.direct_bytes for e in self._engines)
+            if direct:
+                self._stats["aio_direct_bytes"] = \
+                    self._stats.get("aio_direct_bytes", 0) + direct
+            self._stats.setdefault("aio_mode", self._mode)
         if self._stats is not None:
             key_busy: dict[str, float] = {}
             for i, busy in enumerate(self._busy):
@@ -739,8 +777,13 @@ def overlap_fraction(stats: dict) -> float | None:
     backpressured run reads as ~0, not as overlapped.  None when the
     stats carry no wall clock or no stage time (e.g. an empty volume)."""
     wall = stats.get("wall_s")
+    # submit_s/complete_s are the engine's finer cut of the same seconds
+    # the write stages already carry — counting them again would inflate
+    # the stage sum and fake overlap
     total = sum(v for key, v in stats.items()
-                if key.endswith("_s") and key not in ("wall_s", "stall_s")
+                if key.endswith("_s")
+                and key not in ("wall_s", "stall_s", "submit_s",
+                                "complete_s")
                 and isinstance(v, float))
     if not wall or total <= 0:
         return None
@@ -788,13 +831,18 @@ def _encode_serial_host(codec, dat_fd: int, dat_view: np.ndarray,
     k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
     min_step, max_step = _unit_steps(dat_size, large_block, small_block,
                                      batch_size)
+    # ALIGN-aligned parity ring: rows qualify for O_DIRECT + registered-
+    # buffer submission whenever the step is an ALIGN multiple
+    pbufs = [_aligned_empty((m, max_step))
+             for _ in range(_parity_ring_size(min_step, max_step))]
     pbuf_pool: queue.Queue = queue.Queue()
-    for _ in range(_parity_ring_size(min_step, max_step)):
-        pbuf_pool.put(np.empty((m, max_step), dtype=np.uint8))
+    for b in pbufs:
+        pbuf_pool.put(b)
     tailbuf = np.zeros(max_step, dtype=np.uint8)
     writers = _ShardWriterPool(
         out_fds, highwater, stats,
-        stage_key=lambda i: "write_data_s" if i < k else "write_parity_s")
+        stage_key=lambda i: "write_data_s" if i < k else "write_parity_s",
+        reg_bufs=pbufs)
     sink = _make_sink(writers, layout.TOTAL_SHARDS, min_step)
     done = 0
     try:
@@ -881,18 +929,23 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
     from seaweedfs_tpu.ops.native_codec import NativeRSCodec
     native_host = isinstance(codec, NativeRSCodec)
     k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
-    _, max_step = _unit_steps(dat_size, large_block, small_block,
-                              batch_size)
+    min_step, max_step = _unit_steps(dat_size, large_block, small_block,
+                                     batch_size)
     pool: queue.Queue = queue.Queue()
+    reg_bufs = None
     if native_host:
         tailbuf = np.zeros(max_step, dtype=np.uint8)
         # sized like _parity_ring_size's BATCHED branch: the pipelined
-        # drain always submits through a _ShardFlusher (its pwritev
+        # drain submits small units through a _ShardFlusher (its pwritev
         # merging measures ~4% faster than direct submission even for
         # DIRECT_MIN-sized units), so the ring must cover a full
-        # unflushed flush group
-        for _ in range(PIPELINE_DEPTH + max(1, FLUSH_BYTES // max_step)):
-            pool.put(np.empty((m, max_step), dtype=np.uint8))
+        # unflushed flush group.  ALIGN-aligned so O_DIRECT/WRITE_FIXED
+        # engage on production block sizes.
+        reg_bufs = [_aligned_empty((m, max_step))
+                    for _ in range(PIPELINE_DEPTH +
+                                   max(1, FLUSH_BYTES // max_step))]
+        for b in reg_bufs:
+            pool.put(b)
     else:
         for _ in range(PIPELINE_DEPTH):
             pool.put(np.empty((k, max_step), dtype=np.uint8))
@@ -907,7 +960,8 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
     errors: list[BaseException] = []
     writers = _ShardWriterPool(
         out_fds, highwater, stats,
-        stage_key=lambda i: "write_data_s" if i < k else "write_parity_s")
+        stage_key=lambda i: "write_data_s" if i < k else "write_parity_s",
+        reg_bufs=reg_bufs)
     done = 0
 
     def reader() -> None:
@@ -959,7 +1013,13 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
 
     def drain() -> None:
         failed = False
-        flusher = _ShardFlusher(writers, layout.TOTAL_SHARDS)
+        # production-size units submit DIRECTLY: each unit's parity is
+        # on its writer the moment its d2h lands, so write_parity busy
+        # time overlaps the next unit's d2h instead of queueing behind a
+        # flush-group boundary.  Tiny units keep the batcher — per-unit
+        # queue hops would cost more than the writes.
+        flusher = writers if min_step >= DIRECT_MIN else \
+            _ShardFlusher(writers, layout.TOTAL_SHARDS)
         while True:
             item = q_disp.get()
             if item is None:
@@ -1117,13 +1177,18 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
         # buffers (countdown-released once every shard writer is done with
         # its row) keep the decode from racing its own in-flight writes.
         wpos = {i: r for r, i in enumerate(missing)}
+        # aligned output ring, registered with the writer engines: the
+        # reconstruction writes ride the same aio path as encode parity
+        # (heal-side ceiling_frac must match the encode side's)
+        obufs = [_aligned_empty(
+            (len(missing), min(batch_size, max(shard_size, 1))))
+            for _ in range(PIPELINE_DEPTH)]
         writers = _ShardWriterPool([out_fds[i] for i in missing], None,
-                                   stats, stage_key=lambda i: "write_s")
+                                   stats, stage_key=lambda i: "write_s",
+                                   reg_bufs=obufs)
         opool: queue.Queue = queue.Queue()
-        for _ in range(PIPELINE_DEPTH):
-            opool.put(np.empty(
-                (len(missing), min(batch_size, max(shard_size, 1))),
-                dtype=np.uint8))
+        for b in obufs:
+            opool.put(b)
         for i, f in ins.items():
             if shard_size:
                 mm = _map_readonly(f.fileno(), shard_size)

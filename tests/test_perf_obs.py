@@ -142,6 +142,26 @@ def test_writer_pool_worker_counts_accumulate_across_pools(tmp_path):
     assert stats["write_workers"] > pools[0]._nworkers  # summed
 
 
+def test_aio_submit_complete_stages_flow_through_snapshot():
+    """The host I/O engine's submit/complete split (storage/aio.py)
+    rides the same stats-dict contract as every other stage: the
+    observatory snapshot carries both, worker-normalized, and maps them
+    to the disk resource for ceiling attribution."""
+    stats = {"write_parity_s": 2.0, "write_parity_workers": 2,
+             "submit_s": 0.5, "submit_workers": 2,
+             "complete_s": 0.25, "complete_workers": 2}
+    job = pipeline.track("aio", stats)
+    job.finish()
+    snap = job.snapshot()
+    assert snap["stages"]["submit"]["busy_s"] == 0.5
+    assert snap["stages"]["complete"]["busy_s"] == 0.25
+    assert pipeline.STAGE_RESOURCE["submit"] == "disk"
+    assert pipeline.STAGE_RESOURCE["complete"] == "disk"
+    # the sub-stages never outrank the write stage they are a cut of
+    best = max(snap["stages"], key=lambda s: snap["stages"][s]["busy_s"])
+    assert best == "write_parity"
+
+
 def test_perf_endpoint_is_cluster_internal_but_objects_stay_data():
     """/perf rides the /heat posture: the endpoint itself is internal
     (open to the master's /cluster/perf fan-out, out of data-plane SLO
