@@ -661,7 +661,23 @@ class _ShardWriterPool:
             if direct:
                 self._stats["aio_direct_bytes"] = \
                     self._stats.get("aio_direct_bytes", 0) + direct
-            self._stats.setdefault("aio_mode", self._mode)
+            # aio_mode is what the engines RESOLVED to, not what the
+            # pool asked for: a worker's ring setup can fail where the
+            # probe passed (RLIMIT_NOFILE, memlock) and degrade that
+            # engine alone — report the most-degraded mode seen so a
+            # partly-synchronous round never wears the 'uring' label in
+            # the trajectory gate's like-for-like comparison
+            rank = {"buffered": 0, "pwritev": 1, "uring": 2}
+            modes = [e.mode for e in self._engines]
+            resolved = min(modes, key=lambda m: rank.get(m, 0)) \
+                if modes else self._mode
+            cur = self._stats.get("aio_mode")
+            if cur is None or rank.get(resolved, 0) < rank.get(cur, 3):
+                self._stats["aio_mode"] = resolved
+            degraded = sum(1 for m in modes if m != self._mode)
+            if degraded:
+                self._stats["aio_degraded_engines"] = \
+                    self._stats.get("aio_degraded_engines", 0) + degraded
         if self._stats is not None:
             key_busy: dict[str, float] = {}
             for i, busy in enumerate(self._busy):

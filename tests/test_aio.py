@@ -11,6 +11,7 @@ pwritev batching without changing a single output byte, and the
 tmp+rename crash-safety of fleet conversion must hold under the ring.
 """
 
+import errno
 import hashlib
 import os
 
@@ -79,6 +80,26 @@ def test_writev_modes_byte_identical_with_ragged_tail(tmp_path,
         got = f.read()
     want = body.tobytes() + tail.tobytes() + b"\0" * 13 + odd.tobytes()
     assert got == want
+
+
+def test_auto_resolves_ring_only_with_direct(monkeypatch):
+    """``auto`` picks the ring only when O_DIRECT gives its completions
+    device latency to hide; page-cache writeback rides pwritev (punting
+    buffered writes to io-wq workers is a measured loss on filesystems
+    without NOWAIT support).  Explicit ``uring`` always engages."""
+    if not aio.probe_uring():
+        pytest.skip("io_uring unavailable on this host")
+    monkeypatch.delenv("WEEDTPU_AIO", raising=False)
+    monkeypatch.setenv("WEEDTPU_AIO_DIRECT", "0")
+    assert aio.engine_mode() == "pwritev"
+    assert aio.engine_label() == "pwritev"
+    monkeypatch.setenv("WEEDTPU_AIO_DIRECT", "1")
+    assert aio.engine_mode() == "uring"
+    assert aio.engine_label() == "uring+direct"
+    monkeypatch.setenv("WEEDTPU_AIO", "uring")
+    monkeypatch.setenv("WEEDTPU_AIO_DIRECT", "0")
+    assert aio.engine_mode() == "uring"  # explicit request engages
+    assert aio.engine_label() == "uring"
 
 
 def test_uring_probe_failure_degrades_to_pwritev(monkeypatch, capsys):
@@ -153,6 +174,100 @@ def test_odirect_is_opt_in_and_engages_on_aligned_runs(tmp_path,
     if got == 0:
         pytest.skip("filesystem refused O_DIRECT (EINVAL latch took it)")
     assert got == body.nbytes
+
+
+def test_uring_engages_ring_without_direct(tmp_path, monkeypatch):
+    """Default config (uring mode, O_DIRECT off) must still drive the
+    ring: every run goes out as SQEs — the engine is not a deferred
+    synchronous writer wearing an async label.  Regression test for the
+    bug where direct-off routed everything to the tail path and drain()
+    wrote it all with pwritev."""
+    if not aio.probe_uring():
+        pytest.skip("io_uring unavailable on this host")
+    _set_mode(monkeypatch, "uring", "0")
+    body = aio.aligned_empty((1, 256 * 1024))[0]
+    body[:] = 7
+    tail = np.full(777, 9, dtype=np.uint8)
+    p = str(tmp_path / "ring")
+    fd = os.open(p, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        eng = aio.WriteEngine(reg=[body])
+        assert eng.mode == "uring" and eng._ring is not None
+        eng.writev(fd, [body], 0)
+        # the run is queued ON THE RING, not parked in the deferred
+        # synchronous tail list
+        assert not eng._tails
+        assert eng._ring.inflight == 1 and len(eng._pending) == 1
+        # without O_DIRECT there is no alignment rule: the unaligned
+        # buffer rides the ring too
+        eng.writev(fd, [tail], body.nbytes)
+        assert not eng._tails
+        assert eng._ring.inflight == 2
+        eng.drain()
+        assert eng.wbytes == body.nbytes + tail.nbytes
+        assert eng.fixed_bytes == body.nbytes  # registered -> WRITE_FIXED
+        assert eng.direct_bytes == 0  # page cache, as opted
+        eng.close()
+    finally:
+        os.close(fd)
+    with open(p, "rb") as f:
+        assert f.read() == body.tobytes() + tail.tobytes()
+
+
+def test_odirect_einval_latch_rescues_all_inflight_runs(tmp_path):
+    """EVERY in-flight direct run completing with -EINVAL must rewrite
+    buffered, not just the first: the first failing CQE un-latches the
+    fd, and later completions used to miss the 'fd in _direct_fds'
+    guard and hard-fail the encode on filesystems without O_DIRECT."""
+    a = aio.aligned_empty(aio.ALIGN)
+    a[:] = 1
+    b = aio.aligned_empty(aio.ALIGN)
+    b[:] = 2
+    p = str(tmp_path / "latch")
+    fd = os.open(p, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        eng = aio.WriteEngine(mode="buffered")  # _complete needs no ring
+        # two direct runs in flight at once, as the writer pool submits
+        # them; both CQEs come back EINVAL (tmpfs-style refusal)
+        eng._direct_fds.add(fd)
+        eng._pending[1] = (aio._OP_WRITEV, fd, [a], 0, a.nbytes,
+                           None, 0, True)
+        eng._pending[2] = (aio._OP_WRITEV, fd, [b], aio.ALIGN, b.nbytes,
+                           None, 0, True)
+        eng._complete(1, -errno.EINVAL)  # latches the fd buffered
+        eng._complete(2, -errno.EINVAL)  # must rewrite too, not raise
+        assert fd in eng._no_direct_fds
+        assert eng.wbytes == a.nbytes + b.nbytes
+        eng.close()
+    finally:
+        os.close(fd)
+    with open(p, "rb") as f:
+        assert f.read() == a.tobytes() + b.tobytes()
+
+
+def test_ensure_buffered_flushes_deferred_tails(tmp_path, monkeypatch):
+    """The non-engine-I/O barrier must also write out deferred tails
+    for the fd — a copy_file_range issued after it must land over
+    fully-ordered prior writes, not jump ahead of a queued tail."""
+    if not aio.probe_uring():
+        pytest.skip("io_uring unavailable on this host")
+    _set_mode(monkeypatch, "uring", "1")
+    tail = np.full(777, 5, dtype=np.uint8)  # unaligned -> deferred
+    p = str(tmp_path / "barrier")
+    fd = os.open(p, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        eng = aio.WriteEngine()
+        if eng.mode != "uring":
+            pytest.skip("ring setup failed on this host")
+        eng.writev(fd, [tail], 0)
+        assert eng._tails  # parked for the post-direct buffered pwrite
+        eng.ensure_buffered(fd)
+        assert not eng._tails
+        assert os.pread(fd, 777, 0) == tail.tobytes()  # already on disk
+        eng.drain()
+        eng.close()
+    finally:
+        os.close(fd)
 
 
 # ---- consumer byte-identity across modes --------------------------------

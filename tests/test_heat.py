@@ -409,25 +409,41 @@ def test_tenant_counters_conserve_with_netflow(s3_heat_stack):
                                         timeout=30) as r:
                 assert r.read() == payload
 
-    reqs = _tenant_requests()
-    d = {k: reqs.get(k, 0) - req0.get(k, 0) for k in reqs}
-    # 1 bucket PUT + n object PUTs per tenant; n GETs per tenant
-    assert d[("tenant-a", "write")] == 4, d
-    assert d[("tenant-a", "read")] == 3, d
-    assert d[("tenant-b", "write")] == 3, d
-    assert d[("tenant-b", "read")] == 2, d
+    # 1 bucket PUT + n object PUTs per tenant; n GETs per tenant — but
+    # the middleware books in its finally, which may still be running
+    # when the client's read() returns: wait for the ledger to converge
+    want = {("tenant-a", "write"): 4, ("tenant-a", "read"): 3,
+            ("tenant-b", "write"): 3, ("tenant-b", "read"): 2}
+    deadline = time.time() + 5
+    while True:
+        reqs = _tenant_requests()
+        d = {k: reqs.get(k, 0) - req0.get(k, 0) for k in want}
+        if d == want or time.time() >= deadline:
+            break
+        time.sleep(0.05)
+    assert d == want, d
 
     # conservation: tenant recv bytes == the netflow ledger's
     # client-facing data recv bytes, both booked in the same middleware
-    # from the same values
-    nf_recv = 0.0
-    for labels, child in metrics.NET_BYTES._pairs():
-        ld = dict(labels)
-        if ld.get("direction") == "recv" and ld.get("class") == "data" \
-                and ld.get("peer_role") == "client":
-            nf_recv += child.value
-    tenant_recv = _tenant_bytes_total("recv") - b0_recv
+    # from the same values (polled: the last request's finally may have
+    # booked one counter but not yet the other)
     expect = 3 * len(payload_a) + 2 * len(payload_b)
+    deadline = time.time() + 5
+    while True:
+        nf_recv = 0.0
+        for labels, child in metrics.NET_BYTES._pairs():
+            ld = dict(labels)
+            if ld.get("direction") == "recv" \
+                    and ld.get("class") == "data" \
+                    and ld.get("peer_role") == "client":
+                nf_recv += child.value
+        tenant_recv = _tenant_bytes_total("recv") - b0_recv
+        if (tenant_recv >= expect
+                and tenant_recv == pytest.approx(nf_recv - nf0_recv,
+                                                 rel=0.01)) \
+                or time.time() >= deadline:
+            break
+        time.sleep(0.05)
     assert tenant_recv >= expect  # PUT bodies at minimum
     assert tenant_recv == pytest.approx(nf_recv - nf0_recv, rel=0.01), \
         (tenant_recv, nf_recv - nf0_recv)
