@@ -663,6 +663,18 @@ def _bench_config(backend: str) -> dict:
         pass
     if _PROBED_DISK_CEILING:
         cfg["disk_ceiling"] = dict(_PROBED_DISK_CEILING)
+    # serving-plane shape: the knee is measured through the location
+    # cache / hot tier / QoS stack, so rounds with different serving
+    # config are not comparable (SERVING_SCOPED_METRICS gate on this)
+    try:
+        from seaweedfs_tpu.utils.vid_cache import _env_float as _ef
+        cfg["serving"] = {
+            "hot_tier": os.environ.get("WEEDTPU_HOT_TIER", "1") != "0",
+            "vid_cache_ttl": _ef("WEEDTPU_VID_CACHE_TTL", 10.0),
+            "qos": _ef("WEEDTPU_S3_QOS_RATE", 0.0) > 0,
+        }
+    except Exception:
+        pass
     return cfg
 
 
@@ -748,7 +760,11 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
     # the stamp stay comparable.
     aio_now = cfg.get("aio")
 
+    serving_now = cfg.get("serving")
+
     def metric_comparable(e: dict, m: str) -> bool:
+        if m.startswith(SERVING_SCOPED_METRICS):
+            return (e.get("config") or {}).get("serving") == serving_now
         if not m.startswith(AIO_SCOPED_METRICS):
             return True
         a = (e.get("config") or {}).get("aio")
@@ -890,7 +906,8 @@ def main() -> None:
                _bench_flow_canary_overhead, _bench_heat_overhead,
                _bench_history_overhead, _bench_perf_obs_overhead,
                _bench_interference_overhead,
-               _bench_serving_knee, _bench_chaos, _bench_autopilot):
+               _bench_serving_knee, _bench_serving_plane,
+               _bench_chaos, _bench_autopilot):
         try:
             fn(extra)
         except Exception as e:
@@ -1130,7 +1147,7 @@ TRAJECTORY_TOL = 0.90
 # round where ON loses to OFF reads < 1 and fails against the 1.1 bar)
 TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1",
                     "ec_encode_rs10_4_mesh", "fleet_convert_gbps",
-                    "autopilot_p99_gate")
+                    "autopilot_p99_gate", "serving_knee_rps")
 # batch placement must stay within this fraction of the unsharded
 # single-call kernel at equal bytes (satellite gate, ISSUE 12)
 BATCH_PLACE_TOL = 0.90
@@ -1141,6 +1158,12 @@ TRAJECTORY_GATED_MIN = ("repair_network_ratio",)
 # additionally require the prior round's config.aio to match (see
 # _record_trajectory.metric_comparable)
 AIO_SCOPED_METRICS = ("ec_encode_e2e", "fleet_convert", "ec_rebuild_e2e")
+# serving-plane metrics compare ONLY against rounds measured under an
+# IDENTICAL config.serving stamp (strict equality, not None-tolerant:
+# rounds predating the stamp were measured before the location-cache /
+# hot-tier serving stack existed and must not set — or be judged by —
+# its bar; the first stamped round establishes it)
+SERVING_SCOPED_METRICS = ("serving_knee_rps",)
 # ...comparing against the best of only the last N recorded same-backend
 # rounds, so one cache-hot outlier round ages out of the bar instead of
 # ratcheting it forever
@@ -3279,6 +3302,173 @@ def _bench_serving_knee(extra: dict, n_blobs: int = 400,
     else:
         # the fleet outran the bench's ceiling without flipping
         extra["serving_knee_saturated"] = True
+
+
+def _bench_serving_plane(extra: dict, n_files: int = 64,
+                         size: int = 64 * 1024,
+                         cache_mem: int = 3 * 1024 * 1024,
+                         level_s: float = 2.0,
+                         n_threads: int = 8) -> None:
+    """Cluster hot tier OFF/ON A/B through two filer gateways sharing
+    one namespace: the working set (64 x 64 KiB) is ~1.3x ONE filer's
+    chunk cache, so with the tier OFF each gateway thrashes its own LRU
+    and re-fetches from the volume tier forever, while ON the
+    rendezvous ring splits the set so each half fits its home's cache
+    and the whole cluster fetches each chunk once.  Reports
+    `serving_plane_read_rps_{off,on}` (closed-loop fixed-thread read
+    throughput), `serving_plane_volume_fetches_{off,on}` (volume-tier
+    GETs each phase issued for the same client load),
+    `serving_plane_offload` (off/on fetch ratio — the scarce resource
+    at serving scale is the volume tier, and fetch-once semantics is
+    what the tier buys), and `hot_tier_hit_ratio` (the ON-phase
+    fraction of chunk demands served from the tier).  NOTE the rps pair
+    is recorded for honesty, not as the headline: on a one-process
+    loopback harness the extra gateway hop costs about what the saved
+    loopback volume fetch costs, so wall-clock parity (or a small loss)
+    here coexists with a large volume-tier offload — the number that
+    moves the knee when the volume tier is disk- or network-bound."""
+    import asyncio
+    import threading
+    import urllib.request
+
+    from seaweedfs_tpu.client import WeedClient
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(120)
+
+    def run_quiet(coro):
+        try:
+            run(coro)
+        except Exception:
+            pass
+
+    overrides = {"WEEDTPU_CANARY_INTERVAL": "0",
+                 "WEEDTPU_REPAIR_INTERVAL": "3600",
+                 "WEEDTPU_SCRUB_MBPS": "0",
+                 "WEEDTPU_HOT_SEED_INTERVAL": "0"}
+    old_env = {k: os.environ.get(k)
+               for k in (*overrides, "WEEDTPU_HOT_TIER")}
+    os.environ.update(overrides)
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-plane-") as d:
+            master = MasterServer("127.0.0.1", free_port())
+            vs_dir = os.path.join(d, "v")
+            os.makedirs(vs_dir, exist_ok=True)
+            vs = VolumeServer([vs_dir], master.url,
+                              port=free_port(), heartbeat_interval=0.2)
+            shared = os.path.join(d, "filer-ns")
+            started = []
+            try:
+                run(master.start())
+                started.append(master)
+                run(vs.start())
+                started.append(vs)
+                deadline = time.time() + 10
+                while time.time() < deadline and not master.topo.nodes:
+                    time.sleep(0.05)
+                # seed the shared namespace through a bootstrap gateway
+                # (uploads do not warm read caches — both phases start
+                # cold)
+                boot = FilerServer(master.url, port=free_port(),
+                                   data_dir=shared)
+                run(boot.start())
+                # incompressible payload: stored chunks must occupy
+                # their nominal size or the working set silently fits
+                # one cache and the OFF arm never thrashes
+                import random as _random
+                payload = _random.Random(0xB10B).randbytes(size)
+                paths = [f"/plane/f{i:03d}.bin" for i in range(n_files)]
+                for p in paths:
+                    urllib.request.urlopen(urllib.request.Request(
+                        f"http://{boot.url}{p}", data=payload,
+                        method="PUT"), timeout=30).read()
+                run_quiet(boot.stop())
+                master.cluster_members.get("filer", {}).clear()
+
+                def phase(hot: bool) -> tuple[float, float | None, int]:
+                    os.environ["WEEDTPU_HOT_TIER"] = "1" if hot else "0"
+                    filers = [FilerServer(master.url, port=free_port(),
+                                          data_dir=shared,
+                                          chunk_cache_mem=cache_mem)
+                              for _ in range(2)]
+                    for f in filers:
+                        run(f.start())
+                    dl = time.time() + 10
+                    while time.time() < dl and len(
+                            master.cluster_members.get("filer", {})) < 2:
+                        time.sleep(0.05)
+                    for f in filers:
+                        run(f._refresh_hot_ring())
+                    stop_at = time.time() + level_s
+                    counts = [0] * n_threads
+                    errors = [0]
+
+                    def worker(k: int) -> None:
+                        # uniform random over (gateway, path): every
+                        # filer sees the FULL working set (a strided
+                        # walk would quietly shard it so each cache
+                        # fits its half and the OFF arm never misses)
+                        rng = _random.Random(0xCAFE + k)
+                        while time.time() < stop_at:
+                            url = (f"http://"
+                                   f"{filers[rng.randrange(2)].url}"
+                                   f"{paths[rng.randrange(n_files)]}")
+                            try:
+                                with urllib.request.urlopen(
+                                        url, timeout=30) as r:
+                                    r.read()
+                                counts[k] += 1
+                            except Exception:
+                                errors[0] += 1
+                    threads = [threading.Thread(target=worker, args=(k,))
+                               for k in range(n_threads)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join(level_s + 60)
+                    ev = {k: sum(f.hot_stats[k] for f in filers)
+                          for k in ("hit_local", "route_out", "direct")}
+                    for f in filers:
+                        run_quiet(f.stop())
+                    master.cluster_members.get("filer", {}).clear()
+                    rps = sum(counts) / level_s
+                    hits = ev["hit_local"] + ev["route_out"]
+                    demands = hits + ev["direct"]
+                    ratio = round(hits / demands, 4) if demands else None
+                    if errors[0]:
+                        extra[f"serving_plane_errors_"
+                              f"{'on' if hot else 'off'}"] = errors[0]
+                    return rps, ratio, ev["direct"]
+
+                rps_off, _, fetches_off = phase(False)
+                rps_on, hit_ratio, fetches_on = phase(True)
+                extra["serving_plane_read_rps_off"] = round(rps_off, 1)
+                extra["serving_plane_read_rps_on"] = round(rps_on, 1)
+                extra["serving_plane_volume_fetches_off"] = fetches_off
+                extra["serving_plane_volume_fetches_on"] = fetches_on
+                if fetches_on > 0:
+                    extra["serving_plane_offload"] = round(
+                        fetches_off / fetches_on, 2)
+                if hit_ratio is not None:
+                    extra["hot_tier_hit_ratio"] = hit_ratio
+            finally:
+                if vs in started:
+                    run_quiet(vs.stop())
+                if master in started:
+                    run_quiet(master.stop())
+                loop.call_soon_threadsafe(loop.stop)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _bench_e2e_ceiling(size: int, batch: int, reps: int = 10) -> dict:

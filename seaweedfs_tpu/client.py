@@ -14,6 +14,7 @@ import urllib.parse
 import urllib.request
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.utils.http import PooledHTTP
+from seaweedfs_tpu.utils.vid_cache import SyncVidResolver, VidCache
 
 
 class WeedClient:
@@ -37,8 +38,8 @@ class WeedClient:
         self.timeout = timeout
         self.jwt_signer = jwt_signer
         self.jwt_read_signer = jwt_read_signer
-        self._vid_cache: dict[int, tuple[list[str], float]] = {}
-        self.vid_cache_ttl = 10.0
+        self._vid_cache = VidCache()
+        self._resolver = SyncVidResolver(self._vid_cache, self._lookup_master)
         # keep-alive pool: every blob op reuses a warm connection to its
         # volume server instead of paying a TCP (and TLS) handshake per
         # request — the reference client rides Go's default Transport
@@ -53,6 +54,14 @@ class WeedClient:
             t = threading.Thread(target=self._stream_loop,
                                  name="weed-vidmap-stream", daemon=True)
             t.start()
+
+    @property
+    def vid_cache_ttl(self) -> float:
+        return self._vid_cache.ttl
+
+    @vid_cache_ttl.setter
+    def vid_cache_ttl(self, ttl: float) -> None:
+        self._vid_cache.ttl = ttl
 
     def close(self) -> None:
         if self._stream_stop is not None:
@@ -167,15 +176,23 @@ class WeedClient:
             raise RuntimeError(f"assign failed: {r['error']}")
         return r
 
+    def _lookup_master(self, vid: int) -> list[str]:
+        """One real /dir/lookup.  404 ('volume id not found') returns []
+        so the resolver caches it negatively; transport errors raise and
+        stay uncached."""
+        try:
+            r = self._master_json(f"/dir/lookup?volumeId={vid}")
+        except RuntimeError as e:
+            if "HTTP 404" in str(e):
+                return []
+            raise
+        return [l["url"] for l in r.get("locations", [])]
+
     def lookup(self, vid: int) -> list[str]:
-        cached = self._vid_cache.get(vid)
-        if cached and time.time() - cached[1] < self.vid_cache_ttl:
-            return cached[0]
-        r = self._master_json(f"/dir/lookup?volumeId={vid}")
-        urls = [l["url"] for l in r.get("locations", [])]
-        if urls:
-            self._vid_cache[vid] = (urls, time.time())
-        return urls
+        """Cached vid->locations: TTL hit, else negative-window hit, else
+        a singleflighted master lookup (N concurrent misses on one vid
+        cost one /dir/lookup; waiters share the result)."""
+        return self._resolver.lookup(vid)
 
     # -- blob ops ------------------------------------------------------
 
@@ -229,8 +246,8 @@ class WeedClient:
                 if status < 300:
                     return body
                 last_err = RuntimeError(f"{url}/{fid}: HTTP {status}")
-            if attempt == 0 and vid in self._vid_cache:
-                del self._vid_cache[vid]  # stale route: re-ask the master
+            if attempt == 0 and self._vid_cache.invalidate(vid):
+                pass  # stale route dropped: re-ask the master once
             else:
                 break
         raise RuntimeError(f"download {fid} failed: {last_err or 'no locations'}")

@@ -66,7 +66,8 @@ from seaweedfs_tpu.storage.ec import layout
 log = logging.getLogger("autopilot")
 
 PLAN_STATES = ("planned", "approved", "executing", "done", "aborted")
-POLICIES = ("tiering_demote", "tiering_promote", "balance_move")
+POLICIES = ("tiering_demote", "tiering_promote", "balance_move",
+            "chunk_promote")
 
 
 def autopilot_mode() -> str:
@@ -121,7 +122,16 @@ class Autopilot:
                 balance_rate if balance_rate is not None
                 else _env_float("WEEDTPU_AUTOPILOT_BALANCE_RATE", 0.1),
                 _env_float("WEEDTPU_AUTOPILOT_BALANCE_BURST", 2.0)),
+            "chunk": TokenBucket(
+                _env_float("WEEDTPU_AUTOPILOT_CHUNK_RATE", 1.0),
+                _env_float("WEEDTPU_AUTOPILOT_CHUNK_BURST", 8.0)),
         }
+        # chunk-granular promotion: sustained-hot chunks from the fleet
+        # heat sketch are seeded into their hot-tier home filer (the
+        # missing finer-grained sibling of volume tiering)
+        self.chunk_rps = _env_float("WEEDTPU_AUTOPILOT_CHUNK_RPS", 2.0)
+        self.chunk_s = _env_float("WEEDTPU_AUTOPILOT_CHUNK_S", 30.0)
+        self._chunk_last: dict[str, float] = {}  # per-fid cooldown
         self.plans: dict[str, dict] = {}  # insertion-ordered ledger
         self._plan_seq = 0
         # hysteresis state: when each volume was FIRST seen cold (reset
@@ -162,6 +172,7 @@ class Autopilot:
         new: list[dict] = []
         new += self._plan_tiering(now, vol_heat, ledger)
         new += self._plan_balancing(now, vol_heat)
+        new += self._plan_chunk_promote(now, heat_view)
         if mode == "execute":
             for plan in [p for p in self.plans.values()
                          if p["state"] == "planned"]:
@@ -361,6 +372,76 @@ class Autopilot:
                         "horizon_s": self.horizon_s}))
         return plans
 
+    # -- chunk promotion policy -------------------------------------------
+
+    def _live_filers(self) -> list[str]:
+        now = time.time()
+        return sorted(a for a, ts in
+                      self.master.cluster_members.get("filer", {}).items()
+                      if now - ts < 30.0)
+
+    def _plan_chunk_promote(self, now: float,
+                            heat_view: dict) -> list[dict]:
+        """Chunk-granular promotion: a chunk the fleet heat sketch shows
+        sustained-hot gets seeded into its hot-tier home filer (the same
+        rendezvous ring every filer computes), so the whole cluster
+        serves it from one warm copy before organic misses converge
+        there.  One plan per home filer per tick, paced by the governed
+        `chunk` bucket."""
+        if self.buckets["chunk"].rate <= 0:
+            return []
+        top = (heat_view.get("chunks") or {}).get("top", [])
+        if not top:
+            return []
+        filers = self._live_filers()
+        if not filers:
+            return []
+        from seaweedfs_tpu.utils.hashring import RendezvousRing
+        ring = RendezvousRing(filers)
+        active_fids = {f for p in self.plans.values()
+                       if p["policy"] == "chunk_promote"
+                       and p["state"] in ("planned", "approved",
+                                          "executing")
+                       for f in p.get("fids", [])}
+        by_home: dict[str, list[tuple[float, str]]] = {}
+        for rec in top:
+            fid = str(rec.get("key", ""))
+            if "," not in fid:
+                continue  # not a blob fid
+            rps = float(rec.get("rps", 0.0))
+            if rps < self.chunk_rps or \
+                    float(rec.get("sustained_s", 0.0)) < self.chunk_s:
+                continue
+            last = self._chunk_last.get(fid)
+            if last is not None and now - last < self.cooldown_s:
+                continue
+            if fid in active_fids:
+                continue
+            home = ring.home(fid)
+            if home is not None:
+                by_home.setdefault(home, []).append((rps, fid))
+        plans: list[dict] = []
+        for home in sorted(by_home):
+            if not self.buckets["chunk"].try_acquire():
+                break
+            batch = sorted(by_home[home], reverse=True)[:32]
+            fids = [f for _, f in batch]
+            plans.append(self._new_plan(
+                "chunk_promote",
+                vid=int(fids[0].partition(",")[0]),
+                node=home, fids=fids,
+                reason={"hottest_rps": round(batch[0][0], 3),
+                        "chunks": len(fids),
+                        "rps_floor": self.chunk_rps,
+                        "sustained_floor_s": self.chunk_s}))
+        # bound the per-fid cooldown map (hot sets churn; dead entries
+        # must not accrete forever)
+        if len(self._chunk_last) > 4096:
+            self._chunk_last = {f: ts for f, ts
+                                in self._chunk_last.items()
+                                if now - ts < self.cooldown_s}
+        return plans
+
     # -- the plan ledger --------------------------------------------------
 
     def _new_plan(self, policy: str, vid: int, **fields) -> dict:
@@ -453,6 +534,8 @@ class Autopilot:
                     await self._exec_promote(plan)
                 elif policy == "balance_move":
                     await self._exec_move(plan)
+                elif policy == "chunk_promote":
+                    await self._exec_chunk_promote(plan)
                 else:
                     raise RuntimeError(f"unknown policy {policy}")
             plan["state"] = "done"
@@ -465,8 +548,14 @@ class Autopilot:
                         plan["id"], policy, vid, e)
         finally:
             # success AND failure arm the cooldown: a broken actuator
-            # must not be retried at tick cadence
-            self._last_action[vid] = (time.time(), policy)
+            # must not be retried at tick cadence.  Chunk plans cool
+            # down per-fid (their vid is incidental — arming the volume
+            # cooldown would block unrelated volume-level plans)
+            if policy == "chunk_promote":
+                for fid in plan.get("fids", []):
+                    self._chunk_last[fid] = time.time()
+            else:
+                self._last_action[vid] = (time.time(), policy)
             plan["seconds"] = round(time.monotonic() - t0, 3)
 
     async def _exec_demote(self, plan: dict) -> None:
@@ -516,6 +605,16 @@ class Autopilot:
         plan["outcome"] = {"crc": data.get("crc"),
                            "target": data.get("target")}
 
+    async def _exec_chunk_promote(self, plan: dict) -> None:
+        """Seed the batch into its home filer's hot tier.  The pull-
+        through bytes are speculative, so they book as class=readahead
+        — the governor's interference index sees and paces them."""
+        with netflow.flow("readahead"):
+            data = await self._post(plan["node"], "/__hot__/seed",
+                                    {"fids": plan["fids"]}, timeout=120.0)
+        plan["outcome"] = {"seeded": data.get("seeded"),
+                           "skipped": data.get("skipped")}
+
     # -- views ------------------------------------------------------------
 
     def status(self) -> dict:
@@ -531,6 +630,8 @@ class Autopilot:
             "states": counts,
             "knobs": {"cold_rps": self.cold_rps, "cold_s": self.cold_s,
                       "hot_rps": self.hot_rps, "hot_s": self.hot_s,
+                      "chunk_rps": self.chunk_rps,
+                      "chunk_s": self.chunk_s,
                       "cooldown_s": self.cooldown_s,
                       "full_horizon_s": self.horizon_s},
             "buckets": {name: {"rate_per_s": b.rate, "burst": b.burst,

@@ -885,6 +885,92 @@ def _fault_master_failover(c: ChaosCluster, ctx: dict) -> None:
     c.fail_over_master()
 
 
+def _fault_noisy_neighbor(c: ChaosCluster, ctx: dict) -> None:
+    """One abusive tenant hammers the s3 edge open-loop while a victim
+    tenant keeps reading its object: per-tenant QoS admission must shed
+    the abuser with 429s AND keep the victim error-free inside its
+    latency bound — one tenant's abuse degrades into its own rejects,
+    never into another tenant's SLO (429s are 4xx, so they cannot flip
+    the 5xx-based availability SLO either).  The workload's own verify
+    runs during the noise too (verify_during_fault), proving the
+    scenario tenant is a second un-harmed victim.  Clusters without an
+    s3 gateway get a temporary one for the fault's duration."""
+    s3 = c.s3
+    started = False
+    if s3 is None:
+        from seaweedfs_tpu.s3.s3api_server import S3ApiServer
+        s3 = S3ApiServer(c.filer.url, port=free_port(),
+                         master_url=c.leader().url)
+        c.submit(s3.start())
+        started = True
+    prev = (s3.qos.total_rate, s3.qos.burst_s, dict(s3.qos.weights))
+    # weighted admission: the victim (and the scenario workload's
+    # bucket) carry heat-earned weight, the abuser rides the default —
+    # unauthenticated tenants resolve to their bucket name
+    s3.qos.configure(rate=200.0, burst_s=1.0,
+                     weights={"victim-bucket": 4.0, "chaos-bucket": 4.0,
+                              "default": 1.0})
+    base = f"http://{s3.url}"
+    for bucket in ("victim-bucket", "noisy-bucket"):
+        st, out, _ = _req(f"{base}/{bucket}", method="PUT")
+        assert st in (200, 409), out
+    payload = os.urandom(64 * 1024)
+    st, out, _ = _req(f"{base}/victim-bucket/slo.bin", method="PUT",
+                      data=payload)
+    assert st == 200, out
+    st, out, _ = _req(f"{base}/noisy-bucket/spam.bin", method="PUT",
+                      data=b"x" * 1024)
+    assert st == 200, out
+
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                _req(f"{base}/noisy-bucket/spam.bin", timeout=5)
+            except OSError:
+                pass
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+
+    def undo():
+        stop.set()
+        for t in threads:
+            t.join(10)
+        s3.qos.configure(rate=prev[0], burst_s=prev[1], weights=prev[2])
+        if started:
+            c.submit(s3.stop())
+
+    ctx["undo"] = undo
+    ctx["verify_during_fault"] = True
+
+    # the edge is throttling the abuser: its shed count must be GROWING
+    time.sleep(1.0)
+    shed0 = s3.qos.shed_by_tenant.get("noisy-bucket", 0)
+    time.sleep(1.5)
+    abuser_shed = s3.qos.shed_by_tenant.get("noisy-bucket", 0)
+    assert abuser_shed > shed0 and abuser_shed > 10, \
+        f"abuser not throttled at the edge: shed {shed0}->{abuser_shed}"
+    # the victim's SLO class under the noise: every read succeeds,
+    # paced inside its admitted share, p99 bounded
+    lat = []
+    for _ in range(40):
+        t0 = time.monotonic()
+        st, body, _ = _req(f"{base}/victim-bucket/slo.bin", timeout=10)
+        lat.append(time.monotonic() - t0)
+        assert st == 200, f"victim read failed: HTTP {st}"
+        assert body == payload, "victim bytes changed under noise"
+        time.sleep(0.03)
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    assert p99 < 2.0, f"victim p99 {p99:.3f}s out of SLO under noise"
+    assert s3.qos.shed_by_tenant.get("victim-bucket", 0) == 0, \
+        "victim tenant was shed — admission is not isolating tenants"
+
+
 # faults that must see PLAIN volumes (their own conversion, or a
 # volume move — both operate on .dat volumes): run_scenario must not
 # pre-encode the workload's volumes for these
@@ -902,6 +988,7 @@ FAULTS = {
     "master_failover": _fault_master_failover,
     "rack_loss": _fault_rack_loss,
     "helper_death_mid_rebuild": _fault_helper_death_mid_rebuild,
+    "noisy_neighbor": _fault_noisy_neighbor,
 }
 
 MATRIX = [(w, f) for w in WORKLOADS for f in FAULTS]

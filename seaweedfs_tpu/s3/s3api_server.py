@@ -113,6 +113,10 @@ class S3ApiServer:
                 self._filer(method, path, data=data))
         from seaweedfs_tpu.s3.circuit_breaker import CircuitBreaker
         self.breaker = breaker or CircuitBreaker()
+        # per-tenant token-bucket admission (s3/qos.py): heat-weighted
+        # shares of WEEDTPU_S3_QOS_RATE, shed as 429 before any work
+        from seaweedfs_tpu.s3.qos import TenantQoS
+        self.qos = TenantQoS()
         self.buckets_dir = buckets_dir.rstrip("/")
         self.security = security
         self.app = web.Application(
@@ -147,7 +151,10 @@ class S3ApiServer:
                                      trace.debug_guard(heat.handle_heat)),
                              web.get("/perf",
                                      trace.debug_guard(
-                                         pipeline.handle_perf))])
+                                         pipeline.handle_perf)),
+                             web.route("*", "/__qos__",
+                                       trace.debug_guard(
+                                           self.handle_qos))])
         self.app.add_routes([web.route("*", "/{tail:.*}", self.dispatch)])
         self._runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
@@ -330,11 +337,44 @@ class S3ApiServer:
 
     # -- dispatch ------------------------------------------------------
 
+    async def handle_qos(self, req: web.Request) -> web.Response:
+        """Loopback-only QoS surface: GET returns the live per-tenant
+        admission state; POST {"rate"|"burst_s"|"weights"} retunes it —
+        the operator/governor seam (qos.set_rate is the same contract
+        every governed TokenBucket exposes)."""
+        if req.method == "POST":
+            try:
+                body = await req.json()
+            except ValueError:
+                return web.json_response({"error": "bad json"}, status=400)
+            weights = body.get("weights")
+            if weights is not None and not isinstance(weights, dict):
+                return web.json_response({"error": "weights must be a "
+                                          "tenant->weight object"},
+                                         status=400)
+            self.qos.configure(rate=body.get("rate"),
+                               burst_s=body.get("burst_s"),
+                               weights=weights)
+        return web.json_response(self.qos.status())
+
     async def dispatch(self, req: web.Request) -> web.StreamResponse:
         raw_path = req.raw_path.split("?", 1)[0]
         path = urllib.parse.unquote(raw_path)
         bucket, _, key = path.lstrip("/").partition("/")
         q = {k: req.query.get(k, "") for k in req.query}
+
+        # tenant QoS admission: the middleware already resolved this
+        # request's tenant; a dry tenant bucket sheds with 429 SlowDown
+        # HERE, before auth or body buffering, so an abusive tenant
+        # costs the gateway almost nothing per rejected request
+        if self.qos.enabled:
+            tenant = heat.current_tenant() or heat.resolve_tenant(
+                req.headers, req.query, req.path)
+            if not self.qos.admit(tenant):
+                return _error_response(
+                    "SlowDown",
+                    "Your tenant is over its admission rate; "
+                    "reduce your request rate.", 429, path)
 
         # circuit breaker (reference: s3api_circuit_breaker.go): shed load
         # with 503 SlowDown before doing any work

@@ -53,6 +53,7 @@ from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFound
 from seaweedfs_tpu.stats import (heat, metrics, netflow, pipeline,
                                   profile, trace)
 from seaweedfs_tpu.utils.http import aiohttp_trace_config, parse_range
+from seaweedfs_tpu.utils.vid_cache import _env_float
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 from seaweedfs_tpu.security import tls as _tls
 
@@ -83,7 +84,6 @@ class FilerServer:
         self.jwt_signer = jwt_signer
 
         if data_dir:
-            import os
             os.makedirs(data_dir, exist_ok=True)
             if store_kind and store_kind not in ("sqlite",):
                 from seaweedfs_tpu.filer.filerstore import make_store
@@ -134,6 +134,9 @@ class FilerServer:
             web.get("/metrics", self.handle_metrics),
             web.get("/heat", heat.handle_heat),
             web.get("/perf", pipeline.handle_perf),
+            web.get("/__hot__/chunk/{fid}", self.handle_hot_chunk),
+            web.post("/__hot__/seed", self.handle_hot_seed),
+            web.get("/__hot__/status", self.handle_hot_status),
             web.route("*", "/{path:.*}", self.handle_path),
         ])
         self.notification = notification  # MessageQueue | None
@@ -156,6 +159,32 @@ class FilerServer:
         # the one in-flight fetch+decode every concurrent GET of that
         # chunk joins
         self._chunk_flight: dict[tuple[str, bool], asyncio.Future] = {}
+        # shared vid->locations cache (utils/vid_cache.py): steady-state
+        # chunk fetches resolve locations here instead of paying one
+        # master /dir/lookup per cache miss; entries are pushed fresh by
+        # the /cluster/stream subscription and carry the invalidate-once
+        # re-lookup contract on total location failure
+        from seaweedfs_tpu.utils.vid_cache import AsyncVidResolver, VidCache
+        self.vid_cache = VidCache()
+        self._vid_resolver = AsyncVidResolver(self.vid_cache,
+                                              self._master_lookup_vid)
+        self._vid_stream_task: asyncio.Task | None = None
+        self._vid_stream_live = False
+        # cluster hot tier: each chunk has one home filer chosen by
+        # rendezvous hash over live filer membership; local misses route
+        # to the home so a hot chunk is fetched from the volume tier once
+        # per cluster, not once per filer
+        from seaweedfs_tpu.utils.hashring import RendezvousRing
+        self.hot_ring = RendezvousRing()
+        self.hot_enabled = os.environ.get("WEEDTPU_HOT_TIER", "1") != "0"
+        # L1 mode additionally caches remote-home chunks locally (burns
+        # the one-copy-per-cluster economy for lower hit latency)
+        self.hot_l1 = os.environ.get("WEEDTPU_HOT_TIER_L1", "0") == "1"
+        self.hot_stats = {"hit_local": 0, "route_out": 0, "route_in": 0,
+                          "route_fail": 0, "seeded": 0, "seed_skipped": 0,
+                          "direct": 0}
+        self._blob_flight: dict[str, asyncio.Future] = {}
+        self._hot_seed_task: asyncio.Task | None = None
         # peer meta aggregation (reference: weed/filer/meta_aggregator.go)
         self.aggregate_peers = aggregate_peers
         self._peer_tasks: dict[str, asyncio.Task] = {}
@@ -197,6 +226,13 @@ class FilerServer:
                            ssl_context=_tls.server_ssl("filer"))
         await site.start()
         self._register_task = asyncio.create_task(self._register_loop())
+        if os.environ.get("WEEDTPU_FILER_VID_STREAM", "1") != "0":
+            self._vid_stream_task = asyncio.create_task(
+                self._vid_stream_loop())
+        seed_interval = _env_float("WEEDTPU_HOT_SEED_INTERVAL", 0.0)
+        if self.hot_enabled and seed_interval > 0:
+            self._hot_seed_task = asyncio.create_task(
+                self._hot_seed_loop(seed_interval))
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         from seaweedfs_tpu.maintenance import faults as _faults
         _faults.register_node(self.url, "filer")
@@ -214,6 +250,7 @@ class FilerServer:
                         f"{_tls_scheme()}://{self.master_url}/cluster/register",
                         json={"type": "filer", "address": self.url}):
                     pass
+                await self._refresh_hot_ring()
                 if self.aggregate_peers:
                     await self._refresh_peer_aggregators()
             except asyncio.CancelledError:
@@ -310,6 +347,10 @@ class FilerServer:
     async def stop(self) -> None:
         if getattr(self, "_register_task", None):
             self._register_task.cancel()
+        if self._vid_stream_task is not None:
+            self._vid_stream_task.cancel()
+        if self._hot_seed_task is not None:
+            self._hot_seed_task.cancel()
         for task in self._peer_tasks.values():
             task.cancel()
         self.deletion.stop(drain=False)
@@ -395,47 +436,88 @@ class FilerServer:
                          mtime=time.time_ns(), etag=etag,
                          cipher_key=cipher_key, is_compressed=is_compressed)
 
-    async def _fetch_chunk(self, fid: str, cache: bool = True) -> bytes:
-        with trace.span("filer.chunk_fetch", fid=fid) as sp:
-            # workload heat: every demanded chunk access counts, cache
-            # hit or miss — "hot" means requested often, and the future
-            # hot-chunk cache tier sizes itself on exactly this signal.
-            # Readahead counts too (it is demand one chunk early);
-            # canary/internal traffic does not.
-            track = heat.ambient_is_data(include_readahead=True)
-            # disk tiers do blocking IO; mem-only lookups stay inline
-            if self.chunk_cache.tiers:
-                cached = await asyncio.to_thread(self.chunk_cache.get, fid)
-            else:
-                cached = self.chunk_cache.get(fid)
-            if cached is not None:
-                sp.set(cache_hit=True, bytes=len(cached))
-                if track:
-                    heat.record("chunk", fid, len(cached), "read")
-                return cached
-            sp.set(cache_hit=False)
-            vid = fid.partition(",")[0]
-            async with self._session.get(
-                    f"{_tls_scheme()}://{self.master_url}/dir/lookup",
-                    params={"volumeId": vid}) as r:
-                locs = (await r.json()).get("locations", [])
-            headers = {}
-            if self.security is not None and self.security.volume_read:
-                from seaweedfs_tpu.security.jwt import gen_jwt
-                headers["Authorization"] = "Bearer " + gen_jwt(
-                    self.security.volume_read, fid)
-            last = None
-            for loc in locs:
+    async def _master_lookup_vid(self, vid: int) -> list[str]:
+        """One real master /dir/lookup for the shared vid cache.  404
+        ('volume id not found') returns [] so the resolver caches it
+        negatively; transport errors raise and stay uncached."""
+        async with self._session.get(
+                f"{_tls_scheme()}://{self.master_url}/dir/lookup",
+                params={"volumeId": str(vid)}) as r:
+            if r.status == 404:
+                return []
+            if r.status >= 300:
+                raise IOError(f"/dir/lookup vid {vid}: HTTP {r.status}")
+            locs = (await r.json()).get("locations", [])
+        return [l["url"] for l in locs]
+
+    async def _vid_stream_loop(self) -> None:
+        """Subscribe to the master's /cluster/stream push feed (the same
+        contract the client rides): volume-location deltas land in the
+        shared vid cache the moment the master learns them, stamped past
+        the poll TTL up to the push horizon; a broken feed drops all
+        pushed entries so lookups degrade to TTL polling."""
+        from seaweedfs_tpu.client import WeedClient as _WC
+        horizon = _WC.STREAM_ENTRY_HORIZON
+        while True:
+            try:
+                async with self._session.get(
+                        f"{_tls_scheme()}://{self.master_url}/cluster/stream",
+                        timeout=aiohttp.ClientTimeout(total=None,
+                                                      sock_read=60)) as r:
+                    self._vid_stream_live = True
+                    async for raw in r.content:
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        if "vid" not in ev:
+                            continue  # ping / snapshot_end
+                        urls = [l["url"] for l in ev.get("locations", [])]
+                        if urls:
+                            self.vid_cache.put(
+                                ev["vid"], urls,
+                                ts=time.time() + horizon
+                                - self.vid_cache.ttl)
+                        else:
+                            self.vid_cache.invalidate(ev["vid"])
+            except asyncio.CancelledError:
+                raise
+            except (aiohttp.ClientError, json.JSONDecodeError, OSError,
+                    ValueError):
+                pass
+            finally:
+                self._vid_stream_live = False
+            # pushed entries go stale the moment the feed breaks
+            self.vid_cache.clear()
+            await asyncio.sleep(1.0)
+
+    def _volume_read_headers(self, fid: str) -> dict:
+        headers = {}
+        if self.security is not None and self.security.volume_read:
+            from seaweedfs_tpu.security.jwt import gen_jwt
+            headers["Authorization"] = "Bearer " + gen_jwt(
+                self.security.volume_read, fid)
+        return headers
+
+    async def _fetch_chunk_direct(self, fid: str, sp, cache: bool) -> bytes:
+        """Volume-tier fetch through the shared vid cache: resolve
+        locations (singleflighted, TTL'd, stream-fed), fan over them, and
+        on TOTAL failure invalidate the cached route once and re-ask the
+        master — the same invalidate-once contract the client's download
+        path carries, now deduped through utils/vid_cache.py."""
+        vid = int(fid.partition(",")[0])
+        headers = self._volume_read_headers(fid)
+        last = None
+        for attempt in range(2):
+            urls = await self._vid_resolver.lookup(vid)
+            for url in urls:
                 try:
                     async with self._session.get(
-                            f"{_tls_scheme()}://{loc['url']}/{fid}",
+                            f"{_tls_scheme()}://{url}/{fid}",
                             headers=headers) as r:
                         if r.status == 200:
                             blob = await r.read()
-                            sp.set(peer=loc["url"], bytes=len(blob))
-                            if track:
-                                heat.record("chunk", fid, len(blob),
-                                            "read")
+                            sp.set(peer=url, bytes=len(blob))
                             if cache and self.chunk_cache.tiers:
                                 await asyncio.to_thread(
                                     self.chunk_cache.put, fid, blob)
@@ -445,7 +527,230 @@ class FilerServer:
                         last = f"HTTP {r.status}"
                 except aiohttp.ClientError as e:
                     last = str(e)
-            raise IOError(f"chunk {fid}: {last or 'no locations'}")
+            if attempt == 0 and self.vid_cache.invalidate(vid):
+                continue  # stale route dropped: re-ask the master once
+            break
+        raise IOError(f"chunk {fid}: {last or 'no locations'}")
+
+    def _hot_home(self, fid: str) -> str | None:
+        """The hot-tier home filer for a chunk, or None when the tier is
+        off / the ring is empty / this node IS the home."""
+        if not self.hot_enabled or len(self.hot_ring) < 2:
+            return None
+        home = self.hot_ring.home(fid)
+        return None if home in (None, self.url) else home
+
+    async def _hot_route(self, home: str, fid: str) -> bytes | None:
+        """Fetch a chunk's stored bytes from its home filer.  None means
+        the peer failed — the caller falls back to a direct volume-tier
+        fetch, so a dead home degrades to pre-hot-tier behavior, never an
+        error."""
+        headers = {}
+        if self.security is not None and self.security.filer_read:
+            from seaweedfs_tpu.security.jwt import gen_jwt
+            headers["Authorization"] = "Bearer " + gen_jwt(
+                self.security.filer_read, fid)
+        try:
+            async with self._session.get(
+                    f"{_tls_scheme()}://{home}/__hot__/chunk/{fid}",
+                    headers=headers) as r:
+                if r.status == 200:
+                    self.hot_stats["route_out"] += 1
+                    return await r.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            pass
+        self.hot_stats["route_fail"] += 1
+        return None
+
+    async def _fetch_chunk(self, fid: str, cache: bool = True,
+                           track: bool | None = None,
+                           allow_route: bool = True) -> bytes:
+        with trace.span("filer.chunk_fetch", fid=fid) as sp:
+            # workload heat: every demanded chunk access counts, cache
+            # hit or miss — "hot" means requested often, and the hot
+            # tier's promotion policy sizes itself on exactly this
+            # signal.  Readahead counts too (it is demand one chunk
+            # early); canary/internal traffic does not.
+            if track is None:
+                track = heat.ambient_is_data(include_readahead=True)
+            # disk tiers do blocking IO; mem-only lookups stay inline
+            if self.chunk_cache.tiers:
+                cached = await asyncio.to_thread(self.chunk_cache.get, fid)
+            else:
+                cached = self.chunk_cache.get(fid)
+            if cached is not None:
+                sp.set(cache_hit=True, bytes=len(cached))
+                self.hot_stats["hit_local"] += 1
+                if track:
+                    heat.record("chunk", fid, len(cached), "read")
+                return cached
+            sp.set(cache_hit=False)
+            # local miss: if the chunk's hot-tier home is another live
+            # filer, route there — the home fetches from the volume tier
+            # once and every gateway serves from that one copy
+            home = self._hot_home(fid) if allow_route else None
+            if home is not None:
+                blob = await self._hot_route(home, fid)
+                if blob is not None:
+                    sp.set(hot_home=home, bytes=len(blob))
+                    if track:
+                        heat.record("chunk", fid, len(blob), "read")
+                    if self.hot_l1 and cache:
+                        self.chunk_cache.put(fid, blob)
+                    return blob
+            blob = await self._fetch_chunk_stored(fid, sp, cache)
+            if track:
+                heat.record("chunk", fid, len(blob), "read")
+            return blob
+
+    async def _fetch_chunk_stored(self, fid: str, sp,
+                                  cache: bool) -> bytes:
+        """Volume-tier fetch with stored-bytes singleflight: EVERY
+        concurrent demand for one cold chunk — local readers (whose
+        decoded-view flights are a separate table) and hot-tier
+        route-ins alike — collapses into a single upstream fetch here.
+        This is what makes the cluster-wide fetch count exactly one per
+        chunk: the home node's `direct` counter ticks once per actual
+        volume-tier fetch, never once per demand.  The cache flag joins
+        the key for the same reason as the view flight's: a no-cache
+        reader must not suppress cache population for a caching one."""
+        key = (fid, cache)
+        fut = self._blob_flight.get(key)
+        if fut is None:
+            async def flight():
+                # shared flight: strip the starter's deadline so a
+                # joiner with a healthy budget never inherits a
+                # budget-poisoned starter's 504
+                from seaweedfs_tpu.utils import resilience as _res
+                tok = _res.set_deadline(None)
+                try:
+                    self.hot_stats["direct"] += 1
+                    return await self._fetch_chunk_direct(fid, sp, cache)
+                finally:
+                    _res.reset_deadline(tok)
+            fut = asyncio.ensure_future(flight())
+            self._blob_flight[key] = fut
+            fut.add_done_callback(
+                lambda _f, k=key: self._blob_flight.pop(k, None))
+        else:
+            metrics.FILER_SINGLEFLIGHT_JOINED.labels().inc()
+        return await asyncio.shield(fut)
+
+    async def _fetch_chunk_home(self, fid: str,
+                                track: bool = False) -> bytes:
+        """Stored-bytes fetch on the HOME side of a hot-tier route (or a
+        seed): cache-first, never re-routed (a mismatched membership
+        view during churn must not create routing loops), collapsed with
+        every other demand at the `_fetch_chunk_stored` singleflight."""
+        return await self._fetch_chunk(
+            fid, cache=True, track=track, allow_route=False)
+
+    async def _refresh_hot_ring(self) -> None:
+        """Rebuild the rendezvous ring from the master's live filer
+        membership (piggybacked on the 10s register heartbeat, so joins
+        and leaves re-home 1/N of the key space within one beat)."""
+        if not self.hot_enabled:
+            return
+        async with self._session.get(
+                f"{_tls_scheme()}://{self.master_url}/cluster/status") as r:
+            members = (await r.json()).get("Members", {})
+        filers = set(members.get("filer", []))
+        filers.add(self.url)  # self is a member even pre-heartbeat
+        if self.hot_ring.update(filers):
+            log.info("hot-tier ring now %s", sorted(filers))
+
+    async def _hot_seed_loop(self, interval: float) -> None:
+        """Pre-warm this filer with the cluster heat sketch's hottest
+        chunks homed here (WEEDTPU_HOT_SEED_INTERVAL > 0 enables;
+        /cluster/heat top-K, WEEDTPU_HOT_SEED_TOPK)."""
+        topk = int(_env_float("WEEDTPU_HOT_SEED_TOPK", 32))
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                async with self._session.get(
+                        f"{_tls_scheme()}://{self.master_url}"
+                        "/cluster/heat") as r:
+                    if r.status != 200:
+                        continue
+                    view = await r.json()
+                top = (view.get("chunks") or {}).get("top", [])[:topk]
+                fids = [e["key"] for e in top
+                        if self.hot_ring.home(e["key"]) in (None, self.url)]
+                await self._seed_fids(fids)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.debug("hot seed pass failed", exc_info=True)
+
+    async def _seed_fids(self, fids: list[str]) -> tuple[int, int]:
+        """Pull-through the given chunks into the local cache (books as
+        readahead, not demand, and records no heat — seeding must not
+        feed back into the signal that triggered it)."""
+        seeded = skipped = 0
+        for fid in fids[:256]:
+            if self.chunk_cache.get(fid) is not None:
+                skipped += 1
+                continue
+            try:
+                with netflow.flow("readahead"):
+                    await self._fetch_chunk_home(fid, track=False)
+                seeded += 1
+            except (IOError, OSError, aiohttp.ClientError):
+                skipped += 1
+        self.hot_stats["seeded"] += seeded
+        self.hot_stats["seed_skipped"] += skipped
+        return seeded, skipped
+
+    # -- hot-tier HTTP face ---------------------------------------------
+
+    async def handle_hot_chunk(self, req: web.Request) -> web.Response:
+        """Serve a chunk's STORED bytes as its hot-tier home (peer
+        gateways route their misses here).  Always serves locally —
+        routed requests never re-route, so mismatched membership views
+        during churn cannot loop."""
+        err = self._check_filer_jwt(req, write=False)
+        if err is not None:
+            return err
+        fid = req.match_info["fid"]
+        self.hot_stats["route_in"] += 1
+        try:
+            blob = await self._fetch_chunk_home(fid, track=False)
+        except (IOError, OSError, aiohttp.ClientError) as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.Response(body=blob,
+                            content_type="application/octet-stream")
+
+    async def handle_hot_seed(self, req: web.Request) -> web.Response:
+        """POST {"fids": [...]}: pull-through the listed chunks into this
+        filer's cache — the actuator behind the autopilot's chunk-granular
+        promotion policy."""
+        err = self._check_filer_jwt(req, write=True)
+        if err is not None:
+            return err
+        try:
+            fids = list((await req.json()).get("fids", []))
+        except (ValueError, TypeError):
+            return web.json_response({"error": "bad body"}, status=400)
+        seeded, skipped = await self._seed_fids(
+            [f for f in fids if isinstance(f, str)])
+        return web.json_response({"seeded": seeded, "skipped": skipped})
+
+    async def handle_hot_status(self, req: web.Request) -> web.Response:
+        return web.json_response(self.hot_status())
+
+    def hot_status(self) -> dict:
+        cc = self.chunk_cache.stats()
+        return {"node": self.url, "enabled": self.hot_enabled,
+                "ring": list(self.hot_ring.members),
+                "ring_version": self.hot_ring.version,
+                "events": dict(self.hot_stats),
+                "cache": {"hits": cc.get("hits", 0),
+                          "misses": cc.get("misses", 0),
+                          "mem_bytes": cc.get("mem_bytes", 0)},
+                "vid_cache": self.vid_cache.stats(),
+                "vid_stream_live": self._vid_stream_live,
+                "vid_lookups": self._vid_resolver.upstream_lookups,
+                "vid_joined": self._vid_resolver.joined}
 
     async def _decode_chunk_blob(self, blob: bytes, cipher_key: bytes,
                                  is_compressed: bool) -> bytes:
@@ -557,6 +862,12 @@ class FilerServer:
         # at scrape time so the bench can read filer cache hit ratio
         for stat, value in self.chunk_cache.stats().items():
             metrics.FILER_CHUNK_CACHE.labels(stat).set(value)
+        for stat, value in self.vid_cache.stats().items():
+            if isinstance(value, (int, float)):
+                metrics.VID_CACHE.labels(stat).set(value)
+        for event, value in self.hot_stats.items():
+            metrics.HOT_TIER_EVENTS.labels(event).set(value)
+        metrics.HOT_TIER_RING.labels().set(len(self.hot_ring))
         return metrics.scrape_response(req)
 
     async def handle_raw_entry(self, req: web.Request) -> web.Response:

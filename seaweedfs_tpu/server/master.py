@@ -766,6 +766,55 @@ class MasterServer:
         rows.sort(key=lambda r: -r.get("busy_s", 0.0))
         out["roofline"] = rows
         out["offenders"] = _pipeline.roofline_offenders({"rows": rows})
+        hot = self.collect_hot_tier()
+        if hot:
+            out["hot_tier"] = hot
+        if errors:
+            out["node_errors"] = errors
+        return out
+
+    def collect_hot_tier(self) -> dict:
+        """Pull every live filer's /__hot__/status and fold the event
+        ledgers into one fleet view: per-node rows plus summed events and
+        the tier-wide hit ratio ((local hits + routed hits) / all chunk
+        demands) that the bench records as `hot_tier_hit_ratio`."""
+        import concurrent.futures
+        import json as _json
+        horizon = time.time() - 30.0
+        filers = sorted(a for a, ts in
+                        self.cluster_members.get("filer", {}).items()
+                        if ts > horizon)
+        if not filers:
+            return {}
+
+        def pull(netloc):
+            try:
+                status, _, body = self.aggregator.pool.request(
+                    f"{_tls_scheme()}://{netloc}/__hot__/status",
+                    timeout=5.0)
+                if status != 200:
+                    return netloc, None, f"HTTP {status}"
+                return netloc, _json.loads(body), None
+            except Exception as e:
+                return netloc, None, str(e) or type(e).__name__
+
+        with concurrent.futures.ThreadPoolExecutor(
+                min(8, len(filers)), "hot-pull") as ex:
+            pulled = list(ex.map(pull, filers))
+        nodes: list[dict] = []
+        events: dict[str, int] = {}
+        errors: dict[str, str] = {}
+        for netloc, payload, err in pulled:
+            if err is not None:
+                errors[netloc] = err
+                continue
+            nodes.append(payload)
+            for k, v in (payload.get("events") or {}).items():
+                events[k] = events.get(k, 0) + int(v)
+        hits = events.get("hit_local", 0) + events.get("route_out", 0)
+        demands = hits + events.get("direct", 0)
+        out = {"nodes": nodes, "events": events,
+               "hit_ratio": round(hits / demands, 4) if demands else None}
         if errors:
             out["node_errors"] = errors
         return out
@@ -1290,6 +1339,9 @@ class MasterServer:
         return web.json_response(resp)
 
     async def handle_lookup(self, req: web.Request) -> web.Response:
+        # the fan-in the gateway vid caches exist to absorb: tests (and
+        # capacity math) assert this stays flat once caches are warm
+        metrics.MASTER_LOOKUPS.labels().inc()
         raw = req.query.get("volumeId", "")
         vid = int(raw.partition(",")[0])
         nodes = self.topo.lookup(vid, req.query.get("collection", ""))

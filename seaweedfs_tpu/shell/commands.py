@@ -892,6 +892,30 @@ def cmd_cluster_perf(env: CommandEnv, args, out):
                      f"best={tile.get('best_tile')} "
                      f"drift={tile.get('drift', 0):+.1%}")
         print(line, file=out)
+    hot = st.get("hot_tier") or {}
+    if hot:
+        ev = hot.get("events") or {}
+        ratio = hot.get("hit_ratio")
+        print(f"hot tier: hit_ratio="
+              + (f"{ratio:.1%}" if ratio is not None else "n/a")
+              + f" local={ev.get('hit_local', 0)} "
+              f"routed={ev.get('route_out', 0)} "
+              f"served_for_peers={ev.get('route_in', 0)} "
+              f"direct={ev.get('direct', 0)} "
+              f"seeded={ev.get('seeded', 0)} "
+              f"route_fail={ev.get('route_fail', 0)}", file=out)
+        for n in hot.get("nodes") or []:
+            nev = n.get("events") or {}
+            vc = n.get("vid_cache") or {}
+            print(f"  {n.get('node', '?'):22s} "
+                  f"ring={len(n.get('ring') or [])} "
+                  f"local={nev.get('hit_local', 0)} "
+                  f"routed={nev.get('route_out', 0)} "
+                  f"in={nev.get('route_in', 0)} "
+                  f"vid_cache h/m={vc.get('hits', 0)}/"
+                  f"{vc.get('misses', 0)}"
+                  + (" stream" if n.get("vid_stream_live") else ""),
+                  file=out)
 
 
 @command("cluster.metrics")

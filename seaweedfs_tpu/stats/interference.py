@@ -399,6 +399,10 @@ class Governor:
                 ap.buckets["balance"].rate
             self.classes["autopilot_tier"] = "convert"
             self.classes["autopilot_balance"] = "rebalance"
+            # chunk promotion's seed pull-throughs book as readahead
+            if "chunk" in ap.buckets:
+                self.ceilings["autopilot_chunk"] = ap.buckets["chunk"].rate
+                self.classes["autopilot_chunk"] = "readahead"
         self._scrub_rate = self.ceilings["scrub"]
         self._last_push = 0.0
         # a fresh master does not know what rate the fleet's scrubbers
@@ -433,6 +437,8 @@ class Governor:
             return self.master.autopilot.buckets["tiering"]
         if name == "autopilot_balance":
             return self.master.autopilot.buckets["balance"]
+        if name == "autopilot_chunk":
+            return self.master.autopilot.buckets["chunk"]
         return None
 
     def _current_rate(self, name: str) -> float:

@@ -499,6 +499,26 @@ FILER_CHUNK_CACHE = REGISTRY.gauge(
 FILER_SINGLEFLIGHT_JOINED = REGISTRY.counter(
     "weedtpu_filer_chunk_singleflight_joined_total",
     "concurrent chunk fetches collapsed into an already in-flight one")
+# serving plane: the master lookup fan-in the vid cache exists to
+# eliminate (tests assert it stays flat at steady state), the shared
+# vid-cache counters mirrored at scrape time, and the consistent-hash
+# hot tier's event ledger (hit_local / route_out / route_in / seeded /
+# fallback — mirrored from each gateway's per-instance stats dict)
+MASTER_LOOKUPS = REGISTRY.counter(
+    "weedtpu_master_lookup_total", "/dir/lookup requests served by the "
+    "master — the fan-in the gateway vid caches absorb")
+VID_CACHE = REGISTRY.gauge(
+    "weedtpu_vid_cache", "shared vid->location cache counters "
+    "(hits/misses/negative_hits/invalidations/entries)", ("stat",))
+HOT_TIER_EVENTS = REGISTRY.gauge(
+    "weedtpu_hot_tier_events", "cluster hot-tier event counters by kind "
+    "(cumulative; mirrored from the filer's hot-tier ledger)", ("event",))
+HOT_TIER_RING = REGISTRY.gauge(
+    "weedtpu_hot_tier_ring_members", "live filers in the hot-tier "
+    "rendezvous ring, as this node sees it")
+S3_QOS = REGISTRY.counter(
+    "weedtpu_s3_qos_total", "tenant QoS admission verdicts at the s3 "
+    "edge", ("outcome",))
 EC_DEGRADED_READ = REGISTRY.gauge(
     "weedtpu_ec_degraded_read", "EC degraded-read engine counters "
     "(shards fetched, intervals coalesced, reconstruct batches/intervals, "

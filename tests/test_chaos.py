@@ -682,6 +682,35 @@ def test_chaos_status_and_fsck_gate(tmp_path):
         c.stop()
 
 
+# ---- noisy-neighbor tenant QoS smoke -----------------------------------
+
+
+def test_noisy_neighbor_smoke(tmp_path):
+    """Tier-1 smoke for the noisy-neighbor cell: one abusive tenant
+    hammering the s3 edge is shed with 429s while the victim tenant's
+    reads stay error-free inside their latency bound, and the scenario
+    workload (a second tenant) verifies byte-identical during the noise.
+    encode=False keeps it fast; the EC-encoded variants run in the slow
+    matrix."""
+    c = ChaosCluster(tmp_path, n_volume_servers=2, with_filer=True,
+                     with_s3=True)
+    c.start()
+    try:
+        c.wait_heartbeats()
+        report = run_scenario(c, "s3_multipart", "noisy_neighbor",
+                              encode=False)
+        assert report["fault"] == "noisy_neighbor"
+        # the per-tenant ledger survived the fault's config restore:
+        # the abuser was shed at the edge, the victim never was
+        assert c.s3.qos.shed_by_tenant.get("noisy-bucket", 0) > 10
+        assert c.s3.qos.shed_by_tenant.get("victim-bucket", 0) == 0
+        assert c.s3.qos.shed_by_tenant.get("chaos-bucket", 0) == 0
+        # admission was restored to its pre-fault (disabled) state
+        assert not c.s3.qos.enabled
+    finally:
+        c.stop()
+
+
 # ---- hedged reads gate (timing-sensitive -> slow) ----------------------
 
 

@@ -890,8 +890,17 @@ def test_trace_propagation_degraded_filer_read(tmp_path, monkeypatch):
             trace.TRACE_HEADER: f"{tid}-{trace._new_span_id()}-1"})
         with urllib.request.urlopen(treq, timeout=120) as r:
             assert r.read() == payload
-        spans = [s for s in trace.ring_snapshot() if s["trace"] == tid]
-        names = {s["name"] for s in spans}
+        # the root span lands in the middleware's finally — in the
+        # server's loop thread, AFTER the last response byte reaches the
+        # client — so give it a moment instead of racing it
+        deadline = time.time() + 5.0
+        while True:
+            spans = [s for s in trace.ring_snapshot()
+                     if s["trace"] == tid]
+            names = {s["name"] for s in spans}
+            if "filer.request" in names or time.time() > deadline:
+                break
+            time.sleep(0.05)
         assert len(spans) >= 5, (len(spans), sorted(names))
         assert "filer.request" in names
         assert "filer.chunk_fetch" in names

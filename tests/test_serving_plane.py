@@ -1,0 +1,450 @@
+"""Serving plane: gateway vid-location caching, the consistent-hash
+cluster hot tier, and heat-driven tenant QoS.
+
+The acceptance bar this file asserts directly:
+  - steady-state reads issue ZERO master /dir/lookup calls (counter
+    delta, not vibes),
+  - under concurrent multi-filer load a hot chunk is fetched from the
+    volume tier exactly ONCE cluster-wide,
+  - hot-tier membership churn (joins AND leaves) re-homes the key
+    space without stale-home 404s — bytes stay identical mid-churn.
+"""
+
+import asyncio
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from seaweedfs_tpu.s3.qos import TenantQoS, parse_weights
+from seaweedfs_tpu.stats import heat, metrics
+from seaweedfs_tpu.utils.hashring import RendezvousRing
+from seaweedfs_tpu.utils.vid_cache import (AsyncVidResolver, SyncVidResolver,
+                                           VidCache)
+from tests.test_cluster import Cluster, free_port
+
+
+# ---------------------------------------------------------------------------
+# VidCache unit
+# ---------------------------------------------------------------------------
+
+class TestVidCache:
+    def test_ttl_and_expiry(self):
+        c = VidCache(ttl=0.05)
+        c.put(7, ["127.0.0.1:1"])
+        assert c.fresh(7) == ["127.0.0.1:1"]
+        time.sleep(0.08)
+        assert c.fresh(7) is None  # expired, not invalidated
+        assert c.misses >= 1
+
+    def test_negative_window(self):
+        c = VidCache(ttl=10.0, negative_ttl=0.05)
+        c.put_negative(9)
+        assert c.negative(9)
+        time.sleep(0.08)
+        assert not c.negative(9)
+        # a positive sighting clears the negative mark immediately
+        c.put_negative(9)
+        c.put(9, ["a:1"])
+        assert not c.negative(9) and c.fresh(9) == ["a:1"]
+
+    def test_invalidate_once_semantics(self):
+        c = VidCache(ttl=10.0)
+        c.put(3, ["a:1"])
+        assert c.invalidate(3) is True   # dropped a live route: retry
+        assert c.invalidate(3) is False  # nothing left: do NOT retry
+        assert c.invalidations == 1
+
+    def test_dict_facade(self):
+        """Existing tests poke client._vid_cache like a plain dict of
+        vid -> (urls, ts); the facade must keep that contract."""
+        c = VidCache(ttl=10.0)
+        c[5] = (["a:1", "b:2"], time.time())
+        assert 5 in c and len(c) == 1
+        urls, ts = c[5]
+        assert urls == ["a:1", "b:2"] and ts > 0
+        c.pop(5)
+        assert 5 not in c
+        c[6] = (["x:1"], time.time())
+        c.clear()
+        assert len(c) == 0
+
+
+# ---------------------------------------------------------------------------
+# Resolver singleflight
+# ---------------------------------------------------------------------------
+
+class TestSyncResolver:
+    def test_collapses_concurrent_lookups(self):
+        gate = threading.Event()
+        calls = []
+
+        def fetch(vid):
+            calls.append(vid)
+            gate.wait(5.0)
+            return ["127.0.0.1:9"]
+
+        r = SyncVidResolver(VidCache(ttl=10.0), fetch)
+        with ThreadPoolExecutor(8) as ex:
+            futs = [ex.submit(r.lookup, 4) for _ in range(8)]
+            time.sleep(0.2)
+            gate.set()
+            results = [f.result(10) for f in futs]
+        assert all(res == ["127.0.0.1:9"] for res in results)
+        assert len(calls) == 1 and r.upstream_lookups == 1
+        assert r.joined == 7
+
+    def test_negative_caching_absorbs_repeats(self):
+        calls = []
+
+        def fetch(vid):
+            calls.append(vid)
+            return []
+
+        r = SyncVidResolver(VidCache(ttl=10.0, negative_ttl=5.0), fetch)
+        assert r.lookup(404) == []
+        assert r.lookup(404) == []
+        assert len(calls) == 1  # second miss served from the neg cache
+
+    def test_errors_propagate_and_are_not_cached(self):
+        calls = []
+
+        def fetch(vid):
+            calls.append(vid)
+            raise RuntimeError("master down")
+
+        r = SyncVidResolver(VidCache(ttl=10.0), fetch)
+        with pytest.raises(RuntimeError):
+            r.lookup(1)
+        with pytest.raises(RuntimeError):
+            r.lookup(1)
+        assert len(calls) == 2  # a failure never poisons the cache
+
+
+class TestAsyncResolver:
+    def test_collapses_concurrent_lookups(self):
+        async def run():
+            gate = asyncio.Event()
+            calls = []
+
+            async def fetch(vid):
+                calls.append(vid)
+                await gate.wait()
+                return ["127.0.0.1:9"]
+
+            r = AsyncVidResolver(VidCache(ttl=10.0), fetch)
+            tasks = [asyncio.ensure_future(r.lookup(4))
+                     for _ in range(12)]
+            await asyncio.sleep(0.05)
+            gate.set()
+            results = await asyncio.gather(*tasks)
+            assert all(res == ["127.0.0.1:9"] for res in results)
+            assert len(calls) == 1 and r.upstream_lookups == 1
+            # cached now: no new upstream call
+            assert await r.lookup(4) == ["127.0.0.1:9"]
+            assert r.upstream_lookups == 1
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous ring
+# ---------------------------------------------------------------------------
+
+class TestRendezvousRing:
+    def test_home_is_deterministic_and_member_bound(self):
+        ring = RendezvousRing(["a:1", "b:2", "c:3"])
+        homes = {k: ring.home(k) for k in ("3,01x", "3,02x", "7,aa")}
+        assert all(h in ("a:1", "b:2", "c:3") for h in homes.values())
+        assert homes == {k: ring.home(k) for k in homes}
+
+    def test_update_versions_only_on_change(self):
+        ring = RendezvousRing(["a:1", "b:2"])
+        v = ring.version
+        assert ring.update(["b:2", "a:1"]) is False  # order-insensitive
+        assert ring.version == v
+        assert ring.update(["a:1", "b:2", "c:3"]) is True
+        assert ring.version == v + 1
+
+    def test_minimal_disruption_on_leave(self):
+        """Rendezvous hashing's point: removing one node only re-homes
+        the keys that lived there; every other key keeps its home."""
+        members = ["a:1", "b:2", "c:3", "d:4"]
+        ring = RendezvousRing(members)
+        keys = [f"{v},{i:08x}" for v in range(1, 5) for i in range(64)]
+        before = {k: ring.home(k) for k in keys}
+        ring.update([m for m in members if m != "c:3"])
+        for k in keys:
+            if before[k] != "c:3":
+                assert ring.home(k) == before[k]
+            else:
+                assert ring.home(k) != "c:3"
+
+
+# ---------------------------------------------------------------------------
+# Tenant QoS unit
+# ---------------------------------------------------------------------------
+
+class TestTenantQoS:
+    def test_parse_weights(self):
+        assert parse_weights("alice=4,bob=1,default=1") == \
+            {"alice": 4.0, "bob": 1.0, "default": 1.0}
+        assert parse_weights(" a = 2 , junk, =3, neg=-1, c=0.5 ") == \
+            {"a": 2.0, "c": 0.5}
+        assert parse_weights("") == {}
+
+    def test_disabled_admits_everything(self):
+        q = TenantQoS(rate=0.0)
+        assert not q.enabled
+        assert all(q.admit("anyone") for _ in range(100))
+        assert q.shed == 0
+
+    def test_abusive_tenant_sheds_into_429s(self):
+        q = TenantQoS(rate=5.0, burst_s=0.2, refresh_s=60.0)
+        outcomes = [q.admit("noisy") for _ in range(50)]
+        assert any(outcomes) and not all(outcomes)
+        assert q.shed_by_tenant["noisy"] == outcomes.count(False)
+        # a different tenant still gets its own bucket's burst
+        assert q.admit("quiet")
+
+    def test_weighted_shares_follow_config(self):
+        for _ in range(64):  # enough traffic for the sketch to call
+            heat.record("tenant", "qos-gold", 4096, "read")
+            heat.record("tenant", "qos-lead", 4096, "read")
+        q = TenantQoS(rate=100.0, burst_s=1.0, refresh_s=60.0,
+                      weights={"qos-gold": 3.0, "default": 1.0})
+        q.admit("qos-gold")
+        q.admit("qos-lead")
+        q.set_rate(100.0)   # force a refresh over BOTH live buckets
+        q.admit("qos-gold")
+        gold = q._buckets["qos-gold"].rate
+        lead = q._buckets["qos-lead"].rate
+        assert gold > 0 and lead > 0
+        assert abs(gold / lead - 3.0) < 0.01
+        assert gold + lead <= 100.0 + 1e-6
+
+    def test_set_rate_and_configure_force_refresh(self):
+        q = TenantQoS(rate=10.0, burst_s=1.0, refresh_s=60.0)
+        q.admit("t1")
+        r0 = q._buckets["t1"].rate
+        q.set_rate(20.0)
+        q.admit("t1")  # refresh was forced: split recomputed
+        assert q._buckets["t1"].rate > r0
+        q.configure(rate=0.0)
+        assert not q.enabled and q.admit("t1")
+        st = q.status()
+        assert st["total_rate"] == 0.0 and "tenants" in st
+
+
+# ---------------------------------------------------------------------------
+# Cluster integration: zero-master-lookup steady state, one fetch per
+# chunk cluster-wide, membership churn
+# ---------------------------------------------------------------------------
+
+def req(url, method="GET", data=None, headers=None):
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers=headers or {})
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def plane(tmp_path_factory):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+
+    tmp = tmp_path_factory.mktemp("plane")
+    c = Cluster(tmp, n_volume_servers=2).start()
+    c.wait_heartbeats()
+    filers = []
+    for i in range(2):
+        # both gateways share ONE metadata store (the sqlite-file analog
+        # of several filers pointed at one shared store backend) — same
+        # namespace, separate chunk caches: the hot-tier scenario
+        f = FilerServer(c.master.url, port=free_port(),
+                        data_dir=str(tmp / "fshared"), chunk_size=8192)
+        f._test_data_dir = str(tmp / "fshared")
+        c.submit(f.start())
+        filers.append(f)
+    _sync_rings(c, filers)
+    yield c, filers
+    for f in filers:
+        c.submit(f.stop())
+    c.stop()
+
+
+def _sync_rings(c, filers, expect=None):
+    """Force the ring refresh that normally rides the 10s register
+    heartbeat, so every filer sees the same membership NOW.  Waits for
+    `expect` (default: all of `filers`) registrations to land first —
+    a just-started filer's first register POST races the caller."""
+    want = len(filers) if expect is None else expect
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        fresh = time.time() - 30.0
+        live = [a for a, ts in
+                c.master.cluster_members.get("filer", {}).items()
+                if ts > fresh]
+        if len(live) >= want:
+            break
+        time.sleep(0.05)
+    for f in filers:
+        c.submit(f._refresh_hot_ring())
+
+
+def _hot_delta(before, after):
+    return {k: after[k] - before[k] for k in after}
+
+
+class TestZeroMasterLookups:
+    def test_filer_steady_state_reads_skip_master(self, plane):
+        c, (f1, _) = plane
+        base = f"http://{f1.url}"
+        body = bytes(range(256)) * 128  # 32 KiB -> 4 chunks @ 8 KiB
+        st, _, _ = req(f"{base}/steady/zero.bin", method="PUT", data=body)
+        assert st == 201
+        # warm-up read resolves locations (allowed to touch the master)
+        st, got, _ = req(f"{base}/steady/zero.bin")
+        assert st == 200 and got == body
+        master_before = metrics.MASTER_LOOKUPS.labels().value
+        resolver_before = f1._vid_resolver.upstream_lookups
+        for _ in range(10):
+            st, got, _ = req(f"{base}/steady/zero.bin")
+            assert st == 200 and got == body
+        assert metrics.MASTER_LOOKUPS.labels().value == master_before
+        assert f1._vid_resolver.upstream_lookups == resolver_before
+        assert f1.vid_cache.hits > 0 or f1.hot_stats["hit_local"] > 0
+
+    def test_client_negative_caching(self, plane):
+        c, _ = plane
+        from seaweedfs_tpu.client import WeedClient
+        client = WeedClient(c.master.url)
+        assert client.lookup(999999) == []
+        upstream = client._resolver.upstream_lookups
+        assert client.lookup(999999) == []  # absorbed by the neg cache
+        assert client._resolver.upstream_lookups == upstream
+
+
+class TestHotTierOneFetchPerCluster:
+    def test_concurrent_multi_filer_load(self, plane):
+        c, (f1, f2) = plane
+        assert len(f1.hot_ring) >= 2 and len(f2.hot_ring) >= 2
+        body = bytes((i * 7) & 0xFF for i in range(128 * 1024))  # 16 chunks
+        st, _, _ = req(f"http://{f1.url}/hot/one.bin", method="PUT",
+                       data=body)
+        assert st == 201
+        before = [dict(f1.hot_stats), dict(f2.hot_stats)]
+        urls = [f"http://{f1.url}/hot/one.bin",
+                f"http://{f2.url}/hot/one.bin"]
+        with ThreadPoolExecutor(16) as ex:
+            futs = [ex.submit(req, urls[i % 2]) for i in range(16)]
+            results = [f.result(60) for f in futs]
+        for st, got, _ in results:
+            assert st == 200 and got == body
+        d1 = _hot_delta(before[0], f1.hot_stats)
+        d2 = _hot_delta(before[1], f2.hot_stats)
+        # THE acceptance number: 16 gateways' worth of concurrent reads,
+        # 16 unique chunks, exactly 16 volume-tier fetches cluster-wide
+        assert d1["direct"] + d2["direct"] == 16, (d1, d2)
+        # both filers held homes (16 chunks over 2 nodes) and traffic
+        # actually crossed the ring in both directions
+        assert d1["route_in"] + d2["route_in"] > 0
+        assert d1["route_out"] + d2["route_out"] > 0
+        assert d1["route_fail"] == 0 and d2["route_fail"] == 0
+
+    def test_hot_status_and_master_rollup(self, plane):
+        c, (f1, f2) = plane
+        st, raw, _ = req(f"http://{f1.url}/__hot__/status")
+        assert st == 200
+        import json
+        s = json.loads(raw)
+        assert s["enabled"] and s["ring"] and s["ring_version"] >= 1
+        assert "vid_cache" in s and "events" in s
+        hot = c.master.collect_hot_tier()
+        assert len(hot.get("nodes") or []) == 2
+        assert hot["events"]["direct"] > 0
+        assert hot.get("hit_ratio") is not None
+
+
+class TestMembershipChurn:
+    def test_join_and_leave_rebuild_ring_without_stale_404s(
+            self, plane, tmp_path):
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        c, (f1, f2) = plane
+        body = bytes((i * 13) & 0xFF for i in range(96 * 1024))
+        st, _, _ = req(f"http://{f1.url}/churn/mid.bin", method="PUT",
+                       data=body)
+        assert st == 201
+        v_before = f1.hot_ring.version
+
+        # -- join: a third filer re-homes ~1/3 of the key space
+        f3 = FilerServer(c.master.url, port=free_port(),
+                         data_dir=f1._test_data_dir, chunk_size=8192)
+        c.submit(f3.start())
+        _sync_rings(c, [f1, f2, f3])
+        assert f1.hot_ring.version > v_before
+        assert len(f1.hot_ring) == 3 == len(f3.hot_ring)
+        for f in (f1, f2, f3):
+            st, got, _ = req(f"http://{f.url}/churn/mid.bin")
+            assert st == 200 and got == body
+
+        # -- leave: stop f3 but leave it in the membership table (a
+        # crashed node lingers up to the 30s horizon).  Routes to the
+        # dead home MUST degrade to direct fetches, never 404s.
+        c.submit(f3.stop())
+        for f in (f1, f2):
+            st, got, _ = req(f"http://{f.url}/churn/mid.bin")
+            assert st == 200 and got == body
+
+        # -- expiry: once the register horizon drops f3, rings shrink
+        # and every read is served ring-internal again
+        c.master.cluster_members.get("filer", {}).pop(f3.url, None)
+        _sync_rings(c, [f1, f2])
+        assert len(f1.hot_ring) == 2 == len(f2.hot_ring)
+        assert f3.url not in f1.hot_ring._members
+        fails_before = f1.hot_stats["route_fail"] + \
+            f2.hot_stats["route_fail"]
+        for f in (f1, f2):
+            st, got, _ = req(f"http://{f.url}/churn/mid.bin")
+            assert st == 200 and got == body
+        assert f1.hot_stats["route_fail"] + f2.hot_stats["route_fail"] \
+            == fails_before
+
+
+class TestAutopilotChunkPromote:
+    def test_plan_and_execute_seeds_home_filer(self, plane, monkeypatch):
+        c, (f1, f2) = plane
+        from seaweedfs_tpu.client import WeedClient
+        client = WeedClient(c.master.url)
+        fid = client.upload(b"promote me " * 512)
+        ring = RendezvousRing([f1.url, f2.url])
+        home = f1 if ring.home(fid) == f1.url else f2
+        assert home.chunk_cache.get(fid) is None
+
+        view = {"chunks": {"total_rps": 9.0, "top": [
+            {"key": fid, "rps": 9.0, "sustained_s": 120.0,
+             "bytes_rate": 1e6, "reads": 900, "writes": 0}]}}
+        monkeypatch.setattr(c.master, "cached_heat", lambda: view)
+        monkeypatch.setenv("WEEDTPU_AUTOPILOT", "execute")
+        ap = c.master.autopilot
+        made = c.submit(ap.tick())
+        plans = [p for p in made if p["policy"] == "chunk_promote"]
+        assert len(plans) == 1
+        assert plans[0]["node"] == home.url
+        assert fid in plans[0]["fids"]
+        c.submit(ap.wait_idle())
+        done = ap.plans[plans[0]["id"]]
+        assert done["state"] == "done", done
+        assert done["outcome"]["seeded"] == 1
+        assert home.chunk_cache.get(fid) is not None
+        assert home.hot_stats["seeded"] >= 1
+
+        # per-fid cooldown: an immediate second tick replans nothing
+        made2 = c.submit(ap.tick())
+        assert not [p for p in made2 if p["policy"] == "chunk_promote"]
