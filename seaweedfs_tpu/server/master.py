@@ -22,7 +22,8 @@ from aiohttp import web
 
 from seaweedfs_tpu.security.jwt import gen_jwt
 from seaweedfs_tpu.stats import (aggregate, heat, history, interference,
-                                 metrics, netflow, pipeline, profile, trace)
+                                 loops, metrics, netflow, pipeline, profile,
+                                 trace)
 from seaweedfs_tpu.utils import weedlog
 from seaweedfs_tpu.stats.canary import CanaryProber
 from seaweedfs_tpu.utils.http import aiohttp_trace_config
@@ -132,6 +133,7 @@ class MasterServer:
             web.route("*", "/cluster/autopilot",
                       self.handle_cluster_autopilot),
             web.get("/cluster/alerts", self.handle_cluster_alerts),
+            web.get("/cluster/loops", self.handle_cluster_loops),
             web.get("/cluster/dashboard", self.handle_cluster_dashboard),
             web.get("/", self.handle_ui),
         ])
@@ -162,12 +164,19 @@ class MasterServer:
         from seaweedfs_tpu.maintenance.convert import ConvertScheduler
         self.convert = ConvertScheduler(self)
         self._convert_task: asyncio.Task | None = None
+        # control-plane observatory (stats/loops.py): every background
+        # loop below ticks through this monitor, so per-loop wall/CPU,
+        # backlog, overruns, and last-error are first-class series —
+        # constructed first because the aggregator and the observer
+        # stages all report into it
+        self.loops = loops.LoopMonitor()
         # observability plane: fleet /metrics federation + the SLO
         # burn-rate engine (stats/aggregate.py).  Pulls every known
         # node's exposition over PooledHTTP; this master's own registry
         # is read directly.
         self.aggregator = aggregate.ClusterAggregator(
-            self._agg_nodes, local=(self.url, metrics.REGISTRY))
+            self._agg_nodes, local=(self.url, metrics.REGISTRY),
+            monitor=self.loops)
         # historical telemetry plane (stats/history.py): every scrape tick
         # lands in the fixed-memory multi-resolution store, then the
         # capacity forecaster re-regresses fill rates and the alert-rule
@@ -197,6 +206,26 @@ class MasterServer:
         # path (stats/canary.py), feeding the SLO engine and pinning
         # their trace ids for ready-made failure waterfalls
         self.canary = CanaryProber(self)
+        # master self-accounting: live-entry counts for every stateful
+        # subsystem, stamped as weedtpu_subsystem_entries on each scrape
+        # tick and on /cluster/loops — growth here is the leading
+        # indicator for control-plane memory, visible before RSS moves
+        self.loops.add_cardinality(
+            "registry_series", metrics.REGISTRY.series_count)
+        self.loops.add_cardinality(
+            "history_series", self.history.series_count)
+        self.loops.add_cardinality(
+            "history_node_baselines", lambda: len(self.history._prev))
+        self.loops.add_cardinality(
+            "alert_groups", lambda: sum(
+                len(st) for st in self.alerts._state.values()))
+        self.loops.add_cardinality(
+            "interference_nodes", lambda: len(self.interference._nodes))
+        self.loops.add_cardinality(
+            "heat_entries", lambda: sum(
+                len(sk.entries) for sk in heat.TRACKER._top.values()))
+        self.loops.add_cardinality(
+            "pinned_traces", lambda: len(trace.pinned_ids()))
         # workload heat: last fleet-merged /cluster/heat view (ts, dict)
         import threading as _threading
         self._heat_cache: tuple[float, dict] | None = None
@@ -253,6 +282,7 @@ class MasterServer:
             q.put_nowait(None)
         await asyncio.to_thread(self.aggregator.stop)
         self.interference.close()
+        self.loops.close()
         if self._session:
             await self._session.close()
         if self._runner:
@@ -329,22 +359,27 @@ class MasterServer:
 
     async def _expire_loop(self) -> None:
         tick = 0
+        interval = min(5.0, self.node_timeout / 2)
         while True:
-            await asyncio.sleep(min(5.0, self.node_timeout / 2))
-            dead = self.topo.expire_dead_nodes(self.node_timeout)
-            for nid in dead:
-                log.warning("volume server %s expired from topology", nid)
-            now = time.time()
-            for members in self.cluster_members.values():
-                for addr in [a for a, ts in members.items() if now - ts > 30]:
-                    del members[addr]
-            tick += 1
-            if tick % 12 == 0:  # every minute: vacuum scan
-                try:
-                    if self.vacuum_enabled:
-                        await self._vacuum_scan(self.garbage_threshold)
-                except Exception:
-                    log.warning("vacuum scan failed", exc_info=True)
+            await asyncio.sleep(interval)
+            with self.loops.tick("expire", interval=interval) as lt:
+                dead = self.topo.expire_dead_nodes(self.node_timeout)
+                lt.items = len(dead)
+                for nid in dead:
+                    log.warning("volume server %s expired from topology",
+                                nid)
+                now = time.time()
+                for members in self.cluster_members.values():
+                    for addr in [a for a, ts in members.items()
+                                 if now - ts > 30]:
+                        del members[addr]
+                tick += 1
+                if tick % 12 == 0:  # every minute: vacuum scan
+                    try:
+                        if self.vacuum_enabled:
+                            await self._vacuum_scan(self.garbage_threshold)
+                    except Exception:
+                        log.warning("vacuum scan failed", exc_info=True)
 
     async def _vacuum_scan(self, threshold: float) -> int:
         """Master-driven compaction: scan volumes whose garbage ratio
@@ -393,7 +428,10 @@ class MasterServer:
                     time.time() - self._admin_lock[2] < 30:
                 continue
             try:
-                await self.maintenance.tick()
+                with self.loops.tick("repair", interval=interval) as lt:
+                    actions = await self.maintenance.tick()
+                    lt.items = len(actions)
+                    lt.backlog = len(self.maintenance._active_vids)
             except Exception:
                 log.warning("repair tick failed", exc_info=True)
             # conversion rides the same cadence but runs as its OWN task
@@ -415,13 +453,18 @@ class MasterServer:
 
     async def _convert_tick_once(self) -> None:
         try:
-            await self.convert.tick()
+            with self.loops.tick("convert") as lt:
+                launched = await self.convert.tick()
+                lt.items = len(launched)
+                lt.backlog = len(self.convert.queued)
         except Exception:
             log.warning("convert tick failed", exc_info=True)
 
     async def _autopilot_tick_once(self) -> None:
         try:
-            await self.autopilot.tick()
+            with self.loops.tick("autopilot") as lt:
+                plans = await self.autopilot.tick()
+                lt.items = len(plans)
         except Exception:
             log.warning("autopilot tick failed", exc_info=True)
 
@@ -429,30 +472,50 @@ class MasterServer:
         """Aggregator scrape observer: record the tick into history, then
         forecast and evaluate alerts over the updated store (runs on the
         aggregator thread; each stage is independent so one failing must
-        not starve the others)."""
+        not starve the others).  Every stage ticks the loop monitor —
+        they share the aggregator's cadence, so each inherits its
+        interval for overrun detection."""
+        iv = self.aggregator.interval
+        iv = iv if iv > 0 else None
         try:
-            self.history.record(ts, per_node)
+            with self.loops.tick("history_record", interval=iv) as lt:
+                lt.items = len(per_node)
+                self.history.record(ts, per_node)
+                lt.backlog = self.history.series_count()
         except Exception:
             log.warning("history record failed", exc_info=True)
         try:
-            self.forecaster.update(
-                ts, volume_size_limit=self.topo.volume_size_limit)
+            with self.loops.tick("forecast", interval=iv):
+                self.forecaster.update(
+                    ts, volume_size_limit=self.topo.volume_size_limit)
         except Exception:
             log.warning("capacity forecast failed", exc_info=True)
         try:
-            self.alerts.evaluate(ts)
+            with self.loops.tick("alerts", interval=iv) as lt:
+                self.alerts.evaluate(ts)
+                lt.backlog = sum(
+                    len(st) for st in self.alerts._state.values())
         except Exception:
             log.warning("alert evaluation failed", exc_info=True)
         try:
-            self.interference.observe(ts, per_node)
+            with self.loops.tick("interference", interval=iv) as lt:
+                lt.items = len(per_node)
+                self.interference.observe(ts, per_node)
         except Exception as e:
             weedlog.warning("interference observe failed: %s", e,
                             name="interference", exc_info=True)
         try:
-            self.governor.tick(ts)
+            with self.loops.tick("governor", interval=iv):
+                self.governor.tick(ts)
         except Exception as e:
             weedlog.warning("governor tick failed: %s", e,
                             name="governor", exc_info=True)
+        try:
+            # stamp subsystem cardinality gauges once per scrape so the
+            # history store records them like any other master series
+            self.loops.refresh_accounting()
+        except Exception:
+            log.warning("loop accounting refresh failed", exc_info=True)
 
     # -- historical telemetry plane --------------------------------------
 
@@ -590,6 +653,27 @@ class MasterServer:
             await asyncio.to_thread(self.alerts.evaluate)
         return web.json_response(self.alerts.status())
 
+    async def handle_cluster_loops(self, req: web.Request
+                                   ) -> web.Response:
+        """/cluster/loops: the control-plane observatory — per-loop tick
+        wall/CPU seconds, items, backlog, overruns, and last error for
+        every master background loop, plus live subsystem cardinality
+        (registry/history/alert/interference/heat/trace entry counts).
+        ?refresh=1 runs a scrape tick first so the answer reflects a
+        just-measured aggregator pass.  Loopback-gated: last_error
+        strings can carry node names and paths."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        if req.query.get("refresh"):
+            try:
+                await asyncio.to_thread(self.aggregator.scrape_once)
+            except Exception:
+                log.warning("loops refresh pull failed", exc_info=True)
+        st = await asyncio.to_thread(self.loops.status)
+        st["headline"] = self.loops.headline()
+        return web.json_response(st)
+
     async def handle_cluster_dashboard(self, req: web.Request
                                        ) -> web.Response:
         """/cluster/dashboard: self-contained HTML status page — SLO,
@@ -668,8 +752,9 @@ class MasterServer:
 
         if not nodes:
             return []
+        from seaweedfs_tpu.utils import fanout
         with concurrent.futures.ThreadPoolExecutor(
-                min(8, len(nodes)), pool_name) as ex:
+                fanout.workers(len(nodes)), pool_name) as ex:
             return list(ex.map(pull, sorted(nodes.items())))
 
     # -- workload heat: fleet-merged hot chunks/volumes/tenants ----------
@@ -798,8 +883,9 @@ class MasterServer:
             except Exception as e:
                 return netloc, None, str(e) or type(e).__name__
 
+        from seaweedfs_tpu.utils import fanout
         with concurrent.futures.ThreadPoolExecutor(
-                min(8, len(filers)), "hot-pull") as ex:
+                fanout.workers(len(filers)), "hot-pull") as ex:
             pulled = list(ex.map(pull, filers))
         nodes: list[dict] = []
         events: dict[str, int] = {}
@@ -1007,6 +1093,12 @@ class MasterServer:
             snap["autopilot"] = self.autopilot.headline()
         except Exception:
             log.warning("autopilot status failed", exc_info=True)
+        try:
+            # control-plane loops headline (slowest loop + overruns);
+            # /cluster/loops has per-loop detail and cardinality
+            snap["loops"] = {"headline": self.loops.headline()}
+        except Exception:
+            log.warning("loops status failed", exc_info=True)
         with self._heat_lock:
             cached = self._heat_cache
         if cached is not None:

@@ -724,6 +724,10 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
         print(f"governor: {'on' if gov.get('enabled') else 'OFF'} "
               f"retunes={gov.get('retunes', 0)} {rates}"
               + (f"  index: {idx}" if idx else ""), file=out)
+    lp = st.get("loops") or {}
+    if lp.get("headline"):
+        # control-plane loops one-liner (cluster.loops for per-loop detail)
+        print(f"loops: {lp['headline']}", file=out)
 
 
 def _fmt_bytes(n: float) -> str:
@@ -1093,6 +1097,49 @@ def cmd_cluster_alerts(env: CommandEnv, args, out):
             ex = f" trace={g['exemplar']}" if g.get("exemplar") else ""
             print(f"    {g['state'].upper():8s} {lbl} value={val}{ex}",
                   file=out)
+
+
+@command("cluster.loops")
+def cmd_cluster_loops(env: CommandEnv, args, out):
+    """Control-plane observatory (/cluster/loops): per master background
+    loop, tick wall time (last/EMA/max vs its interval), CPU seconds,
+    items processed, backlog depth, overrun and error counts — plus
+    live subsystem cardinality (registry/history/alert/interference/
+    heat/trace entries).  -refresh runs one scrape tick first; -json
+    dumps raw.  Runbook: loop_overrun fires -> cluster.loops (which
+    loop, how far past its interval, does wall time track node count?)
+    -> if it's the aggregator/fan-out plane, raise WEEDTPU_FANOUT_POOL;
+    otherwise raise that loop's own interval knob or shed its input."""
+    flags = parse_flags(args)
+    params = {"refresh": "1"} if "refresh" in flags else {}
+    st = env.master_get("/cluster/loops", **params)
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    print(f"loops: {st.get('headline', '')}", file=out)
+    loops = st.get("loops") or {}
+    for name, lp in sorted(loops.items()):
+        iv = lp.get("interval")
+        iv_s = f"{iv:g}s" if iv else "-"
+        flag = ""
+        if iv and lp.get("wall_last", 0.0) > iv:
+            flag = "  OVERRUN"
+        elif lp.get("overruns"):
+            flag = f"  overruns={lp['overruns']}"
+        err = lp.get("last_error")
+        err_s = f"  last_error={err['error']}" if err else ""
+        print(f"  {name:16s} ticks={lp.get('ticks', 0):<6d} "
+              f"last={lp.get('wall_last', 0.0) * 1000:8.2f}ms "
+              f"ema={lp.get('wall_ema', 0.0) * 1000:8.2f}ms "
+              f"max={lp.get('wall_max', 0.0) * 1000:8.2f}ms "
+              f"interval={iv_s:6s} cpu={lp.get('cpu_total', 0.0):.3f}s "
+              f"items={lp.get('items_total', 0.0):g} "
+              f"backlog={lp.get('backlog', 0.0):g}"
+              f"{flag}{err_s}", file=out)
+    subs = st.get("subsystems") or {}
+    if subs:
+        print("entries: " + " ".join(f"{k}={v}" for k, v in
+                                     sorted(subs.items())), file=out)
 
 
 @command("cluster.interference")

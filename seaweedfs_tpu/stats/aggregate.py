@@ -463,7 +463,7 @@ class ClusterAggregator:
     def __init__(self, nodes_fn, local: tuple | None = None,
                  pool=None, rules: list[dict] | None = None,
                  windows: list[float] | None = None,
-                 interval: float | None = None):
+                 interval: float | None = None, monitor=None):
         from seaweedfs_tpu.utils.http import PooledHTTP
         self.nodes_fn = nodes_fn  # () -> {node name: netloc}
         self.local = local        # (node name, Registry) served locally
@@ -471,6 +471,14 @@ class ClusterAggregator:
                                        max_idle_per_host=2,
                                        role="master")
         self.interval = agg_interval() if interval is None else interval
+        # optional stats.loops.LoopMonitor: every scrape reports wall/CPU
+        # and node count as the "aggregator" loop
+        self.monitor = monitor
+        # persistent fan-out pool for _pull_node, sized with the fleet
+        # (grow-only, capped by WEEDTPU_FANOUT_POOL); a fresh min(8,n)
+        # pool per scrape serialized 500-node pulls into 500/8 RTTs
+        self._pull_ex = None
+        self._pull_ex_size = 0
         self.engine = SLOEngine(rules, windows)
         # (ts, {node: counters}, {node: hists}); trimmed to the longest
         # SLO window (+ slack) on every scrape
@@ -506,6 +514,10 @@ class ClusterAggregator:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        with self._lock:
+            ex, self._pull_ex, self._pull_ex_size = self._pull_ex, None, 0
+        if ex is not None:
+            ex.shutdown(wait=False)
         self.pool.close()
 
     def _run(self) -> None:
@@ -542,8 +554,30 @@ class ClusterAggregator:
         except Exception as e:  # transport or parse: node marked down
             return None, str(e) or type(e).__name__
 
-    def scrape_once(self) -> dict[str, dict]:
+    def _pull_executor(self, n: int):
+        """Persistent, grow-only fan-out pool sized min(n, cap) — see
+        utils/fanout.py for why the pool must scale with the fleet."""
         import concurrent.futures
+        from seaweedfs_tpu.utils import fanout
+        want = fanout.workers(n)
+        with self._lock:
+            if self._pull_ex is None or self._pull_ex_size < want:
+                old = self._pull_ex
+                self._pull_ex = concurrent.futures.ThreadPoolExecutor(
+                    want, "agg-pull")
+                self._pull_ex_size = want
+                if old is not None:
+                    old.shutdown(wait=False)
+            return self._pull_ex
+
+    def scrape_once(self) -> dict[str, dict]:
+        if self.monitor is None:
+            return self._scrape_once(None)
+        iv = self.interval if self.interval > 0 else None
+        with self.monitor.tick("aggregator", interval=iv) as t:
+            return self._scrape_once(t)
+
+    def _scrape_once(self, t) -> dict[str, dict]:
         nodes = dict(self.nodes_fn() or {})
         per_node: dict[str, dict] = {}
         errors: dict[str, str] = {}
@@ -557,14 +591,25 @@ class ClusterAggregator:
             # connect timeout, and paid serially that would stall the
             # scrape cadence (and every ?refresh=1 handler) for longer
             # than the aggregation interval
-            with concurrent.futures.ThreadPoolExecutor(
-                    min(8, len(remote)), "agg-pull") as ex:
-                results = ex.map(self._pull_node, [loc for _, loc in remote])
+            for attempt in (0, 1):
+                ex = self._pull_executor(len(remote))
+                try:
+                    results = list(ex.map(self._pull_node,
+                                          [loc for _, loc in remote]))
+                    break
+                except RuntimeError:
+                    # a concurrent scrape grew the pool and shut this one
+                    # down mid-map; retry once against the new pool
+                    if attempt:
+                        raise
             for (name, _), (fams, err) in zip(remote, results):
                 if err is not None:
                     errors[name] = err
                 else:
                     per_node[name] = fams
+        if t is not None:
+            t.items = len(per_node)
+            t.backlog = len(errors)
         ts = time.time()
         # snapshots stay PER NODE so the SLO engine can delta each node
         # separately (counter resets on a restarted node must not clamp

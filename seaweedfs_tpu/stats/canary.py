@@ -121,8 +121,17 @@ class CanaryProber:
     # -- probing ---------------------------------------------------------
 
     async def run_once(self, paths: tuple[str, ...] | None = None) -> dict:
-        for path in paths or canary_paths():
-            await self._probe(path)
+        paths = tuple(paths or canary_paths())
+        monitor = getattr(self.master, "loops", None)
+        if monitor is None:
+            for path in paths:
+                await self._probe(path)
+            return self.status()
+        iv = canary_interval()
+        with monitor.tick("canary", interval=iv if iv > 0 else None) as lt:
+            lt.items = len(paths)
+            for path in paths:
+                await self._probe(path)
         return self.status()
 
     async def _probe(self, path: str) -> None:

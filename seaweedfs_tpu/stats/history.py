@@ -603,7 +603,16 @@ _DEFAULT_ALERT_RULES = (
     # companion ratio gauge: federated gauges sum across nodes, and a
     # healthy fleet must sum to zero at any size
     "tile_pin_stale=threshold,series=weedtpu_tile_drift,"
-    "agg=max,window=120,op=gt,value=0.1,for=30")
+    "agg=max,window=120,op=gt,value=0.1,for=30;"
+    # control-plane observatory (stats/loops.py): a master loop whose
+    # tick wall time exceeds its own interval can no longer hold its
+    # cadence — the scrape/repair/alert plane is silently falling
+    # behind.  Fires on the worst loop's last-tick ratio staying >1
+    # (runbook: cluster.loops — which loop, how far over, and is the
+    # cost tracking node count? — then WEEDTPU_FANOUT_POOL or the
+    # loop's own interval knob)
+    "loop_overrun=threshold,series=weedtpu_loop_overrun_ratio,"
+    "agg=max,window=120,op=gt,value=1,for=30")
 
 
 def parse_alert_rules(spec: str | None = None) -> list[dict]:
@@ -922,18 +931,20 @@ class CapacityForecaster:
                                  "fill_bps": round(slope, 3),
                                  "predicted_full_seconds": round(secs, 3)}
         with self._lock:
-            # gauges for keys that stopped filling (or vanished) reset
-            # to the cap — a Registry child cannot be removed, and a
-            # stale "full in 600s" must not alarm forever
+            # RETIRE gauges for keys that vanished (node evicted, disk
+            # history aged out) — pinning them at the cap forever was a
+            # per-node series leak under churn: 500 joining/leaving
+            # nodes each left a (vs, dir) child behind.  A key that
+            # merely stopped filling is still in `disks` with a CAP
+            # forecast, so its gauge stays and reads un-alarming.
             for key in self.disks:
                 if key not in disks:
-                    metrics.PREDICTED_FULL.labels(*key).set(self.CAP)
-            for vid, rec in self.volumes.items():
-                if rec["predicted_full_seconds"] < self.CAP and \
-                        vols.get(vid, {}).get("predicted_full_seconds",
-                                              self.CAP) >= self.CAP:
-                    metrics.VOLUME_PREDICTED_FULL.labels(vid).set(
-                        self.CAP)
+                    metrics.PREDICTED_FULL.remove_matching(
+                        vs=key[0], dir=key[1])
+            for vid in self.volumes:
+                if vid not in vols or \
+                        vols[vid]["predicted_full_seconds"] >= self.CAP:
+                    metrics.VOLUME_PREDICTED_FULL.remove_matching(vid=vid)
             for vid, rec in vols.items():
                 if rec["predicted_full_seconds"] < self.CAP:
                     metrics.VOLUME_PREDICTED_FULL.labels(vid).set(

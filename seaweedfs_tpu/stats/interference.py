@@ -492,8 +492,9 @@ class Governor:
                 weedlog.V(1, "governor").infof(
                     "scrub-rate push to %s failed: %s", url, e)
 
+        from seaweedfs_tpu.utils import fanout
         with concurrent.futures.ThreadPoolExecutor(
-                min(8, len(nodes)), "scrub-push") as ex:
+                fanout.workers(len(nodes)), "scrub-push") as ex:
             list(ex.map(push, nodes))
 
     # -- the tick --------------------------------------------------------

@@ -668,3 +668,49 @@ METRIC_SERIES = REGISTRY.gauge(
     "weedtpu_metric_series",
     "label sets live across all metric families in this registry")
 REGISTRY._series_gauge = METRIC_SERIES
+# control-plane observatory (stats/loops.py): every master background
+# loop (aggregator, history record, alerts, forecast, interference,
+# governor, repair, convert, autopilot, canary, expire) reports each
+# tick through a shared LoopMonitor.  The loop label is a closed set of
+# master loop names, so cardinality is bounded by construction.  The
+# overrun ratio (tick wall seconds / loop interval) is the alertable
+# signal: a loop whose ratio crosses 1 can no longer keep its cadence,
+# which is how control planes die at fleet scale — see the default
+# loop_overrun alert rule.
+LOOP_TICK_SECONDS = REGISTRY.histogram(
+    "weedtpu_loop_tick_seconds",
+    "wall-clock seconds per master background-loop tick", ("loop",))
+LOOP_CPU_SECONDS = REGISTRY.counter(
+    "weedtpu_loop_cpu_seconds_total",
+    "thread CPU seconds consumed by each master background loop "
+    "(thread_time delta around the tick; awaits that migrate work to "
+    "other threads are attributed to those threads' loops)", ("loop",))
+LOOP_ITEMS = REGISTRY.counter(
+    "weedtpu_loop_items_total",
+    "items processed per master background loop (nodes scraped, plans "
+    "made, actions launched, probes fired)", ("loop",))
+LOOP_OVERRUNS = REGISTRY.counter(
+    "weedtpu_loop_overruns_total",
+    "ticks whose wall time exceeded the loop's own interval", ("loop",))
+LOOP_ERRORS = REGISTRY.counter(
+    "weedtpu_loop_errors_total",
+    "ticks that raised; the exception is swallowed by the loop's own "
+    "guard but recorded here and in /cluster/loops last_error", ("loop",))
+LOOP_BACKLOG = REGISTRY.gauge(
+    "weedtpu_loop_backlog",
+    "queue/backlog depth behind each master background loop (convert "
+    "queue, repair queue, ...; 0 for loops without a queue)", ("loop",))
+LOOP_OVERRUN_RATIO = REGISTRY.gauge(
+    "weedtpu_loop_overrun_ratio",
+    "last tick wall seconds / loop interval (>1 = the loop can no "
+    "longer hold its cadence; 0 when the loop has no fixed interval)",
+    ("loop",))
+# master self-accounting (stats/loops.py cardinality providers): live
+# entry counts per stateful master subsystem, so memory growth is a
+# first-class queryable signal rather than an RSS surprise
+SUBSYSTEM_ENTRIES = REGISTRY.gauge(
+    "weedtpu_subsystem_entries",
+    "live entries per stateful master subsystem (registry series, "
+    "history series + counter baselines, alert-engine state groups, "
+    "interference node states, heat tracker entries, pinned traces)",
+    ("subsystem",))

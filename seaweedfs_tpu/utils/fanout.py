@@ -1,0 +1,37 @@
+"""Fan-out pool sizing for master→fleet parallel HTTP calls.
+
+Every place the master fans a request out to the whole fleet — the
+aggregator scrape, admin fan-gets, hot-tier pulls, governor scrub-rate
+pushes — needs a thread-pool size.  The historical `min(8, n)` cap was
+invisible at ≤4 nodes but becomes a serialization wall at fleet scale:
+scraping 500 nodes through 8 threads takes 500/8 round-trips end to
+end, so aggregator tick time grows linearly in node count even though
+each node answers in milliseconds.
+
+`workers(n)` scales the pool with the fleet up to WEEDTPU_FANOUT_POOL
+(default 64).  Threads here are cheap — they spend their lives blocked
+in socket reads — so the cap bounds file descriptors and peak memory,
+not CPU.  Raise it if aggregator tick times climb with node count past
+the cap (watch weedtpu_loop_tick_seconds{loop="aggregator"}); lower it
+if the master's fd budget is tight.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_CAP = 64
+
+
+def pool_cap() -> int:
+    """Upper bound on fan-out pool size (WEEDTPU_FANOUT_POOL, default 64)."""
+    try:
+        cap = int(os.environ.get("WEEDTPU_FANOUT_POOL", str(_DEFAULT_CAP)))
+    except ValueError:
+        cap = _DEFAULT_CAP
+    return max(1, cap)
+
+
+def workers(n: int) -> int:
+    """Pool size for a fan-out over ``n`` targets: min(n, cap), ≥1."""
+    return max(1, min(int(n), pool_cap()))

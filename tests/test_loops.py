@@ -1,0 +1,390 @@
+"""Control-plane observatory tests (stats/loops.py + maintenance/
+fleetsim.py): LoopMonitor tick math (wall/CPU/items/backlog, overrun
+detection, error capture-and-reraise, EMA, close() retiring its metric
+children), subsystem cardinality self-accounting, the fan-out pool knob,
+per-node gauge/series retirement under 500-node join/leave churn
+(HistoryStore cap + baseline aging, AlertEngine group bound + pruning,
+interference index eviction, forecaster gauge retirement), and an
+end-to-end pass where a FleetSim fleet drives a real master's
+/cluster/loops, cluster.loops, and rack-failure backlog accounting."""
+
+import io
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.maintenance import fleetsim
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.stats import history, interference, loops, metrics
+from seaweedfs_tpu.utils import fanout
+from tests.test_cluster import Cluster
+from tests.test_maintenance import _get
+
+
+# ---- LoopMonitor unit ---------------------------------------------------
+
+def test_tick_records_wall_items_backlog_and_status():
+    mon = loops.LoopMonitor()
+    try:
+        with mon.tick("aggregator", interval=10.0) as t:
+            time.sleep(0.01)
+            t.items = 5
+            t.backlog = 2
+        st = mon.status()["loops"]["aggregator"]
+        assert st["ticks"] == 1
+        assert st["wall_last"] >= 0.01
+        assert st["items_total"] == 5
+        assert st["backlog"] == 2
+        assert st["overruns"] == 0
+        assert st["interval"] == 10.0
+        assert 0.0 < st["overrun_ratio"] < 1.0
+        assert st["wall_avg"] == pytest.approx(st["wall_total"])
+    finally:
+        mon.close()
+
+
+def test_overrun_detected_and_counted():
+    mon = loops.LoopMonitor()
+    try:
+        with mon.tick("repair", interval=0.001):
+            time.sleep(0.01)
+        st = mon.status()["loops"]["repair"]
+        assert st["overruns"] == 1
+        assert st["overrun_ratio"] > 1.0
+        assert "OVERRUN:repair" in mon.headline()
+    finally:
+        mon.close()
+
+
+def test_no_interval_never_overruns():
+    mon = loops.LoopMonitor()
+    try:
+        with mon.tick("convert"):  # no fixed cadence
+            time.sleep(0.005)
+        st = mon.status()["loops"]["convert"]
+        assert st["overruns"] == 0
+        assert st["interval"] is None
+        assert st["overrun_ratio"] == 0.0
+    finally:
+        mon.close()
+
+
+def test_error_captured_and_reraised():
+    mon = loops.LoopMonitor()
+    try:
+        with pytest.raises(ValueError):
+            with mon.tick("autopilot", interval=30.0):
+                raise ValueError("boom")
+        st = mon.status()["loops"]["autopilot"]
+        assert st["ticks"] == 1  # a raising tick is still timed
+        assert st["errors"] == 1
+        assert st["last_error"]["error"] == "ValueError: boom"
+        # a clean tick keeps the last error on record for the operator
+        with mon.tick("autopilot", interval=30.0):
+            pass
+        st = mon.status()["loops"]["autopilot"]
+        assert st["ticks"] == 2 and st["errors"] == 1
+        assert st["last_error"] is not None
+    finally:
+        mon.close()
+
+
+def test_ema_max_avg_math():
+    mon = loops.LoopMonitor()
+    try:
+        mon._record("governor", 1.0, 0.5, 10, 0, None, None)
+        mon._record("governor", 3.0, 0.5, 10, 0, None, None)
+        st = mon.status()["loops"]["governor"]
+        assert st["wall_ema"] == pytest.approx(0.8 * 1.0 + 0.2 * 3.0)
+        assert st["wall_max"] == 3.0
+        assert st["wall_avg"] == pytest.approx(2.0)
+        assert st["cpu_total"] == pytest.approx(1.0)
+        assert st["items_total"] == 20
+        assert mon.headline().startswith("slowest=governor")
+    finally:
+        mon.close()
+
+
+def test_headline_before_any_tick():
+    mon = loops.LoopMonitor()
+    assert mon.headline() == "no ticks yet"
+    mon.close()
+
+
+def test_close_retires_metric_children():
+    mon = loops.LoopMonitor()
+    with mon.tick("unit_close_test", interval=1.0):
+        pass
+    mon.add_cardinality("unit_close_sub", lambda: 7)
+    mon.refresh_accounting()
+    text = metrics.REGISTRY.render()
+    assert 'loop="unit_close_test"' in text
+    assert 'subsystem="unit_close_sub"' in text
+    mon.close()
+    text = metrics.REGISTRY.render()
+    assert 'loop="unit_close_test"' not in text
+    assert 'subsystem="unit_close_sub"' not in text
+    mon.close()  # idempotent
+
+
+def test_cardinality_providers_and_broken_provider():
+    mon = loops.LoopMonitor()
+    try:
+        mon.add_cardinality("unit_prov_ok", lambda: 3)
+
+        def _broken():
+            raise RuntimeError("nope")
+
+        mon.add_cardinality("unit_prov_bad", _broken)
+        acct = mon.refresh_accounting()
+        assert acct["unit_prov_ok"] == 3
+        assert "unit_prov_bad" not in acct  # skipped, not fatal
+        assert mon.status()["subsystems"]["unit_prov_ok"] == 3
+    finally:
+        mon.close()
+
+
+# ---- fan-out pool knob --------------------------------------------------
+
+def test_fanout_workers_scale_with_nodes_and_knob(monkeypatch):
+    monkeypatch.delenv("WEEDTPU_FANOUT_POOL", raising=False)
+    assert fanout.workers(2) == 2
+    assert fanout.workers(500) == 64  # default cap
+    assert fanout.workers(0) == 1
+    monkeypatch.setenv("WEEDTPU_FANOUT_POOL", "4")
+    assert fanout.workers(100) == 4
+    monkeypatch.setenv("WEEDTPU_FANOUT_POOL", "junk")
+    assert fanout.workers(100) == 64  # bad value -> default
+
+
+# ---- churn bounds: synthetic 500-node fleets (no sockets) ---------------
+
+def _gauge_node(url, used=10.0):
+    """Parsed-exposition shape for one node exporting per-node-labeled
+    disk gauges (what a real volume server's scrape contributes)."""
+    return {"weedtpu_disk_bytes": {"type": "gauge", "samples": [
+        ("weedtpu_disk_bytes",
+         {"vs": url, "dir": "/d", "kind": "used"}, used),
+        ("weedtpu_disk_bytes",
+         {"vs": url, "dir": "/d", "kind": "total"}, 100.0),
+    ]}}
+
+
+def _age_node(url, age=100.0):
+    return {"weedtpu_agg_scrape_age_seconds": {
+        "type": "gauge",
+        "samples": [("weedtpu_agg_scrape_age_seconds",
+                     {"node": url}, age)]}}
+
+
+def test_history_series_bounded_under_500_node_churn():
+    store = history.HistoryStore(resolutions=[(0, 8), (60, 8)],
+                                 max_series=64)
+    t0 = 1_700_000_000.0
+    # 25 waves x 20 fresh nodes = 500 distinct nodes; each leaves after
+    # one tick, each exporting 2 per-node-labeled series -> 1000 distinct
+    # series offered against a 64-series cap
+    for wave in range(25):
+        per_node = {f"http://churn-h-{wave}-{i}:80":
+                    _gauge_node(f"http://churn-h-{wave}-{i}:80")
+                    for i in range(20)}
+        store.record(t0 + wave * 30.0, per_node)
+    assert store.series_count() <= 64
+    assert store.evicted > 0  # the cap did real work
+
+
+def test_history_counter_baselines_age_out_after_departure():
+    store = history.HistoryStore(resolutions=[(0, 8)], max_series=64)
+    t0 = 1_700_000_000.0
+
+    def _counter_node(v):
+        return {"weedtpu_net_bytes_total": {"type": "counter", "samples": [
+            ("weedtpu_net_bytes_total", {"class": "scrub"}, v)]}}
+
+    store.record(t0, {"http://churn-b-gone:80": _counter_node(5.0),
+                      "http://churn-b-live:80": _counter_node(5.0)})
+    assert "http://churn-b-gone:80" in store._prev
+    # the departed node's baseline survives a short gap (scrape timeout)…
+    store.record(t0 + 30.0, {"http://churn-b-live:80": _counter_node(9.0)})
+    assert "http://churn-b-gone:80" in store._prev
+    # …but ages out past EVICT_IDLE_S instead of leaking forever
+    store.record(t0 + history.HistoryStore.EVICT_IDLE_S + 31.0,
+                 {"http://churn-b-live:80": _counter_node(12.0)})
+    assert "http://churn-b-gone:80" not in store._prev
+    assert "http://churn-b-live:80" in store._prev
+
+
+def test_alert_groups_bounded_and_pruned_under_churn():
+    store = history.HistoryStore(resolutions=[(0, 8)], max_series=512)
+    rules = history.parse_alert_rules(
+        "stale=threshold,series=weedtpu_agg_scrape_age_seconds,"
+        "agg=max,window=60,op=gt,value=45,for=0,clear_for=0")
+    eng = history.AlertEngine(store, rules=rules)
+    t0 = 1_700_000_000.0
+    # a 300-node fleet where EVERY node trips the predicate: label-set
+    # growth must stop at MAX_GROUPS, not track the fleet
+    per_node = {f"http://churn-a-{i}:80":
+                _age_node(f"http://churn-a-{i}:80") for i in range(300)}
+    store.record(t0, per_node)
+    eng.evaluate(t0 + 1.0)
+    groups = eng._state["stale"]
+    assert 0 < len(groups) <= history.AlertEngine.MAX_GROUPS
+    # mass leave: only 10 nodes remain; departed groups must be pruned
+    # once their series leaves the window, not pinned at firing forever
+    t1 = t0 + 700.0
+    live = {f"http://churn-a-{i}:80":
+            _age_node(f"http://churn-a-{i}:80") for i in range(10)}
+    store.record(t1, live)
+    eng.evaluate(t1 + 1.0)
+    eng.evaluate(t1 + 2.0)  # firing ghosts take the clear path, then drop
+    groups = eng._state["stale"]
+    assert len(groups) == 10
+    want = {(("node", f"http://churn-a-{i}:80"),) for i in range(10)}
+    assert set(groups) == want
+
+
+def _interf_node():
+    return {"weedtpu_volume_request_seconds": {
+        "type": "histogram", "samples": [
+            ("weedtpu_volume_request_seconds_bucket",
+             {"type": "read", "le": "0.005"}, 10.0),
+            ("weedtpu_volume_request_seconds_bucket",
+             {"type": "read", "le": "+Inf"}, 12.0),
+            ("weedtpu_volume_request_seconds_count",
+             {"type": "read"}, 12.0)]}}
+
+
+def test_interference_index_series_retired_after_eviction_window():
+    obs = interference.InterferenceObservatory(min_samples=1)
+    t0 = 1_700_000_000.0
+    try:
+        obs.observe(t0, {"http://churn-i-gone:80": _interf_node(),
+                         "http://churn-i-live:80": _interf_node()})
+        assert "http://churn-i-gone:80" in obs._nodes
+        # a node missing one tick decays but keeps its state…
+        obs.observe(t0 + 30.0, {"http://churn-i-live:80": _interf_node()})
+        assert "http://churn-i-gone:80" in obs._nodes
+        # …and past EVICT_IDLE_S both the state AND the gauge series go
+        obs.observe(t0 + obs.EVICT_IDLE_S + 31.0,
+                    {"http://churn-i-live:80": _interf_node()})
+        assert "http://churn-i-gone:80" not in obs._nodes
+        assert 'node="http://churn-i-gone:80"' not in \
+            metrics.REGISTRY.render()
+    finally:
+        obs.close()
+
+
+def test_forecaster_retires_gauges_for_departed_nodes():
+    store = history.HistoryStore(resolutions=[(0, 16)], max_series=64)
+    t0 = 1_700_000_000.0
+    url = "http://churn-f-a:80"
+    for k, used in enumerate((10.0, 40.0, 70.0)):
+        per_node = {url: _gauge_node(url, used=used)}
+        per_node[url]["weedtpu_volume_size_bytes"] = {
+            "type": "gauge", "samples": [
+                ("weedtpu_volume_size_bytes",
+                 {"vid": "churnf7", "vs": url}, 1e6 * (k + 1))]}
+        store.record(t0 + k * 30.0, per_node)
+    f = history.CapacityForecaster(store, window=600.0)
+    f.update(now=t0 + 61.0, volume_size_limit=10_000_000)
+    assert (url, "/d") in f.disks
+    assert "churnf7" in f.volumes
+    text = metrics.REGISTRY.render()
+    assert f'vs="{url}"' in text
+    assert 'vid="churnf7"' in text
+    # node leaves; once its history ages past the window the forecaster
+    # must RETIRE the per-node gauges, not pin them at the cap
+    f.update(now=t0 + 10_000.0, volume_size_limit=10_000_000)
+    assert not f.disks and not f.volumes
+    text = metrics.REGISTRY.render()
+    assert f'vs="{url}"' not in text
+    assert 'vid="churnf7"' not in text
+
+
+# ---- integration: real master -------------------------------------------
+
+@pytest.fixture()
+def loops_cluster(tmp_path, monkeypatch):
+    """One real volume server, on-demand aggregation (deterministic
+    ticks), repair loop parked so only the loops under test run."""
+    monkeypatch.setenv("WEEDTPU_SCRUB_MBPS", "0")
+    monkeypatch.setenv("WEEDTPU_REPAIR_INTERVAL", "3600")
+    monkeypatch.setenv("WEEDTPU_AGG_INTERVAL", "0")
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    yield c
+    c.stop()
+
+
+def test_cluster_loops_endpoint_and_shell(loops_cluster):
+    c = loops_cluster
+    st = _get(c.master.url, "/cluster/loops?refresh=1")
+    # one on-demand scrape drives the whole observer chain
+    for name in ("aggregator", "history_record", "forecast", "alerts",
+                 "interference", "governor"):
+        assert name in st["loops"], sorted(st["loops"])
+    agg = st["loops"]["aggregator"]
+    assert agg["ticks"] >= 1
+    assert agg["items_total"] >= 2  # master + 1 volume server
+    assert agg["wall_last"] > 0.0
+    assert st["headline"].startswith("slowest=")
+    subs = st["subsystems"]
+    assert subs["registry_series"] > 0
+    assert subs["history_series"] > 0
+    assert "alert_groups" in subs and "interference_nodes" in subs
+    assert "heat_entries" in subs and "pinned_traces" in subs
+
+    env = CommandEnv(c.master.url)
+    out = io.StringIO()
+    run_command(env, "cluster.loops -refresh", out)
+    text = out.getvalue()
+    assert "aggregator" in text
+    assert "entries:" in text
+    out = io.StringIO()
+    run_command(env, "cluster.loops -json", out)
+    doc = json.loads(out.getvalue())
+    assert "aggregator" in doc["loops"]
+
+    out = io.StringIO()
+    run_command(env, "maintenance.status", out)
+    assert "loops:" in out.getvalue()
+
+
+def test_fleetsim_drives_master_loops(tmp_path, monkeypatch):
+    monkeypatch.setenv("WEEDTPU_SCRUB_MBPS", "0")
+    monkeypatch.setenv("WEEDTPU_REPAIR_INTERVAL", "3600")
+    monkeypatch.setenv("WEEDTPU_AGG_INTERVAL", "0")
+    c = Cluster(tmp_path, n_volume_servers=1).start()
+    c.wait_heartbeats()
+    # heartbeat_s is huge: beat_all() below is the only heartbeat source,
+    # so registration is deterministic and the test never sleeps
+    sim = fleetsim.FleetSim(c.master.url, nodes=20, racks=4,
+                            volumes_per_node=2, heartbeat_s=3600.0,
+                            base_rps=50.0, seed=7)
+    sim.start()
+    try:
+        assert sim.beat_all() == 20
+        st = _get(c.master.url, "/cluster/loops?refresh=1")
+        agg = st["loops"]["aggregator"]
+        assert agg["items_total"] >= 21  # 20 vnodes + the real fleet
+        assert agg["backlog"] == 0      # every scrape answered
+        # the synthesized expositions are real enough for the whole
+        # observer chain: per-node interference state for every vnode
+        assert st["subsystems"]["interference_nodes"] >= 20
+
+        # correlated rack failure -> scrape errors surface as backlog
+        failed = sim.fail_rack("rack0")
+        assert len(failed) == 5  # 20 nodes round-robined over 4 racks
+        st = _get(c.master.url, "/cluster/loops?refresh=1")
+        assert st["loops"]["aggregator"]["backlog"] >= len(failed)
+        sim.recover_rack("rack0")
+        st = _get(c.master.url, "/cluster/loops?refresh=1")
+        assert st["loops"]["aggregator"]["backlog"] == 0
+
+        # leave churn shrinks the fleet
+        gone = sim.stop_nodes(5)
+        assert len(gone) == 5 and len(sim) == 15
+    finally:
+        sim.stop()
+        c.stop()
