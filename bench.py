@@ -661,6 +661,14 @@ def _bench_config(backend: str) -> dict:
         cfg["aio"] = _aio.engine_label()
     except Exception:
         pass
+    try:
+        # which erasure code untagged volumes get this round: the heal /
+        # repair-traffic numbers depend on the matrix family they ran
+        # under (CODEC_SCOPED_METRICS gate on this)
+        from seaweedfs_tpu.ops import codecs as _codecs
+        cfg["codec"] = _codecs.default_tag()
+    except Exception:
+        pass
     if _PROBED_DISK_CEILING:
         cfg["disk_ceiling"] = dict(_PROBED_DISK_CEILING)
     # serving-plane shape: the knee is measured through the location
@@ -761,10 +769,18 @@ def _record_trajectory(gbps: float, backend: str, extra: dict) -> None:
     aio_now = cfg.get("aio")
 
     serving_now = cfg.get("serving")
+    codec_now = cfg.get("codec")
 
     def metric_comparable(e: dict, m: str) -> bool:
         if m.startswith(SERVING_SCOPED_METRICS):
             return (e.get("config") or {}).get("serving") == serving_now
+        if m.startswith(CODEC_SCOPED_METRICS):
+            # like-codec rounds only (the config.aio pattern): a heal
+            # measured under MSR regeneration must not set — or be
+            # judged by — an RS round's repair-traffic bar
+            c = (e.get("config") or {}).get("codec")
+            if not (c is None or codec_now is None or c == codec_now):
+                return False
         if not m.startswith(AIO_SCOPED_METRICS):
             return True
         a = (e.get("config") or {}).get("aio")
@@ -900,7 +916,8 @@ def main() -> None:
     # batched degraded EC reads and pipelined filer streaming raced
     # against their serial baselines, and the tracing layer raced against
     # itself disabled — each with a regression gate
-    for fn in (_bench_degraded_read, _bench_filer_stream,
+    for fn in (_bench_degraded_read, _bench_codec_family,
+               _bench_filer_stream,
                _bench_trace_overhead, _bench_profile_overhead,
                _bench_heal_time, _bench_scrub_overhead,
                _bench_flow_canary_overhead, _bench_heat_overhead,
@@ -1088,6 +1105,8 @@ def _exit_code(extra: dict) -> int:
              "interference_overhead_regression",
              "repair_interference_regression",
              "repair_ratio_regression",
+             "lrc_degraded_regression",
+             "msr_repair_ratio_regression",
              "chaos_scenario_failed",
              "batch_place_regression",
              "fleet_convert_failed",
@@ -1154,11 +1173,16 @@ TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1",
 BATCH_PLACE_TOL = 0.90
 # lower-is-better trajectory gates: the metric failing when it RISES
 # more than 10% above the best (minimum) prior recorded round
-TRAJECTORY_GATED_MIN = ("repair_network_ratio", "fleet_sim_tick_gate")
+TRAJECTORY_GATED_MIN = ("repair_network_ratio", "fleet_sim_tick_gate",
+                        "repair_network_ratio_msr_9_16")
 # metric prefixes whose numbers are bound by the host I/O engine: these
 # additionally require the prior round's config.aio to match (see
 # _record_trajectory.metric_comparable)
 AIO_SCOPED_METRICS = ("ec_encode_e2e", "fleet_convert", "ec_rebuild_e2e")
+# repair-traffic metrics are shaped by the erasure code the volumes ran
+# under: compare only like-codec rounds (None-tolerant — rounds
+# predating the codec stamp were all RS)
+CODEC_SCOPED_METRICS = ("repair_network_ratio", "heal_")
 # serving-plane metrics compare ONLY against rounds measured under an
 # IDENTICAL config.serving stamp (strict equality, not None-tolerant:
 # rounds predating the stamp were measured before the location-cache /
@@ -1173,6 +1197,10 @@ TRAJECTORY_LOOKBACK = 5
 # heal must move <= 0.6x the repair bytes of the naive shell-rebuild
 # walk over the same loss pattern
 REPAIR_RATIO_TOL = 0.6
+# PM-MSR regenerating repair (ISSUE 19 acceptance bar): remote repair
+# traffic for one lost shard must stay under 1/3 of the naive k-shard
+# copy (the (9,16) code's cut-set floor is d/(k*alpha) = 0.222)
+MSR_REPAIR_RATIO_TOL = 0.334
 # foreground read p99 while the repair planner rebuilds lost shards must
 # stay within 1.5x the idle p99 (ISSUE 9 acceptance bar; the 1709.05365
 # measurement: online repair/encode interference with foreground traffic)
@@ -1329,6 +1357,160 @@ def _bench_blob_rps(extra: dict, n: int = 2000, size: int = 1024,
             if master in started:
                 run_quiet(master.stop())
             loop.call_soon_threadsafe(loop.stop)
+
+
+def _bench_codec_family(extra: dict, n_needles: int = 24,
+                        nsize: int = 64 * 1024, reads: int = 120) -> None:
+    """Codec-family benches (ISSUE 19), all on the host codec:
+
+    (a) codec-labeled encode throughput — ``ec_encode_lrc_10_2_2`` /
+        ``ec_encode_msr_9_16`` GB/s next to the RS rows;
+    (b) LRC vs RS(10,4) single-loss degraded-read p99: the LRC decode
+        touches ONE local parity group (r+1 surviving shards) where RS
+        gathers all k, so its tail must come in below RS — a round
+        where it does not fails the run;
+    (c) PM-MSR reduced-repair network ratio: every survivor served
+        remotely, measured helper bytes over the naive k-shard copy,
+        gated at MSR_REPAIR_RATIO_TOL (the (9,16) cut-set floor is
+        d/(k*alpha) = 0.222) and recorded codec-labeled for the
+        lower-is-better trajectory gate."""
+    from seaweedfs_tpu import native
+    from seaweedfs_tpu.ops import codecs as _codecs
+    from seaweedfs_tpu.ops import gf
+    from seaweedfs_tpu.storage import needle as ndl
+    from seaweedfs_tpu.storage.ec import ec_files, ec_volume, layout
+    from seaweedfs_tpu.storage.volume import Volume
+
+    kind = "cpp" if native.available() else "numpy"
+    old = os.environ.get("WEEDTPU_EC_CODEC")
+    os.environ["WEEDTPU_EC_CODEC"] = kind
+    try:
+        # (a) encode throughput per family, one device-free dispatch shape
+        n_bytes = 4 * 1024 * 1024
+        rng = np.random.default_rng(19)
+        for tag in ("lrc_10_2_2", "msr_9_16"):
+            spec = _codecs.parse_tag(tag)
+            codec = _codecs.make_codec(tag, kind)
+            data = rng.integers(0, 256, (spec.k, n_bytes), dtype=np.uint8)
+            codec.encode_parity(data)  # warm
+            iters = 8
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                codec.encode_parity(data)
+            el = time.perf_counter() - t0
+            extra[f"ec_encode_{tag}"] = round(
+                spec.k * n_bytes * iters / el / 1e9, 3)
+
+        small = 4096
+        with tempfile.TemporaryDirectory(prefix="weedtpu-codec-") as d:
+            vol = Volume(d, "", 19)
+            ids = []
+            for i in range(1, n_needles + 1):
+                data = rng.integers(0, 256, nsize, dtype=np.uint8).tobytes()
+                vol.append_needle(ndl.Needle(cookie=0x77, id=i, data=data))
+                ids.append(i)
+            vol.close()
+            src_base = os.path.join(d, "19")
+
+            def make(tag: str, lose: tuple) -> str:
+                bdir = os.path.join(d, tag)
+                os.makedirs(bdir)
+                base = os.path.join(bdir, "19")
+                for ext in (".dat", ".idx"):
+                    os.link(src_base + ext, base + ext)
+                ec_files.write_ec_files(base, large_block=1 << 40,
+                                        small_block=small,
+                                        batch_size=small * 40,
+                                        codec_tag=tag)
+                ec_files.write_sorted_ecx(base + ".idx")
+                for sid in lose:
+                    os.remove(base + layout.to_ext(sid))
+                return base
+
+            # (b) single-loss degraded p99, LRC vs RS — per-read
+            # latencies on one thread, interleaved arms, shard 1 lost
+            bases = {tag: make(tag, (1,))
+                     for tag in ("rs_10_4", "lrc_10_2_2")}
+            lats: dict[str, list] = {t: [] for t in bases}
+            evs = {t: ec_volume.EcVolume(b, 1 << 40, small)
+                   for t, b in bases.items()}
+            try:
+                for t, ev in evs.items():  # warm both arms
+                    ev.read_needle(ids[0])
+                for i in range(reads):
+                    for t, ev in evs.items():
+                        nid = ids[i % len(ids)]
+                        t0 = time.perf_counter()
+                        n = ev.read_needle(nid)
+                        lats[t].append(time.perf_counter() - t0)
+                        assert len(n.data) == nsize
+            finally:
+                for ev in evs.values():
+                    ev.close()
+            p99 = {t: sorted(v)[int(0.99 * (len(v) - 1))] * 1e3
+                   for t, v in lats.items()}
+            extra["rs_degraded_p99_ms"] = round(p99["rs_10_4"], 3)
+            extra["lrc_degraded_p99_ms"] = round(p99["lrc_10_2_2"], 3)
+            if p99["lrc_10_2_2"] >= p99["rs_10_4"]:
+                extra["lrc_degraded_regression"] = True
+                print(f"bench: REGRESSION — LRC single-loss degraded "
+                      f"p99 {p99['lrc_10_2_2']:.2f}ms is not below "
+                      f"RS(10,4)'s {p99['rs_10_4']:.2f}ms; the local-"
+                      f"group decode has stopped paying off. Failing "
+                      f"the bench run.", file=sys.stderr)
+
+            # (c) MSR repair network ratio: one shard lost, EVERY
+            # survivor remote — measured helper payloads / naive copy
+            mbase = make("msr_9_16", ())
+            spec = _codecs.parse_tag("msr_9_16")
+            shard_size = os.path.getsize(mbase + layout.to_ext(0))
+            shards = {i: np.fromfile(mbase + layout.to_ext(i),
+                                     dtype=np.uint8)
+                      for i in range(spec.n)}
+            lost = 2
+            for i in range(spec.n):  # nothing local: all repair is net
+                os.remove(mbase + layout.to_ext(i))
+            a = spec.alpha
+            fetched = {"bytes": 0}
+
+            def fetch(group, sids, coeff, off, size):
+                blocks: dict[int, np.ndarray] = {}
+                rows = []
+                for s in sids:
+                    f = s // a
+                    if f not in blocks:
+                        blocks[f] = shards[f][off * a:(off + size) * a
+                                              ].reshape(size, a)
+                    rows.append(np.ascontiguousarray(blocks[f][:, s % a]))
+                out = gf.gf_matmul(np.asarray(coeff, np.uint8),
+                                   np.stack(rows))
+                fetched["bytes"] += out.nbytes
+                return out.tobytes()
+
+            groups = [{"node": f"h{i}:1", "shards": [i], "locality": 3,
+                       "shard_size": shard_size}
+                      for i in range(spec.n) if i != lost]
+            res = ec_files.rebuild_ec_reduced(mbase, [lost], groups,
+                                              fetch, codec_tag="msr_9_16")
+            rebuilt = np.fromfile(mbase + layout.to_ext(lost),
+                                  dtype=np.uint8)
+            assert np.array_equal(rebuilt, shards[lost]), \
+                "msr repair output differs"
+            ratio = fetched["bytes"] / (spec.k * shard_size)
+            extra["repair_network_ratio_msr_9_16"] = round(ratio, 3)
+            extra["msr_repair_bytes"] = int(fetched["bytes"])
+            if ratio > MSR_REPAIR_RATIO_TOL:
+                extra["msr_repair_ratio_regression"] = True
+                print(f"bench: REGRESSION — MSR repair moved "
+                      f"{ratio:.3f}x of the naive copy bytes (bar: "
+                      f"<= {MSR_REPAIR_RATIO_TOL}; cut-set floor "
+                      f"{spec.params[1] / (spec.k * a):.3f}). Failing "
+                      f"the bench run.", file=sys.stderr)
+    finally:
+        if old is None:
+            os.environ.pop("WEEDTPU_EC_CODEC", None)
+        else:
+            os.environ["WEEDTPU_EC_CODEC"] = old
 
 
 def _bench_degraded_read(extra: dict, n_needles: int = 40,

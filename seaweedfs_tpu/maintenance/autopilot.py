@@ -30,6 +30,14 @@ health ledger, and the capacity forecasts:
   the volume server's ``/admin/volume/move`` (staged copy, CRC verify,
   commit on target, retire on source; abortable mid-failure with no
   partial state; every byte books as netflow ``class=rebalance``).
+- **codec selection** — per-volume erasure-code choice from the same
+  heat evidence: a sustained-hot EC volume plans a recode to LRC
+  (degraded reads touch one local parity group), a sustained-cold one
+  to PM-MSR (repair ships d/(k*alpha) shard-equivalents instead of k),
+  executed by the volume server's in-place ``/admin/ec/recode`` on the
+  shard-majority node.  Paced by its own governed ``codec`` bucket
+  (``WEEDTPU_AUTOPILOT_CODEC_RATE``/``_BURST``); warm middle-band
+  volumes keep their codec.
 - **action ledger** — every plan is a pinned trace plus a decision
   record with a state machine ``planned -> approved -> executing ->
   done | aborted``.  ``WEEDTPU_AUTOPILOT=plan`` (the DEFAULT) creates
@@ -67,7 +75,7 @@ log = logging.getLogger("autopilot")
 
 PLAN_STATES = ("planned", "approved", "executing", "done", "aborted")
 POLICIES = ("tiering_demote", "tiering_promote", "balance_move",
-            "chunk_promote")
+            "chunk_promote", "codec_select")
 
 
 def autopilot_mode() -> str:
@@ -125,6 +133,9 @@ class Autopilot:
             "chunk": TokenBucket(
                 _env_float("WEEDTPU_AUTOPILOT_CHUNK_RATE", 1.0),
                 _env_float("WEEDTPU_AUTOPILOT_CHUNK_BURST", 8.0)),
+            "codec": TokenBucket(
+                _env_float("WEEDTPU_AUTOPILOT_CODEC_RATE", 0.1),
+                _env_float("WEEDTPU_AUTOPILOT_CODEC_BURST", 2.0)),
         }
         # chunk-granular promotion: sustained-hot chunks from the fleet
         # heat sketch are seeded into their hot-tier home filer (the
@@ -137,6 +148,10 @@ class Autopilot:
         # hysteresis state: when each volume was FIRST seen cold (reset
         # on any warm sighting), and the per-volume action cooldown
         self._cold_since: dict[int, float] = {}
+        # codec_select's own sustained-cold clock: a volume can be
+        # tiering-stable yet still drift between codec temperature
+        # bands, so the two hysteresis clocks are independent
+        self._codec_cold_since: dict[int, float] = {}
         self._last_action: dict[int, tuple[float, str]] = {}
         self._tasks: set[asyncio.Task] = set()
         self.ticks = 0
@@ -148,6 +163,9 @@ class Autopilot:
         # which this engine does not do): counted + logged, never
         # silently dropped
         self.promote_blocked_spread = 0
+        # codec_select plans that could not run because no node holds
+        # k shards (recode decodes locally, like promote)
+        self.recode_blocked_spread = 0
 
     # -- the tick ---------------------------------------------------------
 
@@ -173,6 +191,7 @@ class Autopilot:
         new += self._plan_tiering(now, vol_heat, ledger)
         new += self._plan_balancing(now, vol_heat)
         new += self._plan_chunk_promote(now, heat_view)
+        new += self._plan_codec_select(now, vol_heat, ledger)
         if mode == "execute":
             for plan in [p for p in self.plans.values()
                          if p["state"] == "planned"]:
@@ -283,11 +302,16 @@ class Autopilot:
         return plans
 
     @staticmethod
-    def _promote_node(info: dict) -> tuple[str | None, dict]:
+    def _promote_node(info: dict,
+                      k: int | None = None) -> tuple[str | None, dict]:
         """The node to decode on — it must hold at least k shards
         locally (rebuild_ec_files regenerates the rest in place) — plus
         {node: [shards]} for every OTHER node whose remnant shards the
-        promote retires afterwards."""
+        promote (or recode) retires afterwards.  `k` defaults to the
+        volume's own codec stripe width from the ledger."""
+        if k is None:
+            from seaweedfs_tpu.ops import codecs as _codecs
+            k = _codecs.parse_tag(info.get("codec")).k
         per_node: dict[str, list[int]] = {}
         for sid, nodes in (info.get("shard_locations") or {}).items():
             for url in nodes:
@@ -295,10 +319,85 @@ class Autopilot:
         if not per_node:
             return None, {}
         best = max(per_node, key=lambda u: len(per_node[u]))
-        if len(per_node[best]) < layout.DATA_SHARDS:
+        if len(per_node[best]) < k:
             return None, {}
         others = {u: sorted(s) for u, s in per_node.items() if u != best}
         return best, others
+
+    # -- codec selection policy -------------------------------------------
+
+    def _plan_codec_select(self, now: float, vol_heat: dict[int, dict],
+                           ledger: dict[int, dict]) -> list[dict]:
+        """Per-volume codec choice from the heat sketches: a
+        sustained-HOT EC volume (lots of degraded/partial reads at
+        stake) wants LRC — single-shard repair touches one local group
+        instead of k-wide decode; a sustained-COLD archival volume
+        wants PM-MSR — repair bandwidth drops to d/(k*alpha) shard
+        equivalents and nobody is waiting on its read latency.  Same
+        hysteresis discipline as tiering (the cold clock resets on any
+        warm sighting; hot uses the sketch's monotone sustained_s),
+        same per-volume cooldown, its own governed `codec` bucket.
+        Warm middle-band volumes keep whatever codec they have — the
+        policy only moves volumes OUT of a mismatched band."""
+        from seaweedfs_tpu.ops import codecs as _codecs
+        if self.buckets["codec"].rate <= 0:
+            return []
+        active = self._active_vids()
+        plans: list[dict] = []
+        for vid, info in sorted(ledger.items()):
+            if info.get("kind") != "ec":
+                self._codec_cold_since.pop(vid, None)
+                continue
+            if info.get("state") != "healthy":
+                # missing shards: heal first — a recode decodes the
+                # stripe and would race the repair plane
+                self._codec_cold_since.pop(vid, None)
+                continue
+            cur = _codecs.parse_tag(info.get("codec"))
+            rec = vol_heat.get(vid)
+            rps = float(rec.get("rps", 0.0)) if rec else 0.0
+            sustained = float(rec.get("sustained_s", 0.0)) if rec else 0.0
+            target = reason = None
+            if rps >= self.hot_rps:
+                self._codec_cold_since.pop(vid, None)
+                if sustained >= self.hot_s and cur.family != "lrc":
+                    target = _codecs.parse_tag("lrc").tag
+                    reason = {"band": "hot", "rps": round(rps, 3),
+                              "sustained_s": round(sustained, 1),
+                              "threshold_rps": self.hot_rps}
+            elif rps <= self.cold_rps:
+                since = self._codec_cold_since.setdefault(vid, now)
+                cold_for = now - since
+                if cold_for >= self.cold_s and cur.family != "msr":
+                    target = _codecs.parse_tag("msr").tag
+                    reason = {"band": "cold", "rps": round(rps, 3),
+                              "cold_for_s": round(cold_for, 1),
+                              "threshold_rps": self.cold_rps}
+            else:
+                self._codec_cold_since.pop(vid, None)
+            if target is None or target == cur.tag:
+                continue
+            if vid in active or self._in_cooldown(vid, now):
+                continue
+            node, others = self._promote_node(info, k=cur.k)
+            if node is None:
+                self.recode_blocked_spread += 1
+                from seaweedfs_tpu.utils import weedlog
+                weedlog.warn_ratelimited(
+                    f"autopilot_recode_spread:{vid}", 300.0,
+                    "autopilot: volume %d wants codec %s but no node "
+                    "holds %d+ shards; recode needs shard "
+                    "consolidation (unbuilt) — not planned", vid,
+                    target, cur.k, name="autopilot")
+                continue
+            if not self.buckets["codec"].try_acquire():
+                break
+            plans.append(self._new_plan(
+                "codec_select", vid, node=node,
+                from_codec=cur.tag, to_codec=target,
+                collection=info.get("collection", ""),
+                other_shard_nodes=others, reason=reason))
+        return plans
 
     # -- balancing policy -------------------------------------------------
 
@@ -536,6 +635,8 @@ class Autopilot:
                     await self._exec_move(plan)
                 elif policy == "chunk_promote":
                     await self._exec_chunk_promote(plan)
+                elif policy == "codec_select":
+                    await self._exec_recode(plan)
                 else:
                     raise RuntimeError(f"unknown policy {policy}")
             plan["state"] = "done"
@@ -605,6 +706,34 @@ class Autopilot:
         plan["outcome"] = {"crc": data.get("crc"),
                            "target": data.get("target")}
 
+    async def _exec_recode(self, plan: dict) -> None:
+        """One in-place codec change on the shard-majority node, then
+        remnant-shard retirement elsewhere — the same shape as promote,
+        riding the convert traffic class (it IS a re-encode)."""
+        vid = plan["vid"]
+        with netflow.flow("convert"):
+            data = await self._post(plan["node"], "/admin/ec/recode",
+                                    {"volume": vid,
+                                     "codec": plan["to_codec"],
+                                     "collection":
+                                         plan.get("collection", "")},
+                                    timeout=1800.0)
+            retired: dict[str, list[int]] = {}
+            for url, sids in (plan.get("other_shard_nodes")
+                              or {}).items():
+                try:
+                    await self._post(url, "/admin/ec/delete_shards",
+                                     {"volume": vid, "shards": sids},
+                                     timeout=60.0)
+                    retired[url] = sids
+                except Exception as e:
+                    log.warning("autopilot: remnant shard retirement "
+                                "on %s failed: %s", url, e)
+        plan["outcome"] = {"codec": data.get("codec"),
+                           "from": data.get("from"),
+                           "shards": data.get("shards"),
+                           "remnants_retired": retired}
+
     async def _exec_chunk_promote(self, plan: dict) -> None:
         """Seed the batch into its home filer's hot tier.  The pull-
         through bytes are speculative, so they book as class=readahead
@@ -627,6 +756,7 @@ class Autopilot:
             "ticks": self.ticks,
             "actuator_calls": self.actuator_calls,
             "promote_blocked_spread": self.promote_blocked_spread,
+            "recode_blocked_spread": self.recode_blocked_spread,
             "states": counts,
             "knobs": {"cold_rps": self.cold_rps, "cold_s": self.cold_s,
                       "hot_rps": self.hot_rps, "hot_s": self.hot_s,

@@ -289,7 +289,7 @@ def _ec_base(store, vid: int) -> str | None:
                      loc.base_path(vid)):
             if os.path.exists(cand + ".ecx") or any(
                     os.path.exists(cand + layout.to_ext(i))
-                    for i in range(layout.TOTAL_SHARDS)):
+                    for i in range(layout.MAX_TOTAL_SHARDS)):
                 return cand
     return None
 

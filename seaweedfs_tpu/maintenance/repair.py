@@ -117,12 +117,14 @@ def build_ledger(topo, scrub_reports: dict) -> dict[int, dict]:
     """Fold the topology's heartbeat-derived volume/shard maps and the
     stored scrub reports into {vid: health info}."""
     out: dict[int, dict] = {}
+    from seaweedfs_tpu.ops import codecs as _codecs
     with topo._lock:
         ec = {vid: {sid: [n.url for n in nodes]
                     for sid, nodes in per.items() if nodes}
               for vid, per in topo.ec_shard_locations.items()}
         ec_cols = dict(topo.ec_collections)
         ec_sizes = dict(topo.ec_shard_sizes)
+        ec_codecs = dict(getattr(topo, "ec_codecs", {}))
         node_loc = {n.url: (n.dc, n.rack) for n in topo.nodes.values()}
         normal: dict[int, dict] = {}
         for node in topo.nodes.values():
@@ -135,10 +137,12 @@ def build_ledger(topo, scrub_reports: dict) -> dict[int, dict]:
 
     for vid, shards in ec.items():
         present = sorted(shards)
-        missing = [s for s in range(layout.TOTAL_SHARDS)
+        spec = _codecs.parse_tag(ec_codecs.get(vid))
+        missing = [s for s in range(spec.n)
                    if s not in shards]
         info = {
             "vid": vid, "kind": "ec", "collection": ec_cols.get(vid, ""),
+            "codec": spec.tag,
             "shards_present": present, "shards_missing": missing,
             "shard_locations": shards,
             "shard_size": ec_sizes.get(vid, 0),
@@ -161,7 +165,7 @@ def build_ledger(topo, scrub_reports: dict) -> dict[int, dict]:
         info["corrupt"] = corrupt
         info["last_scrub"] = last_scrub
         info["quarantined"] = quarantined
-        if len(present) < layout.DATA_SHARDS:
+        if len(present) < spec.k:
             info["state"] = "critical"
         elif corrupt:
             info["state"] = "corrupt"
@@ -343,15 +347,17 @@ class RepairPlanner:
         reducible EC repair (nothing missing, < k survivors, or no
         shard-size report yet)."""
         from seaweedfs_tpu.topology.topology import locality_class
+        from seaweedfs_tpu.ops import codecs as _codecs
         if info.get("kind") != "ec":
             return None
+        spec = _codecs.parse_tag(info.get("codec"))
         shards = {int(s): list(n) for s, n in
                   (shards if shards is not None
                    else info.get("shard_locations") or {}).items()
                   if n}
-        missing = [s for s in range(layout.TOTAL_SHARDS)
+        missing = [s for s in range(spec.n)
                    if s not in shards]
-        if not missing or len(shards) < layout.DATA_SHARDS:
+        if not missing or len(shards) < spec.k:
             return None
         shard_size = int(info.get("shard_size") or 0)
         if shard_size <= 0:
@@ -375,6 +381,42 @@ class RepairPlanner:
 
         local = sorted(s for s, nodes in shards.items()
                        if rebuilder in nodes)
+        # codec-aware survivor demand: which shards the rebuild actually
+        # reads, and how many bytes each helper shard ships per lost
+        # shard.  RS: any k, full shard rows.  LRC: the lost shard's
+        # local group (repair_support) — single-group fan-in, no wide
+        # reads.  MSR: d whole helper files, each shipping one combined
+        # sub-row (shard_size/alpha bytes) per lost shard.
+        needed: set[int] | None = None  # None = any-k (MDS)
+        per_helper_shard = shard_size
+        need = spec.k - len(local)
+        if spec.family == "lrc":
+            from seaweedfs_tpu.ops import lrc as _lrc
+            code = _lrc.get_code(*spec.params)
+            needed = set()
+            cur = set(shards)
+            for sid in missing:
+                sup = code.repair_support(sid, sorted(cur))
+                if sup is None:
+                    needed = None
+                    break
+                needed |= set(sup) - {s for s in sup if s in missing}
+                cur.add(sid)  # rebuilt: a survivor for the next loss
+            if needed is None:
+                try:
+                    needed = set(code.decode_select(sorted(shards),
+                                                    list(missing)))
+                except ValueError:
+                    return None
+            need = len(needed - set(local))
+        elif spec.family == "msr":
+            d_helpers = spec.params[1]
+            if len(shards) < d_helpers:
+                # fewer than d survivors: the regenerating plan cannot
+                # run; let the naive copy+rebuild path handle it
+                return None
+            need = d_helpers - len(local)
+            per_helper_shard = shard_size // max(1, spec.alpha)
         from seaweedfs_tpu.topology.topology import locality_name
         remote_by_node: dict[str, list[int]] = {}
         naive_xrack = 0
@@ -382,6 +424,8 @@ class RepairPlanner:
         for sid, nodes in sorted(shards.items()):
             if rebuilder in nodes:
                 continue
+            if needed is not None and sid not in needed:
+                continue  # outside the codec's survivor demand
             best = min(nodes, key=loc_of)
             remote_by_node.setdefault(best, []).append(sid)
             # the naive baseline copies EVERY survivor not already on
@@ -394,7 +438,6 @@ class RepairPlanner:
         ordered = sorted(remote_by_node.items(),
                          key=lambda kv: (loc_of(kv[0]), -len(kv[1]),
                                          kv[0]))
-        need = layout.DATA_SHARDS - len(local)
         groups: list[dict] = []
         have = 0
         for url, sids in ordered:
@@ -404,14 +447,19 @@ class RepairPlanner:
                            "locality": loc_of(url),
                            "shard_size": shard_size})
             have += len(sids)
-        if len(local) + have < layout.DATA_SHARDS:
+        covered = len(local) + have if needed is None else             len([s for s in local if s in needed]) + have
+        floor = need + (len(local) if needed is None
+                        else len([s for s in local if s in needed]))
+        if covered < floor or covered < min(
+                spec.k, floor if needed is not None else spec.k):
             return None
         n_lost = len(missing)
-        est_remote = n_lost * shard_size * len(groups)
-        est_xrack = n_lost * shard_size * sum(
+        est_remote = n_lost * per_helper_shard * len(groups)
+        est_xrack = n_lost * per_helper_shard * sum(
             1 for g in groups if g["locality"] >= 2)
         return {
             "rebuilder": rebuilder, "lost": missing, "groups": groups,
+            "codec": spec.tag,
             "local_shards": local, "shard_size": shard_size,
             "est_remote_bytes": est_remote,
             "est_xrack_bytes": est_xrack,
@@ -624,7 +672,9 @@ class RepairPlanner:
                 resolved.add(sid)  # already gone: the loss path rebuilds
                 continue
             # len(shards) tracks earlier purges in this loop already
-            if sid in shards and len(shards) - 1 < layout.DATA_SHARDS:
+            from seaweedfs_tpu.ops import codecs as _c2
+            k_min = _c2.parse_tag(info.get("codec")).k
+            if sid in shards and len(shards) - 1 < k_min:
                 unresolved.append(
                     f"shard {sid} corrupt but only {len(shards)} shards "
                     "present — purging would drop below k")
@@ -644,15 +694,17 @@ class RepairPlanner:
             # quarantine only guards needle reads), so a rebuild here
             # could bake the bad bytes into fresh shards
             raise RuntimeError("; ".join(unresolved))
+        from seaweedfs_tpu.ops import codecs as _codecs
+        spec = _codecs.parse_tag(info.get("codec"))
         present = set(shards)
-        missing = [s for s in range(layout.TOTAL_SHARDS)
+        missing = [s for s in range(spec.n)
                    if s not in present]
         if not missing:
             return resolved
-        if len(present) < layout.DATA_SHARDS:
+        if len(present) < spec.k:
             raise RuntimeError(
                 f"only {len(present)} shards survive, need "
-                f"{layout.DATA_SHARDS}")
+                f"{spec.k}")
         collection = info.get("collection", "")
         # survivor plan: the tick's (budget-debited) plan when the purge
         # loop above didn't change the shard map, else a fresh one
@@ -679,7 +731,7 @@ class RepairPlanner:
                 try:
                     resp = await self._post(
                         rebuilder, "/admin/ec/rebuild",
-                        {"volume": vid,
+                        {"volume": vid, "codec": spec.tag,
                          "reduced": {"lost": missing,
                                      "groups": plan["groups"],
                                      "shard_size": plan["shard_size"]}})
@@ -735,7 +787,7 @@ class RepairPlanner:
         with trace.span("repair.rebuild", vid=vid, node=rebuilder,
                         missing=len(missing)):
             await self._post(rebuilder, "/admin/ec/rebuild",
-                             {"volume": vid})
+                             {"volume": vid, "codec": spec.tag})
         if borrowed:
             await self._post(rebuilder, "/admin/ec/delete_shards",
                              {"volume": vid, "shards": borrowed})
@@ -756,6 +808,7 @@ class RepairPlanner:
         /maintenance/status) + the repair-byte-by-locality ledger."""
         from seaweedfs_tpu.stats import metrics as _metrics
         rec = {"ts": round(time.time(), 3), "vid": vid, "mode": mode,
+               "codec": plan.get("codec", "rs_10_4"),
                "rebuilder": plan["rebuilder"], "lost": plan["lost"],
                "helpers": [{"node": g["node"], "shards": g["shards"],
                             "locality": g["locality"]}

@@ -91,24 +91,27 @@ class RateLimiter:
             time.sleep(delay)
 
 
-def localize_corrupt_shard(cols: np.ndarray) -> int | None:
+def localize_corrupt_shard(cols: np.ndarray, code=None) -> int | None:
     """Identify the single corrupt shard from the stored bytes at the
     mismatching byte columns.
 
-    `cols` is [TOTAL_SHARDS, C].  For each candidate shard, reconstruct it
-    from the other 13 and test whether the stripe becomes fully consistent
-    (all m parity rows match a recompute from the data rows).  With one
-    corrupt shard exactly one candidate passes: excluding the corrupt
-    shard from the survivors yields a consistent stripe, while any other
-    candidate either reconstructs from (or is checked against) the bad
-    bytes.  Returns None when zero or several candidates pass — more than
-    one shard is corrupt in this window, or the stripe is degenerate."""
+    `cols` is [n, C] for the volume's code (RS by default; any alpha=1
+    code with reconstruct_numpy + parity_matrix works — LRC does).  For
+    each candidate shard, reconstruct it from the other n-1 and test
+    whether the stripe becomes fully consistent (all m parity rows
+    match a recompute from the data rows).  With one corrupt shard
+    exactly one candidate passes: excluding the corrupt shard from the
+    survivors yields a consistent stripe, while any other candidate
+    either reconstructs from (or is checked against) the bad bytes.
+    Returns None when zero or several candidates pass — more than one
+    shard is corrupt in this window, or the stripe is degenerate."""
     from seaweedfs_tpu.models import rs
     from seaweedfs_tpu.ops import gf
-    code = rs.get_code(layout.DATA_SHARDS, layout.PARITY_SHARDS)
+    if code is None:
+        code = rs.get_code(layout.DATA_SHARDS, layout.PARITY_SHARDS)
     passing: list[int] = []
-    for cand in range(layout.TOTAL_SHARDS):
-        others = {i: cols[i] for i in range(layout.TOTAL_SHARDS)
+    for cand in range(code.n):
+        others = {i: cols[i] for i in range(code.n)
                   if i != cand}
         rec = code.reconstruct_numpy(others, wanted=[cand])[cand]
         rows = dict(others)
@@ -138,19 +141,25 @@ def syndrome_scan(ev, codec=None, window: int | None = None,
 
     Returns corrupt-range dicts {shard, offset, size, columns}; shard is
     -1 when the corruption could not be localized to one shard."""
+    from seaweedfs_tpu.ops import codecs as _codecs
     from seaweedfs_tpu.ops import dispatch
     from seaweedfs_tpu.storage.ec import ec_files
     if codec is None:
-        codec = ec_files._get_codec()
+        codec = ec_files._get_codec(tag=getattr(ev, "codec_tag", None))
+    spec = getattr(ev, "spec", None) or _codecs.spec_of(codec)
     window = window or DEFAULT_WINDOW
-    k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
+    if spec.alpha > 1:
+        # sub-packetized codewords are positionally blocked per alpha
+        # bytes: parity only recomputes over alpha-aligned windows
+        window = max(spec.alpha, window - window % spec.alpha)
+    k, m = spec.k, spec.m
     out: list[dict] = []
     for off in range(0, ev.shard_size, window):
         if stop is not None and stop.is_set():
             break
         n = min(window, ev.shard_size - off)
         rows: dict[int, np.ndarray] = {}
-        for sid in range(layout.TOTAL_SHARDS):
+        for sid in range(spec.n):
             data = ev._read_local(sid, off, n)
             if (data is None or len(data) != n) and shard_reader is not None:
                 data = shard_reader(sid, off, n)
@@ -181,11 +190,14 @@ def syndrome_scan(ev, codec=None, window: int | None = None,
         if bad_cols.size == 0:
             continue
         shard = -1
-        if len(rows) == layout.TOTAL_SHARDS:
+        # single-byte-column localization needs columns to be
+        # independent codewords: true for alpha=1 families only
+        if len(rows) == spec.n and spec.alpha == 1:
             sel = bad_cols[:LOCALIZE_COLS]
             cols = np.stack([rows[i][sel]
-                             for i in range(layout.TOTAL_SHARDS)])
-            loc = localize_corrupt_shard(cols)
+                             for i in range(spec.n)])
+            loc = localize_corrupt_shard(
+                cols, code=getattr(codec, "code", None))
             if loc is not None:
                 shard = loc
         out.append({"shard": shard, "offset": off, "size": n,

@@ -1,0 +1,246 @@
+"""Codec registry: tag grammar, per-volume codec identity, backend builds.
+
+A volume's erasure code is no longer a constant — `.vif` metadata, the
+heartbeat shard report, repair planning and the autopilot all carry a
+codec *tag*, and this module is the one place the tag grammar lives:
+
+    rs_<k>_<m>        Reed-Solomon (MDS), e.g. rs_10_4
+    lrc_<k>_<l>_<g>   locally repairable, e.g. lrc_10_2_2
+    msr_<k>_<d>       product-matrix regenerating, e.g. msr_9_16
+
+`parse_tag(None)` and any unknown tag resolve to the RS default — old
+nodes that never heard of codec tags keep working with no flag-day.
+
+Backend builds go through `make_codec(tag, kind)`, the codec-family
+generalisation of ec_files._get_codec: the same WEEDTPU_EC_CODEC knob
+(auto|tpu|jax|cpp|numpy|mesh) picks the matrix-apply backend, and every
+family rides the RSCodecBase / NativeRSCodec shells unchanged — LRC is
+just another fixed matrix; MSR wraps the shell in its interleaving
+file codec.  The Pallas and mesh backends are RS-shaped (fixed 10x4
+tiling assumptions); non-RS families fall back to the XLA bit-sliced
+backend there rather than guessing at tile geometry.
+
+Knobs: WEEDTPU_CODEC_DEFAULT (tag or family for untagged volumes),
+WEEDTPU_CODEC_LRC ("k,l,g" params behind the bare "lrc" family name),
+WEEDTPU_CODEC_MSR ("k,d" likewise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+
+import numpy as np
+
+DEFAULT_TAG = "rs_10_4"
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecSpec:
+    """Identity + geometry of one registered code: everything the
+    control plane needs without building a backend."""
+    tag: str
+    family: str       # rs | lrc | msr
+    k: int            # data shard files
+    m: int            # parity shard files
+    n: int            # total shard files
+    alpha: int        # sub-packetization (1 for rs/lrc)
+    params: tuple     # family params, e.g. (10, 4) / (10, 2, 2) / (9, 16)
+
+    @property
+    def tolerance(self) -> int:
+        """Worst-case guaranteed losses: m for MDS codes, the minimum
+        distance - 1 for LRC (g + 1 with one local parity per group)."""
+        if self.family == "lrc":
+            return self.params[2] + 1
+        return self.m
+
+    def describe(self) -> dict:
+        return {"tag": self.tag, "family": self.family, "k": self.k,
+                "m": self.m, "n": self.n, "alpha": self.alpha,
+                "tolerance": self.tolerance,
+                "params": list(self.params)}
+
+
+def _lrc_params() -> tuple[int, int, int]:
+    raw = os.environ.get("WEEDTPU_CODEC_LRC", "10,2,2")
+    try:
+        k, l, g = (int(v) for v in raw.split(","))  # noqa: E741
+        return k, l, g
+    except ValueError:
+        return 10, 2, 2
+
+
+def _msr_params() -> tuple[int, int]:
+    raw = os.environ.get("WEEDTPU_CODEC_MSR", "9,16")
+    try:
+        k, d = (int(v) for v in raw.split(","))
+        return k, d
+    except ValueError:
+        return 9, 16
+
+
+def _spec_rs(k: int, m: int) -> CodecSpec:
+    return CodecSpec(tag=f"rs_{k}_{m}", family="rs", k=k, m=m, n=k + m,
+                     alpha=1, params=(k, m))
+
+
+def _spec_lrc(k: int, l: int, g: int) -> CodecSpec:  # noqa: E741
+    return CodecSpec(tag=f"lrc_{k}_{l}_{g}", family="lrc", k=k, m=l + g,
+                     n=k + l + g, alpha=1, params=(k, l, g))
+
+
+def _spec_msr(k: int, d: int) -> CodecSpec:
+    n = d + 2
+    return CodecSpec(tag=f"msr_{k}_{d}", family="msr", k=k, m=n - k, n=n,
+                     alpha=k - 1, params=(k, d))
+
+
+def parse_tag(tag: str | None) -> CodecSpec:
+    """Tag string -> CodecSpec.  None, "", bare family names and any
+    unparseable/unknown tag degrade to a usable spec — an old node
+    reporting no codec means RS, not an error."""
+    if not tag:
+        return parse_tag(DEFAULT_TAG)
+    tag = str(tag).strip().lower()
+    if tag == "rs":
+        return _spec_rs(10, 4)
+    if tag == "lrc":
+        return _spec_lrc(*_lrc_params())
+    if tag == "msr":
+        return _spec_msr(*_msr_params())
+    parts = tag.split("_")
+    try:
+        if parts[0] == "rs" and len(parts) == 3:
+            return _spec_rs(int(parts[1]), int(parts[2]))
+        if parts[0] == "lrc" and len(parts) == 4:
+            return _spec_lrc(int(parts[1]), int(parts[2]), int(parts[3]))
+        if parts[0] == "msr" and len(parts) == 3:
+            return _spec_msr(int(parts[1]), int(parts[2]))
+    except ValueError:
+        pass
+    return parse_tag(DEFAULT_TAG)
+
+
+def default_tag() -> str:
+    """The codec newly-encoded volumes get when nothing chose one:
+    WEEDTPU_CODEC_DEFAULT accepts a full tag or a bare family name."""
+    return parse_tag(os.environ.get("WEEDTPU_CODEC_DEFAULT", DEFAULT_TAG)).tag
+
+
+def registered() -> list[CodecSpec]:
+    """The codec family as configured right now — what `ec.codecs`
+    lists."""
+    return [_spec_rs(10, 4), _spec_lrc(*_lrc_params()),
+            _spec_msr(*_msr_params())]
+
+
+# ---------------------------------------------------------------------------
+# backend builds
+
+
+class _NumpyShell:
+    """Pure-numpy eager shell for non-RS inner codes when no native lib
+    and no device backend is wanted (WEEDTPU_EC_CODEC=numpy).  Slowest
+    path, test/reference only."""
+
+    host_backend = True
+
+    def __init__(self, code):
+        self.code = code
+        self.k, self.m, self.n = code.k, code.m, code.n
+        self._decode_cache: dict = {}
+
+    def encode_parity(self, data):
+        from seaweedfs_tpu.ops import gf
+        return gf.gf_matmul(self.code.parity_matrix, np.asarray(data))
+
+    def encode(self, data):
+        data = np.asarray(data)
+        return np.concatenate([data, self.encode_parity(data)], axis=0)
+
+    def reconstruct(self, shards, wanted=None):
+        from seaweedfs_tpu.ops import codec_base, gf
+        present = tuple(sorted(shards))
+        if wanted is None:
+            wanted = [i for i in range(self.n) if i not in shards]
+        if not wanted:
+            return {}
+        basis = codec_base.select_survivors(self.code, present, list(wanted))
+        mat = self.code.decode_matrix(list(present), list(wanted))
+        stack = np.stack([np.asarray(shards[i]) for i in basis])
+        out = gf.gf_matmul(mat, stack)
+        return {w: out[i] for i, w in enumerate(wanted)}
+
+
+def _code_for(spec: CodecSpec):
+    """The bare code object (matrix + decode protocol) behind a spec.
+    For MSR this is the inner virtual-row code; the file surface is
+    MSRFileCodec's."""
+    if spec.family == "lrc":
+        from seaweedfs_tpu.ops import lrc
+        return lrc.get_code(*spec.params)
+    if spec.family == "msr":
+        from seaweedfs_tpu.ops import msr
+        return msr.get_code(*spec.params)
+    from seaweedfs_tpu.models import rs
+    return rs.get_code(spec.k, spec.m)
+
+
+def _shell_for(code, kind: str):
+    """An RSCodecBase-compatible shell over `code` for one backend
+    kind.  Pallas/mesh are RS-tiled; generic codes use the XLA
+    bit-sliced backend there."""
+    if kind in ("cpp", "native"):
+        from seaweedfs_tpu.ops import native_codec
+        return native_codec.NativeRSCodec(code)
+    if kind == "numpy":
+        return _NumpyShell(code)
+    if kind == "auto":
+        import jax
+        if jax.default_backend() == "tpu":
+            from seaweedfs_tpu.ops import gfmat_jax
+            return gfmat_jax.JaxRSCodec(code)
+        from seaweedfs_tpu import native
+        if native.available():
+            from seaweedfs_tpu.ops import native_codec
+            return native_codec.NativeRSCodec(code)
+    from seaweedfs_tpu.ops import gfmat_jax
+    return gfmat_jax.JaxRSCodec(code)
+
+
+@functools.lru_cache(maxsize=16)
+def _build(tag: str, kind: str):
+    spec = parse_tag(tag)
+    if spec.family == "rs":
+        # RS keeps its existing per-backend registries (incl. Pallas
+        # fused kernels and the mesh codec) — delegate so behaviour and
+        # caches stay byte-identical with pre-family builds
+        from seaweedfs_tpu.storage.ec import ec_files
+        return ec_files._get_codec(kind if kind != "default" else None)
+    code = _code_for(spec)
+    if spec.family == "msr":
+        from seaweedfs_tpu.ops import msr
+        return msr.MSRFileCodec(_shell_for(code, kind))
+    return _shell_for(code, kind)
+
+
+def make_codec(tag: str | None, kind: str | None = None):
+    """Backend codec for a codec tag.  `kind` defaults to the
+    WEEDTPU_EC_CODEC knob, exactly like ec_files._get_codec."""
+    spec = parse_tag(tag)
+    kind = kind or os.environ.get("WEEDTPU_EC_CODEC", "auto")
+    return _build(spec.tag, kind)
+
+
+def spec_of(codec) -> CodecSpec:
+    """Best-effort spec for a live codec object (for metrics labels)."""
+    code = getattr(codec, "code", codec)
+    tag = getattr(code, "tag", None)
+    if tag:
+        return parse_tag(tag)
+    fam = getattr(code, "family", "rs")
+    if fam == "msr":
+        return _spec_msr(code.k_nodes, code.d)
+    return _spec_rs(getattr(codec, "k", 10), getattr(codec, "m", 4))

@@ -33,16 +33,31 @@ def _host_classes():
     return NativeRSCodec, RSCode
 
 
+def _is_host(codec) -> bool:
+    """Eager host backend: computes synchronously, numpy in/out.  The
+    native AVX2 shell plus anything flagged `host_backend` (the MSR
+    file wrapper and the registry's numpy shell propagate the flag so
+    wrapped codecs route like the shell they wrap)."""
+    NativeRSCodec, _ = _host_classes()
+    return isinstance(codec, NativeRSCodec) or getattr(
+        codec, "host_backend", False)
+
+
+def _is_numpy_ref(codec) -> bool:
+    """Bare reference code object (RSCode / LRCCode): no backend shell,
+    just encode_numpy / reconstruct_numpy."""
+    return hasattr(codec, "encode_numpy") and not hasattr(codec, "_factory")
+
+
 def dispatch_parity(codec, batch: np.ndarray):
     """Dispatch [k, B] -> [m, B] parity. JAX backends return the device
     array WITHOUT materialising it; host backends compute eagerly."""
-    NativeRSCodec, RSCode = _host_classes()
-    if isinstance(codec, NativeRSCodec):
+    if _is_host(codec):
         with trace.span("codec.dispatch_parity", backend="host",
                         bytes=batch.nbytes), \
                 KERNELS.timed("encode_parity", nbytes=batch.nbytes):
             return codec.encode_parity(batch)
-    if isinstance(codec, RSCode):
+    if _is_numpy_ref(codec):
         with trace.span("codec.dispatch_parity", backend="host",
                         bytes=batch.nbytes), \
                 KERNELS.timed("encode_parity", nbytes=batch.nbytes):
@@ -93,16 +108,18 @@ def dispatch_parity_batch(codec, units, placed=None):
     batch geometry to win; the pipeline's value there is the interleaved
     I/O).  Device dispatches return un-materialised; `unit_parity_shards`
     is the streaming sync point."""
-    NativeRSCodec, RSCode = _host_classes()
     nbytes = units.nbytes
-    if isinstance(codec, (NativeRSCodec, RSCode)):
+    if _is_host(codec) or _is_numpy_ref(codec):
         with trace.span("codec.dispatch_parity_batch", backend="host",
                         bytes=nbytes), \
                 KERNELS.timed("fleet_encode", nbytes=nbytes):
-            if isinstance(codec, NativeRSCodec):
-                return np.stack([codec.encode_parity(units[u])
+            if _is_numpy_ref(codec):
+                return np.stack([codec.encode_numpy(units[u])[codec.k:]
                                  for u in range(units.shape[0])], axis=0)
-            return np.stack([codec.encode_numpy(units[u])[codec.k:]
+            batched = getattr(codec, "encode_parity_batch", None)
+            if batched is not None:
+                return batched(units)
+            return np.stack([codec.encode_parity(units[u])
                              for u in range(units.shape[0])], axis=0)
     import jax.numpy as jnp
     with trace.span("codec.dispatch_parity_batch", backend="device",
@@ -197,15 +214,17 @@ def apply_matrix(codec, C: np.ndarray, stack: np.ndarray) -> np.ndarray:
     reduced-read repair path (profiled as `repair_partial`)."""
     C = np.ascontiguousarray(C, dtype=np.uint8)
     nbytes = stack.nbytes
-    NativeRSCodec, RSCode = _host_classes()
-    if isinstance(codec, NativeRSCodec):
+    if _is_host(codec):
         from seaweedfs_tpu import native
         with trace.span("codec.apply_matrix", backend="host",
                         bytes=nbytes), \
                 KERNELS.timed("repair_partial", nbytes=nbytes):
-            return native.gf_matmul(C, np.ascontiguousarray(stack))
+            if native.available():
+                return native.gf_matmul(C, np.ascontiguousarray(stack))
+            from seaweedfs_tpu.ops import gf
+            return gf.gf_matmul(C, stack)
     factory = getattr(codec, "_factory", None)
-    if isinstance(codec, RSCode) or factory is None:
+    if _is_numpy_ref(codec) or factory is None:
         from seaweedfs_tpu.ops import gf
         with trace.span("codec.apply_matrix", backend="host",
                         bytes=nbytes), \
@@ -236,14 +255,13 @@ def reconstruct_batch(codec, shards: dict[int, np.ndarray],
                       wanted: list[int]) -> dict[int, np.ndarray]:
     """Rebuild `wanted` shard rows from >=k survivor rows (host bytes
     in/out)."""
-    NativeRSCodec, RSCode = _host_classes()
     nbytes = sum(v.nbytes for v in shards.values())
-    if isinstance(codec, NativeRSCodec):
+    if _is_host(codec):
         with trace.span("codec.reconstruct", backend="host",
                         bytes=nbytes, wanted=len(wanted)), \
                 KERNELS.timed("reconstruct", nbytes=nbytes):
             return codec.reconstruct(shards, wanted=wanted)
-    if isinstance(codec, RSCode):
+    if _is_numpy_ref(codec):
         with trace.span("codec.reconstruct", backend="host",
                         bytes=nbytes, wanted=len(wanted)), \
                 KERNELS.timed("reconstruct", nbytes=nbytes):

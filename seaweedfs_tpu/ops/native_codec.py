@@ -1,4 +1,4 @@
-"""CPU RS codec over the native C++ AVX2 GF(2^8) kernels (native.py).
+"""CPU codec over the native C++ AVX2 GF(2^8) kernels (native.py).
 
 The host-side twin of ops.gfmat_jax / ops.pallas_gf with the same
 encode/reconstruct surface but numpy arrays in and out.  Fills the role
@@ -6,21 +6,30 @@ klauspost/reedsolomon's SIMD assembly plays in the reference (invoked from
 weed/storage/erasure_coding/ec_encoder.go:214 enc.Encode and
 weed/storage/store_ec.go:374 enc.ReconstructData): the fast path when no
 TPU is attached, and the honest CPU baseline for bench.py.
+
+Code-generic like codec_base: anything with k/m/n, `parity_matrix` and
+`decode_matrix` plugs in; non-MDS codes steer survivor choice through
+their `decode_select` hook.
 """
 
 from __future__ import annotations
 
-import numpy as np
+import collections
 
 from seaweedfs_tpu import native
 from seaweedfs_tpu.models import rs
+from seaweedfs_tpu.ops import codec_base
+
+import numpy as np
 
 
 class NativeRSCodec:
-    def __init__(self, code: rs.RSCode):
+    host_backend = True  # dispatch.py routes through native.gf_matmul
+
+    def __init__(self, code):
         self.code = code
         self.k, self.m, self.n = code.k, code.m, code.n
-        self._decode_cache: dict = {}
+        self._decode_cache: collections.OrderedDict = collections.OrderedDict()
 
     def encode_parity(self, data: np.ndarray) -> np.ndarray:
         """[k, n] data -> [m, n] parity."""
@@ -37,12 +46,17 @@ class NativeRSCodec:
             wanted = [i for i in range(self.n) if i not in shards]
         if not wanted:
             return {}
-        key = (present[: self.k], tuple(wanted))
+        basis = codec_base.select_survivors(self.code, present, list(wanted))
+        key = (basis, tuple(wanted))
         mat = self._decode_cache.get(key)
         if mat is None:
             mat = self.code.decode_matrix(list(present), list(wanted))
             self._decode_cache[key] = mat
-        stack = np.stack([np.asarray(shards[i]) for i in present[: self.k]])
+            while len(self._decode_cache) > codec_base.decode_cache_cap():
+                self._decode_cache.popitem(last=False)
+        else:
+            self._decode_cache.move_to_end(key)
+        stack = np.stack([np.asarray(shards[i]) for i in basis])
         out = native.gf_matmul(mat, stack)
         return {w: out[i] for i, w in enumerate(wanted)}
 

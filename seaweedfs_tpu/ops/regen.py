@@ -76,10 +76,15 @@ class HelperGroup:
 @dataclass(frozen=True)
 class Part:
     """One group's contribution to one segment: coeff [f, len(shards)]
-    over the group's shard rows, in `shards` order."""
+    over the group's shard rows, in `shards` order.  `post`, when set,
+    is a rebuilder-side matrix applied to the helper's payload before
+    the XOR accumulate — the regenerating-code shape, where helper j's
+    f=1 payload expands to its rank-1 contribution R[:, j] (x) payload
+    across all alpha output sub-rows."""
     group: HelperGroup
     shards: tuple[int, ...]
     coeff: np.ndarray
+    post: np.ndarray | None = None
 
 
 @dataclass(frozen=True)
@@ -96,6 +101,10 @@ class RepairPlan:
     d: int
     length: int
     segments: list[Segment] = field(default_factory=list)
+    # output rows per offset: 1 for scalar-row plans (RS/LRC), alpha
+    # for MSR sub-packetized plans, whose offsets/lengths are in
+    # SUB-ROW coordinates (file bytes / alpha)
+    out_rows: int = 1
 
     def predicted_bytes(self) -> dict:
         """Exact repair bandwidth this plan will move, per node and per
@@ -124,8 +133,10 @@ class RepairPlan:
 
     def naive_remote_bytes(self, n_local: int) -> int:
         """Bytes the copy-survivors-then-rebuild baseline would move for
-        this loss: (k - local survivors) full shard ranges."""
-        return max(0, self.k - n_local) * self.length
+        this loss: (k - local survivors) full shard ranges.  Sub-row
+        plans scale back up by out_rows — the baseline copies whole
+        shard files, not sub-rows."""
+        return max(0, self.k - n_local) * self.length * self.out_rows
 
 
 def _order_survivors(groups: list[HelperGroup], exclude: set[int]
@@ -147,23 +158,37 @@ def plan_repair(code, lost: int, groups: list[HelperGroup], length: int,
 
     `d` caps how many helper shards participate (None = all survivors;
     clamped to [k, available]).  With d > k the range stripes into
-    rotating k-of-d windows; local shards are in every window."""
+    rotating k-of-d windows; local shards are in every window.
+
+    Codes exposing `repair_support(lost, available)` (LRC) steer the
+    plan into the lost shard's LOCAL GROUP when it suffices: the window
+    becomes the support set — fewer survivors than k, no cross-group
+    fan-in — and the decode matrix follows the code's basis choice."""
     k = code.k
     entries = _order_survivors(groups, {lost})
-    if len(entries) < k:
+    support_hook = getattr(code, "repair_support", None)
+    k_eff = k
+    if support_hook is not None:
+        support = support_hook(lost, sorted({s for _, s in entries}))
+        if support is not None:
+            sup = set(support)
+            entries = [(g, s) for g, s in entries if s in sup]
+            k_eff = len(support)
+    if len(entries) < k_eff:
         raise ValueError(
-            f"need >= {k} survivors to repair shard {lost}, "
+            f"need >= {k_eff} survivors to repair shard {lost}, "
             f"have {len(entries)}")
-    d_eff = len(entries) if d is None else max(k, min(int(d), len(entries)))
+    d_eff = len(entries) if d is None \
+        else max(k_eff, min(int(d), len(entries)))
     helpers = entries[:d_eff]
     local = [(g, s) for g, s in helpers if g.locality == 0]
     remote = [(g, s) for g, s in helpers if g.locality != 0]
-    t = k - len(local)
+    t = k_eff - len(local)
     plan = RepairPlan(lost=lost, k=k, d=d_eff, length=length)
     if length <= 0:
         return plan
     if t <= 0:
-        windows = [local[:k]]
+        windows = [local[:k_eff]]
     elif t >= len(remote):
         windows = [local + remote]
     else:
@@ -184,12 +209,18 @@ def plan_repair(code, lost: int, groups: list[HelperGroup], length: int,
         size = base if s < nseg - 1 else length - off
         win = windows[s]
         sids = sorted(sid for _, sid in win)
-        M = code.decode_matrix(sids, [lost])  # [1, k], cols follow sids
-        col = {sid: i for i, sid in enumerate(sids)}
+        # cols of M follow the code's survivor basis: all of sids for
+        # MDS windows, possibly a subset in the code's preferred order
+        # for non-MDS codes (LRC prunes to the rows its solve uses)
+        sel = getattr(code, "decode_select", None)
+        basis = list(sel(sids, [lost])) if sel is not None else sids
+        M = code.decode_matrix(sids, [lost])  # [1, |basis|]
+        col = {sid: i for i, sid in enumerate(basis)}
         parts: list[Part] = []
         for g in sorted({id(gr): gr for gr, _ in win}.values(),
                         key=lambda g: (g.locality, g.node)):
-            mine = tuple(sorted(sid for gr, sid in win if gr is g))
+            mine = tuple(sorted(sid for gr, sid in win
+                                if gr is g and sid in col))
             if not mine:
                 continue
             coeff = np.ascontiguousarray(
@@ -197,6 +228,68 @@ def plan_repair(code, lost: int, groups: list[HelperGroup], length: int,
             parts.append(Part(group=g, shards=mine, coeff=coeff))
         plan.segments.append(Segment(offset=off, size=size,
                                      parts=tuple(parts)))
+    return plan
+
+
+def plan_msr_repair(code, lost: int, groups: list[HelperGroup],
+                    length: int, d: int | None = None,
+                    align: int = DEFAULT_SEG_ALIGN) -> RepairPlan:
+    """Build the regenerating-code repair plan for ONE lost MSR shard
+    file over its full [0, length) byte range.
+
+    Plan coordinates are SUB-ROWS (file bytes / alpha): shard ids in
+    Parts are virtual ids `file_sid * alpha + j`, offsets and sizes are
+    sub-row offsets, and the executor's read_local / fetch_partial /
+    sink closures own the byte-interleave translation (a sub-range
+    [o, o+s) of virtual rows is the contiguous file range
+    [o*alpha, (o+s)*alpha)).
+
+    Every one of d helpers ships ONE combined sub-row (coeff = phi_f
+    per held shard, block-diagonal for multi-shard nodes) and the
+    rebuilder expands each payload through its R-column `post` matrix —
+    total network d/alpha shard-equivalents, the cut-set floor, vs k
+    for the naive copy.  Raises ValueError when fewer than d helper
+    shards survive; the caller falls back to whole-shard decode or the
+    copy+rebuild path."""
+    inner = getattr(code, "code", code)  # MSRFileCodec -> PMMSRCode
+    a = inner.alpha
+    need = inner.d if d is None else max(inner.d, int(d))
+    if length % a != 0:
+        raise ValueError(f"msr length {length} not a multiple of "
+                         f"alpha={a}")
+    sub_len = length // a
+    entries = _order_survivors(groups, {lost})
+    if len(entries) < need:
+        raise ValueError(
+            f"msr repair of shard {lost} needs {need} helpers, "
+            f"have {len(entries)}")
+    helpers = entries[:need]
+    helper_sids = [sid for _, sid in helpers]
+    phi = inner.repair_coeff(lost)                 # [1, alpha]
+    R = inner.repair_matrix(lost, helper_sids)     # [alpha, d]
+    col = {sid: i for i, sid in enumerate(helper_sids)}
+    plan = RepairPlan(lost=lost, k=inner.k_nodes, d=need, length=sub_len,
+                      out_rows=a)
+    if sub_len <= 0:
+        return plan
+    parts: list[Part] = []
+    for g in sorted({id(gr): gr for gr, _ in helpers}.values(),
+                    key=lambda g: (g.locality, g.node)):
+        mine = tuple(sorted(sid for gr, sid in helpers if gr is g))
+        if not mine:
+            continue
+        c = len(mine)
+        coeff = np.zeros((c, c * a), dtype=np.uint8)
+        vids: list[int] = []
+        for i, sid in enumerate(mine):
+            coeff[i, i * a:(i + 1) * a] = phi[0]
+            vids.extend(sid * a + j for j in range(a))
+        post = np.ascontiguousarray(R[:, [col[sid] for sid in mine]],
+                                    dtype=np.uint8)
+        parts.append(Part(group=g, shards=tuple(vids),
+                          coeff=np.ascontiguousarray(coeff), post=post))
+    plan.segments.append(Segment(offset=0, size=sub_len,
+                                 parts=tuple(parts)))
     return plan
 
 
@@ -254,6 +347,8 @@ def execute_plan(codec, plan: RepairPlan, read_local, fetch_partial,
                         rows.append(np.frombuffer(data, dtype=np.uint8))
                     out = dispatch.apply_matrix(codec, part.coeff,
                                                 np.stack(rows))
+                    if part.post is not None:
+                        out = dispatch.apply_matrix(codec, part.post, out)
                     acc = _xor_into(acc, out)
                 for fut in as_completed(futs):
                     part = futs[fut]
@@ -274,11 +369,19 @@ def execute_plan(codec, plan: RepairPlan, read_local, fetch_partial,
                         bl = stats.setdefault("by_locality", {})
                         name = locality_name(part.group.locality)
                         bl[name] = bl.get(name, 0) + want
-                    acc = _xor_into(
-                        acc, np.frombuffer(payload, dtype=np.uint8)
-                        .reshape(part.coeff.shape[0], n))
+                    arr = np.frombuffer(payload, dtype=np.uint8) \
+                        .reshape(part.coeff.shape[0], n)
+                    if part.post is not None:
+                        arr = dispatch.apply_matrix(codec, part.post, arr)
+                    acc = _xor_into(acc, arr)
                 assert acc is not None, "plan segment with no parts"
-                sink(off, acc.reshape(-1, n)[0])
+                if plan.out_rows == 1:
+                    sink(off, acc.reshape(-1, n)[0])
+                else:
+                    # sub-packetized plan: the sink receives all
+                    # out_rows sub-rows of this offset window at once
+                    # and interleaves them back into file bytes
+                    sink(off, acc.reshape(plan.out_rows, n))
     finally:
         if own_pool and pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
@@ -288,13 +391,20 @@ def repair_shard(code, codec, lost: int, groups: list[HelperGroup],
                  length: int, read_local, fetch_partial, sink, *,
                  d: int | None = None, batch_size: int = 16 * 1024 * 1024,
                  align: int = DEFAULT_SEG_ALIGN, cancel=None,
-                 stats=None) -> RepairPlan:
+                 stats=None, planner=None) -> RepairPlan:
     """Repair one lost shard with automatic re-planning: when a helper
     dies mid-transfer (HelperDied), its node/shards leave the survivor
     pool and the WHOLE shard recomputes under a fresh plan — `sink`
     writes are offset-addressed and idempotent, so a restart simply
     overwrites.  Raises ValueError when fewer than k survivors remain.
-    Returns the plan that completed."""
+    Returns the plan that completed.
+
+    `planner` defaults to `plan_repair` (decode-window plans, with LRC
+    local-group steering); MSR volumes pass `plan_msr_repair` and reuse
+    the identical replan / pool / stats machinery — a helper death
+    mid-regeneration substitutes survivors while >= d remain, then
+    degrades to the caller's naive fallback via ValueError."""
+    plan_fn = planner if planner is not None else plan_repair
     dead_nodes: set[str] = set()
     dead_shards: set[int] = set()
     pool: ThreadPoolExecutor | None = None
@@ -307,7 +417,7 @@ def repair_shard(code, codec, lost: int, groups: list[HelperGroup],
                 keep = tuple(s for s in g.shards if s not in dead_shards)
                 if keep:
                     live.append(g.replace_shards(keep))
-            plan = plan_repair(code, lost, live, length, d=d, align=align)
+            plan = plan_fn(code, lost, live, length, d=d, align=align)
             remote = {g.node for g in live if g.locality != 0}
             if pool is None and remote:
                 # one pool for every attempt: a replan must not pay
@@ -321,14 +431,19 @@ def repair_shard(code, codec, lost: int, groups: list[HelperGroup],
                              stats=stats, pool=pool)
                 return plan
             except HelperDied as e:
+                # sub-packetized plans carry VIRTUAL shard ids
+                # (file_sid * out_rows + j); survivor bookkeeping is in
+                # file ids, so map back before excluding
+                factor = max(1, plan.out_rows)
+                file_shards = sorted({s // factor for s in e.shards})
                 if stats is not None:
                     stats["replans"] = stats.get("replans", 0) + 1
                     stats.setdefault("dead_helpers", []).append(
-                        {"node": e.node, "shards": list(e.shards)})
+                        {"node": e.node, "shards": file_shards})
                 if e.node:
                     dead_nodes.add(e.node)
                 else:
-                    dead_shards.update(e.shards)
+                    dead_shards.update(file_shards)
     finally:
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)

@@ -84,7 +84,8 @@ def heartbeat_to_bytes(beat: dict) -> bytes:
         hb.ec_shards.add(id=int(e.get("id", 0)),
                          collection=e.get("collection", "") or "",
                          shards=[int(s) for s in e.get("shard_ids", [])],
-                         shard_size=int(e.get("shard_size", 0)))
+                         shard_size=int(e.get("shard_size", 0)),
+                         codec=e.get("codec", "") or "")
     return hb.SerializeToString()
 
 
@@ -110,6 +111,8 @@ def heartbeat_from_bytes(raw: bytes) -> dict:
             "ttl": v.ttl, "modified_at": v.modified_at_second,
         } for v in hb.volumes],
         "ec_shards": [{
+            # empty codec = a pre-codec-family node: consumers default rs
+            **({"codec": e.codec} if e.codec else {}),
             "id": e.id, "collection": e.collection,
             "shard_ids": list(e.shards),
             "shard_size": e.shard_size,

@@ -854,6 +854,21 @@ class MasterServer:
         hot = self.collect_hot_tier()
         if hot:
             out["hot_tier"] = hot
+        # per-volume codec identity from the heartbeat plane: which
+        # erasure code each EC volume runs, plus the fleet mix — the
+        # perf view names WHERE time goes, the codec tag says under
+        # WHICH matrix family
+        from seaweedfs_tpu.ops import codecs as _codecs
+        with self.topo._lock:
+            ec_vids = {vid for n in self.topo.nodes.values()
+                       for vid, s in n.ec_shards.items() if s}
+            codec_map = dict(self.topo.ec_codecs)
+        per_vol = {str(vid): _codecs.parse_tag(codec_map.get(vid)).tag
+                   for vid in sorted(ec_vids)}
+        mix: dict = {}
+        for tag in per_vol.values():
+            mix[tag] = mix.get(tag, 0) + 1
+        out["codecs"] = {"volumes": per_vol, "mix": mix}
         if errors:
             out["node_errors"] = errors
         return out

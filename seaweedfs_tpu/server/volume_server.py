@@ -37,7 +37,8 @@ from seaweedfs_tpu.security import tls as _tls
 
 log = logging.getLogger("volume")
 
-EC_FILE_EXTS = [layout.to_ext(i) for i in range(layout.TOTAL_SHARDS)] + \
+EC_FILE_EXTS = [layout.to_ext(i)
+                for i in range(layout.MAX_TOTAL_SHARDS)] + \
     [".ecx", ".ecj", ".vif"]
 
 
@@ -167,6 +168,7 @@ class VolumeServer:
             web.post("/admin/ec/delete_shards", self.handle_ec_delete_shards),
             web.post("/admin/ec/copy", self.handle_ec_copy),
             web.post("/admin/ec/to_volume", self.handle_ec_to_volume),
+            web.post("/admin/ec/recode", self.handle_ec_recode),
             web.get("/admin/ec/shard_read", self.handle_ec_shard_read),
             web.post("/admin/ec/partial", self.handle_ec_partial),
             web.get("/admin/ec/probe_read", self.handle_ec_probe_read),
@@ -1132,13 +1134,17 @@ class VolumeServer:
                "stages": stages}
         self._ec_jobs[vid] = job
 
+        from seaweedfs_tpu.ops import codecs as _codecs
+        spec = _codecs.parse_tag(body.get("codec") or _codecs.default_tag())
+        job["codec"] = spec.tag
+
         def gen():
             v.nm.flush()
             ec_files.write_ec_files(
                 base,
                 progress=lambda n: job.__setitem__("bytes_done", n),
                 cancel=lambda: job["cancel"],
-                stats=stages)
+                stats=stages, codec_tag=spec.tag)
             ec_files.write_sorted_ecx(base + ".idx")
             metrics.EC_ENCODE_BYTES.labels("tpu").inc(job["total"])
 
@@ -1156,7 +1162,8 @@ class VolumeServer:
             raise
         job["state"] = "done"
         job["bytes_done"] = job["total"]
-        return web.json_response({"shards": list(range(layout.TOTAL_SHARDS))})
+        return web.json_response({"shards": list(range(spec.n)),
+                                  "codec": spec.tag})
 
     async def handle_ec_fleet_convert(self, req: web.Request
                                       ) -> web.Response:
@@ -1301,16 +1308,25 @@ class VolumeServer:
             return web.json_response({"error": "ec job already running"},
                                      status=409)
         reduced = body.get("reduced")
-        present = [i for i in range(layout.TOTAL_SHARDS)
+        # codec identity: the caller's tag (master plans carry it) wins,
+        # else the local .vif — a rebuilder holding copied shards but no
+        # sidecar must still decode with the right matrix
+        from seaweedfs_tpu.ops import codecs as _codecs
+        tag = body.get("codec") or \
+            (ec_files.read_vif(base) or {}).get("codec")
+        spec = _codecs.parse_tag(tag)
+        present = [i for i in range(spec.n)
                    if os.path.exists(base + layout.to_ext(i))]
         total = (os.path.getsize(base + layout.to_ext(present[0]))
-                 * layout.DATA_SHARDS) if present else 0
+                 * spec.k) if present else 0
         stages: dict = {}
         job = {"state": "running",
                "kind": "rebuild_reduced" if reduced else "rebuild",
+               "codec": spec.tag,
                "bytes_done": 0, "total": total, "cancel": False,
                "error": None, "started": time.time(), "stages": stages}
         self._ec_jobs[vid] = job
+        from seaweedfs_tpu.ops import regen as _regen
         try:
             if reduced:
                 # reduced-read path: no survivor copies land here — each
@@ -1325,11 +1341,11 @@ class VolumeServer:
                                      reduced["shard_size"])
                 result = await asyncio.to_thread(
                     ec_files.rebuild_ec_reduced, base, lost, groups,
-                    self._partial_fetcher(vid),
+                    self._partial_fetcher(vid, alpha=spec.alpha),
                     d=reduced.get("d"),
                     progress=lambda n: job.__setitem__("bytes_done", n),
                     cancel=lambda: job["cancel"],
-                    stats=stages)
+                    stats=stages, codec_tag=spec.tag)
                 job["state"] = "done"
                 job["bytes_done"] = job["total"]
                 await self._heartbeat_once()
@@ -1338,10 +1354,23 @@ class VolumeServer:
                 ec_files.rebuild_ec_files, base,
                 progress=lambda n: job.__setitem__("bytes_done", n),
                 cancel=lambda: job["cancel"],
-                stats=stages)
+                stats=stages, codec_tag=spec.tag)
         except ec_files.EncodeCancelled:
             job["state"] = "cancelled"
             return web.json_response({"error": "cancelled"}, status=409)
+        except _regen.HelperDied as e:
+            # re-planning exhausted its substitutes: the master retries /
+            # falls back to naive copies, and needs to know how hard we
+            # tried and who killed us — a bare 500 hides the replan story
+            job["state"] = "failed"
+            job["error"] = str(e)
+            return web.json_response(
+                {"error": str(e),
+                 "helper": e.node or "<local>",
+                 "helper_shards": list(e.shards),
+                 "replans": stages.get("replans", 0),
+                 "dead_helpers": stages.get("dead_helpers", [])},
+                status=500)
         except Exception as e:
             job["state"] = "failed"
             job["error"] = str(e)
@@ -1395,7 +1424,7 @@ class VolumeServer:
                 mounted.clear_quarantine(sid)
         # if no shards remain anywhere, drop index files too
         if not any(os.path.exists(base + layout.to_ext(i))
-                   for i in range(layout.TOTAL_SHARDS)):
+                   for i in range(layout.MAX_TOTAL_SHARDS)):
             for ext in (".ecx", ".ecj"):
                 if os.path.exists(base + ext):
                     os.remove(base + ext)
@@ -1695,10 +1724,13 @@ class VolumeServer:
             if existing is not None and \
                     os.path.exists(existing._base + ".dat"):
                 return False  # frozen .dat survives: thaw-only promote
-            missing = [i for i in range(layout.DATA_SHARDS)
+            from seaweedfs_tpu.ops import codecs as _codecs
+            spec = _codecs.parse_tag(
+                (ec_files.read_vif(base) or {}).get("codec"))
+            missing = [i for i in range(spec.k)
                        if not os.path.exists(base + layout.to_ext(i))]
             if missing:
-                ec_files.rebuild_ec_files(base)
+                ec_files.rebuild_ec_files(base, codec_tag=spec.tag)
             dat_size = ec_files.find_dat_file_size(base)
             job["total"] = dat_size
             dat_tmp, idx_tmp = base + ".dat.unc", base + ".idx.unc"
@@ -1759,7 +1791,7 @@ class VolumeServer:
             if os.path.exists(base + ext):
                 os.remove(base + ext)
         removed = []
-        for i in range(layout.TOTAL_SHARDS):
+        for i in range(layout.MAX_TOTAL_SHARDS):
             p = base + layout.to_ext(i)
             if os.path.exists(p):
                 os.remove(p)
@@ -2155,6 +2187,11 @@ class VolumeServer:
             sids = [int(s) for s in body["shards"]]
             offset, size = int(body["offset"]), int(body["size"])
             coeff = np.asarray(body["coeff"], dtype=np.uint8)
+            # MSR regenerating repair addresses SUB-ROWS: shard ids are
+            # virtual (file*alpha + row), offset/size in sub-row bytes.
+            # alpha=1 (absent for rs/lrc rebuilders and old callers)
+            # keeps the original whole-shard semantics.
+            alpha = int(body.get("alpha", 1) or 1)
         except (KeyError, TypeError, ValueError):
             return web.json_response({"error": "bad partial request"},
                                      status=400)
@@ -2162,12 +2199,15 @@ class VolumeServer:
         # the legitimate rebuilder never asks for more than its batch
         # size per hop, and without the shard-count cap (and duplicate
         # check) one malformed request could pread an unbounded
-        # multiple of `size` and OOM the server
-        if not sids or len(sids) > layout.TOTAL_SHARDS or \
+        # multiple of `size` and OOM the server.  With sub-packetization
+        # the ids are virtual (up to n*alpha of them) and every file
+        # read is size*alpha bytes — both caps scale accordingly.
+        if not sids or alpha < 1 or alpha > 64 or \
+                len(sids) > layout.TOTAL_SHARDS * max(1, alpha) or \
                 len(set(sids)) != len(sids) or \
-                size <= 0 or size > ec_files.DEFAULT_BATCH or \
+                size <= 0 or size * alpha > ec_files.DEFAULT_BATCH or \
                 coeff.ndim != 2 or coeff.shape[1] != len(sids) or \
-                coeff.shape[0] > layout.PARITY_SHARDS:
+                coeff.shape[0] > max(layout.PARITY_SHARDS, len(sids)):
             return web.json_response({"error": "bad partial shape"},
                                      status=400)
         base = self._ec_base(vid)
@@ -2176,26 +2216,41 @@ class VolumeServer:
                                      status=404)
         ev = self.store.get_ec_volume(vid)
 
+        def read_range(fsid: int, off: int, n: int) -> bytes | None:
+            if ev is not None:
+                # honors the quarantine: corrupt ranges read as None
+                return ev._read_local(fsid, off, n)
+            p = base + layout.to_ext(fsid)
+            try:
+                fd = os.open(p, os.O_RDONLY)
+                try:
+                    return os.pread(fd, n, off)
+                finally:
+                    os.close(fd)
+            except OSError:
+                return None
+
         def compute() -> bytes:
             rows = []
-            for sid in sids:
-                data = None
-                if ev is not None:
-                    # honors the quarantine: corrupt ranges read as None
-                    data = ev._read_local(sid, offset, size)
-                else:
-                    p = base + layout.to_ext(sid)
-                    try:
-                        fd = os.open(p, os.O_RDONLY)
-                        try:
-                            data = os.pread(fd, size, offset)
-                        finally:
-                            os.close(fd)
-                    except OSError:
-                        data = None
-                if data is None or len(data) != size:
-                    raise KeyError(sid)
-                rows.append(np.frombuffer(data, dtype=np.uint8))
+            if alpha > 1:
+                # one pread + de-interleave per FILE, shared by its
+                # alpha virtual sub-rows
+                blocks: dict[int, np.ndarray] = {}
+                for fsid in sorted({s // alpha for s in sids}):
+                    data = read_range(fsid, offset * alpha, size * alpha)
+                    if data is None or len(data) != size * alpha:
+                        raise KeyError(fsid)
+                    blocks[fsid] = np.frombuffer(
+                        data, dtype=np.uint8).reshape(size, alpha)
+                for sid in sids:
+                    rows.append(np.ascontiguousarray(
+                        blocks[sid // alpha][:, sid % alpha]))
+            else:
+                for sid in sids:
+                    data = read_range(sid, offset, size)
+                    if data is None or len(data) != size:
+                        raise KeyError(sid)
+                    rows.append(np.frombuffer(data, dtype=np.uint8))
             from seaweedfs_tpu.ops import dispatch
             codec = ec_files._get_codec()
             return dispatch.apply_matrix(codec, coeff,
@@ -2213,7 +2268,7 @@ class VolumeServer:
         return web.Response(body=out,
                             content_type="application/octet-stream")
 
-    def _partial_fetcher(self, vid: int):
+    def _partial_fetcher(self, vid: int, alpha: int = 1):
         """Client side of /admin/ec/partial for the reduced rebuild:
         runs on executor threads, so the trace context, traffic class,
         and deadline are captured HERE.  Rides the resilience layer —
@@ -2250,7 +2305,8 @@ class VolumeServer:
             payload = _json.dumps({
                 "volume": vid, "shards": list(sids),
                 "coeff": coeff.tolist(), "offset": offset,
-                "size": size}).encode()
+                "size": size,
+                **({"alpha": alpha} if alpha > 1 else {})}).encode()
             try:
                 with trace.span("repair.partial_fetch", parent=tctx,
                                 vid=vid, peer=node,
@@ -2340,6 +2396,95 @@ class VolumeServer:
                                   "bytes": len(n.data),
                                   "skipped_shard": skip})
 
+    async def handle_ec_recode(self, req: web.Request) -> web.Response:
+        """Re-encode an EC volume under a DIFFERENT codec, in place: the
+        autopilot codec_select actuator.  Decodes the stripe back to a
+        temp .dat from the local shard set (regenerating any missing
+        data shard first), re-encodes under the target codec —
+        write_ec_files commits each shard tmp+rename and rewrites .vif
+        with the new tag, so a crash mid-recode leaves either the old
+        set or the new set, never a hybrid — then retires shard files
+        past the new geometry.  Needs >= k_old shards locally; remnant
+        shards on OTHER nodes are the caller's to retire (the autopilot
+        does, exactly like tiering_promote)."""
+        body = await req.json()
+        try:
+            vid = int(body["volume"])
+        except (KeyError, TypeError, ValueError):
+            return web.json_response({"error": "bad volume"}, status=400)
+        from seaweedfs_tpu.ops import codecs as _codecs
+        to = _codecs.parse_tag(body.get("codec") or _codecs.default_tag())
+        base = self._ec_base(vid)
+        if base is None:
+            return web.json_response({"error": "no shards here"}, status=404)
+        old = _codecs.parse_tag((ec_files.read_vif(base) or {}).get("codec"))
+        if old.tag == to.tag:
+            return web.json_response({"codec": to.tag, "unchanged": True})
+        if self._ec_jobs.get(vid, {}).get("state") == "running":
+            return web.json_response({"error": "ec job already running"},
+                                     status=409)
+        present = [i for i in range(old.n)
+                   if os.path.exists(base + layout.to_ext(i))]
+        if len(present) < old.k:
+            return web.json_response(
+                {"error": f"recode needs {old.k} local shards, "
+                          f"have {len(present)}"}, status=409)
+        stages: dict = {}
+        job = {"state": "running", "kind": "recode", "bytes_done": 0,
+               "total": 0, "cancel": False, "error": None,
+               "started": time.time(), "stages": stages,
+               "from": old.tag, "codec": to.tag}
+        self._ec_jobs[vid] = job
+        tmp_dat = base + ".dat.recode"
+
+        def work():
+            if any(i not in present for i in range(old.k)):
+                ec_files.rebuild_ec_files(base, codec_tag=old.tag)
+            dat_size = ec_files.find_dat_file_size(base)
+            job["total"] = dat_size
+            ec_files.write_dat_file(base, dat_size, out_path=tmp_dat)
+            ec_files.write_ec_files(
+                base, dat_path=tmp_dat,
+                progress=lambda n: job.__setitem__("bytes_done", n),
+                cancel=lambda: job["cancel"],
+                stats=stages, codec_tag=to.tag)
+            # shard files past the new geometry are stale ciphertext of
+            # the OLD code — fsck would count them against the wrong
+            # spec, and a later rebuild could mix matrices
+            for i in range(to.n, max(old.n, to.n)):
+                try:
+                    os.remove(base + layout.to_ext(i))
+                except OSError:
+                    pass
+
+        try:
+            await asyncio.to_thread(work)
+        except ec_files.EncodeCancelled:
+            job["state"] = "cancelled"
+            return web.json_response({"error": "cancelled"}, status=409)
+        except Exception as e:
+            job["state"] = "failed"
+            job["error"] = str(e)
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            try:
+                os.remove(tmp_dat)
+            except OSError:
+                pass
+        # remount so the served spec matches the new shard set
+        loc = next(l for l in self.store.locations
+                   if base.startswith(l.directory))
+        ev = loc.ec_volumes.pop(vid, None)
+        if ev is not None:
+            ev.close()
+        loc.ec_volumes[vid] = ecv.EcVolume(base)
+        job["state"] = "done"
+        job["bytes_done"] = job["total"]
+        await self._heartbeat_once()
+        return web.json_response(
+            {"codec": to.tag, "from": old.tag,
+             "shards": loc.ec_volumes[vid].shard_ids()})
+
     async def handle_ec_to_volume(self, req: web.Request) -> web.Response:
         """VolumeEcShardsToVolume (volume_grpc_erasure_coding.go:407):
         decode local data shards back into a normal volume."""
@@ -2349,11 +2494,13 @@ class VolumeServer:
         base = self._ec_base(vid)
         if base is None:
             return web.json_response({"error": "no shards here"}, status=404)
-        missing = [i for i in range(layout.DATA_SHARDS)
+        from seaweedfs_tpu.ops import codecs as _codecs
+        spec = _codecs.parse_tag((ec_files.read_vif(base) or {}).get("codec"))
+        missing = [i for i in range(spec.k)
                    if not os.path.exists(base + layout.to_ext(i))]
         def decode():
             if missing:
-                ec_files.rebuild_ec_files(base)
+                ec_files.rebuild_ec_files(base, codec_tag=spec.tag)
             dat_size = ec_files.find_dat_file_size(base)
             ec_files.write_dat_file(base, dat_size)
             ec_files.write_idx_from_ecx(base + ".ecx")

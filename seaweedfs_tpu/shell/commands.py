@@ -249,18 +249,19 @@ def cmd_volume_mark(env: CommandEnv, args, out):
 
 
 def balanced_ec_distribution(nodes: list[str],
-                             racks: dict[str, str] | None = None
+                             racks: dict[str, str] | None = None,
+                             n_shards: int = layout.TOTAL_SHARDS
                              ) -> dict[str, list[int]]:
-    """Spread the 14 shards rack-aware: each shard goes to the rack with
-    the fewest shards so far, then the least-loaded node inside it — a
-    rack loss never takes more shards than necessary (reference:
-    command_ec_encode.go:272 balancedEcDistribution + the rack spread of
-    command_ec_balance.go)."""
+    """Spread the volume's n shards rack-aware: each shard goes to the
+    rack with the fewest shards so far, then the least-loaded node
+    inside it — a rack loss never takes more shards than necessary
+    (reference: command_ec_encode.go:272 balancedEcDistribution + the
+    rack spread of command_ec_balance.go)."""
     racks = racks or {}
     alloc: dict[str, list[int]] = {n: [] for n in nodes}
     rack_of = {n: racks.get(n, n) for n in nodes}  # rackless: node = rack
     rack_load: dict[str, int] = {r: 0 for r in rack_of.values()}
-    for sid in range(layout.TOTAL_SHARDS):
+    for sid in range(n_shards):
         # fewest-loaded rack, then fewest-loaded node within it; sorted
         # keys make ties deterministic
         rack = min(sorted(rack_load), key=lambda r: rack_load[r])
@@ -313,6 +314,7 @@ def cmd_ec_encode(env: CommandEnv, args, out):
     env.require_lock()
     flags = parse_flags(args)
     collection = flags.get("collection", "")
+    codec = flags.get("codec", "")
     if "volumeId" in flags:
         vids = [int(flags["volumeId"])]
     else:
@@ -323,10 +325,11 @@ def cmd_ec_encode(env: CommandEnv, args, out):
         print(f"{len(vids)} volume(s) ≥{full_percent}% full and quiet "
               f"for {quiet:.0f}s: {vids}", file=out)
     for vid in vids:
-        _ec_encode_one(env, vid, collection, out)
+        _ec_encode_one(env, vid, collection, out, codec=codec)
 
 
-def _ec_encode_one(env: CommandEnv, vid: int, collection: str, out):
+def _ec_encode_one(env: CommandEnv, vid: int, collection: str, out,
+                   codec: str = ""):
     locations = env.volume_locations(vid)
     if not locations:
         raise RuntimeError(f"volume {vid} not found")
@@ -335,10 +338,15 @@ def _ec_encode_one(env: CommandEnv, vid: int, collection: str, out):
     # 1. freeze writes on every replica
     for url in locations:
         env.vs_post(url, "/admin/volume/readonly", {"volume": vid, "readonly": True})
-    # 2. generate shards on the source (TPU codec)
+    # 2. generate shards on the source (TPU codec); -codec picks the
+    # erasure-code family (rs/lrc/msr tag), default per WEEDTPU_CODEC_*
+    from seaweedfs_tpu.ops import codecs as _codecs
+    spec = _codecs.parse_tag(codec or _codecs.default_tag())
     env.vs_post(source, "/admin/ec/generate",
-                {"volume": vid, "collection": collection})
-    print(f"generated 14 shards of volume {vid} on {source}", file=out)
+                {"volume": vid, "collection": collection,
+                 **({"codec": spec.tag} if codec else {})})
+    print(f"generated {spec.n} {spec.tag} shards of volume {vid} "
+          f"on {source}", file=out)
 
     # 3. spread shards over the cluster; copies fan out in parallel
     # (reference: command_ec_encode.go:213 parallelCopyEcShardsFromSource)
@@ -347,7 +355,7 @@ def _ec_encode_one(env: CommandEnv, vid: int, collection: str, out):
     nodes = sorted(topo["nodes"])
     racks = {nid: f"{nd['dc']}/{nd['rack']}"
              for nid, nd in topo["nodes"].items()}
-    alloc = balanced_ec_distribution(nodes, racks)
+    alloc = balanced_ec_distribution(nodes, racks, n_shards=spec.n)
 
     def place(target_shards):
         target, shards = target_shards
@@ -390,10 +398,18 @@ def _ec_rebuild_all(env: CommandEnv, out) -> None:
     for vid in sorted(ec_vids):
         shard_locs = env.ec_shard_locations(vid)
         present = set(shard_locs)
-        missing = [s for s in range(layout.TOTAL_SHARDS) if s not in present]
+        from seaweedfs_tpu.ops import codecs as _codecs
+        try:
+            health = env.master_get("/maintenance/status")
+            spec = _codecs.parse_tag(
+                (health.get("volumes", {}).get(str(vid)) or
+                 {}).get("codec"))
+        except RuntimeError:
+            spec = _codecs.parse_tag(None)
+        missing = [s for s in range(spec.n) if s not in present]
         if not missing:
             continue
-        if len(present) < layout.DATA_SHARDS:
+        if len(present) < spec.k:
             print(f"volume {vid}: only {len(present)} shards left, "
                   f"cannot rebuild", file=out)
             continue
@@ -419,6 +435,44 @@ def _ec_rebuild_all(env: CommandEnv, out) -> None:
         env.vs_post(rebuilder, "/admin/ec/mount", {"volume": vid})
         print(f"volume {vid}: rebuilt {r.get('rebuilt')} on {rebuilder}",
               file=out)
+
+
+@command("ec.codecs")
+def cmd_ec_codecs(env: CommandEnv, args, out):
+    """List the registered erasure-codec family as configured right now
+    (tag, geometry, sub-packetization, worst-case loss tolerance) plus
+    the fleet's per-codec volume mix from the maintenance ledger.
+    -json emits the raw spec rows."""
+    from seaweedfs_tpu.ops import codecs as _codecs
+    flags = parse_flags(args)
+    specs = [s.describe() for s in _codecs.registered()]
+    mix: dict[str, int] = {}
+    try:
+        st = env.master_get("/maintenance/status")
+        for v in (st.get("volumes") or {}).values():
+            if v.get("kind") == "ec":
+                tag = _codecs.parse_tag(v.get("codec")).tag
+                mix[tag] = mix.get(tag, 0) + 1
+    except RuntimeError:
+        pass
+    if "json" in flags:
+        print(json.dumps({"codecs": specs, "default":
+                          _codecs.default_tag(), "mix": mix},
+                         separators=(",", ":")), file=out)
+        return
+    print(f"default: {_codecs.default_tag()}", file=out)
+    for s in specs:
+        extra = f" alpha={s['alpha']}" if s["alpha"] > 1 else ""
+        print(f"{s['tag']:12s} family={s['family']:4s} k={s['k']:2d} "
+              f"m={s['m']:2d} n={s['n']:2d}{extra} "
+              f"tolerates={s['tolerance']} loss(es)"
+              + (f"  volumes={mix[s['tag']]}" if s["tag"] in mix
+                 else ""), file=out)
+    stray = {t: c for t, c in mix.items()
+             if t not in {s["tag"] for s in specs}}
+    for tag, c in sorted(stray.items()):
+        print(f"{tag:12s} (not in the configured family)  "
+              f"volumes={c}", file=out)
 
 
 @command("ec.decode")
@@ -449,7 +503,9 @@ def cmd_ec_decode(env: CommandEnv, args, out):
     for url in all_nodes:
         env.vs_post(url, "/admin/ec/unmount", {"volume": vid})
         env.vs_post(url, "/admin/ec/delete_shards",
-                    {"volume": vid, "shards": list(range(layout.TOTAL_SHARDS))})
+                    {"volume": vid,
+                     "shards": sorted(set(range(layout.TOTAL_SHARDS)) |
+                                      {int(s) for s in shard_locs})})
     print(f"ec.decode {vid} -> normal volume on {collector}", file=out)
 
 
@@ -674,8 +730,10 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
     for vid, v in sorted(st.get("volumes", {}).items(),
                          key=lambda kv: int(kv[0])):
         if v.get("kind") == "ec":
+            from seaweedfs_tpu.ops import codecs as _codecs
+            spec = _codecs.parse_tag(v.get("codec"))
             present = v.get("shards_present", [])
-            detail = f"shards {len(present)}/{layout.TOTAL_SHARDS}"
+            detail = f"{spec.tag} shards {len(present)}/{spec.n}"
             if v.get("shards_missing"):
                 detail += f" missing {v['shards_missing']}"
             if v.get("corrupt"):
@@ -896,6 +954,11 @@ def cmd_cluster_perf(env: CommandEnv, args, out):
                      f"best={tile.get('best_tile')} "
                      f"drift={tile.get('drift', 0):+.1%}")
         print(line, file=out)
+    cx = st.get("codecs") or {}
+    if cx.get("mix"):
+        print("codecs: " + " ".join(
+            f"{tag}={n}" for tag, n in sorted(cx["mix"].items()))
+            + f" ({len(cx.get('volumes', {}))} ec volumes)", file=out)
     hot = st.get("hot_tier") or {}
     if hot:
         ev = hot.get("events") or {}
@@ -1464,6 +1527,8 @@ def cmd_volume_fsck(env: CommandEnv, args, out):
             "quarantined": v.get("quarantined") or {},
             "shards_missing": v.get("shards_missing", []),
         }
+        if v.get("kind") == "ec":
+            rec["codec"] = v.get("codec", "rs_10_4")
     # `ok` is the chaos/CI gate: false — and a nonzero shell exit — on
     # anything that means data is damaged or being served around damage
     # (broken refs, corrupt/critical state, quarantined ranges).

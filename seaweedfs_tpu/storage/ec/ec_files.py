@@ -42,13 +42,21 @@ def _mesh_codec(k: int, m: int):
     return pmesh.ShardedRSEncoder(rs.get_code(k, m), pmesh.make_mesh())
 
 
-def _get_codec(kind: str | None = None):
+def _get_codec(kind: str | None = None, tag: str | None = None):
     """Select the EC codec backend: the `ec.codec` knob of this framework.
 
     auto (default): Pallas on TPU, native C++ AVX2 on CPU hosts, XLA
     bit-sliced otherwise.  Override with WEEDTPU_EC_CODEC=tpu|jax|cpp|numpy.
-    """
+
+    `tag` picks the CODE (ops/codecs grammar: rs_10_4 / lrc_10_2_2 /
+    msr_9_16); non-RS families build through the codec registry, which
+    reuses the same backend kinds over their matrices."""
     kind = kind or os.environ.get("WEEDTPU_EC_CODEC", "auto")
+    if tag is not None:
+        from seaweedfs_tpu.ops import codecs as _codecs
+        spec = _codecs.parse_tag(tag)
+        if spec.family != "rs":
+            return _codecs.make_codec(spec.tag, kind)
     k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
     if kind in ("cpp", "native"):
         from seaweedfs_tpu.ops import native_codec
@@ -148,7 +156,8 @@ def write_ec_files(base: str, dat_path: str | None = None,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
                    batch_size: int = DEFAULT_BATCH,
-                   progress=None, cancel=None, stats=None) -> None:
+                   progress=None, cancel=None, stats=None,
+                   codec_tag: str | None = None) -> None:
     """Encode `<base>.dat` (or dat_path) into `<base>.ec00` .. `.ec13`,
     plus a `<base>.vif` volume-info sidecar recording the encode-time dat
     size and version (the reference's .vif, volume_info.go:16-40, as JSON):
@@ -171,7 +180,9 @@ def write_ec_files(base: str, dat_path: str | None = None,
     otherwise re-extend the files block by block."""
     dat_path = dat_path or base + ".dat"
     dat_size = os.path.getsize(dat_path)
-    codec = _get_codec()
+    from seaweedfs_tpu.ops import codecs as _codecs
+    spec = _codecs.parse_tag(codec_tag or _codecs.default_tag())
+    codec = _get_codec(tag=spec.tag)
 
     # chaos hook: an armed shard_write_error fault (maintenance/faults)
     # fails the encode exactly like a dying disk would — before any tmp
@@ -180,7 +191,7 @@ def write_ec_files(base: str, dat_path: str | None = None,
     _faults.check_shard_write(base)
 
     tmp_paths = [base + layout.to_ext(i) + ".tmp"
-                 for i in range(layout.TOTAL_SHARDS)]
+                 for i in range(spec.n)]
     # O_RDWR without O_TRUNC: recycle pages of stale tmp files (see above);
     # _encode_stream ftruncates each fd to its exact final size.
     out_fds = [os.open(p_, os.O_RDWR | os.O_CREAT, 0o644) for p_ in tmp_paths]
@@ -193,7 +204,7 @@ def write_ec_files(base: str, dat_path: str | None = None,
         for fd in out_fds:
             os.close(fd)
         if ok:
-            write_vif(base, dat_size)
+            write_vif(base, dat_size, codec=spec.tag)
             for i, p_ in enumerate(tmp_paths):
                 os.replace(p_, base + layout.to_ext(i))
         else:
@@ -205,29 +216,32 @@ def write_ec_files(base: str, dat_path: str | None = None,
 
 
 def _iter_units(dat_size: int, large_block: int, small_block: int,
-                batch_size: int):
+                batch_size: int, data_shards: int = layout.DATA_SHARDS):
     """Yield (row_start, block, col, step, shard_off) column-batch work
-    units in shard file order: N full rows of 10 large blocks, then
+    units in shard file order: N full rows of k large blocks, then
     small-block rows.  shard_off is the unit's byte offset inside every
-    shard file (all 14 shard files are parallel arrays of blocks)."""
+    shard file (all n shard files are parallel arrays of blocks).
+    `data_shards` is the codec's stripe width k (10 for RS/LRC, 9 for
+    MSR volumes)."""
+    k = data_shards
     processed = 0
     remaining = dat_size
     shard_base = 0
-    while remaining > large_block * layout.DATA_SHARDS:
+    while remaining > large_block * k:
         step = min(batch_size, large_block)
         assert large_block % step == 0, (large_block, step)
         for col in range(0, large_block, step):
             yield processed, large_block, col, step, shard_base + col
-        processed += large_block * layout.DATA_SHARDS
-        remaining -= large_block * layout.DATA_SHARDS
+        processed += large_block * k
+        remaining -= large_block * k
         shard_base += large_block
     while remaining > 0:
         step = min(batch_size, small_block)
         assert small_block % step == 0, (small_block, step)
         for col in range(0, small_block, step):
             yield processed, small_block, col, step, shard_base + col
-        processed += small_block * layout.DATA_SHARDS
-        remaining -= small_block * layout.DATA_SHARDS
+        processed += small_block * k
+        remaining -= small_block * k
         shard_base += small_block
 
 
@@ -325,8 +339,9 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
     # renders, so a production encode is observable, not just a bench one
     stats = stats if stats is not None else {}
     stats["bytes"] = dat_size
-    shard_size = layout.shard_file_size(dat_size, large_block, small_block)
-    highwater = [0] * layout.TOTAL_SHARDS
+    shard_size = layout.shard_file_size(dat_size, large_block, small_block,
+                                        data_shards=codec.k)
+    highwater = [0] * (codec.k + codec.m)
     if dat_size == 0:
         _finalize_shards(out_fds, highwater, shard_size)
         return
@@ -377,7 +392,7 @@ def _encode_stream(codec, dat_path: str, dat_size: int, large_block: int,
         # zero hot-path cost, and the bottleneck verdict gets achieved
         # GB/s per stage for its ceiling-fraction attribution
         _book_stage_bytes(pjob, stats, dat_size,
-                          layout.PARITY_SHARDS * shard_size)
+                          codec.m * shard_size)
     _finalize_shards(out_fds, highwater, shard_size)
 
 
@@ -397,14 +412,15 @@ def _book_stage_bytes(pjob, stats: dict, data_bytes: int,
 
 
 def _unit_steps(dat_size: int, large_block: int, small_block: int,
-                batch_size: int) -> tuple[int, int]:
+                batch_size: int,
+                data_shards: int = layout.DATA_SHARDS) -> tuple[int, int]:
     """(min, max) column-batch step _iter_units will actually cut for this
     volume — min picks direct vs batched submission, max sizes the parity
     ring buffers.  Sizing by the actual max matters: a small-block-only
     volume (every production volume under 10x large_block) cuts 1MB units,
     and ring buffers sized by the never-used large step would cycle an 8x
     larger working set through the cache for nothing."""
-    k = layout.DATA_SHARDS
+    k = data_shards
     row = large_block * k
     n_large = (dat_size - 1) // row if dat_size > row else 0
     remaining = dat_size - n_large * row
@@ -419,12 +435,13 @@ def _unit_steps(dat_size: int, large_block: int, small_block: int,
 
 
 def _unit_coverage(dat_size: int, row_start: int, block: int, col: int,
-                   step: int) -> tuple[int, int]:
+                   step: int,
+                   data_shards: int = layout.DATA_SHARDS) -> tuple[int, int]:
     """-> (nz, tail): nz = number of leading rows carrying any data in this
     unit, tail = valid bytes in row nz-1 (== step when that row is full)."""
     nz = 0
     tail = step
-    for j in range(layout.DATA_SHARDS):
+    for j in range(data_shards):
         off = row_start + j * block + col
         n = min(step, dat_size - off)
         if n <= 0:
@@ -844,9 +861,9 @@ def _encode_serial_host(codec, dat_fd: int, dat_view: np.ndarray,
     units N-1.. still in flight.  Parity lands in a small ring of pooled
     buffers so the matmul only waits (stall_s) when every buffer is still
     queued behind the disks."""
-    k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
+    k, m = codec.k, codec.m
     min_step, max_step = _unit_steps(dat_size, large_block, small_block,
-                                     batch_size)
+                                     batch_size, data_shards=k)
     # ALIGN-aligned parity ring: rows qualify for O_DIRECT + registered-
     # buffer submission whenever the step is an ALIGN multiple
     pbufs = [_aligned_empty((m, max_step))
@@ -859,16 +876,18 @@ def _encode_serial_host(codec, dat_fd: int, dat_view: np.ndarray,
         out_fds, highwater, stats,
         stage_key=lambda i: "write_data_s" if i < k else "write_parity_s",
         reg_bufs=pbufs)
-    sink = _make_sink(writers, layout.TOTAL_SHARDS, min_step)
+    sink = _make_sink(writers, codec.k + codec.m, min_step)
     done = 0
     try:
         for row_start, block, col, step, shard_off in _iter_units(
-                dat_size, large_block, small_block, batch_size):
+                dat_size, large_block, small_block, batch_size,
+                data_shards=k):
             if cancel is not None and cancel():
                 raise EncodeCancelled("ec encode cancelled")
             if writers.failed:
                 break
-            nz, tail = _unit_coverage(dat_size, row_start, block, col, step)
+            nz, tail = _unit_coverage(dat_size, row_start, block, col, step,
+                                      data_shards=k)
             if nz == 0:
                 continue
             # data shards: in-kernel copy on the per-shard workers, no
@@ -944,9 +963,9 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
     instead)."""
     from seaweedfs_tpu.ops.native_codec import NativeRSCodec
     native_host = isinstance(codec, NativeRSCodec)
-    k, m = layout.DATA_SHARDS, layout.PARITY_SHARDS
+    k, m = codec.k, codec.m
     min_step, max_step = _unit_steps(dat_size, large_block, small_block,
-                                     batch_size)
+                                     batch_size, data_shards=k)
     pool: queue.Queue = queue.Queue()
     reg_bufs = None
     if native_host:
@@ -985,13 +1004,14 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
         flusher = _ShardFlusher(writers, k)  # data shards only
         try:
             for row_start, block, col, step, shard_off in _iter_units(
-                    dat_size, large_block, small_block, batch_size):
+                    dat_size, large_block, small_block, batch_size,
+                    data_shards=k):
                 if errors or writers.failed:  # downstream died: stop
                     break
                 if cancel is not None and cancel():
                     raise EncodeCancelled("ec encode cancelled")
                 nz, tail = _unit_coverage(dat_size, row_start, block, col,
-                                          step)
+                                          step, data_shards=k)
                 if nz == 0:
                     continue
                 for j in range(nz):
@@ -1035,7 +1055,7 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
         # flush-group boundary.  Tiny units keep the batcher — per-unit
         # queue hops would cost more than the writes.
         flusher = writers if min_step >= DIRECT_MIN else \
-            _ShardFlusher(writers, layout.TOTAL_SHARDS)
+            _ShardFlusher(writers, codec.k + codec.m)
         while True:
             item = q_disp.get()
             if item is None:
@@ -1123,8 +1143,24 @@ def _encode_pipelined(codec, dat_fd: int, dat_view: np.ndarray,
         raise writers.errors[0]
 
 
+def _survivor_basis(codec, present: list[int],
+                    wanted: list[int]) -> list[int]:
+    """Which surviving shard files a rebuild must actually read.  RS/MDS:
+    any k.  LRC: the code's decode_select picks a minimal span (one local
+    group for a single loss).  MSR whole-file rebuild: the file codec's
+    node-MDS selection (any k whole files)."""
+    sel = getattr(codec, "decode_select", None)
+    if sel is not None:  # file-surface hook (MSRFileCodec)
+        return list(sel(sorted(present), list(wanted)))
+    from seaweedfs_tpu.ops import codec_base as _cb
+    code = getattr(codec, "code", codec)
+    return list(_cb.select_survivors(code, tuple(sorted(present)),
+                                     list(wanted)))
+
+
 def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
-                     progress=None, cancel=None, stats=None) -> list[int]:
+                     progress=None, cancel=None, stats=None,
+                     codec_tag: str | None = None) -> list[int]:
     """Regenerate whichever `.ecXX` files are missing from the >=10 present
     ones. Returns the rebuilt shard ids.
 
@@ -1136,29 +1172,41 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     stream to per-shard writer workers (the decode of batch N overlaps the
     writes of batch N-1) into recycled `.tmp` inodes, committed by rename
     only on success (reference: RebuildEcFiles, ec_encoder.go:237-291)."""
-    present = [i for i in range(layout.TOTAL_SHARDS)
+    from seaweedfs_tpu.ops import codecs as _codecs
+    spec = _codecs.parse_tag(codec_tag or
+                             (read_vif(base) or {}).get("codec"))
+    present = [i for i in range(spec.n)
                if os.path.exists(base + layout.to_ext(i))]
-    missing = [i for i in range(layout.TOTAL_SHARDS) if i not in present]
+    missing = [i for i in range(spec.n) if i not in present]
     if not missing:
         return []
-    if len(present) < layout.DATA_SHARDS:
+    if len(present) < spec.k:
         raise ValueError(
-            f"need >= {layout.DATA_SHARDS} shards to rebuild, have {len(present)}")
+            f"need >= {spec.k} shards to rebuild, have {len(present)}")
     # chaos hook: fail like a dying disk BEFORE tmp shard files exist
     from seaweedfs_tpu.maintenance import faults as _faults
     _faults.check_shard_write(base)
-    codec = _get_codec()
-    use = present[: layout.DATA_SHARDS]
+    codec = _get_codec(tag=spec.tag)
+    use = _survivor_basis(codec, present, missing)
     shard_size = os.path.getsize(base + layout.to_ext(use[0]))
     stats = stats if stats is not None else {}
-    stats["bytes"] = shard_size * layout.DATA_SHARDS
+    stats["bytes"] = shard_size * len(use)
+    stats["codec"] = spec.tag
+    # MSR sub-packetization: every chunk a codec's interleave must see is
+    # an alpha multiple (shard files themselves are block-multiples)
+    if spec.alpha > 1:
+        batch_size = max(spec.alpha,
+                         batch_size - batch_size % spec.alpha)
+        if shard_size % spec.alpha:
+            raise ValueError(
+                f"shard size {shard_size} not {spec.alpha}-aligned")
 
     from seaweedfs_tpu.ops.native_codec import NativeRSCodec
     native_host = isinstance(codec, NativeRSCodec)
     stats["mode"] = "host-serial" if native_host else "staged"
     if native_host:
         from seaweedfs_tpu import native
-        dec_mat = codec.code.decode_matrix(list(use), list(missing))
+        dec_mat = codec.code.decode_matrix(list(present), list(missing))
 
     # a rebuild IS repair work: unless a caller already declared a class
     # (the planner's header re-entered through the middleware), any
@@ -1166,8 +1214,9 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
     # shard_reader for survivors not on local disk — books as repair
     _flow_token = _netflow.set_class(_netflow.current_class() or "repair")
     pjob = _pipeline.track("ec_rebuild", stats,
-                           shard_size * layout.DATA_SHARDS,
-                           meta={"missing": len(missing)})
+                           shard_size * len(use),
+                           meta={"missing": len(missing),
+                                 "codec": spec.tag})
     t_wall = time.perf_counter()
     import mmap as mmap_mod
     ins: dict[int, object] = {}
@@ -1228,7 +1277,7 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
                         native.gf_matmul_ptrs(dec_mat, rows, outs, n)
                 else:
                     if stage is None:
-                        stage = np.empty((layout.DATA_SHARDS,
+                        stage = np.empty((len(use),
                                           min(batch_size, shard_size)),
                                          dtype=np.uint8)
                     for row, i in enumerate(use):
@@ -1244,7 +1293,7 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
             for i in missing:
                 writers.put(wpos[i], obuf[wpos[i], :n], off,
                             release=release)
-            done += n * layout.DATA_SHARDS
+            done += n * len(use)
             if progress is not None:
                 progress(done)
         writers.close()
@@ -1257,7 +1306,7 @@ def rebuild_ec_files(base: str, batch_size: int = DEFAULT_BATCH,
         if frac is not None:
             stats["overlap_frac"] = frac
         _book_stage_bytes(pjob, stats,
-                          shard_size * layout.DATA_SHARDS,
+                          shard_size * len(use),
                           shard_size * len(missing))
         ok = True
     finally:
@@ -1299,7 +1348,8 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
                        batch_size: int = DEFAULT_BATCH,
                        align: int | None = None,
                        progress=None, cancel=None,
-                       stats: dict | None = None) -> dict:
+                       stats: dict | None = None,
+                       codec_tag: str | None = None) -> dict:
     """Reduced-read rebuild of `lost` shards: instead of copying k full
     survivor shards here, each remote helper node ships XOR-combinable
     partial products (ops/regen.py) — repair bandwidth per remote node
@@ -1322,7 +1372,15 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
     from seaweedfs_tpu.maintenance import faults as _faults
     _faults.check_shard_write(base)
 
-    codec = _get_codec()
+    from seaweedfs_tpu.ops import codecs as _codecs
+    spec = _codecs.parse_tag(codec_tag or
+                             (read_vif(base) or {}).get("codec"))
+    codec = _get_codec(tag=spec.tag)
+    if spec.family == "msr":
+        # plan coordinates are sub-rows: a batch of S sub-row bytes costs
+        # each helper an S*alpha-byte file read — shrink so the helper-
+        # side pread stays bounded by the plain path's batch
+        batch_size = max(spec.alpha, batch_size // spec.alpha)
     code = getattr(codec, "code", codec)  # RSCode is its own metadata
 
     lost = sorted(set(lost))
@@ -1333,7 +1391,7 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
     t_wall = time.perf_counter()
     try:
         shard_size = 0
-        for i in range(layout.TOTAL_SHARDS):
+        for i in range(spec.n):
             p_ = base + layout.to_ext(i)
             if i not in lost and os.path.exists(p_):
                 local_fds[i] = os.open(p_, os.O_RDONLY)
@@ -1346,6 +1404,11 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
         if shard_size <= 0:
             raise ValueError(f"cannot size shards of {base}")
         stats["bytes"] = shard_size * len(lost)
+        stats["codec"] = spec.tag
+        alpha = spec.alpha
+        if alpha > 1 and shard_size % alpha:
+            raise ValueError(
+                f"shard size {shard_size} not {alpha}-aligned for {spec.tag}")
 
         def read_local(sid: int, off: int, n: int) -> bytes | None:
             fd = local_fds.get(sid)
@@ -1355,6 +1418,34 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
                 return os.pread(fd, n, off)
             except OSError:
                 return None
+
+        # MSR plans address SUB-ROWS: virtual id = file_shard*alpha + row,
+        # offsets/lengths in sub-row bytes.  A sub-row is the byte-
+        # interleaved slice {t*alpha + row} of its shard file, so reading
+        # one means one contiguous pread of [off*alpha, (off+n)*alpha)
+        # de-interleaved on the fly; a one-slot cache serves the alpha
+        # consecutive sub-row reads execute_plan makes per local file
+        # from a single pread.
+        _vblk: dict = {}
+
+        def read_local_sub(vid: int, off: int, n: int) -> bytes | None:
+            fsid = vid // alpha
+            fd = local_fds.get(fsid)
+            if fd is None:
+                return None
+            key = (fsid, off, n)
+            blk = _vblk.get(key)
+            if blk is None:
+                try:
+                    raw = os.pread(fd, n * alpha, off * alpha)
+                except OSError:
+                    return None
+                if len(raw) != n * alpha:
+                    return None
+                blk = np.frombuffer(raw, np.uint8).reshape(n, alpha)
+                _vblk.clear()
+                _vblk[key] = blk
+            return blk[:, vid % alpha].tobytes()
 
         remote_groups = [
             regen.HelperGroup(node=g["node"],
@@ -1370,20 +1461,43 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
             out_fd = os.open(tmp, os.O_RDWR | os.O_CREAT, 0o644)
             committed = False
             try:
-                def sink(off: int, row: np.ndarray,
-                         fd: int = out_fd) -> None:
-                    _pwrite_all(fd, np.ascontiguousarray(row), off)
+                if spec.family == "msr":
+                    # regenerating repair: [alpha, d] posts land as an
+                    # [alpha, n] block per segment — re-interleave back
+                    # into shard-file byte order on the way to disk
+                    def sink(off: int, rows: np.ndarray,
+                             fd: int = out_fd) -> None:
+                        rows = np.asarray(rows)
+                        if rows.ndim == 1:
+                            rows = rows.reshape(1, -1)
+                        _pwrite_all(
+                            fd,
+                            np.ascontiguousarray(rows.T.reshape(-1)),
+                            off * alpha)
+
+                    planner = regen.plan_msr_repair
+                    plan_code = codec  # file codec carries the inner code
+                    reader = read_local_sub
+                else:
+                    def sink(off: int, row: np.ndarray,
+                             fd: int = out_fd) -> None:
+                        _pwrite_all(fd, np.ascontiguousarray(row), off)
+
+                    planner = None
+                    plan_code = code
+                    reader = read_local
 
                 local_group = regen.HelperGroup(
                     node="", shards=tuple(sorted(local_fds)), locality=0)
                 with _Timer(stats, "reconstruct_s"):
                     plan = regen.repair_shard(
-                        code, codec, sid,
+                        plan_code, codec, sid,
                         [local_group] + remote_groups, shard_size,
-                        read_local, fetch_partial, sink,
+                        reader, fetch_partial, sink,
                         d=d, batch_size=batch_size,
                         align=align or regen.DEFAULT_SEG_ALIGN,
-                        cancel=cancel, stats=stats)
+                        cancel=cancel, stats=stats,
+                        planner=planner)
                 os.ftruncate(out_fd, shard_size)
                 os.close(out_fd)
                 out_fd = -1
@@ -1431,19 +1545,25 @@ def rebuild_ec_reduced(base: str, lost: list[int], groups: list[dict],
 def write_dat_file(base: str, dat_size: int,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
-                   out_path: str | None = None) -> None:
-    """`.ec00`-`.ec09` -> `<base>.dat` (row-major interleave copy).
+                   out_path: str | None = None,
+                   data_shards: int | None = None) -> None:
+    """Data shard files -> `<base>.dat` (row-major interleave copy).
     ``out_path`` redirects the output (the un-convert path decodes into
     a temp name and renames, so a crash mid-decode can never leave a
-    half-written .dat a restart would mount as live data)."""
-    rows = layout.n_large_rows(dat_size, large_block, small_block)
+    half-written .dat a restart would mount as live data).  The stripe
+    width k comes from the volume's .vif codec tag unless overridden."""
+    if data_shards is None:
+        from seaweedfs_tpu.ops import codecs as _codecs
+        data_shards = _codecs.parse_tag((read_vif(base) or {}).get("codec")).k
+    rows = layout.n_large_rows(dat_size, large_block, small_block,
+                               data_shards=data_shards)
     ins = [open(base + layout.to_ext(i), "rb")
-           for i in range(layout.DATA_SHARDS)]
+           for i in range(data_shards)]
     written = 0
     try:
         with open(out_path or (base + ".dat"), "wb") as dat:
             for r in range(rows):
-                for j in range(layout.DATA_SHARDS):
+                for j in range(data_shards):
                     ins[j].seek(r * large_block)
                     n = min(large_block, dat_size - written)
                     if n <= 0:
@@ -1453,7 +1573,7 @@ def write_dat_file(base: str, dat_size: int,
             small_base = rows * large_block
             r = 0
             while written < dat_size:
-                for j in range(layout.DATA_SHARDS):
+                for j in range(data_shards):
                     ins[j].seek(small_base + r * small_block)
                     n = min(small_block, dat_size - written)
                     if n <= 0:
@@ -1500,10 +1620,14 @@ def write_idx_from_ecx(ecx_path: str, idx_path: str | None = None) -> None:
 
 
 def write_vif(base: str, dat_size: int,
-              version: int = t.CURRENT_VERSION) -> None:
+              version: int = t.CURRENT_VERSION,
+              codec: str | None = None) -> None:
     import json
+    doc: dict = {"version": version, "dat_file_size": dat_size}
+    if codec:
+        doc["codec"] = codec
     with open(base + ".vif", "w") as f:
-        json.dump({"version": version, "dat_file_size": dat_size}, f)
+        json.dump(doc, f)
 
 
 def read_vif(base: str) -> dict | None:
@@ -1513,6 +1637,14 @@ def read_vif(base: str) -> dict | None:
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+def volume_codec_tag(base: str) -> str:
+    """Codec tag of an EC volume from its .vif sidecar.  Volumes written
+    before codec tags existed (or whose .vif is missing) are RS — the
+    no-flag-day default."""
+    from seaweedfs_tpu.ops import codecs as _codecs
+    return _codecs.parse_tag((read_vif(base) or {}).get("codec")).tag
 
 
 def find_dat_file_size(base: str, version: int = t.CURRENT_VERSION) -> int:

@@ -94,6 +94,12 @@ class EcVolume:
         self.small_block = small_block
         vif = ec_files.read_vif(base)
         self.version = vif.get("version", version) if vif else version
+        # the volume's erasure code, from its .vif tag (pre-tag volumes
+        # and missing .vif mean RS — no flag-day): geometry (k/n/alpha)
+        # and the degraded-read survivor policy both key off this
+        from seaweedfs_tpu.ops import codecs as _codecs
+        self.spec = _codecs.parse_tag((vif or {}).get("codec"))
+        self.codec_tag = self.spec.tag
 
         # replay any crash-left journal into the .ecx, as the reference
         # does at mount (RebuildEcxFile, ec_volume_delete.go:51-98)
@@ -104,7 +110,7 @@ class EcVolume:
         self.ids, self.offs, self.sizes = idxf.read_columns(data)
 
         self.shards: dict[int, object] = {}
-        for i in range(layout.TOTAL_SHARDS):
+        for i in range(self.spec.n):
             p = base + layout.to_ext(i)
             if os.path.exists(p):
                 self.shards[i] = open(p, "rb")
@@ -299,16 +305,27 @@ class EcVolume:
 
     def _gather_survivors(self, exclude: set[int],
                           segs: list[tuple[int, int]],
-                          shard_reader: ShardReader | None
+                          shard_reader: ShardReader | None,
+                          want: list[int] | None = None,
+                          need: int | None = None
                           ) -> dict[int, np.ndarray]:
-        """k survivor rows covering every segment, local shards first, the
+        """Survivor rows covering every segment, local shards first, the
         remainder fanned out to peers in PARALLEL on the shared pool like
         the reference's recoverOneRemoteEcShardInterval
         (store_ec.go:349-382) — a serial walk would stack per-peer
-        timeouts onto one degraded GET."""
-        k = layout.DATA_SHARDS
+        timeouts onto one degraded GET.
+
+        `want` restricts reads to a codec-chosen basis (an LRC local
+        group: the whole point of the code is touching <= r+1 shards on
+        a single loss); `need` is how many rows suffice (defaults to
+        len(want), else k).  Raises IOError when the floor is missed so
+        the caller can retry unrestricted."""
+        k = self.spec.k
+        universe = want if want is not None else list(range(self.spec.n))
+        need = need if need is not None else             (len(want) if want is not None else k)
+        floor = min(need, k) if want is None else need
         pool = _read_pool()
-        local = [i for i in range(layout.TOTAL_SHARDS)
+        local = [i for i in universe
                  if i not in exclude and i in self.shards]
         results: dict[int, bytes] = {}
         if len(local) == 1:
@@ -322,14 +339,14 @@ class EcVolume:
                 data = None if fut.exception() else fut.result()
                 if data is not None:
                     results[futs[fut]] = data
-                    if len(results) >= k:
+                    if len(results) >= need:
                         break  # enough survivors: no wasted disk reads
             for fut in futs:
                 fut.cancel()  # drop un-started stragglers
         self._bump("local_shard_reads", len(results) * len(segs))
-        if len(results) < k and shard_reader is not None:
-            need = k - len(results)
-            remote = [i for i in range(layout.TOTAL_SHARDS)
+        if len(results) < need and shard_reader is not None:
+            short = need - len(results)
+            remote = [i for i in universe
                       if i not in exclude and i not in results]
             # same-rack-first: when the reader exposes the planner's
             # locality ranking (volume_server._shard_reader), submission
@@ -363,20 +380,20 @@ class EcVolume:
                     if data is not None:
                         results[futs[fut]] = data
                         self._bump("remote_shard_reads", len(segs))
-                        need -= 1
-                        if need <= 0:
+                        short -= 1
+                        if short <= 0:
                             break
             finally:
                 # do NOT wait for stragglers: one blackholed peer must
                 # not stall the degraded GET past the k fast responders
                 rpool.shutdown(wait=False, cancel_futures=True)
-        if len(results) < k:
+        if len(results) < floor:
             raise IOError(
                 f"ec volume {self.base}: only {len(results)} shards "
-                f"readable, need {k} to reconstruct "
+                f"readable, need {floor} to reconstruct "
                 f"shard(s) {sorted(exclude)}")
         rows = {}
-        for sid in sorted(results)[:k]:
+        for sid in sorted(results)[:need]:
             rows[sid] = np.frombuffer(results[sid], dtype=np.uint8)
         return rows
 
@@ -400,13 +417,53 @@ class EcVolume:
             return out  # type: ignore[return-value]
         wanted = sorted({ranges[i][0] for i in todo})
         segs = [(ranges[i][1], ranges[i][2]) for i in todo]
+        codec = ec_files._get_codec(tag=self.codec_tag)
+        # MSR sub-packetization works on byte-interleaved alpha-blocks:
+        # widen each segment to alpha boundaries, slice the lead back off
+        # after the decode (alpha=1 for rs/lrc: no-op)
+        a = self.spec.alpha
+        leads = [0] * len(segs)
+        gsegs = segs
+        if a > 1:
+            gsegs = []
+            for i, (off, size) in enumerate(segs):
+                leads[i] = off % a
+                start = off - leads[i]
+                end = off + size
+                end += (-end) % a
+                gsegs.append((start, end - start))
+        # codec-chosen survivor basis: LRC single-loss repairs read one
+        # local group (r+1 shards), MSR whole-file decode reads any k
+        # whole files.  If a basis shard turns out unreadable, retry
+        # unrestricted — non-MDS decodability is then re-judged by the
+        # shell over whatever actually arrived.
+        sel = getattr(codec, "decode_select", None) or \
+            getattr(getattr(codec, "code", None), "decode_select", None)
+        basis: list[int] | None = None
+        if sel is not None:
+            try:
+                basis = list(sel(
+                    sorted(set(range(self.spec.n)) - set(wanted)),
+                    list(wanted)))
+            except (ValueError, TypeError):
+                basis = None
         with trace.span("ec.gather_survivors", shards_lost=len(wanted),
-                        segs=len(segs)), \
+                        segs=len(gsegs)), \
                 _pipeline.flow("ec_read").stage(
                     "gather_survivors",
-                    nbytes=layout.DATA_SHARDS * sum(s for _, s in segs)):
-            rows = self._gather_survivors(set(wanted), segs, shard_reader)
-        codec = ec_files._get_codec()
+                    nbytes=self.spec.k * sum(s for _, s in gsegs)):
+            try:
+                rows = self._gather_survivors(set(wanted), gsegs,
+                                              shard_reader, want=basis)
+            except IOError:
+                if basis is None:
+                    raise
+                # one extra survivor beyond k keeps every <= tolerance-1
+                # loss pattern decodable for LRC; harmless elsewhere
+                extra = 1 if self.spec.family == "lrc" else 0
+                rows = self._gather_survivors(
+                    set(wanted), gsegs, shard_reader,
+                    need=self.spec.k + extra)
         # one dispatch decodes every wanted shard over the WHOLE
         # concatenation even though each segment only consumes its own
         # shard's slice — deliberately: with f lost shards that wastes
@@ -415,9 +472,9 @@ class EcVolume:
         # per-call orchestration cost this engine exists to amortize
         with trace.span("ec.reconstruct_batch", intervals=len(todo),
                         shards=len(wanted),
-                        bytes=sum(s for _, s in segs)), \
+                        bytes=sum(s for _, s in gsegs)), \
                 _pipeline.flow("ec_read").stage(
-                    "reconstruct", nbytes=sum(s for _, s in segs)):
+                    "reconstruct", nbytes=sum(s for _, s in gsegs)):
             rebuilt = ec_files._reconstruct_batch(codec, rows, wanted)
         self._bump("reconstruct_batches")
         self._bump("reconstruct_intervals", len(todo))
@@ -429,10 +486,12 @@ class EcVolume:
             # record counts — annotate it, don't count it twice
             heat.record("volume", self.vid, 0, "degraded", weight=0.0)
         pos = 0
-        for idx in todo:
+        for i, idx in enumerate(todo):
             sid, off, size = ranges[idx]
-            data = np.asarray(rebuilt[sid][pos:pos + size]).tobytes()
-            pos += size
+            lead = leads[i]
+            data = np.asarray(
+                rebuilt[sid][pos + lead:pos + lead + size]).tobytes()
+            pos += gsegs[i][1]
             out[idx] = data
             if use_cache:
                 self._cache_put((sid, off, size), data)
@@ -661,7 +720,7 @@ class EcVolume:
             length = t.actual_size(size, self.version)
             intervals = layout.locate_data(
                 self.large_block, self.small_block, self.dat_size,
-                dat_offset, length)
+                dat_offset, length, data_shards=self.spec.k)
             plan = []
             for iv in intervals:
                 sid, off = iv.to_shard_id_and_offset(self.large_block,

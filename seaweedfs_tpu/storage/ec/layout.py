@@ -21,6 +21,10 @@ from dataclasses import dataclass
 DATA_SHARDS = 10
 PARITY_SHARDS = 4
 TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+# existence-scan ceiling for shard files of ANY registered codec
+# (msr_9_16 writes .ec17); pure filesystem probes use this instead of
+# TOTAL_SHARDS so a node holding only high shards still finds them
+MAX_TOTAL_SHARDS = 32
 LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1GB
 SMALL_BLOCK_SIZE = 1024 * 1024  # 1MB
 
@@ -36,21 +40,25 @@ class Interval:
     size: int
     is_large_block: bool
     large_block_rows: int
+    # stripe width: k of the volume's codec (RS default; LRC shares the
+    # same 10-wide geometry, MSR volumes stripe 9-wide)
+    data_shards: int = DATA_SHARDS
 
     def to_shard_id_and_offset(self, large_block: int = LARGE_BLOCK_SIZE,
                                small_block: int = SMALL_BLOCK_SIZE) -> tuple[int, int]:
         """(shard_id, offset inside that shard's file)."""
         off = self.inner_block_offset
-        row = self.block_index // DATA_SHARDS
+        row = self.block_index // self.data_shards
         if self.is_large_block:
             off += row * large_block
         else:
             off += self.large_block_rows * large_block + row * small_block
-        return self.block_index % DATA_SHARDS, off
+        return self.block_index % self.data_shards, off
 
 
 def n_large_rows(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
-                 small_block: int = SMALL_BLOCK_SIZE) -> int:
+                 small_block: int = SMALL_BLOCK_SIZE,
+                 data_shards: int = DATA_SHARDS) -> int:
     """Number of 10-wide large-block rows for a volume of dat_size bytes.
 
     Exactly matches the encode loop's strict `remaining > 10*large`
@@ -63,31 +71,37 @@ def n_large_rows(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
     would misroute. We stay loop-consistent for every size instead; for
     sizes outside that window the two formulas agree."""
     del small_block  # kept in the signature for call-site symmetry
-    row = large_block * DATA_SHARDS
+    row = large_block * data_shards
     if dat_size <= row:
         return 0
     return (dat_size - 1) // row
 
 
 def n_small_rows(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
-                 small_block: int = SMALL_BLOCK_SIZE) -> int:
-    remaining = dat_size - n_large_rows(dat_size, large_block, small_block) \
-        * large_block * DATA_SHARDS
-    return max(0, -(-remaining // (small_block * DATA_SHARDS)))
+                 small_block: int = SMALL_BLOCK_SIZE,
+                 data_shards: int = DATA_SHARDS) -> int:
+    remaining = dat_size - \
+        n_large_rows(dat_size, large_block, small_block, data_shards) \
+        * large_block * data_shards
+    return max(0, -(-remaining // (small_block * data_shards)))
 
 
 def shard_file_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
-                    small_block: int = SMALL_BLOCK_SIZE) -> int:
+                    small_block: int = SMALL_BLOCK_SIZE,
+                    data_shards: int = DATA_SHARDS) -> int:
     """Size of each .ecXX file for a volume of dat_size bytes."""
-    return n_large_rows(dat_size, large_block, small_block) * large_block + \
-        n_small_rows(dat_size, large_block, small_block) * small_block
+    return n_large_rows(dat_size, large_block, small_block, data_shards) \
+        * large_block + \
+        n_small_rows(dat_size, large_block, small_block, data_shards) \
+        * small_block
 
 
 def locate_offset(large_block: int, small_block: int, dat_size: int,
-                  offset: int) -> tuple[int, bool, int]:
+                  offset: int,
+                  data_shards: int = DATA_SHARDS) -> tuple[int, bool, int]:
     """-> (block_index, is_large_block, inner_block_offset)."""
-    large_row = large_block * DATA_SHARDS
-    rows = n_large_rows(dat_size, large_block, small_block)
+    large_row = large_block * data_shards
+    rows = n_large_rows(dat_size, large_block, small_block, data_shards)
     if offset < rows * large_row:
         return int(offset // large_block), True, int(offset % large_block)
     offset -= rows * large_row
@@ -95,21 +109,23 @@ def locate_offset(large_block: int, small_block: int, dat_size: int,
 
 
 def locate_data(large_block: int, small_block: int, dat_size: int,
-                offset: int, size: int) -> list[Interval]:
+                offset: int, size: int,
+                data_shards: int = DATA_SHARDS) -> list[Interval]:
     """Map a logical .dat byte range to the shard-block intervals covering it."""
     block_index, is_large, inner = locate_offset(
-        large_block, small_block, dat_size, offset)
-    rows = n_large_rows(dat_size, large_block, small_block)
+        large_block, small_block, dat_size, offset, data_shards)
+    rows = n_large_rows(dat_size, large_block, small_block, data_shards)
     out: list[Interval] = []
     while size > 0:
         remaining = (large_block if is_large else small_block) - inner
         step = min(size, remaining)
-        out.append(Interval(block_index, inner, step, is_large, rows))
+        out.append(Interval(block_index, inner, step, is_large, rows,
+                            data_shards))
         size -= step
         if size <= 0:
             break
         block_index += 1
-        if is_large and block_index == rows * DATA_SHARDS:
+        if is_large and block_index == rows * data_shards:
             is_large = False
             block_index = 0
         inner = 0

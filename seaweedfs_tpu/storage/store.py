@@ -87,7 +87,7 @@ class DiskLocation:
             vid = int(m.group("vid"))
             base = path[: -len(".ecx")]
             has_shards = any(os.path.exists(base + layout.to_ext(i))
-                             for i in range(layout.TOTAL_SHARDS))
+                             for i in range(layout.MAX_TOTAL_SHARDS))
             if vid not in self.ec_volumes and has_shards:
                 self.ec_volumes[vid] = ecv.EcVolume(base)
                 self.collections.setdefault(vid, m.group("col") or "")
@@ -253,6 +253,9 @@ class Store:
                     # repair-byte estimates (planner cross-rack budget)
                     # need the shard file size, which only we know
                     "shard_size": ev.shard_size,
+                    # the volume's erasure code: repair planning and the
+                    # autopilot's codec_select policy key off this
+                    "codec": getattr(ev, "codec_tag", "") or "",
                 })
         return {"volumes": vols, "ec_shards": ec_shards,
                 "max_volume_count": max_slots - staged,

@@ -130,6 +130,9 @@ class Topology:
         # heartbeat-reported shard file size per EC volume: the repair
         # planner's repair-byte estimates (cross-rack budget) need it
         self.ec_shard_sizes: dict[int, int] = {}
+        # heartbeat-reported codec tag per EC volume; absent (old node,
+        # pre-codec-family beat) means rs — use ec_codec() to read
+        self.ec_codecs: dict[int, str] = {}
         self.max_volume_id = 0
         # volume-location delta hook (streamed vid-map updates, reference:
         # master_grpc_server.go broadcastToClients): called with each vid
@@ -215,6 +218,8 @@ class Topology:
                 self.ec_collections[vid] = e.get("collection", "")
                 if e.get("shard_size"):
                     self.ec_shard_sizes[vid] = int(e["shard_size"])
+                if e.get("codec"):
+                    self.ec_codecs[vid] = str(e["codec"])
                 per_vid = self.ec_shard_locations.setdefault(vid, {})
                 for sid in e["shard_ids"]:
                     nodes = per_vid.setdefault(sid, [])
@@ -273,6 +278,13 @@ class Topology:
         with self._lock:
             ec = self.ec_shard_locations.get(vid)
             return {k: list(v) for k, v in ec.items()} if ec else None
+
+    def ec_codec(self, vid: int) -> str:
+        """Normalized codec tag of an EC volume; volumes whose nodes never
+        reported one (pre-codec-family beats) are rs — no flag-day."""
+        from seaweedfs_tpu.ops import codecs
+        with self._lock:
+            return codecs.parse_tag(self.ec_codecs.get(vid)).tag
 
     # -- assignment / growth ------------------------------------------
 
