@@ -922,7 +922,7 @@ def main() -> None:
                _bench_heal_time, _bench_scrub_overhead,
                _bench_flow_canary_overhead, _bench_heat_overhead,
                _bench_history_overhead, _bench_perf_obs_overhead,
-               _bench_interference_overhead,
+               _bench_interference_overhead, _bench_geo_replication,
                _bench_serving_knee, _bench_serving_plane,
                _bench_chaos, _bench_autopilot, _bench_fleetsim):
         try:
@@ -1103,6 +1103,7 @@ def _exit_code(extra: dict) -> int:
              "history_overhead_regression",
              "perf_obs_overhead_regression",
              "interference_overhead_regression",
+             "geo_obs_overhead_regression",
              "repair_interference_regression",
              "repair_ratio_regression",
              "lrc_degraded_regression",
@@ -1152,6 +1153,10 @@ PERF_OBS_OVERHEAD_TOL = 0.97
 # tick AND the governor retuning the background buckets must keep
 # >= 0.97x the plane-off rate (ISSUE 14 acceptance bar)
 INTERFERENCE_OVERHEAD_TOL = 0.97
+# replicated writes with the geo observatory on (lag/backlog gauges,
+# per-event sampled trace roots, WAN double-booking) must keep >= 0.97x
+# the obs-off replication rate (ISSUE 20 acceptance bar)
+GEO_OBS_OVERHEAD_TOL = 0.97
 # bench trajectory: a gated headline metric dropping more than 10% below
 # the best prior recorded round (same backend) fails the run
 TRAJECTORY_TOL = 0.90
@@ -1167,14 +1172,16 @@ TRAJECTORY_TOL = 0.90
 TRAJECTORY_GATED = ("ec_encode_rs10_4", "ec_rebuild_rs10_4_m1",
                     "ec_encode_rs10_4_mesh", "fleet_convert_gbps",
                     "autopilot_p99_gate", "serving_knee_rps",
-                    "fleet_sim_pool_gate", "fleet_sim_actions_gate")
+                    "fleet_sim_pool_gate", "fleet_sim_actions_gate",
+                    "geo_catchup_mbps")
 # batch placement must stay within this fraction of the unsharded
 # single-call kernel at equal bytes (satellite gate, ISSUE 12)
 BATCH_PLACE_TOL = 0.90
 # lower-is-better trajectory gates: the metric failing when it RISES
 # more than 10% above the best (minimum) prior recorded round
 TRAJECTORY_GATED_MIN = ("repair_network_ratio", "fleet_sim_tick_gate",
-                        "repair_network_ratio_msr_9_16")
+                        "repair_network_ratio_msr_9_16",
+                        "geo_replication_lag_s")
 # metric prefixes whose numbers are bound by the host I/O engine: these
 # additionally require the prior round's config.aio to match (see
 # _record_trajectory.metric_comparable)
@@ -3302,6 +3309,128 @@ def _bench_history_overhead(extra: dict, n: int = 1200, size: int = 1024,
               f"run at {ratio:.3f}x the recording-off rate (median of "
               f"interleaved pairs); the history plane exceeds its 3% "
               f"budget. Failing the bench run.", file=sys.stderr)
+
+
+def _bench_geo_replication(extra: dict, n: int = 48, size: int = 64 * 1024,
+                           pairs: int = 5, batch_files: int = 12) -> None:
+    """Geo-replication observatory bench on a real two-region topology
+    (GeoCluster: two master+VS+filer clusters linked by FilerSync).
+    Three headline numbers:
+
+    - ``geo_replication_lag_s``: steady-state replication lag right
+      after a converged write batch (trajectory-gated, lower is better);
+    - ``geo_catchup_mbps``: post-partition catch-up throughput — bytes
+      written during a WAN partition divided by the time from heal() to
+      byte-converged on the far region, reconnect backoff included
+      (trajectory-gated, higher is better);
+    - ``geo_obs_overhead_ratio``: the observatory's own price — batch
+      write+converge throughput with WEEDTPU_GEO_OBS on vs off,
+      interleaved pairs (the pump reads the switch per event), median
+      ratio below GEO_OBS_OVERHEAD_TOL fails the run."""
+    import pathlib
+
+    from seaweedfs_tpu.maintenance.chaos import GeoCluster
+    from seaweedfs_tpu.stats import metrics as _metrics
+
+    old = {k: os.environ.get(k) for k in (
+        "WEEDTPU_GEO_OBS", "WEEDTPU_GEO_AUDIT_INTERVAL",
+        "WEEDTPU_SYNC_BACKLOG_INTERVAL", "WEEDTPU_SYNC_BACKOFF_BASE",
+        "WEEDTPU_SYNC_BACKOFF_CAP")}
+    # deterministic arms: no background audits, fast reconnects
+    os.environ["WEEDTPU_GEO_AUDIT_INTERVAL"] = "0"
+    os.environ["WEEDTPU_SYNC_BACKLOG_INTERVAL"] = "1"
+    os.environ["WEEDTPU_SYNC_BACKOFF_BASE"] = "0.1"
+    os.environ["WEEDTPU_SYNC_BACKOFF_CAP"] = "0.5"
+    os.environ.pop("WEEDTPU_GEO_OBS", None)
+    payload = (bytes(range(256)) * (size // 256 + 1))[:size]
+    seq = iter(range(10_000))
+    try:
+        with tempfile.TemporaryDirectory(prefix="weedtpu-geo-") as d:
+            geo = GeoCluster(pathlib.Path(d))
+            geo.start()
+            try:
+                def converge(paths, timeout=120.0):
+                    deadline = time.time() + timeout
+                    for p in paths:
+                        while geo.read("b", p)[0] != 200:
+                            if time.time() > deadline:
+                                raise RuntimeError(
+                                    f"replication never converged: {p}")
+                            time.sleep(0.02)
+
+                def batch(count) -> float:
+                    tag = next(seq)
+                    paths = [f"/bench/{tag}/f{i}.bin" for i in range(count)]
+                    t0 = time.perf_counter()
+                    for p in paths:
+                        geo.write("a", p, payload)
+                    converge(paths)
+                    return time.perf_counter() - t0
+
+                batch(8)  # warm pools, volume grow, subscribe stream
+                # steady state: lag right after a converged batch
+                batch(n // 2)
+                extra["geo_replication_lag_s"] = round(
+                    geo.sync.a2b.lag_s(), 3)
+
+                # catch-up: write through a WAN partition, heal, time to
+                # byte-convergence on the far region
+                geo.partition()
+                paths = [f"/bench/catchup/f{i}.bin" for i in range(n)]
+                for p in paths:
+                    geo.write("a", p, payload)
+                time.sleep(0.5)  # the pump must hit the partition first
+                geo.heal()
+                t0 = time.perf_counter()
+                converge(paths)
+                dt = time.perf_counter() - t0
+                extra["geo_catchup_mbps"] = round(n * size / dt / 1e6, 2)
+
+                # observatory price: interleaved GEO_OBS on/off pairs
+                applied = _metrics.REPLICATION_APPLIED.labels("a->b")
+
+                def rep(obs: str) -> float:
+                    os.environ["WEEDTPU_GEO_OBS"] = obs
+                    return batch(batch_files)
+
+                ratios: list[float] = []
+                for i in range(pairs):
+                    before = applied.value
+                    if i % 2 == 0:
+                        t_on = rep("1")
+                        t_off = rep("0")
+                    else:
+                        t_off = rep("0")
+                        t_on = rep("1")
+                    # the ON arm must have really exported: otherwise
+                    # both arms measured the obs-off path and the gate
+                    # would pass vacuously over a broken lag plane
+                    if applied.value <= before:
+                        raise RuntimeError(
+                            "geo observatory never engaged during the "
+                            "ON arm — overhead gate is meaningless")
+                    if i == 0:
+                        continue  # warm page cache / pool connections
+                    ratios.append(t_off / t_on)
+            finally:
+                geo.stop()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if not ratios:
+        return
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    extra["geo_obs_overhead_ratio"] = round(ratio, 3)
+    if ratio < GEO_OBS_OVERHEAD_TOL:
+        extra["geo_obs_overhead_regression"] = True
+        print(f"bench: REGRESSION — replicated writes with the geo "
+              f"observatory on run at {ratio:.3f}x the obs-off rate "
+              f"(median of interleaved pairs); the lag plane exceeds "
+              f"its 3% budget. Failing the bench run.", file=sys.stderr)
 
 
 def _bench_interference_overhead(extra: dict, n: int = 1200,

@@ -158,6 +158,23 @@ class MetaLog:
             if event_matches_prefix(ev, prefix):
                 yield ev
 
+    def head_ts(self) -> int:
+        """ts_ns of the newest event ever logged (0 when none) — the
+        sync pump differences this against its resume offset for
+        backlog depth."""
+        with self._lock:
+            return self._last_ts
+
+    def backlog_count(self, since_ts_ns: int, prefix: str = "/") -> int:
+        """Events newer than the offset still in the ring that match the
+        prefix.  O(ring); the ring is bounded (default 8192) so this is
+        cheap enough for the pump's periodic backlog polls."""
+        with self._lock:
+            ring_events = list(self.ring)
+        return sum(1 for ev in ring_events
+                   if ev.ts_ns > since_ts_ns
+                   and event_matches_prefix(ev, prefix))
+
     def close(self) -> None:
         if self._file:
             self._file.close()
@@ -303,6 +320,44 @@ class Filer:
             if len(page) < batch:
                 return
             start, include = page[-1].name, False
+
+    def subtree_digest(self, prefix: str = "/") -> tuple[str, int]:
+        """Deterministic content digest of a subtree: sha256 over the
+        sorted (path, kind, size, md5) lines of every entry under
+        `prefix`.  Chunk fids and mtimes are deliberately excluded —
+        each region places data in its own volumes, so only
+        path+size+content can (and must) agree.  Two filers whose
+        digests match hold byte-identical trees, which is exactly the
+        convergence proof the geo divergence auditor publishes.
+        Best-effort snapshot: concurrent writers can race the walk, the
+        auditor re-probes."""
+        import hashlib
+        lines: list[str] = []
+        root = prefix.rstrip("/") or "/"
+
+        def walk(dir_path: str) -> None:
+            for e in self.iter_entries(dir_path):
+                if e.is_directory:
+                    lines.append(f"{e.full_path}\x00dir")
+                    walk(e.full_path)
+                else:
+                    lines.append(f"{e.full_path}\x00file\x00{e.size()}"
+                                 f"\x00{e.attr.md5}")
+
+        try:
+            root_entry = self.find_entry(root)
+        except NotFound:
+            root_entry = None
+        if root_entry is None:
+            pass  # empty subtree digests to the empty-tree constant
+        elif root_entry.is_directory:
+            walk(root)
+        else:
+            lines.append(f"{root_entry.full_path}\x00file"
+                         f"\x00{root_entry.size()}\x00{root_entry.attr.md5}")
+        lines.sort()
+        digest = hashlib.sha256("\n".join(lines).encode()).hexdigest()
+        return digest, len(lines)
 
     def delete_entry(self, full_path: str, recursive: bool = False,
                      ignore_recursive_error: bool = False,

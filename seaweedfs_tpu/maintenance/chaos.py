@@ -43,7 +43,7 @@ import urllib.request
 from seaweedfs_tpu.maintenance import faults
 from seaweedfs_tpu.storage.ec import layout as _eclayout
 
-__all__ = ["ChaosCluster", "WORKLOADS", "FAULTS", "MATRIX",
+__all__ = ["ChaosCluster", "GeoCluster", "WORKLOADS", "FAULTS", "MATRIX",
            "run_scenario", "fsck_report", "encode_all_volumes"]
 
 
@@ -325,6 +325,147 @@ class ChaosCluster:
                 os.environ.pop("WEEDTPU_SCRUB_REMOTE", None)
             else:
                 os.environ["WEEDTPU_SCRUB_REMOTE"] = prev
+
+
+class GeoCluster:
+    """Two independent regions — each a full master + volume server +
+    filer cluster — linked by a bidirectional FilerSync, all on one
+    asyncio loop in a daemon thread.  The geo-observatory test/chaos
+    harness: every node carries its region tag (trace spans, fault
+    identities), the masters are cross-registered as ``peer_master`` so
+    /cluster/trace federates across the WAN, and region-scoped faults
+    (:func:`partition`, :func:`wan_latency`) cut or slow exactly the
+    cross-region links while intra-region traffic runs clean."""
+
+    def __init__(self, tmp_path, region_a: str = "a", region_b: str = "b",
+                 sync_prefix: str = "/",
+                 volume_size_limit: int = 64 * 1024 * 1024,
+                 heartbeat_interval: float = 0.3):
+        self.tmp = tmp_path
+        self.region_names = (region_a, region_b)
+        self.sync_prefix = sync_prefix
+        self.volume_size_limit = volume_size_limit
+        self.heartbeat_interval = heartbeat_interval
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever,
+                                       daemon=True)
+        # region name -> {"master": ..., "vs": ..., "filer": ...}
+        self.regions: dict[str, dict] = {}
+        self.sync = None
+
+    def submit(self, coro, timeout: float = 120.0):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(
+            timeout)
+
+    def master(self, region: str):
+        return self.regions[region]["master"]
+
+    def filer(self, region: str):
+        return self.regions[region]["filer"]
+
+    def start(self) -> "GeoCluster":
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        self.thread.start()
+        for name in self.region_names:
+            master = MasterServer(
+                "127.0.0.1", free_port(),
+                volume_size_limit=self.volume_size_limit, region=name)
+            self.submit(master.start())
+            d = self.tmp / f"geo_{name}_vs"
+            d.mkdir(exist_ok=True)
+            vs = VolumeServer([str(d)], master.url, "127.0.0.1",
+                              free_port(), max_volumes=20,
+                              heartbeat_interval=self.heartbeat_interval)
+            self.submit(vs.start())
+            # the VS has no region ctor knob; tag it for fault matching
+            faults.register_region(vs.url, name)
+            filer = FilerServer(master.url, port=free_port(),
+                                data_dir=str(self.tmp / f"geo_{name}_f"),
+                                region=name)
+            self.submit(filer.start())
+            self.regions[name] = {"master": master, "vs": vs,
+                                  "filer": filer}
+        # cross-register the masters so trace federation can hop regions
+        a, b = self.region_names
+        for me, other in ((a, b), (b, a)):
+            st, out, _ = _req(
+                f"http://{self.master(other).url}/cluster/register",
+                method="POST",
+                data=json.dumps({"type": "peer_master",
+                                 "address": self.master(me).url}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert st == 200, out
+        from seaweedfs_tpu.replication.filer_sync import FilerSync
+        self.sync = FilerSync(
+            self.filer(a).url, self.filer(b).url, prefix=self.sync_prefix,
+            offset_path=str(self.tmp / "geo_offsets.json"),
+            region_a=a, region_b=b)
+        self.sync.start()
+        return self
+
+    def stop(self) -> None:
+        if self.sync is not None:
+            try:
+                self.sync.stop()
+            except Exception:
+                pass
+        for reg in self.regions.values():
+            for key in ("filer", "vs", "master"):
+                try:
+                    self.submit(reg[key].stop())
+                except Exception:
+                    pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(5)
+        faults.clear_net()
+
+    # -- WAN faults ------------------------------------------------------
+
+    def partition(self) -> None:
+        """Cut every cross-region link (both directions)."""
+        a, b = self.region_names
+        faults.add_partition(f"region:{a}", f"region:{b}")
+        faults.add_partition(f"region:{b}", f"region:{a}")
+
+    def heal(self) -> None:
+        a, b = self.region_names
+        faults.remove_partition(f"region:{a}", f"region:{b}")
+        # the WAN is demonstrably back: close the (process-global)
+        # breakers on every node instead of waiting out half-open
+        from seaweedfs_tpu.utils import resilience
+        for reg in self.regions.values():
+            for key in ("filer", "vs", "master"):
+                resilience.breaker_for(reg[key].url).record(True)
+
+    def wan_latency(self, ms: float, jitter_ms: float = 0.0) -> None:
+        """Charge every boundary-crossing dial `ms` (±jitter) extra."""
+        a, b = self.region_names
+        faults.set_wan_latency(a, b, ms, jitter_ms)
+
+    # -- data helpers ----------------------------------------------------
+
+    def write(self, region: str, path: str, data: bytes) -> None:
+        st, out, _ = _req(f"http://{self.filer(region).url}{path}",
+                          method="PUT", data=data)
+        assert st in (200, 201), (region, path, out)
+
+    def read(self, region: str, path: str) -> tuple[int, bytes]:
+        st, body, _ = _req(f"http://{self.filer(region).url}{path}")
+        return st, body
+
+    def digests(self, prefix: str | None = None) -> tuple[str, str]:
+        """(digest_a, digest_b) straight off the filers' meta endpoint."""
+        out = []
+        for name in self.region_names:
+            st, body, _ = _req(
+                f"http://{self.filer(name).url}/__meta__/digest?"
+                + urllib.parse.urlencode(
+                    {"prefix": prefix or self.sync_prefix}))
+            assert st == 200, body
+            out.append(json.loads(body)["digest"])
+        return tuple(out)
 
 
 def encode_all_volumes(c: ChaosCluster) -> list[int]:

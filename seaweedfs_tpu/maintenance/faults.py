@@ -23,9 +23,17 @@ without containers:
 
   partition:a:b                 refuse dials between a and b (each a role
                                 name like "filer"/"volume"/"master"/"s3",
-                                a netloc, or "*"); bidirectional
+                                a netloc, "region:<name>", or "*");
+                                bidirectional
   peer_latency:dst:ms[:jitter]  add latency to every dial/request toward
                                 dst (role or netloc)
+  region_partition:a:b          refuse every dial CROSSING the a<->b
+                                region boundary (nodes/callers declare
+                                regions via register_region); traffic
+                                inside either region is untouched
+  wan_latency:a:b:ms[:jitter]   add latency only to dials crossing the
+                                a<->b region boundary — the WAN RTT
+                                knob; intra-region dials stay fast
   peer_error:dst:pct            fail requests toward dst with probability
                                 pct/100 (injected ConnectionResetError)
   shard_write_error:EIO|ENOSPC  every EC shard write (encode/rebuild)
@@ -63,6 +71,11 @@ _latency: dict[str, tuple[float, float]] = {}    # dst -> (ms, jitter_ms)
 _error_rate: dict[str, float] = {}               # dst -> probability 0..1
 _disk_shard_write: str | None = None             # "EIO" | "ENOSPC" | None
 _roles: dict[str, str] = {}                      # netloc -> role
+_regions: dict[str, str] = {}                    # netloc -> region name
+# unordered region pair -> (ms, jitter_ms): latency charged only when a
+# dial CROSSES that boundary (dst-keyed peer_latency can't express
+# this — it would also slow region-internal dials toward the same dst)
+_wan_latency: dict[tuple[str, str], tuple[float, float]] = {}
 NET_ACTIVE = False  # cheap hot-path gate; True while any fault is armed
 
 
@@ -72,10 +85,19 @@ def register_node(netloc: str, role: str) -> None:
     _roles[netloc] = role
 
 
+def register_region(netloc: str, region: str) -> None:
+    """Record netloc→region so region_partition / wan_latency faults can
+    tell which dials cross a region boundary.  The GeoCluster harness
+    registers every node of both clusters; single-region deployments
+    never call this and pay nothing."""
+    if region:
+        _regions[netloc] = region
+
+
 def _recompute_active() -> None:
     global NET_ACTIVE
     NET_ACTIVE = bool(_partitions or _latency or _error_rate
-                      or _disk_shard_write)
+                      or _wan_latency or _disk_shard_write)
 
 
 def clear_net() -> None:
@@ -84,6 +106,7 @@ def clear_net() -> None:
         _partitions.clear()
         _latency.clear()
         _error_rate.clear()
+        _wan_latency.clear()
         _disk_shard_write = None
         _recompute_active()
 
@@ -126,33 +149,64 @@ def set_shard_write_error(kind: str | None) -> None:
         _recompute_active()
 
 
+def set_wan_latency(region_a: str, region_b: str, ms: float,
+                    jitter_ms: float = 0.0) -> None:
+    key = (min(region_a, region_b), max(region_a, region_b))
+    with _lock:
+        if ms <= 0 and jitter_ms <= 0:
+            _wan_latency.pop(key, None)
+        else:
+            _wan_latency[key] = (ms, jitter_ms)
+        _recompute_active()
+
+
 def net_snapshot() -> dict:
     with _lock:
         return {"partitions": sorted(list(p) for p in _partitions),
                 "latency_ms": {d: list(v) for d, v in _latency.items()},
+                "wan_latency_ms": {f"{a}<->{b}": list(v)
+                                   for (a, b), v in _wan_latency.items()},
                 "error_rate": {d: round(p * 100.0, 1)
                                for d, p in _error_rate.items()},
                 "shard_write_error": _disk_shard_write,
-                "nodes": dict(_roles)}
+                "nodes": dict(_roles),
+                "regions": dict(_regions)}
 
 
 def _ids(netloc_or_role: str) -> set[str]:
     """Every identity a side of a dial answers to: its literal name, its
-    registered role (for netlocs), and the wildcard."""
+    registered role and region (for netlocs), and the wildcard."""
     out = {netloc_or_role, "*"}
     role = _roles.get(netloc_or_role)
     if role:
         out.add(role)
+    region = _regions.get(netloc_or_role)
+    if region:
+        out.add("region:" + region)
     return out
 
 
-def check_dial(src: str, dst_netloc: str) -> None:
+def _side_ids(src) -> set[str]:
+    """Identity set for a dial's caller side: a plain role string, or an
+    iterable of identities (a region-aware client passes
+    ``{role, "region:<r>"}`` so region faults can match it — clients
+    don't know their own netloc, so register_region can't help them)."""
+    if isinstance(src, str):
+        return _ids(src)
+    out: set[str] = set()
+    for s in src:
+        out |= _ids(s)
+    return out or {"*"}
+
+
+def check_dial(src, dst_netloc: str) -> None:
     """Raise ConnectionRefusedError when (src, dst) crosses an armed
     partition.  `src` is the caller's role (clients don't know their own
-    netloc); `dst_netloc` resolves to its role via register_node."""
+    netloc) or an iterable of identities; `dst_netloc` resolves to its
+    role/region via register_node/register_region."""
     if not NET_ACTIVE:
         return
-    srcs = _ids(src)
+    srcs = _side_ids(src)
     dsts = _ids(dst_netloc)
     with _lock:
         parts = list(_partitions)
@@ -192,15 +246,34 @@ def maybe_inject_error(dst_netloc: str) -> None:
                 f"faults: injected error toward {dst_netloc}")
 
 
-def check_net(src: str, dst_netloc: str) -> float:
+def wan_latency_s(src, dst_netloc: str) -> float:
+    """Injected WAN latency (seconds) when the (src, dst) dial crosses
+    an armed region boundary, 0 otherwise."""
+    if not NET_ACTIVE:
+        return 0.0
+    with _lock:
+        lat = dict(_wan_latency)
+    if not lat:
+        return 0.0
+    srcs = _side_ids(src)
+    dsts = _ids(dst_netloc)
+    for (a, b), (ms, jitter) in lat.items():
+        ra, rb = "region:" + a, "region:" + b
+        if (ra in srcs and rb in dsts) or (ra in dsts and rb in srcs):
+            return max(0.0, ms + _rand.uniform(-jitter, jitter)) / 1000.0
+    return 0.0
+
+
+def check_net(src, dst_netloc: str) -> float:
     """Combined client hook: partition check + error injection; returns
     the latency (seconds) the caller should sleep.  One call site per
-    HTTP stack keeps the hooks from drifting apart."""
+    HTTP stack keeps the hooks from drifting apart.  `src` is a role
+    string or an iterable of identities (role + "region:<r>")."""
     if not NET_ACTIVE:
         return 0.0
     check_dial(src, dst_netloc)
     maybe_inject_error(dst_netloc)
-    return dial_latency_s(dst_netloc)
+    return dial_latency_s(dst_netloc) + wan_latency_s(src, dst_netloc)
 
 
 def check_shard_write(path: str) -> None:
@@ -236,9 +309,15 @@ def parse_env(spec: str) -> list[dict]:
                             "bit": int(fields[4]) if len(fields) > 4 else 0})
             elif action in ("delay_shard_read", "delay_file_pull"):
                 out.append({"action": action, "ms": float(fields[1])})
-            elif action in ("partition", "unpartition"):
+            elif action in ("partition", "unpartition",
+                            "region_partition", "region_unpartition"):
                 out.append({"action": action, "a": fields[1],
                             "b": fields[2]})
+            elif action == "wan_latency":
+                out.append({"action": action, "a": fields[1],
+                            "b": fields[2], "ms": float(fields[3]),
+                            "jitter": float(fields[4])
+                            if len(fields) > 4 else 0.0})
             elif action == "peer_latency":
                 out.append({"action": action, "dst": fields[1],
                             "ms": float(fields[2]),
@@ -266,6 +345,16 @@ def apply_net(fault: dict) -> bool:
         add_partition(str(fault["a"]), str(fault["b"]))
     elif action == "unpartition":
         remove_partition(str(fault["a"]), str(fault["b"]))
+    elif action == "region_partition":
+        add_partition("region:" + str(fault["a"]),
+                      "region:" + str(fault["b"]))
+    elif action == "region_unpartition":
+        remove_partition("region:" + str(fault["a"]),
+                         "region:" + str(fault["b"]))
+    elif action == "wan_latency":
+        set_wan_latency(str(fault["a"]), str(fault["b"]),
+                        float(fault["ms"]),
+                        float(fault.get("jitter", 0.0)))
     elif action == "peer_latency":
         set_peer_latency(str(fault["dst"]), float(fault["ms"]),
                          float(fault.get("jitter", 0.0)))

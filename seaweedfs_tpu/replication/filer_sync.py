@@ -1,6 +1,7 @@
 """filer.sync — continuous (optionally bidirectional) filer→filer
 replication over the meta-event subscribe stream, with persisted resume
-offsets and signature-based loop prevention.
+offsets, signature-based loop prevention, and the geo-replication
+observatory's lag plane.
 
 Reference: weed/command/filer_sync.go (doSubscribeFilerMetaChanges),
 weed/replication/track_sync_offset.go.  Loop prevention follows the
@@ -8,6 +9,36 @@ reference's signature scheme: the direction src→dst stamps every write
 with sig(src) and skips any event already stamped sig(dst) — an event on
 src that was itself written by the dst→src direction carries sig(dst) and
 must not echo back.
+
+Observatory (``WEEDTPU_GEO_OBS=0`` disables all of it, read per event so
+the bench can price it):
+
+- **lag**: now minus the last applied/confirmed source event timestamp.
+  Live-stream keepalives count as confirmation — an idle healthy pipe
+  reads ~0, a partitioned one freezes its progress clock and lag climbs;
+- **backlog**: source meta-log events newer than the resume offset,
+  polled from the source's ``/__meta__/digest`` endpoint (cheap head
+  read, no tree walk) on connect, on stream errors, and at most every
+  ``WEEDTPU_SYNC_BACKLOG_INTERVAL`` seconds while streaming;
+- **stalled**: the pump itself publishes
+  ``weedtpu_replication_stalled{direction}=1`` once no progress has been
+  made for ``WEEDTPU_SYNC_STALL_AFTER`` seconds AND the stream is
+  erroring — the alert engine can't express that conjunction, so the
+  default ``replication_stalled`` rule just thresholds this gauge;
+- **traces**: every applied event runs under a fresh sampled root span
+  (``sync.apply``) that the source read and the sink write inherit, so
+  ``/cluster/trace/<tid>`` shows one write's waterfall across both
+  regions; the last root id is kept on ``SyncDirection.last_trace_id``;
+- **WAN ledger**: sink writes run inside ``netflow.wan(remote_region)``
+  so every cross-region byte is double-booked into
+  ``weedtpu_wan_bytes_total`` beside the class=replication ledger.
+
+Resilience (PR 8 layer, replacing the old fixed ``stop.wait(2.0)``
+reconnect sleep and hand-rolled ``2**attempt`` apply retries):
+reconnects pace on a decorrelated-jitter ``Backoff``
+(``WEEDTPU_SYNC_BACKOFF_BASE``/``_CAP``) and spend class=replication
+retry-budget tokens — an exhausted budget parks the pump at the cap so a
+dead region can't turn N pumps into a retry storm.
 """
 
 from __future__ import annotations
@@ -16,16 +47,41 @@ import json
 import logging
 import os
 import threading
+import time
 import urllib.parse
 import urllib.request
 import zlib
 
 from seaweedfs_tpu.replication.sink import FilerSink, Replicator
 from seaweedfs_tpu.security.tls import scheme as _tls_scheme
+from seaweedfs_tpu.stats import netflow as _netflow
+from seaweedfs_tpu.stats import trace as _trace
+from seaweedfs_tpu.utils import resilience as _res
+from seaweedfs_tpu.utils.http import PooledHTTP
 
 MAX_APPLY_RETRIES = 5
 
 log = logging.getLogger("filer.sync")
+
+
+def geo_obs_enabled() -> bool:
+    """Observatory switch, read per event (the bench flips it between
+    interleaved reps to price the lag plane itself)."""
+    return os.environ.get("WEEDTPU_GEO_OBS", "1") != "0"
+
+
+def _sync_backoff() -> "_res.Backoff":
+    return _res.Backoff(
+        base=float(os.environ.get("WEEDTPU_SYNC_BACKOFF_BASE", "0.5")),
+        cap=float(os.environ.get("WEEDTPU_SYNC_BACKOFF_CAP", "15")))
+
+
+def stall_after_s() -> float:
+    return float(os.environ.get("WEEDTPU_SYNC_STALL_AFTER", "30"))
+
+
+def backlog_interval_s() -> float:
+    return float(os.environ.get("WEEDTPU_SYNC_BACKLOG_INTERVAL", "5"))
 
 
 def filer_signature(filer_url: str) -> int:
@@ -83,38 +139,146 @@ class SyncDirection:
 
     def __init__(self, src: str, dst: str, prefix: str = "/",
                  offsets: SyncOffsetStore | None = None,
-                 timeout: float = 60.0, sink=None):
+                 timeout: float = 60.0, sink=None,
+                 region: str = "", remote_region: str = ""):
         """`sink` defaults to a FilerSink on `dst`; pass any
         ReplicationSink (e.g. LocalSink for filer.backup) to replicate
-        into something other than a peer filer."""
+        into something other than a peer filer.  `region` names the
+        pump's home region (it runs beside its SOURCE filer) and
+        `remote_region` the sink's, for the WAN ledger and region
+        faults; both default to "" — single-region pumps pay nothing."""
         self.src, self.dst = src, dst
         self.prefix = prefix
         self.offsets = offsets or SyncOffsetStore(None)
         self.key = f"{src}=>{dst}"
+        # metric/trace label: region pair when known ("a->b"), else the
+        # netloc pair — region names keep the label space bounded
+        self.direction = (f"{region}->{remote_region}"
+                          if region and remote_region else self.key)
         self.src_sig = filer_signature(src)
         self.dst_sig = filer_signature(dst)
         self.timeout = timeout
+        self.region = region
+        self.remote_region = remote_region
+        # one pool for source reads, backlog polls, AND sink writes:
+        # replication bytes ride the netflow ledger, breakers, and
+        # deadline clamps like every other caller's
+        self.http = PooledHTTP(timeout=timeout, role="replicator",
+                               region=region)
         if sink is None:
-            sink = FilerSink(dst, signature=self.src_sig, timeout=timeout)
+            # retries=1: _apply owns the (budgeted, offset-replaying)
+            # retry loop — a second layer inside the sink would multiply
+            # worst-case stall detection into minutes
+            sink = FilerSink(dst, signature=self.src_sig, timeout=timeout,
+                             http=self.http, region=remote_region,
+                             retries=1)
         self.replicator = Replicator(sink, self._read_source_file, prefix)
         self.applied = 0
         self.skipped = 0
+        self.errors = 0
+        self.backlog = 0
+        self.stalled = False
+        # progress clock: the timestamp replication is known caught up
+        # to (applied event ts, or "now" on a live keepalive).  Lag is
+        # now minus this.
+        self.last_progress = time.time()
+        self.last_trace_id = ""
+        self._backoff = _sync_backoff()
+        self._last_backlog_poll = 0.0
+        self._stop: threading.Event | None = None
+
+    # -- observatory ------------------------------------------------------
+
+    def _gauges(self):
+        from seaweedfs_tpu.stats import metrics as _metrics
+        return _metrics
+
+    def lag_s(self, now: float | None = None) -> float:
+        return max(0.0, (now or time.time()) - self.last_progress)
+
+    def _note_progress(self, event_ts_ns: int | None = None) -> None:
+        """An event applied/skipped (confirmed up to its ts), or a live
+        keepalive (confirmed up to now)."""
+        now = time.time()
+        self.last_progress = now if event_ts_ns is None \
+            else min(now, event_ts_ns / 1e9)
+        self._backoff.reset()
+        if not geo_obs_enabled():
+            return
+        m = self._gauges()
+        m.REPLICATION_LAG.labels(self.direction).set(self.lag_s(now))
+        if self.stalled:
+            self.stalled = False
+            m.REPLICATION_STALLED.labels(self.direction).set(0)
+
+    def _note_error(self) -> None:
+        """A stream/apply error: refresh the lag gauge from the frozen
+        progress clock and raise the stalled flag once the stall window
+        has passed with no progress."""
+        self.errors += 1
+        if not geo_obs_enabled():
+            return
+        m = self._gauges()
+        m.REPLICATION_ERRORS.labels(self.direction).inc()
+        lag = self.lag_s()
+        m.REPLICATION_LAG.labels(self.direction).set(lag)
+        if lag > stall_after_s():
+            self.stalled = True
+            m.REPLICATION_STALLED.labels(self.direction).set(1)
+
+    def _poll_backlog(self, force: bool = False) -> None:
+        """Refresh backlog depth (source meta-log head minus our resume
+        offset) from the source's digest endpoint — cheap head read, no
+        tree walk.  Best effort: a dead source keeps the last value."""
+        if not geo_obs_enabled():
+            return
+        now = time.monotonic()
+        if not force and now - self._last_backlog_poll < \
+                backlog_interval_s():
+            return
+        self._last_backlog_poll = now
+        url = (f"{_tls_scheme()}://{self.src}/__meta__/digest?"
+               + urllib.parse.urlencode({
+                   "prefix": self.prefix, "digest": "0",
+                   "since": str(self.offsets.get(self.key))}))
+        try:
+            status, _, body = self.http.request(url, timeout=self.timeout)
+            if status != 200:
+                return
+            self.backlog = int(json.loads(body).get("backlog_events", 0))
+            self._gauges().REPLICATION_BACKLOG.labels(self.direction).set(
+                self.backlog)
+        except (OSError, ValueError):
+            pass
+
+    def status(self) -> dict:
+        return {"src": self.src, "dst": self.dst, "prefix": self.prefix,
+                "region": self.region, "remote_region": self.remote_region,
+                "applied": self.applied, "skipped": self.skipped,
+                "errors": self.errors, "backlog": self.backlog,
+                "direction": self.direction,
+                "lag_s": round(self.lag_s(), 3), "stalled": self.stalled,
+                "offset_ts_ns": self.offsets.get(self.key),
+                "last_trace_id": self.last_trace_id}
+
+    # -- pump -------------------------------------------------------------
 
     def _read_source_file(self, path: str) -> bytes:
+        from seaweedfs_tpu.replication.sink import HTTPStatusError
         url = f"{_tls_scheme()}://{self.src}{urllib.parse.quote(path)}"
-        try:
-            with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                return r.read()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                # the file was deleted/renamed after this event was logged;
-                # a later event supersedes it — skip, don't stall the stream
-                raise FileNotFoundError(path) from e
-            raise
+        status, _, body = self.http.request(url, timeout=self.timeout)
+        if status == 404:
+            # the file was deleted/renamed after this event was logged;
+            # a later event supersedes it — skip, don't stall the stream
+            raise FileNotFoundError(path)
+        if status >= 400:
+            raise HTTPStatusError(status, url)
+        return body
 
     def run(self, stop: threading.Event, live: bool = True) -> None:
         """Pump events until `stop` is set (or the replay drains when
         live=False)."""
+        self._stop = stop
         while not stop.is_set():
             since = self.offsets.get(self.key)
             url = (f"{_tls_scheme()}://{self.src}/__meta__/subscribe?"
@@ -123,15 +287,20 @@ class SyncDirection:
                        "prefix": self.prefix,
                        "live": "true" if live else "false"}))
             try:
+                self._poll_backlog(force=True)
                 with urllib.request.urlopen(url, timeout=self.timeout) as r:
                     for raw in r:
                         if stop.is_set():
                             return
                         line = raw.strip()
                         if not line:
-                            continue  # keepalive
+                            # keepalive: the stream is live and drained —
+                            # replication is caught up as of now
+                            self._note_progress()
+                            self._poll_backlog()
+                            continue
                         ev = json.loads(line)
-                        if not self._apply(ev):
+                        if not self._apply(ev, stop):
                             # event still failing after retries: reconnect
                             # from the last good offset rather than skip it
                             raise ConnectionError("replicate failed; "
@@ -142,61 +311,138 @@ class SyncDirection:
                     json.JSONDecodeError, TimeoutError) as e:
                 if not live:
                     raise
-                log.warning("%s: stream error, reconnecting: %s",
-                            self.key, e)
-                stop.wait(2.0)
+                self._note_error()
+                self._poll_backlog(force=True)
+                delay = self._backoff.next()
+                if not _res.spend_retry("replication"):
+                    # budget exhausted: park at the cap — the damper
+                    # working, not a bug (see utils/resilience.py)
+                    delay = max(delay, self._backoff.cap)
+                log.warning("%s: stream error, reconnecting in %.1fs: %s",
+                            self.key, delay, e)
+                stop.wait(delay)
 
-    def _apply(self, ev: dict) -> bool:
+    def _replicate_observed(self, ev: dict) -> bool:
+        """One replicate pass under the observatory: class=replication
+        netflow, a fresh sampled root span both regions' servers will
+        parent to, and WAN booking on the sink side (the sink enters
+        ``wan(remote_region)`` itself — the source read is local)."""
+        if not geo_obs_enabled():
+            with _netflow.flow("replication"):
+                return self.replicator.replicate(ev)
+        t = _trace.new_root(sampled=True)
+        tok = _trace._current.set(t)
+        try:
+            path = (ev.get("new_entry") or ev.get("old_entry")
+                    or {}).get("full_path", "")
+            with _netflow.flow("replication"), \
+                    _trace.span("sync.apply", server="replicator",
+                                direction=self.direction, path=path,
+                                region=self.region):
+                return self.replicator.replicate(ev)
+        finally:
+            _trace._current.reset(tok)
+            self.last_trace_id = t.trace_id
+
+    def _apply(self, ev: dict, stop: threading.Event | None = None) -> bool:
         """Apply one event; the offset advances ONLY on success so a
         transient sink failure re-replays instead of silently dropping
         (events are idempotent overwrites)."""
+        stop = stop or self._stop
         if self.dst_sig in (ev.get("signatures") or []):
             self.skipped += 1  # originated on dst; don't echo back
+            if geo_obs_enabled():
+                self._gauges().REPLICATION_SKIPPED.labels(self.direction).inc()
+            self._note_progress(ev["ts_ns"])
             self.offsets.put(self.key, ev["ts_ns"])
             return True
         path = (ev.get("new_entry") or ev.get("old_entry")
                 or {}).get("full_path")
-        for attempt in range(MAX_APPLY_RETRIES):
-            try:
-                if self.replicator.replicate(ev):
-                    self.applied += 1
-                self.offsets.put(self.key, ev["ts_ns"])
-                return True
-            except FileNotFoundError:
-                # source content gone; a later event will converge the sink
-                self.skipped += 1
-                self.offsets.put(self.key, ev["ts_ns"])
-                return True
-            except Exception as e:
-                log.warning("%s: replicate %s failed (try %d/%d): %s",
-                            self.key, path, attempt + 1, MAX_APPLY_RETRIES, e)
-                if attempt + 1 < MAX_APPLY_RETRIES:
-                    import time
-                    time.sleep(min(2 ** attempt, 10))
-        return False
+
+        def giveup(e: BaseException) -> bool:
+            # deleted-at-source is handled by the caller, and client
+            # errors (HTTP < 500) won't heal by retrying
+            return isinstance(e, FileNotFoundError) or \
+                getattr(e, "code", 500) < 500
+
+        try:
+            did = _res.retry_call(
+                lambda: self._replicate_observed(ev),
+                attempts=MAX_APPLY_RETRIES, base=self._backoff.base,
+                cap=10.0, cls="replication", retry_on=(Exception,),
+                giveup=giveup,
+                sleep=(stop.wait if stop is not None else time.sleep))
+        except FileNotFoundError:
+            # source content gone; a later event will converge the sink
+            self.skipped += 1
+            if geo_obs_enabled():
+                self._gauges().REPLICATION_SKIPPED.labels(self.direction).inc()
+            self._note_progress(ev["ts_ns"])
+            self.offsets.put(self.key, ev["ts_ns"])
+            return True
+        except Exception as e:
+            log.warning("%s: replicate %s failed after %d tries: %s",
+                        self.key, path, MAX_APPLY_RETRIES, e)
+            self._note_error()
+            return False
+        if did:
+            self.applied += 1
+            if geo_obs_enabled():
+                self._gauges().REPLICATION_APPLIED.labels(self.direction).inc()
+        self._note_progress(ev["ts_ns"])
+        self.offsets.put(self.key, ev["ts_ns"])
+        return True
 
 
 class FilerSync:
-    """Bidirectional filer.sync (reference: weed filer.sync -a -b)."""
+    """Bidirectional filer.sync (reference: weed filer.sync -a -b).
+
+    With region names attached (the GeoCluster harness does), each
+    direction labels its WAN bytes and the divergence auditor
+    (stats/canary.DivergenceAuditor) rides along, proving both filers'
+    subtree digests converge."""
 
     def __init__(self, filer_a: str, filer_b: str, prefix: str = "/",
-                 offset_path: str | None = None, one_way: bool = False):
+                 offset_path: str | None = None, one_way: bool = False,
+                 region_a: str = "", region_b: str = ""):
         offsets = SyncOffsetStore(offset_path)
-        self.a2b = SyncDirection(filer_a, filer_b, prefix, offsets)
-        self.b2a = None if one_way else SyncDirection(filer_b, filer_a,
-                                                      prefix, offsets)
+        self.a2b = SyncDirection(filer_a, filer_b, prefix, offsets,
+                                 region=region_a, remote_region=region_b)
+        self.b2a = None if one_way else SyncDirection(
+            filer_b, filer_a, prefix, offsets,
+            region=region_b, remote_region=region_a)
         self.stop_event = threading.Event()
         self._threads: list[threading.Thread] = []
+        self.auditor = None
+        if not one_way:
+            from seaweedfs_tpu.stats.canary import DivergenceAuditor
+            self.auditor = DivergenceAuditor(filer_a, filer_b, prefix,
+                                             region_a=region_a,
+                                             region_b=region_b)
 
     def start(self) -> None:
-        for d in filter(None, (self.a2b, self.b2a)):
+        for d in self.directions():
             th = threading.Thread(target=d.run, args=(self.stop_event,),
                                   daemon=True, name=f"sync-{d.key}")
             th.start()
             self._threads.append(th)
+        if self.auditor is not None:
+            self.auditor.start()
+
+    def directions(self) -> list[SyncDirection]:
+        return [d for d in (self.a2b, self.b2a) if d is not None]
+
+    def status(self) -> dict:
+        out = {"directions": {d.key: d.status()
+                              for d in self.directions()}}
+        if self.auditor is not None:
+            out["audit"] = self.auditor.status()
+        return out
 
     def stop(self) -> None:
         self.stop_event.set()
+        if self.auditor is not None:
+            self.auditor.stop()
         for th in self._threads:
             th.join(5)
         self.a2b.offsets.flush()  # both directions share the store

@@ -20,6 +20,17 @@ from seaweedfs_tpu.security.tls import scheme as _tls_scheme
 log = logging.getLogger("replication.sink")
 
 
+class HTTPStatusError(OSError):
+    """An HTTP error status from a PooledHTTP call (which never raises
+    on statuses itself).  Carries ``.code`` like urllib's HTTPError so
+    the shared retry giveup can treat both the same."""
+
+    def __init__(self, code: int, url: str):
+        super().__init__(f"HTTP {code} from {url}")
+        self.code = code
+        self.url = url
+
+
 def retry(fn, attempts: int = 4, base_delay: float = 0.5,
           retriable=(urllib.error.URLError, ConnectionError, OSError)):
     """Budgeted jittered retry for sink IO (reference: util.Retry wraps
@@ -27,12 +38,12 @@ def retry(fn, attempts: int = 4, base_delay: float = 0.5,
     drops the event permanently.  Rides the unified resilience layer:
     decorrelated-jitter delays, and every retry spends a token from the
     process-wide budget so a down replication target can't storm.
-    Client errors (HTTP < 500) won't heal by retrying and raise
-    immediately."""
+    Client errors (HTTP < 500 — urllib HTTPError or our own
+    HTTPStatusError) won't heal by retrying and raise immediately."""
     from seaweedfs_tpu.utils import resilience
 
     def giveup(e: BaseException) -> bool:
-        return isinstance(e, urllib.error.HTTPError) and e.code < 500
+        return getattr(e, "code", 500) < 500
 
     def wrapped():
         try:
@@ -80,16 +91,34 @@ class ReplicationSink:
 
 class FilerSink(ReplicationSink):
     """Replicate into another filer over its HTTP API, stamping the
-    configured signature for sync-loop prevention."""
+    configured signature for sync-loop prevention.  Writes ride a
+    PooledHTTP (deadline clamps, breakers, netflow/trace headers) —
+    raw urllib kept replication bytes invisible to the byte ledger —
+    and, when a remote region is named, run inside ``netflow.wan()``
+    so the WAN ledger books every cross-region byte."""
 
     name = "filer"
 
     def __init__(self, filer_url: str, path_prefix: str = "/",
-                 signature: int = 0, timeout: float = 60.0):
+                 signature: int = 0, timeout: float = 60.0,
+                 http=None, region: str = "", retries: int = 4):
         self.filer_url = filer_url
         self.prefix = path_prefix.rstrip("/")
         self.signature = signature
         self.timeout = timeout
+        # sink-level retry attempts.  The sync pump passes 1: its _apply
+        # loop already does budgeted retries AND re-replays from the
+        # offset, and stacking the two layers multiplies worst-case
+        # stall detection from seconds into minutes.  Standalone users
+        # (filer.backup, cloud sinks) keep the default — this is their
+        # only retry layer.
+        self.retries = retries
+        # the REMOTE region this sink writes toward ("" = same region)
+        self.region = region
+        if http is None:
+            from seaweedfs_tpu.utils.http import PooledHTTP
+            http = PooledHTTP(timeout=timeout, role="replicator")
+        self.http = http
         # transient, set per-event by the Replicator: the event's existing
         # signature chain, forwarded so ring topologies terminate
         self.event_signatures: list[int] = []
@@ -103,39 +132,42 @@ class FilerSink(ReplicationSink):
     def _url(self, path: str) -> str:
         return f"{_tls_scheme()}://{self.filer_url}{urllib.parse.quote(self.prefix + path)}"
 
+    def _request(self, url: str, method: str, body: bytes | None,
+                 headers: dict, ok_statuses=()) -> None:
+        from seaweedfs_tpu.stats import netflow as _netflow
+        if self.region:
+            with _netflow.wan(self.region):
+                status, _, _ = self.http.request(
+                    url, method, body, headers, timeout=self.timeout)
+        else:
+            status, _, _ = self.http.request(
+                url, method, body, headers, timeout=self.timeout)
+        if status >= 400 and status not in ok_statuses:
+            raise HTTPStatusError(status, url)
+
     def create_entry(self, path: str, entry: dict, data: bytes | None) -> None:
         if entry_is_directory(entry):
-            req = urllib.request.Request(self._url(path.rstrip("/") + "/"),
-                                         data=b"", method="POST",
-                                         headers=self._headers())
+            url = self._url(path.rstrip("/") + "/")
+            headers = self._headers()
+            body: bytes = b""
         else:
+            url = self._url(path)
             headers = self._headers()
             attr = entry.get("attr") or {}
             if attr.get("mime"):
                 headers["Content-Type"] = attr["mime"]
             for k, v in (entry.get("extended") or {}).items():
                 headers[f"Seaweed-{k}"] = v
-            req = urllib.request.Request(self._url(path), data=data or b"",
-                                         method="POST", headers=headers)
-
-        def send():
-            with urllib.request.urlopen(req, timeout=self.timeout):
-                pass
-        retry(send)
+            body = data or b""
+        retry(lambda: self._request(url, "POST", body, headers),
+              attempts=self.retries)
 
     def delete_entry(self, path: str, is_directory: bool) -> None:
         url = self._url(path) + "?recursive=true"
-        req = urllib.request.Request(url, method="DELETE",
-                                     headers=self._headers())
-
-        def send():
-            try:
-                with urllib.request.urlopen(req, timeout=self.timeout):
-                    pass
-            except urllib.error.HTTPError as e:
-                if e.code != 404:
-                    raise
-        retry(send)
+        # 404 tolerated: the entry may never have replicated
+        retry(lambda: self._request(url, "DELETE", None, self._headers(),
+                                    ok_statuses=(404,)),
+              attempts=self.retries)
 
 
 class LocalSink(ReplicationSink):

@@ -71,9 +71,15 @@ class FilerServer:
                  encrypt_data: bool = False,
                  chunk_cache_mem: int = 32 * 1024 * 1024,
                  chunk_cache_disk: int = 0, store_kind: str | None = None,
-                 aggregate_peers: bool = False):
+                 aggregate_peers: bool = False, region: str | None = None):
         self.master_url = master_url
         self.host, self.port = host, port
+        # geo region this filer serves in ("" = single-region): stamped
+        # on trace spans so /cluster/trace waterfalls show which side of
+        # the WAN each hop ran on, and registered with the fault plane
+        # so region_partition/wan_latency chaos can find us
+        self.region = os.environ.get("WEEDTPU_GEO_REGION", "") \
+            if region is None else region
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
@@ -117,11 +123,13 @@ class FilerServer:
         self.app = web.Application(
             client_max_size=1024 * 1024 * 1024,
             middlewares=[trace.aiohttp_middleware(
-                "filer", slow_exempt=("/__meta__/subscribe",))])
+                "filer", slow_exempt=("/__meta__/subscribe",),
+                region=self.region)])
         netflow.install(self.app, "filer")
         self.app.add_routes(trace.debug_routes())
         self.app.add_routes([
             web.get("/__meta__/subscribe", self.handle_meta_subscribe),
+            web.get("/__meta__/digest", self.handle_meta_digest),
             web.post("/__admin__/entry", self.handle_raw_entry),
             web.get("/status", self.handle_server_status),
             web.get("/__admin__/filer_conf", self.handle_get_conf),
@@ -236,6 +244,8 @@ class FilerServer:
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         from seaweedfs_tpu.maintenance import faults as _faults
         _faults.register_node(self.url, "filer")
+        if self.region:
+            _faults.register_region(self.url, self.region)
         log.info("filer listening on %s", self.url)
 
     async def _register_loop(self) -> None:
@@ -1602,12 +1612,37 @@ class FilerServer:
             self._local_subscribers.discard(q)
         return resp
 
+    async def handle_meta_digest(self, req: web.Request) -> web.Response:
+        """/__meta__/digest?prefix=&since=&digest=0|1: the geo
+        observatory's convergence probe.  Returns the meta-log head
+        ts_ns and the backlog of events newer than `since` (the sync
+        pump differences its resume offset against this for backlog
+        depth — digest=0 skips the tree walk for that cheap path), plus
+        a deterministic subtree content digest (path+size+md5, no fids
+        or mtimes — see Filer.subtree_digest) the divergence auditor
+        compares across regions."""
+        prefix = req.query.get("prefix", "/") or "/"
+        try:
+            since = int(req.query.get("since", "0"))
+        except ValueError:
+            return web.json_response({"error": "bad since"}, status=400)
+        out = {"prefix": prefix, "region": self.region,
+               "head_ts_ns": self.filer.meta_log.head_ts(),
+               "backlog_events": await asyncio.to_thread(
+                   self.filer.meta_log.backlog_count, since, prefix)}
+        if req.query.get("digest", "1") != "0":
+            digest, entries = await asyncio.to_thread(
+                self.filer.subtree_digest, prefix)
+            out["digest"] = digest
+            out["entries"] = entries
+        return web.json_response(out)
+
     # -- admin ---------------------------------------------------------
 
     async def handle_server_status(self, req: web.Request) -> web.Response:
         return web.json_response({
             "version": "weedtpu", "role": "filer", "url": self.url,
-            "master": self.master_url,
+            "master": self.master_url, "region": self.region,
         })
 
     # -- remote mount mappings (reference: filer/remote_mapping.go) ----

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import secrets
 import time
 
@@ -42,8 +43,15 @@ class MasterServer:
                  grow_count: int = 1, security=None,
                  node_timeout: float = 25.0,
                  peers: list[str] | None = None,
-                 raft_state_dir: str | None = None):
+                 raft_state_dir: str | None = None,
+                 region: str | None = None):
         self.host, self.port = host, port
+        # geo observatory: which region this master (and its cluster)
+        # lives in — stamped on every server span so /cluster/trace can
+        # prove a write crossed the WAN, and matched by region-scoped
+        # fault rules (region_partition/wan_latency)
+        self.region = (os.environ.get("WEEDTPU_GEO_REGION", "")
+                       if region is None else region)
         self.security = security
         self.guard = security.guard if security is not None else None
         sequencer = None
@@ -72,7 +80,6 @@ class MasterServer:
             others = [p for p in peers if p != me]
             state_path = None
             if raft_state_dir:
-                import os
                 os.makedirs(raft_state_dir, exist_ok=True)
                 state_path = os.path.join(
                     raft_state_dir, f"raft_{port}.json")
@@ -87,7 +94,8 @@ class MasterServer:
             client_max_size=64 * 1024 * 1024,
             middlewares=[self._guard_middleware,
                          trace.aiohttp_middleware(
-                             "master", slow_exempt=("/cluster/stream",))])
+                             "master", slow_exempt=("/cluster/stream",),
+                             region=self.region)])
         self.app.add_routes(trace.debug_routes())
         self.app.add_routes([
             web.route("*", "/dir/assign", self.handle_assign),
@@ -135,6 +143,7 @@ class MasterServer:
             web.get("/cluster/alerts", self.handle_cluster_alerts),
             web.get("/cluster/loops", self.handle_cluster_loops),
             web.get("/cluster/dashboard", self.handle_cluster_dashboard),
+            web.get("/cluster/geo", self.handle_cluster_geo),
             web.get("/", self.handle_ui),
         ])
         netflow.install(self.app, "master")
@@ -256,6 +265,8 @@ class MasterServer:
         profile.ensure_started()  # WEEDTPU_PROFILE_HZ, process-wide
         from seaweedfs_tpu.maintenance import faults as _faults
         _faults.register_node(self.url, "master")
+        if self.region:
+            _faults.register_region(self.url, self.region)
         self.aggregator.start()
         self.canary.start()  # WEEDTPU_CANARY_INTERVAL <= 0 disables
         if self.raft:
@@ -478,6 +489,13 @@ class MasterServer:
         iv = self.aggregator.interval
         iv = iv if iv > 0 else None
         try:
+            # geo observatory synthesis MUST precede history.record so
+            # the lag/stall series land in the same tick they derive from
+            with self.loops.tick("geo", interval=iv):
+                self._geo_synth(per_node)
+        except Exception:
+            log.warning("geo synthesis failed", exc_info=True)
+        try:
             with self.loops.tick("history_record", interval=iv) as lt:
                 lt.items = len(per_node)
                 self.history.record(ts, per_node)
@@ -516,6 +534,137 @@ class MasterServer:
             self.loops.refresh_accounting()
         except Exception:
             log.warning("loop accounting refresh failed", exc_info=True)
+
+    # -- geo-replication observatory --------------------------------------
+
+    _GEO_SYNTH = (("weedtpu_replication_lag_seconds",
+                   "geo_replication_lag_s"),
+                  ("weedtpu_replication_stalled",
+                   "geo_replication_stalled"))
+
+    def _geo_synth(self, per_node: dict) -> None:
+        """Collapse the pump-exported replication gauges into
+        per-direction MAX series under a ``__geo__`` pseudo-node (same
+        trick as the aggregator's ``__aggregator__`` staleness gauges).
+        Needed because gauges from nodes sharing one in-process registry
+        (every test topology) SUM in the history store — N nodes would
+        report N× the true lag; max is the honest fleet signal, and it
+        is what the default replication_stalled / replication_lag_high
+        rules watch."""
+        best: dict[tuple[str, str], float] = {}
+        for node, fams in per_node.items():
+            if node.startswith("__"):
+                continue
+            for raw, synth in self._GEO_SYNTH:
+                fam = fams.get(raw)
+                if not fam:
+                    continue
+                for _name, labels, value in fam.get("samples", ()):
+                    if value != value:  # NaN
+                        continue
+                    key = (synth, labels.get("direction", ""))
+                    if value > best.get(key, float("-inf")):
+                        best[key] = value
+        if not best:
+            return  # no pumps anywhere: don't invent empty series
+        out: dict[str, dict] = {}
+        for (synth, direction), value in sorted(best.items()):
+            fam = out.setdefault(synth, {
+                "type": "gauge",
+                "help": "geo observatory synthesis (max across nodes)",
+                "samples": []})
+            fam["samples"].append((synth, {"direction": direction}, value))
+        per_node["__geo__"] = out
+
+    def _geo_fold(self, fname: str, label_keys: tuple[str, ...]
+                  ) -> dict[tuple, float]:
+        """MAX-fold one scraped family across the last scrape's nodes,
+        keyed by the given label values (shared-registry dedup, same
+        rationale as _geo_synth)."""
+        best: dict[tuple, float] = {}
+        for fams in self.aggregator.per_node.values():
+            fam = fams.get(fname)
+            if not fam:
+                continue
+            for _name, labels, value in fam.get("samples", ()):
+                if value != value:
+                    continue
+                key = tuple(labels.get(k, "") for k in label_keys)
+                if value > best.get(key, float("-inf")):
+                    best[key] = value
+        return best
+
+    def geo_status(self) -> dict:
+        """The /cluster/geo payload: per-direction replication lag,
+        backlog, counters and stall flags (from the last scrape),
+        apply/WAN throughput (from the history store), divergence-audit
+        state, WAN byte totals, registered peer masters, and the two
+        geo alert rules' states.  Cached-state only — never blocks on a
+        fleet fan-out (?refresh=1 on the handler scrapes first)."""
+        directions: dict[str, dict] = {}
+        for fname, field in (
+                ("weedtpu_replication_lag_seconds", "lag_s"),
+                ("weedtpu_replication_backlog_events", "backlog_events"),
+                ("weedtpu_replication_stalled", "stalled"),
+                ("weedtpu_replication_applied_total", "applied"),
+                ("weedtpu_replication_skipped_total", "skipped"),
+                ("weedtpu_replication_errors_total", "errors")):
+            for (d,), v in self._geo_fold(fname, ("direction",)).items():
+                directions.setdefault(d, {})[field] = v
+        try:
+            res = self.history.query(
+                "weedtpu_replication_applied_total", None, 120.0, None,
+                "rate")
+            for vec in res.get("vectors", []):
+                d = vec["labels"].get("direction", "")
+                pts = [v for _, v in vec["points"] if v is not None]
+                if d in directions and pts:
+                    directions[d]["apply_rate_eps"] = pts[-1]
+        except Exception:
+            log.warning("geo throughput query failed", exc_info=True)
+        wan = {"sent_bytes": netflow.wan_total("sent"),
+               "recv_bytes": netflow.wan_total("recv"),
+               "by_region": {}}
+        for (direction, cls, region), v in self._geo_fold(
+                "weedtpu_wan_bytes_total",
+                ("direction", "class", "region")).items():
+            wan["by_region"].setdefault(region, {}).setdefault(
+                direction, {})[cls] = v
+        divergence = {
+            "prefixes": {p: v for (p,), v in self._geo_fold(
+                "weedtpu_geo_divergence", ("prefix",)).items()},
+            "audits": {o: v for (o,), v in self._geo_fold(
+                "weedtpu_geo_audits_total", ("outcome",)).items()}}
+        horizon = time.time() - 30.0
+        peers = sorted(
+            a for a, ts in self.cluster_members.get(
+                "peer_master", {}).items() if ts > horizon)
+        alerts = {}
+        try:
+            for r in self.alerts.status().get("rules", []):
+                if r["name"] in ("replication_stalled",
+                                 "replication_lag_high"):
+                    alerts[r["name"]] = r["state"]
+        except Exception:
+            log.warning("geo alert status failed", exc_info=True)
+        return {"region": self.region, "peers": peers,
+                "directions": directions, "wan": wan,
+                "divergence": divergence, "alerts": alerts}
+
+    async def handle_cluster_geo(self, req: web.Request) -> web.Response:
+        """/cluster/geo: the geo-replication observatory headline.
+        Loopback-gated (names nodes, prefixes and trace ids).
+        ?refresh=1 runs one scrape tick first so tests and operators get
+        a deterministic fresh view."""
+        err = trace.loopback_error(req)
+        if err is not None:
+            return err
+        if req.query.get("refresh"):
+            try:
+                await asyncio.to_thread(self.aggregator.scrape_once)
+            except Exception:
+                log.warning("geo refresh pull failed", exc_info=True)
+        return web.json_response(await asyncio.to_thread(self.geo_status))
 
     # -- historical telemetry plane --------------------------------------
 
@@ -930,12 +1079,18 @@ class MasterServer:
             return err
         return web.json_response(await asyncio.to_thread(self.collect_perf))
 
-    def collect_trace(self, tid: str) -> dict:
+    def collect_trace(self, tid: str, federate: bool = True) -> dict:
         """One trace id -> a single parent-ordered waterfall stitched
         from every node's span ring (each fan-out carries pin=1, so the
         spans survive ring wrap on all hops while someone is looking).
         Thread-safe sync function: handlers call it via to_thread, the
-        canary via the same route on failures."""
+        canary via the same route on failures.
+
+        With ``federate`` (the default), registered peer masters — the
+        other region's cluster — are asked for THEIR stitched view of
+        the same id (``?local=1`` stops the recursion there), so a
+        replicated write's waterfall crosses the WAN: assemble()'s
+        ``regions`` list carries both region tags."""
         trace.pin_trace(tid)  # local ring first (and retro-keep it)
         spans: list[dict] = []
         for rec in trace.traces(tid=tid):
@@ -950,7 +1105,24 @@ class MasterServer:
                     s = dict(s)
                     s.setdefault("node", node)
                     spans.append(s)
-        wf = trace.assemble(spans)
+        if federate:
+            import json as _json
+            horizon = time.time() - 30.0
+            peers = sorted(
+                a for a, ts in self.cluster_members.get(
+                    "peer_master", {}).items() if ts > horizon)
+            for peer in peers:
+                try:
+                    status, _, body = self.aggregator.pool.request(
+                        f"{_tls_scheme()}://{peer}/cluster/trace/{tid}"
+                        "?local=1", timeout=5.0)
+                    if status == 200:
+                        spans.extend(_json.loads(body).get("spans", []))
+                    elif status != 404:  # absent-there is not an error
+                        errors[peer] = f"HTTP {status}"
+                except Exception as e:
+                    errors[peer] = str(e) or type(e).__name__
+        wf = trace.assemble(spans)  # dedupes by span id across regions
         if errors:
             wf["node_errors"] = errors
         return wf
@@ -1011,7 +1183,10 @@ class MasterServer:
                                  for c in tid):
             return web.json_response({"error": "bad trace id"},
                                      status=400)
-        result = await asyncio.to_thread(self.collect_trace, tid)
+        # ?local=1: a federating peer is asking — answer from this
+        # region only, or two peers would ping-pong forever
+        result = await asyncio.to_thread(
+            self.collect_trace, tid, req.query.get("local") != "1")
         if not result["spans"]:
             # keep node_errors in the 404: "trace expired" and "every
             # node's debug gate refused the master" must be
@@ -1108,6 +1283,14 @@ class MasterServer:
             snap["autopilot"] = self.autopilot.headline()
         except Exception:
             log.warning("autopilot status failed", exc_info=True)
+        try:
+            # geo observatory headline (cached scrape state only;
+            # /cluster/geo has the same view with ?refresh=1)
+            geo = self.geo_status()
+            if geo["directions"] or geo["peers"] or self.region:
+                snap["geo"] = geo
+        except Exception:
+            log.warning("geo status failed", exc_info=True)
         try:
             # control-plane loops headline (slowest loop + overruns);
             # /cluster/loops has per-loop detail and cardinality

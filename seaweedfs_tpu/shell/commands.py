@@ -782,6 +782,17 @@ def cmd_maintenance_status(env: CommandEnv, args, out):
         print(f"governor: {'on' if gov.get('enabled') else 'OFF'} "
               f"retunes={gov.get('retunes', 0)} {rates}"
               + (f"  index: {idx}" if idx else ""), file=out)
+    ge = st.get("geo") or {}
+    if ge.get("directions"):
+        # geo-replication one-liner (cluster.geo for the full observatory)
+        dirs = " ".join(
+            f"{d}={v.get('lag_s', 0):.2f}s"
+            + ("[STALLED]" if v.get("stalled") else "")
+            for d, v in sorted(ge["directions"].items()))
+        wan = ge.get("wan") or {}
+        print(f"geo: region={ge.get('region') or '-'} {dirs} "
+              f"wan sent={_fmt_bytes(wan.get('sent_bytes', 0))} "
+              f"recv={_fmt_bytes(wan.get('recv_bytes', 0))}", file=out)
     lp = st.get("loops") or {}
     if lp.get("headline"):
         # control-plane loops one-liner (cluster.loops for per-loop detail)
@@ -1160,6 +1171,62 @@ def cmd_cluster_alerts(env: CommandEnv, args, out):
             ex = f" trace={g['exemplar']}" if g.get("exemplar") else ""
             print(f"    {g['state'].upper():8s} {lbl} value={val}{ex}",
                   file=out)
+
+
+@command("cluster.geo")
+def cmd_cluster_geo(env: CommandEnv, args, out):
+    """Geo-replication observatory (/cluster/geo): per sync direction,
+    replication lag (seconds since the last applied event's mtime),
+    source backlog depth, applied/skipped/error counters and the stall
+    flag; plus the divergence auditor's verdict per prefix, WAN byte
+    totals by region, registered peer masters, and the geo alert
+    states.  -refresh runs one scrape tick first; -json dumps raw.
+    Runbook: replication_stalled fires -> cluster.geo (which direction?
+    backlog growing means the WAN link or the remote filer; errors
+    growing with zero backlog means a poisoned event) -> cluster.trace
+    <its last_trace_id> (where the apply died, which region's hop)."""
+    flags = parse_flags(args)
+    params = {"refresh": "1"} if "refresh" in flags else {}
+    st = env.master_get("/cluster/geo", **params)
+    if "json" in flags:
+        print(json.dumps(st, separators=(",", ":")), file=out)
+        return
+    region = st.get("region") or "-"
+    peers = ",".join(st.get("peers") or []) or "-"
+    print(f"region: {region}  peer_masters: {peers}", file=out)
+    dirs = st.get("directions") or {}
+    if not dirs:
+        print("no replication pumps reporting (FilerSync not running,"
+              " or no scrape yet: try -refresh)", file=out)
+    for d, rec in sorted(dirs.items()):
+        stall = "  STALLED" if rec.get("stalled") else ""
+        rate = rec.get("apply_rate_eps")
+        rate_s = f" rate={rate:.2f}/s" if rate is not None else ""
+        print(f"  {d:10s} lag={rec.get('lag_s', 0.0):8.2f}s "
+              f"backlog={rec.get('backlog_events', 0.0):g} "
+              f"applied={rec.get('applied', 0.0):g} "
+              f"skipped={rec.get('skipped', 0.0):g} "
+              f"errors={rec.get('errors', 0.0):g}"
+              f"{rate_s}{stall}", file=out)
+    div = st.get("divergence") or {}
+    for prefix, v in sorted((div.get("prefixes") or {}).items()):
+        verdict = "DIVERGED" if v else "clean"
+        print(f"  divergence {prefix}: {verdict}", file=out)
+    audits = div.get("audits") or {}
+    if audits:
+        print("  audits: " + " ".join(
+            f"{k}={v:g}" for k, v in sorted(audits.items())), file=out)
+    wan = st.get("wan") or {}
+    print(f"  wan: sent={wan.get('sent_bytes', 0.0):g}B "
+          f"recv={wan.get('recv_bytes', 0.0):g}B", file=out)
+    for region_, by_dir in sorted((wan.get("by_region") or {}).items()):
+        for direction, by_cls in sorted(by_dir.items()):
+            tot = sum(by_cls.values())
+            print(f"    -> {region_} {direction}={tot:g}B", file=out)
+    alerts = st.get("alerts") or {}
+    if alerts:
+        print("  alerts: " + " ".join(
+            f"{k}={v}" for k, v in sorted(alerts.items())), file=out)
 
 
 @command("cluster.loops")

@@ -612,7 +612,22 @@ _DEFAULT_ALERT_RULES = (
     # cost tracking node count? — then WEEDTPU_FANOUT_POOL or the
     # loop's own interval knob)
     "loop_overrun=threshold,series=weedtpu_loop_overrun_ratio,"
-    "agg=max,window=120,op=gt,value=1,for=30")
+    "agg=max,window=120,op=gt,value=1,for=30;"
+    # geo observatory (replication/filer_sync.py): a sync pump that is
+    # erroring AND hasn't applied anything for WEEDTPU_SYNC_STALL_AFTER
+    # seconds marks itself stalled; the rule thresholds the master's
+    # MAX-across-nodes synthesis of that flag.  Lag alone can't fire
+    # this — a quiet WAN link has high "lag" but nothing to ship
+    # (runbook: cluster.geo — which direction, backlog depth? — then
+    # cluster.trace of its last_trace_id)
+    "replication_stalled=threshold,series=geo_replication_stalled,"
+    "agg=max,window=60,op=gt,value=0,for=10,clear_for=10;"
+    # geo lag: events are flowing but the remote region is more than a
+    # minute behind — WAN latency injection or a saturated sink.  Uses
+    # the __geo__ synthesized series (max across pump directions), so
+    # N nodes sharing a registry can't inflate it
+    "replication_lag_high=threshold,series=geo_replication_lag_s,"
+    "agg=max,window=120,op=gt,value=60,for=30")
 
 
 def parse_alert_rules(spec: str | None = None) -> list[dict]:
@@ -1153,6 +1168,19 @@ alerts: <span class="badge {badge.get(alerts.get('state', ''), '')}">{_h(alerts.
 {sect("Repair backlog (unhealthy volumes)", "<table>" + _spark_row(
     store, "backlog", "weedtpu_volume_health", None, "max", rng, step)
     + "</table>")}
+{sect("Geo replication (lag s / backlog events / WAN B/s / divergence)",
+      "<table>" + _spark_row(
+          store, "lag", "geo_replication_lag_s", None, "max",
+          rng, step) + "</table>"
+      "<table>" + _spark_row(
+          store, "backlog", "weedtpu_replication_backlog_events", None,
+          "max", rng, step) + "</table>"
+      "<table>" + _spark_row(
+          store, "wan", "weedtpu_wan_bytes_total", {"direction": "sent"},
+          "rate", rng, step, combine="region") + "</table>"
+      "<table>" + _spark_row(
+          store, "divergence", "weedtpu_geo_divergence", None, "max",
+          rng, step) + "</table>")}
 {sect("Capacity forecasts",
       "<table><tr class='mut'><td>node</td><td>dir</td><td>used/total</td>"
       f"<td>fill rate</td><td>full in</td></tr>{disk_rows}</table>"

@@ -475,6 +475,58 @@ TENANT_BYTES = REGISTRY.counter(
     "weedtpu_tenant_bytes_total",
     "body bytes moved for a tenant by direction and op",
     ("tenant", "direction", "op"))
+# geo-replication observatory (replication/filer_sync.py): each
+# SyncDirection pump exports per-direction lag (now minus the
+# last-applied event's ts, refreshed by live-stream keepalives so an
+# idle healthy pipe reads ~0), backlog depth (source meta-log head
+# minus the resume offset), and applied/skipped/errors counters —
+# today's unexported Python attributes promoted to the wire.  The
+# stalled gauge is computed BY the pump (no progress for
+# WEEDTPU_SYNC_STALL_AFTER s while errors or backlog say there is
+# work) because the alert engine can't express that conjunction.
+REPLICATION_LAG = REGISTRY.gauge(
+    "weedtpu_replication_lag_seconds",
+    "per-direction replication lag: now minus last applied/confirmed "
+    "source event timestamp", ("direction",))
+REPLICATION_BACKLOG = REGISTRY.gauge(
+    "weedtpu_replication_backlog_events",
+    "per-direction replication backlog: source meta-log events newer "
+    "than the resume offset", ("direction",))
+REPLICATION_STALLED = REGISTRY.gauge(
+    "weedtpu_replication_stalled",
+    "1 while a sync direction has made no progress for the stall "
+    "window despite errors or backlog, else 0", ("direction",))
+REPLICATION_APPLIED = REGISTRY.counter(
+    "weedtpu_replication_applied_total",
+    "meta-log events applied to the remote filer", ("direction",))
+REPLICATION_SKIPPED = REGISTRY.counter(
+    "weedtpu_replication_skipped_total",
+    "meta-log events skipped by signature loop-prevention",
+    ("direction",))
+REPLICATION_ERRORS = REGISTRY.counter(
+    "weedtpu_replication_errors_total",
+    "sync pump apply/stream errors", ("direction",))
+# divergence auditor (stats/canary.py DivergenceAuditor): rolling
+# subtree digests pulled from both filers' /__meta__/digest — 0 means
+# byte-identical metadata trees, 1 means the regions have diverged.
+# Clean after heal is ROADMAP item 3's convergence proof.
+GEO_DIVERGENCE = REGISTRY.gauge(
+    "weedtpu_geo_divergence",
+    "1 while the two regions' subtree digests differ, 0 when "
+    "byte-identical", ("prefix",))
+GEO_AUDITS = REGISTRY.counter(
+    "weedtpu_geo_audits_total",
+    "divergence audit passes by outcome (clean/diverged/error)",
+    ("outcome",))
+# WAN ledger: bytes that crossed a region boundary, booked by netflow
+# alongside weedtpu_net_bytes_total whenever the ambient wan_region is
+# set (the sync pump sets it around cross-region calls).  The region
+# label names the REMOTE region so each side's sent/recv pairs
+# conserve per class, same as the PR 6 ledger.
+WAN_BYTES = REGISTRY.counter(
+    "weedtpu_wan_bytes_total",
+    "body bytes crossing a region boundary by direction, traffic "
+    "class, and remote region", ("direction", "class", "region"))
 MASTER_ASSIGN_COUNTER = REGISTRY.counter(
     "weedtpu_master_assign_total", "fid assignments", ("collection",))
 VOLUME_REQUEST_COUNTER = REGISTRY.counter(

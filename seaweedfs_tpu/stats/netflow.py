@@ -79,9 +79,42 @@ def classify(path: str) -> str:
 
 _flow: ContextVar[str | None] = ContextVar("weedtpu_netflow", default=None)
 
+# second ambient dimension: the REMOTE region a call is about to cross a
+# WAN boundary toward.  The sync pump (the only cross-region caller
+# today) enters ``wan("b")`` around its reads and sink writes; while it
+# is set, ``account()`` books the same body bytes a second time into
+# ``weedtpu_wan_bytes_total{direction,class,region}`` — the geo ledger
+# rides the existing one instead of duplicating call sites.
+_wan_region: ContextVar[str | None] = ContextVar(
+    "weedtpu_wan_region", default=None)
+
 
 def current_class() -> str | None:
     return _flow.get()
+
+
+def current_wan_region() -> str | None:
+    return _wan_region.get()
+
+
+class wan:
+    """``with wan("region-b"):`` — every request made inside is booked
+    as WAN traffic toward that remote region, on top of the normal
+    per-class ledger.  Same plain-class shape as ``flow`` (pump threads
+    enter/exit per event)."""
+
+    __slots__ = ("region", "_token")
+
+    def __init__(self, region: str):
+        self.region = region
+
+    def __enter__(self):
+        self._token = _wan_region.set(self.region)
+        return self
+
+    def __exit__(self, *exc):
+        _wan_region.reset(self._token)
+        return False
 
 
 def set_class(cls: str | None):
@@ -121,6 +154,7 @@ def enabled() -> bool:
 
 
 _NET_BYTES = None
+_WAN_BYTES = None
 
 
 def _counter():
@@ -133,16 +167,29 @@ def _counter():
     return _NET_BYTES
 
 
+def _wan_counter():
+    global _WAN_BYTES
+    if _WAN_BYTES is None:
+        from seaweedfs_tpu.stats import metrics as _metrics
+        _WAN_BYTES = _metrics.WAN_BYTES
+    return _WAN_BYTES
+
+
 def account(direction: str, cls: str | None, peer_role: str,
             nbytes: int) -> None:
     """Book `nbytes` body bytes moving `direction` for traffic class
     `cls` against `peer_role`.  Zero-byte moves are not booked — a GET's
-    empty request body must not fabricate series."""
+    empty request body must not fabricate series.  While an ambient
+    ``wan(region)`` is entered the same bytes are additionally booked
+    into the WAN ledger against that remote region."""
     if nbytes <= 0 or not enabled():
         return
     if cls not in CLASSES:
         cls = "data"
     _counter().labels(direction, cls, peer_role or "client").inc(nbytes)
+    region = _wan_region.get()
+    if region:
+        _wan_counter().labels(direction, cls, region).inc(nbytes)
 
 
 def class_total(direction: str, cls: str) -> float:
@@ -155,6 +202,22 @@ def class_total(direction: str, cls: str) -> float:
         ld = dict(labels)
         if ld.get("direction") == direction and ld.get("class") == cls:
             total += child.value
+    return total
+
+
+def wan_total(direction: str, region: str | None = None) -> float:
+    """Sum of the WAN ledger for one direction (optionally one remote
+    region) over all classes — /cluster/geo and the conservation tests
+    read deltas of this."""
+    total = 0.0
+    c = _wan_counter()
+    for labels, child in c._pairs():
+        ld = dict(labels)
+        if ld.get("direction") != direction:
+            continue
+        if region is not None and ld.get("region") != region:
+            continue
+        total += child.value
     return total
 
 

@@ -371,17 +371,20 @@ def assemble(spans: list[dict]) -> dict:
     for r in roots:
         emit(r, 0)
     if not out:
-        return {"spans": [], "span_count": 0, "servers": [], "nodes": []}
+        return {"spans": [], "span_count": 0, "servers": [], "nodes": [],
+                "regions": []}
     t0 = min(s["start"] for s in out)
     t1 = max(s["start"] + s["ms"] / 1000.0 for s in out)
     servers = sorted({s.get("attrs", {}).get("server") for s in out
                       if s.get("attrs", {}).get("server")})
     nodes = sorted({s["node"] for s in out if s.get("node")})
+    regions = sorted({s.get("attrs", {}).get("region") for s in out
+                      if s.get("attrs", {}).get("region")})
     return {"trace_id": out[0]["trace"], "start": t0,
             "ms": round((t1 - t0) * 1000.0, 3),
             "error": any(s.get("error") for s in out),
             "span_count": len(out), "servers": servers, "nodes": nodes,
-            "orphans": orphans, "spans": out}
+            "regions": regions, "orphans": orphans, "spans": out}
 
 
 # -- in-flight request registry -----------------------------------------
@@ -430,7 +433,8 @@ def _request_op(method: str, path: str) -> str:
 
 
 def aiohttp_middleware(role: str, slow_exempt: tuple = (),
-                       trust_flow: bool = True, tenant_resolver=None):
+                       trust_flow: bool = True, tenant_resolver=None,
+                       region: str = ""):
     """Server-side half of the propagation: extract X-Weedtpu-Trace (or
     make a root sampling decision), register the request in the in-flight
     table, and on completion record the root span — always for sampled
@@ -655,6 +659,10 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
             if t is not None and t.sampled:
                 attrs = {"method": req.method, "path": req.path,
                          "status": status, "server": role}
+                if region:
+                    # geo federation: the waterfall shows which side of
+                    # the WAN each hop ran on
+                    attrs["region"] = region
                 if cancelled:
                     attrs["cancelled"] = True
                 if timed_out:
@@ -671,6 +679,8 @@ def aiohttp_middleware(role: str, slow_exempt: tuple = (),
                 retro_attrs = {"method": req.method, "path": req.path,
                                "status": status, "server": role,
                                "retro": True}
+                if region:
+                    retro_attrs["region"] = region
                 if timed_out:
                     retro_attrs["op"] = "timeout"
                 record_span(f"{role}.request", retro.trace_id,

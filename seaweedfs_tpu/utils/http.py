@@ -304,7 +304,8 @@ class PooledHTTP:
     does not hold fds to every peer it ever contacted."""
 
     def __init__(self, timeout: float = 30.0, max_idle_per_host: int = 16,
-                 idle_timeout: float = 60.0, role: str = "client"):
+                 idle_timeout: float = 60.0, role: str = "client",
+                 region: str = ""):
         self.timeout = timeout
         self.max_idle_per_host = max_idle_per_host
         self.idle_timeout = idle_timeout
@@ -312,6 +313,12 @@ class PooledHTTP:
         # label who it was talking to (the master's aggregator and the
         # shell pass their own roles; plain clients stay "client")
         self.role = role
+        # fault-plane identities: a region-aware client (the sync pump)
+        # declares its home region so region_partition / wan_latency
+        # faults can tell its cross-region dials from local ones —
+        # clients have no netloc for register_region to map
+        self._fault_ids = (role, "region:" + region) if region \
+            else role
         # key -> [(conn, time.monotonic() when parked), ...]
         self._idle: dict[tuple[str, str],
                          list[tuple[_RawConn, float]]] = {}
@@ -445,7 +452,7 @@ class PooledHTTP:
         # full connect timeout
         from seaweedfs_tpu.maintenance import faults as _faults
         if _faults.NET_ACTIVE:
-            lat = _faults.check_net(self.role, u.netloc)
+            lat = _faults.check_net(self._fault_ids, u.netloc)
             if lat > 0:
                 time.sleep(lat)
         breaker = _res.breaker_for(u.netloc) if _res.breaker_enabled() \
